@@ -1,0 +1,31 @@
+"""quorum_tpu — a TPU-native LLM ensemble serving framework.
+
+An OpenAI-compatible ``/chat/completions`` service that fans each request out to
+N model backends in parallel, incrementally filters "thinking" tags out of token
+streams, and combines the N answers by concatenation or by an LLM-aggregation
+hop — in both SSE-streaming and non-streaming modes.
+
+Unlike the reference design it re-imagines (andrewginns/quorum, an HTTP-only
+proxy — see /root/reference/src/quorum/oai_proxy.py), quorum_tpu's backends can
+be **in-process JAX models on TPU** (``tpu://`` URLs): Hugging Face-style
+checkpoints loaded into sharded JAX/XLA models on a device mesh, with the decode
+loop emitting tokens directly into the SSE path. HTTP backends remain supported
+(with true incremental streaming, fixing the reference's buffer-then-replay
+behavior at oai_proxy.py:187-203).
+
+Package layout:
+  config        typed configuration (superset of the reference config.yaml)
+  filtering     incremental thinking-tag filter (oai_proxy.py:262-371 parity)
+  sse           SSE wire-format encode/parse
+  oai           OpenAI chat-completion object builders
+  backends/     Backend protocol: http://, tpu://, fakes for tests
+  strategies/   concatenate & aggregate response combination
+  server/       ASGI app + h11 production server
+  models/       pure-JAX model zoo (gpt2, llama family, mixtral MoE)
+  ops/          attention (pallas flash), ring attention, sampling, MoE routing
+  parallel/     mesh construction + logical-axis sharding rules
+  runtime/      prefill/decode engine, KV cache, request scheduling
+  train/        loss/train-step (used for multi-chip sharding validation)
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,151 @@
+"""Thinking-tag filtering.
+
+Behavioral parity with the reference's content-transform layer
+(/root/reference/src/quorum/oai_proxy.py:120-139 ``strip_thinking_tags`` and
+:262-371 ``ThinkingTagFilter``), re-implemented as a single-pass scanner rather
+than repeated regex searches over a growing buffer:
+
+* ``strip_thinking_tags``     — batch removal of ``<tag>…</tag>`` blocks.
+* ``ThinkingTagFilter``       — incremental, streaming-safe removal: partial
+  tags are buffered across ``feed()`` boundaries, nesting is tracked, text
+  inside tags is withheld, and unterminated thinking content is discarded at
+  ``flush()``.
+
+Semantics preserved (encoded by the reference unit tests,
+/root/reference/tests/test_thinking_tag_filter.py):
+  - tags match exactly ``<name>`` / ``</name>`` (no attributes), case-insensitive;
+  - nested allowed tags inside a thinking block only adjust depth;
+  - a close tag with no open block is passed through as plain text;
+  - ``flush()`` while inside an unclosed block discards the buffered content;
+  - a trailing partial *open* tag candidate is discarded at ``flush()``.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Iterable, Sequence
+
+DEFAULT_THINKING_TAGS = ("think", "reason", "reasoning", "thought")
+
+
+def strip_thinking_tags(
+    content: str,
+    tags: Sequence[str] = DEFAULT_THINKING_TAGS,
+    hide: bool = True,
+) -> str:
+    """Remove ``<tag>…</tag>`` blocks (case-insensitive, spanning newlines).
+
+    ``hide=False`` returns ``content`` unchanged — mirrors the reference's
+    ``hide_intermediate`` flag gate (oai_proxy.py:133-134). The result is
+    whitespace-stripped when filtering is applied, like the reference's
+    ``re.sub(...).strip()`` (oai_proxy.py:136-139).
+    """
+    if not hide or not tags:
+        return content
+    pattern = "|".join(re.escape(t) for t in tags)
+    return re.sub(
+        rf"<({pattern})>.*?</\1>",
+        "",
+        content,
+        flags=re.IGNORECASE | re.DOTALL,
+    ).strip()
+
+
+class ThinkingTagFilter:
+    """Incremental thinking-tag remover for token streams.
+
+    Feed arbitrarily-chunked text (token deltas); get back the text that is
+    provably outside every thinking block. Text that *might* be the start of a
+    tag (e.g. a chunk ending in ``"<thi"``) is withheld until disambiguated.
+    """
+
+    def __init__(self, tags: Iterable[str] = DEFAULT_THINKING_TAGS):
+        self.tags = [t.lower() for t in tags if t]
+        # With no tags the filter is a passthrough; "(?!x)x" never matches.
+        pattern = "|".join(re.escape(t) for t in self.tags) or "(?!x)x"
+        self._open_re = re.compile(rf"<({pattern})>", re.IGNORECASE)
+        self._close_re = re.compile(rf"</({pattern})>", re.IGNORECASE)
+        # Every literal form a tag can take, for partial-prefix detection.
+        self._open_forms = [f"<{t}>" for t in self.tags]
+        self._close_forms = [f"</{t}>" for t in self.tags]
+        self._buf = ""
+        self._depth = 0
+
+    # -- internal helpers ---------------------------------------------------
+
+    def _partial_open_at_end(self, text: str) -> int:
+        """Index of a trailing substring that is a proper prefix of an open
+        tag, or -1. E.g. for ``"abc<thi"`` returns 3."""
+        pos = text.rfind("<")
+        if pos == -1:
+            return -1
+        candidate = text[pos:].lower()
+        for form in self._open_forms:
+            if form != candidate and form.startswith(candidate):
+                return pos
+        return -1
+
+    def _partial_any_at_end(self, text: str) -> int:
+        """Like :meth:`_partial_open_at_end` but also matches close-tag
+        prefixes — used while inside a block, where a close tag matters."""
+        pos = text.rfind("<")
+        if pos == -1:
+            return -1
+        candidate = text[pos:].lower()
+        for form in self._open_forms + self._close_forms:
+            if form != candidate and form.startswith(candidate):
+                return pos
+        return -1
+
+    # -- public API ---------------------------------------------------------
+
+    def feed(self, text: str) -> str:
+        """Add ``text``; return the newly-safe text outside thinking blocks."""
+        self._buf += text
+        out: list[str] = []
+        while True:
+            if self._depth == 0:
+                m = self._open_re.search(self._buf)
+                if m:
+                    out.append(self._buf[: m.start()])
+                    self._buf = self._buf[m.end() :]
+                    self._depth = 1
+                    continue
+                # No complete open tag. Hold back a possible partial one.
+                cut = self._partial_open_at_end(self._buf)
+                if cut != -1:
+                    out.append(self._buf[:cut])
+                    self._buf = self._buf[cut:]
+                else:
+                    out.append(self._buf)
+                    self._buf = ""
+                break
+            else:
+                mo = self._open_re.search(self._buf)
+                mc = self._close_re.search(self._buf)
+                if mc and (not mo or mc.start() < mo.start()):
+                    self._buf = self._buf[mc.end() :]
+                    self._depth = max(0, self._depth - 1)
+                    continue
+                if mo:
+                    self._buf = self._buf[mo.end() :]
+                    self._depth += 1
+                    continue
+                # Inside a block with no complete tag yet: everything so far
+                # is thinking content — drop it, but keep a possible partial
+                # tag so a close tag split across chunks is still recognized.
+                cut = self._partial_any_at_end(self._buf)
+                self._buf = self._buf[cut:] if cut != -1 else ""
+                break
+        return "".join(out)
+
+    def flush(self) -> str:
+        """Emit remaining safe text; discard unterminated thinking content."""
+        if self._depth > 0:
+            self._buf = ""
+            self._depth = 0
+            return ""
+        cut = self._partial_open_at_end(self._buf)
+        out = self._buf[:cut] if cut != -1 else self._buf
+        self._buf = ""
+        return out

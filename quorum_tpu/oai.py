@@ -1,0 +1,169 @@
+"""OpenAI chat-completion object builders.
+
+The wire format is the OpenAI Chat Completions schema the reference vendors in
+/root/reference/api_reference/chat_completions.yaml (request at :1437, response
+at :1049, stream chunk at :398). The reference hand-builds these dicts inline
+(e.g. oai_proxy.py:530-541, 629-652, 847-862, 1315-1335); quorum_tpu centralizes
+them here.
+
+Conventions preserved for parity (tests in the reference suite assert on them):
+  - parallel-mode chunk ids:  ``chatcmpl-parallel`` (role),
+    ``chatcmpl-parallel-{i}`` (per-backend deltas, i = backend index),
+    ``chatcmpl-parallel-final`` (combined final chunk, finish_reason "stop");
+  - error chunk finish_reason ``"error"`` when every backend failed;
+  - usage summed across backends in combined non-streaming responses.
+
+Fixed vs the reference: ``created`` is real epoch seconds (the reference used
+the asyncio monotonic clock — quirk 8, oai_proxy.py:533, 632-634, 850).
+"""
+
+from __future__ import annotations
+
+import time
+import uuid
+from typing import Any
+
+OBJECT_CHUNK = "chat.completion.chunk"
+OBJECT_COMPLETION = "chat.completion"
+
+PARALLEL_ID = "chatcmpl-parallel"
+PARALLEL_FINAL_ID = "chatcmpl-parallel-final"
+
+
+def now() -> int:
+    return int(time.time())
+
+
+def new_request_id() -> str:
+    return f"chatcmpl-{uuid.uuid4().hex[:24]}"
+
+
+def chunk(
+    *,
+    id: str,
+    model: str,
+    delta: dict[str, Any],
+    finish_reason: str | None = None,
+    created: int | None = None,
+    index: int = 0,
+) -> dict[str, Any]:
+    return {
+        "id": id,
+        "object": OBJECT_CHUNK,
+        "created": created if created is not None else now(),
+        "model": model,
+        "choices": [
+            {"index": index, "delta": delta, "finish_reason": finish_reason}
+        ],
+    }
+
+
+def role_chunk(model: str, id: str = PARALLEL_ID) -> dict[str, Any]:
+    return chunk(id=id, model=model, delta={"role": "assistant"})
+
+
+def content_chunk(
+    content: str, *, model: str, backend_index: int | None = None, id: str | None = None
+) -> dict[str, Any]:
+    if id is None:
+        id = PARALLEL_ID if backend_index is None else f"{PARALLEL_ID}-{backend_index}"
+    return chunk(id=id, model=model, delta={"content": content})
+
+
+def final_chunk(content: str, *, model: str) -> dict[str, Any]:
+    return chunk(
+        id=PARALLEL_FINAL_ID,
+        model=model,
+        delta={"content": content},
+        finish_reason="stop",
+    )
+
+
+def stop_chunk(model: str, id: str) -> dict[str, Any]:
+    return chunk(id=id, model=model, delta={}, finish_reason="stop")
+
+
+def error_chunk(message: str, *, model: str) -> dict[str, Any]:
+    # Parity with the all-backends-failed SSE error chunk (oai_proxy.py:864-881).
+    return chunk(
+        id=PARALLEL_FINAL_ID,
+        model=model,
+        delta={"content": message},
+        finish_reason="error",
+    )
+
+
+def empty_usage() -> dict[str, int]:
+    return {"prompt_tokens": 0, "completion_tokens": 0, "total_tokens": 0}
+
+
+def sum_usage(usages: list[dict[str, Any] | None]) -> dict[str, int]:
+    """Sum token usage across backends (oai_proxy.py:1300-1313)."""
+    total = empty_usage()
+    for u in usages:
+        if not u:
+            continue
+        for k in total:
+            total[k] += int(u.get(k, 0) or 0)
+    return total
+
+
+def completion(
+    *,
+    content: str,
+    model: str,
+    id: str | None = None,
+    created: int | None = None,
+    usage: dict[str, Any] | None = None,
+    finish_reason: str = "stop",
+) -> dict[str, Any]:
+    return {
+        "id": id or new_request_id(),
+        "object": OBJECT_COMPLETION,
+        "created": created if created is not None else now(),
+        "model": model,
+        "choices": [
+            {
+                "index": 0,
+                "message": {"role": "assistant", "content": content},
+                "finish_reason": finish_reason,
+            }
+        ],
+        "usage": usage or empty_usage(),
+    }
+
+
+def error_body(message: str, type_: str = "proxy_error", code: int = 500) -> dict[str, Any]:
+    """Error JSON shape used by the reference (oai_proxy.py:252-259)."""
+    return {"error": {"message": message, "type": type_, "code": code}}
+
+
+def extract_content(response: dict[str, Any]) -> str:
+    """``choices[0].message.content`` with graceful fallback."""
+    try:
+        return response["choices"][0]["message"]["content"] or ""
+    except (KeyError, IndexError, TypeError, AttributeError):
+        return ""
+
+
+def extract_delta_content(chunk_: dict[str, Any]) -> str:
+    try:
+        return chunk_["choices"][0]["delta"].get("content") or ""
+    except (KeyError, IndexError, TypeError, AttributeError):
+        return ""
+
+
+def last_user_message(body: dict[str, Any]) -> str:
+    """The user query used for the aggregation prompt (oai_proxy.py:1178-1181)."""
+    messages = body.get("messages") or []
+    for m in reversed(messages):
+        if isinstance(m, dict) and m.get("role") == "user":
+            c = m.get("content")
+            if isinstance(c, str):
+                return c
+            # OpenAI content-part arrays: concatenate text parts.
+            if isinstance(c, list):
+                return "".join(
+                    p.get("text", "") for p in c if isinstance(p, dict) and p.get("type") == "text"
+                )
+    return ""

@@ -1,0 +1,92 @@
+"""Server-Sent Events wire format: encoding and incremental parsing.
+
+The reference splits SSE frames ad hoc inside its streaming aggregator
+(/root/reference/src/quorum/oai_proxy.py:595-615) and its tests build frames by
+hand (tests/conftest.py:213-249). Here the wire format is one shared module used
+by the server (emit), the HTTP backend (consume upstream streams), and the test
+suite (golden transcripts).
+
+Frames follow the OpenAI streaming contract: each event is a single
+``data: <json>`` line terminated by a blank line; the stream ends with
+``data: [DONE]``.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Iterator
+
+DONE = "[DONE]"
+
+
+def encode_event(payload: dict[str, Any] | str) -> bytes:
+    """Encode one SSE ``data:`` event (JSON dict or raw sentinel string)."""
+    if isinstance(payload, str):
+        return f"data: {payload}\n\n".encode()
+    return f"data: {json.dumps(payload, separators=(',', ':'))}\n\n".encode()
+
+
+def encode_done() -> bytes:
+    return encode_event(DONE)
+
+
+class SSEParser:
+    """Incremental parser: feed raw bytes, yield decoded ``data:`` payloads.
+
+    Handles events split across arbitrary chunk boundaries and both ``\\n\\n``
+    and ``\\r\\n\\r\\n`` separators. Yields parsed JSON dicts; the ``[DONE]``
+    sentinel is yielded as the string ``"[DONE]"``. Non-JSON data lines are
+    yielded as raw strings (the reference logs-and-skips these,
+    oai_proxy.py:612-615 — callers decide).
+    """
+
+    def __init__(self) -> None:
+        self._buf = b""
+
+    def feed(self, chunk: bytes) -> Iterator[dict[str, Any] | str]:
+        self._buf += chunk
+        while True:
+            # Find the earliest event terminator of either flavor.
+            idx_n = self._buf.find(b"\n\n")
+            idx_r = self._buf.find(b"\r\n\r\n")
+            if idx_n == -1 and idx_r == -1:
+                return
+            if idx_r != -1 and (idx_n == -1 or idx_r < idx_n):
+                raw, self._buf = self._buf[:idx_r], self._buf[idx_r + 4 :]
+            else:
+                raw, self._buf = self._buf[:idx_n], self._buf[idx_n + 2 :]
+            payload = self._parse_event(raw)
+            if payload is not None:
+                yield payload
+
+    def flush(self) -> Iterator[dict[str, Any] | str]:
+        """Parse any trailing event not followed by a blank line."""
+        if self._buf.strip():
+            payload = self._parse_event(self._buf)
+            if payload is not None:
+                yield payload
+        self._buf = b""
+
+    @staticmethod
+    def _parse_event(raw: bytes) -> dict[str, Any] | str | None:
+        data_lines = []
+        for line in raw.splitlines():
+            line = line.strip()
+            if line.startswith(b"data:"):
+                data_lines.append(line[5:].strip())
+        if not data_lines:
+            return None
+        data = b"\n".join(data_lines).decode("utf-8", errors="replace")
+        if data == DONE:
+            return DONE
+        try:
+            return json.loads(data)
+        except json.JSONDecodeError:
+            return data
+
+
+def iter_data_events(body: bytes) -> Iterator[dict[str, Any] | str]:
+    """Parse a complete SSE body at once (testing convenience)."""
+    p = SSEParser()
+    yield from p.feed(body)
+    yield from p.flush()

@@ -1,0 +1,35 @@
+"""Shared test configuration.
+
+JAX-dependent tests run on CPU with a virtual 8-device mesh — the standard way
+to exercise sharding logic without TPU hardware (see SURVEY.md §4). The env vars
+must be set before the first ``import jax`` anywhere in the test process, hence
+this conftest sets them at import time.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import asyncio  # noqa: E402
+import inspect  # noqa: E402
+
+import pytest  # noqa: E402
+
+
+# Minimal built-in async-test support (pytest-asyncio is not in this image):
+# run ``async def`` tests via asyncio.run.
+@pytest.hookimpl(tryfirst=True)
+def pytest_pyfunc_call(pyfuncitem):
+    if inspect.iscoroutinefunction(pyfuncitem.obj):
+        kwargs = {
+            name: pyfuncitem.funcargs[name]
+            for name in pyfuncitem._fixtureinfo.argnames
+        }
+        asyncio.run(pyfuncitem.obj(**kwargs))
+        return True
+    return None

@@ -1,0 +1,112 @@
+"""Config loading, schema superset, and tpu:// URL parsing."""
+
+import pytest
+
+from quorum_tpu.config import (
+    BackendSpec,
+    Config,
+    DEFAULT_CONFIG,
+    load_config,
+)
+
+
+THREE_BACKENDS_YAML = """
+settings:
+  timeout: 30
+primary_backends:
+  - name: LLM1
+    url: http://test1.example.com/v1
+    model: model-a
+  - name: LLM2
+    url: http://test2.example.com/v1
+    model: ""
+  - name: LLM3
+    url: ""
+    model: model-c
+iterations:
+  aggregation:
+    strategy: concatenate
+strategy:
+  concatenate:
+    separator: "\\n---\\n"
+    hide_intermediate_think: true
+    hide_final_think: false
+    thinking_tags: ["think"]
+  aggregate:
+    aggregator_backend: LLM1
+    source_backends: ["LLM1", "LLM2"]
+    suppress_individual_responses: true
+"""
+
+
+def test_load_from_path(tmp_path):
+    p = tmp_path / "config.yaml"
+    p.write_text(THREE_BACKENDS_YAML)
+    cfg = load_config(p)
+    assert cfg.timeout == 30
+    assert [b.name for b in cfg.backends] == ["LLM1", "LLM2", "LLM3"]
+    # Invalid (empty-url) backend filtered, parity with oai_proxy.py:1010.
+    assert [b.name for b in cfg.valid_backends] == ["LLM1", "LLM2"]
+    assert cfg.strategy_name == "concatenate"
+    assert cfg.parallel_enabled() is True
+
+
+def test_fallback_to_default_on_missing_file(tmp_path):
+    cfg = load_config(tmp_path / "nope.yaml")
+    assert cfg.raw == DEFAULT_CONFIG
+    assert cfg.timeout == 60
+    assert cfg.backends[0].url == "https://api.openai.com/v1"
+    assert cfg.parallel_enabled() is False
+
+
+def test_fallback_on_invalid_yaml(tmp_path):
+    p = tmp_path / "config.yaml"
+    p.write_text("just a scalar")
+    cfg = load_config(p)
+    assert cfg.raw == DEFAULT_CONFIG
+
+
+def test_env_var_path(tmp_path, monkeypatch):
+    p = tmp_path / "custom.yaml"
+    p.write_text(THREE_BACKENDS_YAML)
+    monkeypatch.setenv("QUORUM_TPU_CONFIG", str(p))
+    cfg = load_config()
+    assert cfg.timeout == 30
+
+
+def test_strategy_params(tmp_path):
+    p = tmp_path / "config.yaml"
+    p.write_text(THREE_BACKENDS_YAML)
+    cfg = load_config(p)
+    c = cfg.concatenate
+    assert c.separator == "\n---\n"
+    assert c.hide_intermediate_think is True
+    assert c.thinking_tags == ["think"]
+    a = cfg.aggregate
+    assert a.aggregator_backend == "LLM1"
+    assert a.source_backends == ["LLM1", "LLM2"]
+    assert a.suppress_individual_responses is True
+    assert "{intermediate_results}" in a.prompt_template
+
+
+def test_parallel_requires_strategy_keys():
+    cfg = Config(raw={
+        "primary_backends": [
+            {"name": "a", "url": "http://a/v1"},
+            {"name": "b", "url": "http://b/v1"},
+        ],
+        "settings": {"timeout": 5},
+    })
+    # >1 backend but no iterations/strategy keys → not parallel
+    # (oai_proxy.py:1043-1044 parity).
+    assert cfg.parallel_enabled() is False
+
+
+def test_tpu_url_parsing():
+    b = BackendSpec(name="local", url="tpu://gpt2?family=gpt2&d_model=256&n_layers=2")
+    assert b.is_tpu
+    assert b.tpu_model_id == "gpt2"
+    assert b.tpu_options == {"family": "gpt2", "d_model": "256", "n_layers": "2"}
+    h = BackendSpec(name="remote", url="https://api.openai.com/v1")
+    assert not h.is_tpu
+    assert h.scheme == "https"

@@ -1,0 +1,125 @@
+"""Unit tests for the incremental thinking-tag filter.
+
+Mirrors the reference's coverage (/root/reference/tests/test_thinking_tag_filter.py):
+basic/multiple blocks, tags split across feeds, nesting, unclosed/mismatched
+tags, case-insensitivity, flush semantics, streaming simulation, multi-tag,
+newlines — plus batch strip_thinking_tags behavior.
+"""
+
+import pytest
+
+from quorum_tpu.filtering import ThinkingTagFilter, strip_thinking_tags
+
+
+TAGS = ["think", "reason"]
+
+
+def run_feed(chunks, tags=TAGS):
+    f = ThinkingTagFilter(tags)
+    out = "".join(f.feed(c) for c in chunks)
+    return out + f.flush()
+
+
+class TestThinkingTagFilter:
+    def test_basic_block_removed(self):
+        assert run_feed(["Hello <think>secret</think> world"]) == "Hello  world"
+
+    def test_multiple_blocks(self):
+        assert (
+            run_feed(["a<think>x</think>b<think>y</think>c"]) == "abc"
+        )
+
+    def test_tag_split_across_feeds(self):
+        assert run_feed(["Hello <thi", "nk>hidden</th", "ink> world"]) == "Hello  world"
+
+    def test_close_tag_split_across_feeds(self):
+        assert run_feed(["<think>hidden</", "think>visible"]) == "visible"
+
+    def test_nested_tags(self):
+        assert (
+            run_feed(["out<think>a<think>b</think>c</think>side"]) == "outside"
+        )
+
+    def test_nested_different_tags(self):
+        assert run_feed(["x<think>a<reason>b</reason>c</think>y"]) == "xy"
+
+    def test_unclosed_tag_discarded_at_flush(self):
+        assert run_feed(["visible<think>never closed"]) == "visible"
+
+    def test_close_without_open_passes_through(self):
+        assert run_feed(["no block</think>here"]) == "no block</think>here"
+
+    def test_case_insensitive(self):
+        assert run_feed(["a<THINK>hidden</ThInK>b"]) == "ab"
+
+    def test_unknown_tag_untouched(self):
+        assert run_feed(["a<other>keep</other>b"]) == "a<other>keep</other>b"
+
+    def test_partial_tag_that_is_not_a_tag_emitted(self):
+        # "<thx" can never become "<think>" — must be emitted, not held.
+        assert run_feed(["a<thx", "yz"]) == "a<thxyz"
+
+    def test_lone_angle_bracket(self):
+        assert run_feed(["1 < 2 and 3 > 2"]) == "1 < 2 and 3 > 2"
+
+    def test_flush_discards_partial_open_tag(self):
+        f = ThinkingTagFilter(TAGS)
+        assert f.feed("abc<thi") == "abc"
+        assert f.flush() == ""
+
+    def test_flush_emits_plain_buffer(self):
+        f = ThinkingTagFilter(TAGS)
+        f.feed("hello")
+        assert f.flush() == ""  # "hello" already emitted by feed
+
+    def test_streaming_token_by_token(self):
+        text = "Start <think>internal reasoning here</think>End"
+        chunks = [text[i : i + 3] for i in range(0, len(text), 3)]
+        assert run_feed(chunks) == "Start End"
+
+    def test_content_with_newlines(self):
+        assert (
+            run_feed(["line1\n<think>\nhidden\nlines\n</think>\nline2"])
+            == "line1\n\nline2"
+        )
+
+    def test_reuse_after_flush(self):
+        f = ThinkingTagFilter(TAGS)
+        f.feed("<think>a")
+        f.flush()
+        assert f.feed("clean") == "clean"
+        assert f.flush() == ""
+
+    def test_empty_feed(self):
+        f = ThinkingTagFilter(TAGS)
+        assert f.feed("") == ""
+        assert f.flush() == ""
+
+
+class TestStripThinkingTags:
+    def test_basic(self):
+        assert strip_thinking_tags("a <think>x</think> b", ["think"]) == "a  b".strip()
+
+    def test_multiline(self):
+        assert (
+            strip_thinking_tags("keep\n<think>\nmulti\nline\n</think>\nend", ["think"])
+            == "keep\n\nend"
+        )
+
+    def test_hide_false_noop(self):
+        s = "a <think>x</think> b"
+        assert strip_thinking_tags(s, ["think"], hide=False) == s
+
+    def test_case_insensitive(self):
+        assert strip_thinking_tags("a<THINK>x</think>b", ["think"]) == "ab"
+
+    def test_multiple_tags(self):
+        assert (
+            strip_thinking_tags("a<think>x</think>b<reason>y</reason>c", ["think", "reason"])
+            == "abc"
+        )
+
+    def test_unclosed_left_alone(self):
+        # Batch strip only removes complete blocks (regex parity).
+        s = "a<think>unclosed"
+        assert strip_thinking_tags(s, ["think"]) == s

@@ -1,0 +1,59 @@
+"""SSE encode/parse round-trips, including events split across chunk boundaries."""
+
+from quorum_tpu import sse
+
+
+def test_encode_event_json():
+    b = sse.encode_event({"a": 1})
+    assert b == b'data: {"a":1}\n\n'
+
+
+def test_encode_done():
+    assert sse.encode_done() == b"data: [DONE]\n\n"
+
+
+def test_parse_single_event():
+    p = sse.SSEParser()
+    events = list(p.feed(b'data: {"x": 1}\n\n'))
+    assert events == [{"x": 1}]
+
+
+def test_parse_split_across_chunks():
+    p = sse.SSEParser()
+    out = []
+    for chunk in [b"da", b'ta: {"x"', b": 1}\n", b"\ndata: [D", b"ONE]\n\n"]:
+        out.extend(p.feed(chunk))
+    assert out == [{"x": 1}, sse.DONE]
+
+
+def test_parse_crlf_frames():
+    p = sse.SSEParser()
+    events = list(p.feed(b'data: {"y":2}\r\n\r\ndata: [DONE]\r\n\r\n'))
+    assert events == [{"y": 2}, sse.DONE]
+
+
+def test_parse_multiple_events_one_chunk():
+    body = sse.encode_event({"i": 0}) + sse.encode_event({"i": 1}) + sse.encode_done()
+    assert list(sse.iter_data_events(body)) == [{"i": 0}, {"i": 1}, sse.DONE]
+
+
+def test_non_json_data_yielded_raw():
+    p = sse.SSEParser()
+    assert list(p.feed(b"data: not json\n\n")) == ["not json"]
+
+
+def test_flush_trailing_event():
+    p = sse.SSEParser()
+    assert list(p.feed(b'data: {"z":3}')) == []
+    assert list(p.flush()) == [{"z": 3}]
+
+
+def test_ignores_non_data_lines():
+    p = sse.SSEParser()
+    assert list(p.feed(b"event: ping\nid: 7\n\n")) == []
+
+
+def test_roundtrip():
+    payloads = [{"id": "chatcmpl-parallel-0", "choices": [{"delta": {"content": "hi"}}]}]
+    body = b"".join(sse.encode_event(e) for e in payloads) + sse.encode_done()
+    assert list(sse.iter_data_events(body)) == payloads + [sse.DONE]
