@@ -1,0 +1,35 @@
+"""Backend layer: the seam between the API/strategy layers and model execution.
+
+The reference funnels every backend call through one HTTP function
+(``call_backend``, /root/reference/src/quorum/oai_proxy.py:142-259) and its
+tests monkeypatch the transport. quorum_tpu instead defines a ``Backend``
+protocol with three implementations:
+
+  HttpBackend   OpenAI-compatible upstream over HTTP, with *true* incremental
+                streaming (the reference buffered the whole upstream response
+                before re-chunking it — quirk 1).
+  TpuBackend    an in-process JAX model on the local TPU mesh (``tpu://`` URLs).
+  FakeBackend   deterministic in-process test double (the idiomatic replacement
+                for monkeypatching httpx).
+"""
+
+from quorum_tpu.backends.base import (
+    Backend,
+    BackendError,
+    CompletionResult,
+    prepare_body,
+)
+from quorum_tpu.backends.fake import FakeBackend
+from quorum_tpu.backends.http_backend import HttpBackend
+from quorum_tpu.backends.registry import BackendRegistry, build_registry
+
+__all__ = [
+    "Backend",
+    "BackendError",
+    "BackendRegistry",
+    "CompletionResult",
+    "FakeBackend",
+    "HttpBackend",
+    "build_registry",
+    "prepare_body",
+]

@@ -1,0 +1,109 @@
+"""Backend protocol and shared request/response types.
+
+Contract parity with the reference dispatcher ``call_backend``
+(/root/reference/src/quorum/oai_proxy.py:142-259):
+
+  - the configured backend model *overrides* the request model; if neither
+    exists the call fails 400 (:161-176);
+  - non-streaming JSON responses are tagged with the backend name (:212);
+  - every failure is normalized into an error payload rather than propagating
+    (:231-259) — here, a :class:`BackendError` carrying the same error body.
+"""
+
+from __future__ import annotations
+
+import copy
+from dataclasses import dataclass, field
+from typing import Any, AsyncIterator, Protocol, runtime_checkable
+
+from quorum_tpu import oai
+
+
+class BackendError(Exception):
+    """A backend call failed. Carries the normalized OpenAI-style error body."""
+
+    def __init__(self, message: str, *, status_code: int = 500, body: dict | None = None):
+        super().__init__(message)
+        self.status_code = status_code
+        self.body = body or oai.error_body(message, code=status_code)
+
+
+@dataclass
+class CompletionResult:
+    """Result of a non-streaming backend call."""
+
+    backend_name: str
+    status_code: int
+    body: dict[str, Any]
+    headers: dict[str, str] = field(default_factory=dict)
+
+    @property
+    def ok(self) -> bool:
+        return 200 <= self.status_code < 300 and "error" not in self.body
+
+    @property
+    def content(self) -> str:
+        return oai.extract_content(self.body)
+
+    @property
+    def usage(self) -> dict[str, Any] | None:
+        u = self.body.get("usage")
+        return u if isinstance(u, dict) else None
+
+
+def prepare_body(
+    body: dict[str, Any], backend_model: str
+) -> dict[str, Any]:
+    """Apply the model-override precedence (oai_proxy.py:161-176).
+
+    Returns a deep-copied body with the effective model set. Raises
+    :class:`BackendError` (400) when neither the backend config nor the request
+    specifies a model.
+    """
+    out = copy.deepcopy(body)
+    if backend_model:
+        out["model"] = backend_model
+    elif not out.get("model"):
+        raise BackendError(
+            "No model specified in config.yaml or request",
+            status_code=400,
+            body=oai.error_body(
+                "No model specified in config.yaml or request",
+                type_="invalid_request_error",
+                code=400,
+            ),
+        )
+    return out
+
+
+@runtime_checkable
+class Backend(Protocol):
+    """One upstream model: remote HTTP service or local JAX program."""
+
+    name: str
+    model: str  # configured override ("" = honor the request's model)
+
+    async def complete(
+        self,
+        body: dict[str, Any],
+        headers: dict[str, str],
+        timeout: float,
+    ) -> CompletionResult:
+        """Non-streaming chat completion."""
+        ...
+
+    def stream(
+        self,
+        body: dict[str, Any],
+        headers: dict[str, str],
+        timeout: float,
+    ) -> AsyncIterator[dict[str, Any]]:
+        """Streaming chat completion: yields parsed OpenAI chunk dicts.
+
+        The ``[DONE]`` sentinel is *not* yielded — stream end is iterator
+        exhaustion. Failures raise :class:`BackendError` (possibly mid-stream).
+        """
+        ...
+
+    async def aclose(self) -> None:  # pragma: no cover - optional cleanup
+        ...

@@ -1,0 +1,117 @@
+"""Deterministic in-process backend for tests.
+
+Replaces the reference test suite's transport monkeypatching
+(/root/reference/tests/conftest.py:184-249, which routes on URL substrings) with
+a first-class test double implementing the Backend protocol. Used throughout
+``tests/`` and usable by downstream users for offline development.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from dataclasses import dataclass, field
+from typing import Any, AsyncIterator, Sequence
+
+from quorum_tpu import oai
+from quorum_tpu.backends.base import BackendError, CompletionResult, prepare_body
+
+
+@dataclass
+class RecordedCall:
+    body: dict[str, Any]
+    headers: dict[str, str]
+    timeout: float
+    streaming: bool
+
+
+class FakeBackend:
+    """Scripted backend.
+
+    Parameters:
+      text           the completion text returned / streamed
+      chunks         explicit stream chunk texts (defaults to splitting ``text``)
+      usage          usage dict attached to non-streaming responses
+      fail_with      a BackendError to raise on every call
+      fail_mid_stream raise after yielding ``chunks[:fail_mid_stream]``
+      delay          seconds to sleep before responding (ordering tests)
+    """
+
+    def __init__(
+        self,
+        name: str,
+        *,
+        model: str = "fake-model",
+        text: str = "",
+        chunks: Sequence[str] | None = None,
+        usage: dict[str, int] | None = None,
+        fail_with: BackendError | None = None,
+        fail_mid_stream: int | None = None,
+        delay: float = 0.0,
+        chunk_delay: float = 0.0,
+        requires_auth: bool = True,
+    ):
+        self.name = name
+        self.model = model
+        self.requires_auth = requires_auth
+        self.chunks = list(chunks) if chunks is not None else self._split(text)
+        self.text = text or "".join(self.chunks)
+        self.usage = usage or {
+            "prompt_tokens": 1,
+            "completion_tokens": max(1, len(self.chunks)),
+            "total_tokens": 1 + max(1, len(self.chunks)),
+        }
+        self.fail_with = fail_with
+        self.fail_mid_stream = fail_mid_stream
+        self.delay = delay
+        self.chunk_delay = chunk_delay
+        self.calls: list[RecordedCall] = []
+
+    @staticmethod
+    def _split(text: str, n: int = 4) -> list[str]:
+        if not text:
+            return []
+        step = max(1, len(text) // n)
+        return [text[i : i + step] for i in range(0, len(text), step)]
+
+    async def complete(
+        self, body: dict[str, Any], headers: dict[str, str], timeout: float
+    ) -> CompletionResult:
+        self.calls.append(RecordedCall(body, dict(headers), timeout, streaming=False))
+        effective = prepare_body(body, self.model)
+        if self.delay:
+            await asyncio.sleep(self.delay)
+        if self.fail_with is not None:
+            raise self.fail_with
+        resp = oai.completion(
+            content=self.text, model=effective["model"], usage=dict(self.usage)
+        )
+        resp["backend"] = self.name
+        return CompletionResult(backend_name=self.name, status_code=200, body=resp)
+
+    async def stream(
+        self, body: dict[str, Any], headers: dict[str, str], timeout: float
+    ) -> AsyncIterator[dict[str, Any]]:
+        self.calls.append(RecordedCall(body, dict(headers), timeout, streaming=True))
+        effective = prepare_body(body, self.model)
+        model = effective["model"]
+        if self.delay:
+            await asyncio.sleep(self.delay)
+        if self.fail_with is not None:
+            raise self.fail_with
+        yield oai.chunk(
+            id=f"chatcmpl-{self.name}", model=model, delta={"role": "assistant"}
+        )
+        for i, text in enumerate(self.chunks):
+            if self.fail_mid_stream is not None and i >= self.fail_mid_stream:
+                raise BackendError(f"Backend {self.name} died mid-stream")
+            if self.chunk_delay:
+                await asyncio.sleep(self.chunk_delay)
+            yield oai.chunk(
+                id=f"chatcmpl-{self.name}", model=model, delta={"content": text}
+            )
+        yield oai.chunk(
+            id=f"chatcmpl-{self.name}", model=model, delta={}, finish_reason="stop"
+        )
+
+    async def aclose(self) -> None:
+        return None

@@ -1,0 +1,134 @@
+"""HTTP backend: OpenAI-compatible upstream with true incremental streaming.
+
+Fixes two structural problems of the reference dispatcher:
+
+  1. The reference POSTs without ``stream=True`` so the whole upstream body is
+     buffered before being re-chunked ("pseudo-streaming", oai_proxy.py:187-203);
+     here ``httpx.AsyncClient.stream`` yields bytes as they arrive.
+  2. The reference creates (and closes) an ephemeral client per call
+     (oai_proxy.py:185, 249-250); here one pooled client per backend instance.
+
+Error normalization parity: any transport exception becomes a 500
+``proxy_error`` body (oai_proxy.py:252-259); non-2xx upstream statuses pass
+their status and parsed body through (oai_proxy.py:216-248).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+from typing import Any, AsyncIterator
+
+import httpx
+
+from quorum_tpu import oai, sse
+from quorum_tpu.backends.base import BackendError, CompletionResult, prepare_body
+
+logger = logging.getLogger(__name__)
+
+# Hop-by-hop / recomputed headers never forwarded upstream.
+_SKIP_HEADERS = {"host", "content-length", "transfer-encoding", "connection"}
+
+
+def _clean_headers(headers: dict[str, str]) -> dict[str, str]:
+    return {k: v for k, v in headers.items() if k.lower() not in _SKIP_HEADERS}
+
+
+class HttpBackend:
+    # Remote upstreams need a credential before the aggregation hop will run
+    # (oai_proxy.py:446-466); local tpu:// backends set this False.
+    requires_auth = True
+
+    def __init__(self, name: str, url: str, model: str = "", client: httpx.AsyncClient | None = None):
+        self.name = name
+        self.url = url.rstrip("/")
+        self.model = model
+        self._client = client or httpx.AsyncClient()
+
+    @property
+    def _endpoint(self) -> str:
+        return f"{self.url}/chat/completions"
+
+    async def complete(
+        self, body: dict[str, Any], headers: dict[str, str], timeout: float
+    ) -> CompletionResult:
+        req_body = prepare_body(body, self.model)
+        req_body["stream"] = False
+        try:
+            resp = await self._client.post(
+                self._endpoint,
+                json=req_body,
+                headers=_clean_headers(headers),
+                timeout=timeout,
+            )
+        except Exception as e:
+            logger.warning("Backend %s transport failure: %s", self.name, e)
+            raise BackendError(
+                f"Backend {self.name} error: {e}", status_code=500
+            ) from e
+        try:
+            parsed = resp.json()
+        except (json.JSONDecodeError, ValueError):
+            parsed = oai.error_body(
+                f"Invalid JSON from backend {self.name}", code=resp.status_code or 500
+            )
+        if isinstance(parsed, dict):
+            # Parity: tag successful JSON with the backend name (oai_proxy.py:212).
+            parsed.setdefault("backend", self.name)
+        else:
+            parsed = oai.error_body(
+                f"Non-object JSON from backend {self.name}", code=500
+            )
+        return CompletionResult(
+            backend_name=self.name,
+            status_code=resp.status_code,
+            body=parsed,
+            headers=dict(resp.headers),
+        )
+
+    async def stream(
+        self, body: dict[str, Any], headers: dict[str, str], timeout: float
+    ) -> AsyncIterator[dict[str, Any]]:
+        req_body = prepare_body(body, self.model)
+        req_body["stream"] = True
+        parser = sse.SSEParser()
+        try:
+            async with self._client.stream(
+                "POST",
+                self._endpoint,
+                json=req_body,
+                headers=_clean_headers(headers),
+                timeout=timeout,
+            ) as resp:
+                if resp.status_code < 200 or resp.status_code >= 300:
+                    raw = await resp.aread()
+                    try:
+                        err = json.loads(raw)
+                    except (json.JSONDecodeError, ValueError):
+                        err = oai.error_body(
+                            raw.decode("utf-8", "replace") or f"HTTP {resp.status_code}",
+                            code=resp.status_code,
+                        )
+                    raise BackendError(
+                        f"Backend {self.name} HTTP {resp.status_code}",
+                        status_code=resp.status_code,
+                        body=err,
+                    )
+                async for raw_chunk in resp.aiter_bytes():
+                    for event in parser.feed(raw_chunk):
+                        if event == sse.DONE:
+                            return
+                        if isinstance(event, dict):
+                            yield event
+                        # Non-JSON data lines are skipped (oai_proxy.py:612-615).
+                for event in parser.flush():
+                    if isinstance(event, dict):
+                        yield event
+        except BackendError:
+            raise
+        except Exception as e:
+            logger.warning("Backend %s stream failure: %s", self.name, e)
+            raise BackendError(f"Backend {self.name} error: {e}", status_code=500) from e
+
+    async def aclose(self) -> None:
+        await self._client.aclose()

@@ -153,17 +153,22 @@ def extract_delta_content(chunk_: dict[str, Any]) -> str:
         return ""
 
 
-def last_user_message(body: dict[str, Any]) -> str:
-    """The user query used for the aggregation prompt (oai_proxy.py:1178-1181)."""
+def first_user_message(body: dict[str, Any]) -> str:
+    """The user query used for the aggregation prompt.
+
+    Parity: the reference takes the *first* user message (oai_proxy.py:794-799,
+    1233-1238 — it breaks on the first match).
+    """
     messages = body.get("messages") or []
-    for m in reversed(messages):
+    for m in messages:
         if isinstance(m, dict) and m.get("role") == "user":
             c = m.get("content")
             if isinstance(c, str):
                 return c
-            # OpenAI content-part arrays: concatenate text parts.
             if isinstance(c, list):
                 return "".join(
                     p.get("text", "") for p in c if isinstance(p, dict) and p.get("type") == "text"
                 )
     return ""
+
+

@@ -1,0 +1,15 @@
+"""API server layer: ASGI app + standalone HTTP server.
+
+The reference served a FastAPI app with uvicorn (/root/reference/Makefile:3-7).
+Neither is present in this environment, so quorum_tpu ships:
+
+  asgi.py    a minimal ASGI toolkit (request/response/router) — the app is a
+             standard ASGI callable, testable with httpx.ASGITransport and
+             servable by any ASGI server;
+  app.py     the OpenAI-compatible application (routes, auth, dispatch);
+  serve.py   an h11-based asyncio HTTP/1.1 server + CLI entry point.
+"""
+
+from quorum_tpu.server.app import create_app
+
+__all__ = ["create_app"]

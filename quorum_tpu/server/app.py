@@ -1,0 +1,253 @@
+"""The OpenAI-compatible application: routes, auth, validation, dispatch.
+
+Endpoint parity with /root/reference/src/quorum/oai_proxy.py:959-1414:
+
+  POST /chat/completions   (and /v1/chat/completions — the reference had no
+                            /v1 alias, quirk 10; both are served here)
+  GET  /health             → {"status": "healthy"}
+
+Request handling parity:
+  - all request headers forwarded minus ``host`` (:973);
+  - missing Authorization → fall back to $OPENAI_API_KEY, else 401
+    ``auth_error`` with the reference's exact message (:976-998); header
+    casing normalized to ``Authorization`` (:1000-1004);
+  - no valid backends → 500 ``configuration_error`` (:1010-1024);
+  - no model in request and none in config → 400 ``invalid_request_error``
+    (:1026-1040);
+  - parallel mode iff strategy config present AND >1 valid backend (:1043-1044);
+  - non-streaming non-parallel: all backends still called concurrently, first
+    success returned verbatim (:1356-1380);
+  - all backends failed → 500 "All backends failed. First error: …" (:1140-1162).
+
+Difference: malformed request JSON returns 400 (the reference's blanket
+handler turned it into a 500).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+from typing import Any, AsyncIterator
+
+from quorum_tpu import oai, sse
+from quorum_tpu.backends.base import Backend, BackendError
+from quorum_tpu.backends.registry import BackendRegistry, build_registry
+from quorum_tpu.config import Config, load_config
+from quorum_tpu.server.asgi import (
+    App,
+    JSONResponse,
+    Request,
+    Response,
+    StreamingResponse,
+)
+from quorum_tpu.strategies.combine import combine_outcomes
+from quorum_tpu.strategies.fanout import fanout_complete
+from quorum_tpu.strategies.streaming import StreamPlan, parallel_stream
+
+logger = logging.getLogger(__name__)
+
+# content-encoding must be dropped too: httpx decompresses upstream bodies, so
+# forwarding the upstream's "gzip" label over our identity-encoded JSON would
+# corrupt the response for compression-aware clients.
+_PASSTHROUGH_SKIP = {"content-length", "content-type", "transfer-encoding", "content-encoding", "connection"}
+
+
+def _auth_error() -> JSONResponse:
+    return JSONResponse(
+        {
+            "error": {
+                "message": (
+                    "Authorization header is required and OPENAI_API_KEY "
+                    "environment variable is not set"
+                ),
+                "type": "auth_error",
+            }
+        },
+        status_code=401,
+    )
+
+
+def _resolve_headers(request_headers: dict[str, str]) -> dict[str, str] | None:
+    """Forward headers minus host; normalize/inject Authorization.
+
+    Returns None when no credential is available (→ 401).
+    """
+    headers = {k: v for k, v in request_headers.items() if k.lower() != "host"}
+    lower_to_orig = {k.lower(): k for k in headers}
+    if "authorization" not in lower_to_orig:
+        api_key = os.environ.get("OPENAI_API_KEY", "")
+        if not api_key:
+            return None
+        headers["Authorization"] = f"Bearer {api_key}"
+    elif "Authorization" not in headers:
+        orig = lower_to_orig["authorization"]
+        headers["Authorization"] = headers.pop(orig)
+    if "content-type" not in lower_to_orig:
+        headers["Content-Type"] = "application/json"
+    return headers
+
+
+async def _stream_with_role(
+    first_chunk: dict[str, Any] | None,
+    rest: AsyncIterator[dict[str, Any]],
+    model: str,
+) -> AsyncIterator[bytes]:
+    """Single-backend SSE normalization (oai_proxy.py:888-956 parity):
+    synthetic role chunk first, duplicate upstream role-only chunk skipped,
+    trailing [DONE] guaranteed."""
+    yield sse.encode_event(oai.chunk(id="chatcmpl-role", model=model, delta={"role": "assistant"}))
+    try:
+        if first_chunk is not None:
+            delta = (first_chunk.get("choices") or [{}])[0].get("delta") or {}
+            is_dup_role = bool(delta.get("role")) and not delta.get("content")
+            if not is_dup_role:
+                yield sse.encode_event(first_chunk)
+        async for chunk in rest:
+            yield sse.encode_event(chunk)
+    except BackendError as e:
+        # Mid-stream failure: surface as an SSE error chunk, then terminate.
+        yield sse.encode_event(
+            oai.chunk(
+                id="error",
+                model=model,
+                delta={"content": f"Backend failed: {e}"},
+                finish_reason="error",
+            )
+        )
+    yield sse.encode_done()
+
+
+def create_app(
+    config: Config | None = None,
+    registry: BackendRegistry | None = None,
+    **backend_overrides: Backend,
+) -> App:
+    """Build the ASGI application.
+
+    Tests inject deterministic backends via ``backend_overrides`` (name →
+    Backend) or a fully custom ``registry``.
+    """
+    cfg = config if config is not None else load_config()
+    reg = registry if registry is not None else build_registry(cfg, **backend_overrides)
+
+    app = App()
+    app.state["config"] = cfg
+    app.state["registry"] = reg
+
+    @app.route("GET", "/health", "/v1/health")
+    async def health(request: Request) -> Response:
+        return JSONResponse({"status": "healthy"})
+
+    @app.route("POST", "/chat/completions", "/v1/chat/completions")
+    async def chat_completions(request: Request) -> Response:
+        try:
+            body = await request.json()
+            if not isinstance(body, dict):
+                raise ValueError("request body must be a JSON object")
+        except Exception as e:
+            return JSONResponse(
+                {"error": {"message": f"Invalid JSON body: {e}", "type": "invalid_request_error"}},
+                status_code=400,
+            )
+
+        headers = _resolve_headers(request.headers)
+        if headers is None:
+            return _auth_error()
+
+        if len(reg) == 0:
+            return JSONResponse(
+                {"error": {"message": "No valid backends configured", "type": "configuration_error"}},
+                status_code=500,
+            )
+
+        if "model" not in body and not any(b.model for b in reg.backends):
+            return JSONResponse(
+                {
+                    "error": {
+                        "message": "Model must be specified when config.yaml model is blank",
+                        "type": "invalid_request_error",
+                    }
+                },
+                status_code=400,
+            )
+
+        is_streaming = bool(body.get("stream", False))
+        is_parallel = cfg.parallel_enabled(len(reg))
+        timeout = cfg.timeout
+
+        if is_streaming:
+            if is_parallel:
+                plan = StreamPlan.from_config(cfg, reg, body)
+                return StreamingResponse(
+                    parallel_stream(plan, body, headers, timeout)
+                )
+            return await _single_stream(reg.backends[0], body, headers, timeout)
+
+        # Non-streaming. Parity: every backend is called even in non-parallel
+        # mode (oai_proxy.py:1132-1137); in aggregate strategy only the
+        # configured source_backends are (fix of quirk 4).
+        if is_parallel and cfg.strategy_name == "aggregate":
+            targets = reg.select(cfg.aggregate.source_backends)
+            if not targets:
+                return JSONResponse(
+                    {
+                        "error": {
+                            "message": "source_backends matches no configured backend",
+                            "type": "configuration_error",
+                        }
+                    },
+                    status_code=500,
+                )
+        else:
+            targets = reg.backends
+        outcomes = await fanout_complete(targets, body, headers, timeout)
+        successes = [o for o in outcomes if o.ok]
+        if not successes:
+            return JSONResponse(
+                {
+                    "error": {
+                        "message": f"All backends failed. First error: {outcomes[0].error_message}",
+                        "type": "proxy_error",
+                    }
+                },
+                status_code=500,
+            )
+
+        if is_parallel:
+            combined = await combine_outcomes(
+                cfg, reg, outcomes, body, headers, aggregator_timeout=timeout
+            )
+            return JSONResponse(combined)
+
+        # Non-parallel: first successful response verbatim (oai_proxy.py:1356-1380).
+        first = successes[0]
+        resp_headers = {
+            k: v
+            for k, v in first.result.headers.items()
+            if k.lower() not in _PASSTHROUGH_SKIP
+        }
+        return JSONResponse(first.result.body, status_code=first.result.status_code, headers=resp_headers)
+
+    async def _single_stream(
+        backend: Backend, body: dict[str, Any], headers: dict[str, str], timeout: float
+    ) -> Response:
+        model = body.get("model") or backend.model or "unknown"
+        stream = backend.stream(body, headers, timeout)
+        try:
+            first_chunk = await stream.__anext__()
+        except StopAsyncIteration:
+            first_chunk = None
+        except BackendError as e:
+            # Failure before any token: JSON error with upstream status
+            # (oai_proxy.py:1107-1128 parity).
+            msg = e.body.get("error", {}).get("message", str(e)) if isinstance(
+                e.body.get("error"), dict
+            ) else str(e)
+            return JSONResponse(
+                {"error": {"message": f"Backend failed: {msg}", "type": "proxy_error"}},
+                status_code=e.status_code,
+            )
+        return StreamingResponse(_stream_with_role(first_chunk, stream, model))
+
+    return app

@@ -1,0 +1,183 @@
+"""Minimal ASGI toolkit: Request, Response types, and a method+path router.
+
+Scope: exactly what the API layer needs (JSON bodies, JSON responses, SSE
+streaming responses). The app remains a standard ASGI3 callable so it works
+under httpx.ASGITransport (tests), the bundled h11 server (production), or any
+external ASGI server.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+from typing import Any, AsyncIterator, Awaitable, Callable
+
+logger = logging.getLogger(__name__)
+
+
+class Request:
+    def __init__(self, scope: dict[str, Any], receive: Callable):
+        self.scope = scope
+        self._receive = receive
+        self._body: bytes | None = None
+
+    @property
+    def method(self) -> str:
+        return self.scope["method"].upper()
+
+    @property
+    def path(self) -> str:
+        return self.scope["path"]
+
+    @property
+    def headers(self) -> dict[str, str]:
+        """Headers with original casing preserved (the reference forwards
+        header casing through to upstreams; latin-1 per ASGI spec)."""
+        if not hasattr(self, "_headers"):
+            self._headers = {
+                k.decode("latin-1"): v.decode("latin-1")
+                for k, v in self.scope.get("headers", [])
+            }
+        return self._headers
+
+    async def body(self) -> bytes:
+        if self._body is None:
+            chunks = []
+            while True:
+                message = await self._receive()
+                chunks.append(message.get("body", b""))
+                if not message.get("more_body"):
+                    break
+            self._body = b"".join(chunks)
+        return self._body
+
+    async def json(self) -> Any:
+        return json.loads(await self.body())
+
+
+class Response:
+    media_type = "application/octet-stream"
+
+    def __init__(
+        self,
+        content: bytes | str = b"",
+        status_code: int = 200,
+        headers: dict[str, str] | None = None,
+        media_type: str | None = None,
+    ):
+        self.body = content.encode() if isinstance(content, str) else content
+        self.status_code = status_code
+        self.headers = dict(headers or {})
+        if media_type is not None:
+            self.media_type = media_type
+
+    def _header_list(self, extra: dict[str, str]) -> list[tuple[bytes, bytes]]:
+        merged = {**extra, **self.headers}
+        merged.setdefault("content-type", self.media_type)
+        return [(k.encode("latin-1"), v.encode("latin-1")) for k, v in merged.items()]
+
+    async def __call__(self, scope, receive, send) -> None:
+        await send(
+            {
+                "type": "http.response.start",
+                "status": self.status_code,
+                "headers": self._header_list({"content-length": str(len(self.body))}),
+            }
+        )
+        await send({"type": "http.response.body", "body": self.body})
+
+
+class JSONResponse(Response):
+    media_type = "application/json"
+
+    def __init__(self, content: Any, status_code: int = 200, headers: dict[str, str] | None = None):
+        super().__init__(json.dumps(content), status_code, headers)
+
+
+class StreamingResponse(Response):
+    """Streams an async byte iterator; used for SSE (``text/event-stream``)."""
+
+    media_type = "text/event-stream"
+
+    def __init__(
+        self,
+        iterator: AsyncIterator[bytes],
+        status_code: int = 200,
+        headers: dict[str, str] | None = None,
+        media_type: str | None = None,
+    ):
+        super().__init__(b"", status_code, headers, media_type)
+        self.iterator = iterator
+
+    async def __call__(self, scope, receive, send) -> None:
+        await send(
+            {
+                "type": "http.response.start",
+                "status": self.status_code,
+                "headers": self._header_list({"cache-control": "no-cache"}),
+            }
+        )
+        try:
+            async for chunk in self.iterator:
+                if chunk:
+                    await send(
+                        {"type": "http.response.body", "body": chunk, "more_body": True}
+                    )
+        finally:
+            await send({"type": "http.response.body", "body": b""})
+
+
+Handler = Callable[[Request], Awaitable[Response]]
+
+
+class App:
+    """Method+path router implementing the ASGI3 interface."""
+
+    def __init__(self) -> None:
+        self._routes: dict[tuple[str, str], Handler] = {}
+        self.state: dict[str, Any] = {}
+
+    def route(self, method: str, *paths: str) -> Callable[[Handler], Handler]:
+        def register(handler: Handler) -> Handler:
+            for p in paths:
+                self._routes[(method.upper(), p)] = handler
+            return handler
+
+        return register
+
+    async def __call__(self, scope, receive, send) -> None:
+        if scope["type"] == "lifespan":
+            # Drain lifespan events so ASGI servers that emit them work.
+            while True:
+                message = await receive()
+                if message["type"] == "lifespan.startup":
+                    await send({"type": "lifespan.startup.complete"})
+                elif message["type"] == "lifespan.shutdown":
+                    await send({"type": "lifespan.shutdown.complete"})
+                    return
+        if scope["type"] != "http":
+            return
+        request = Request(scope, receive)
+        handler = self._routes.get((request.method, request.path))
+        if handler is None:
+            known_paths = {p for (_, p) in self._routes}
+            if request.path in known_paths:
+                response: Response = JSONResponse(
+                    {"error": {"message": "Method not allowed", "type": "invalid_request_error"}},
+                    status_code=405,
+                )
+            else:
+                response = JSONResponse(
+                    {"error": {"message": "Not found", "type": "invalid_request_error"}},
+                    status_code=404,
+                )
+        else:
+            try:
+                response = await handler(request)
+            except Exception as e:  # last-resort normalization (oai_proxy.py:1395-1408)
+                logger.exception("Unhandled error in %s %s", request.method, request.path)
+                response = JSONResponse(
+                    {"error": {"message": f"Error processing request: {e}", "type": "proxy_error"}},
+                    status_code=500,
+                )
+        await response(scope, receive, send)

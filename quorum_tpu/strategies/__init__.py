@@ -1,0 +1,23 @@
+"""Response-combination strategies: ``concatenate`` and ``aggregate``.
+
+Layer L3 of the framework (SURVEY.md §1). Operates purely on the Backend
+protocol — works identically over HTTP upstreams and in-process TPU models.
+
+  fanout.py     parallel dispatch to N backends (non-streaming + streaming)
+  aggregate.py  the LLM-synthesis second hop with degrade-to-concatenation
+  streaming.py  the SSE parallel streaming aggregator (live interleaving)
+"""
+
+from quorum_tpu.strategies.aggregate import aggregate_responses
+from quorum_tpu.strategies.combine import combine_outcomes
+from quorum_tpu.strategies.fanout import BackendOutcome, fanout_complete
+from quorum_tpu.strategies.streaming import StreamPlan, parallel_stream
+
+__all__ = [
+    "BackendOutcome",
+    "StreamPlan",
+    "aggregate_responses",
+    "combine_outcomes",
+    "fanout_complete",
+    "parallel_stream",
+]

@@ -1,0 +1,134 @@
+"""The LLM-aggregation second hop.
+
+Parity with ``aggregate_responses`` (/root/reference/src/quorum/oai_proxy.py:374-486):
+label + join source responses, build the synthesis prompt, call the aggregator
+backend non-streaming with sanitized headers (Authorization + Content-Type
+only, with OPENAI_API_KEY env fallback), and degrade to a separator-join of the
+raw sources on *any* failure.
+
+Deliberate fixes over the reference:
+  - source labels use the real backend names (the reference substituted
+    synthetic ``LLM{i+1}`` names, oai_proxy.py:409-411);
+  - the prompt template accepts ``{intermediate_results}``,
+    ``{{intermediate_results}}``, or the legacy ``{responses}`` placeholder
+    (the reference only replaced ``{responses}`` while its shipped config used
+    ``{{intermediate_results}}``, so substitution silently never happened —
+    oai_proxy.py:424 vs config.yaml:66-73);
+  - the aggregator timeout is configurable instead of hardcoded 60 s
+    (quirk 12, oai_proxy.py:472).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Any, Sequence
+
+from quorum_tpu.backends.base import Backend
+from quorum_tpu.config import AggregateParams
+
+logger = logging.getLogger(__name__)
+aggregation_logger = logging.getLogger("aggregation")
+
+_PLACEHOLDERS = ("{{intermediate_results}}", "{intermediate_results}", "{responses}")
+
+
+def build_aggregation_prompt(
+    labeled_sources: Sequence[tuple[str, str]],
+    params: AggregateParams,
+    user_query: str,
+) -> str:
+    """Format the synthesis prompt from (backend_name, response_text) pairs."""
+    formatted = []
+    for name, text in labeled_sources:
+        if params.include_source_names:
+            formatted.append(params.source_label_format.format(backend_name=name) + text)
+        else:
+            formatted.append(text)
+    intermediate_results = params.intermediate_separator.join(formatted)
+
+    prompt = ""
+    if params.include_original_query:
+        prompt += params.query_format.format(query=user_query)
+    template = params.prompt_template
+    for ph in _PLACEHOLDERS:
+        if ph in template:
+            template = template.replace(ph, intermediate_results)
+            break
+    else:
+        # No placeholder at all: append the sources so they are never dropped.
+        template = template + "\n\n" + intermediate_results
+    return prompt + template
+
+
+def clean_aggregator_headers(headers: dict[str, str] | None) -> dict[str, str] | None:
+    """Authorization (header case-normalized, env fallback) + Content-Type only.
+
+    Returns None when no credential can be found — the caller must then skip
+    the aggregation hop (oai_proxy.py:446-466).
+    """
+    clean: dict[str, str] = {}
+    headers = headers or {}
+    auth = headers.get("Authorization") or headers.get("authorization")
+    if not auth:
+        for k, v in headers.items():
+            if k.lower() == "authorization":
+                auth = v
+                break
+    if not auth:
+        api_key = os.environ.get("OPENAI_API_KEY", "")
+        if api_key:
+            auth = f"Bearer {api_key}"
+    if not auth:
+        return None
+    clean["Authorization"] = auth
+    clean["Content-Type"] = "application/json"
+    return clean
+
+
+async def aggregate_responses(
+    labeled_sources: Sequence[tuple[str, str]],
+    aggregator: Backend | None,
+    params: AggregateParams,
+    user_query: str,
+    headers: dict[str, str] | None,
+    timeout: float = 60.0,
+) -> str:
+    """Synthesize N source responses via the aggregator backend.
+
+    Any failure (no aggregator, no credentials, HTTP error, exception) degrades
+    to ``intermediate_separator.join(raw sources)`` (oai_proxy.py:479-486).
+    """
+    fallback = params.intermediate_separator.join(t for _, t in labeled_sources)
+    if aggregator is None:
+        aggregation_logger.error("Aggregator backend not configured/found")
+        return fallback
+
+    prompt = build_aggregation_prompt(labeled_sources, params, user_query)
+    aggregation_logger.info("Prompt for aggregator: %s", prompt)
+
+    clean_headers = clean_aggregator_headers(headers)
+    if clean_headers is None:
+        # Local (tpu://) aggregators need no upstream credential; remote ones
+        # keep the reference's skip-on-missing-auth behavior.
+        if getattr(aggregator, "requires_auth", True):
+            aggregation_logger.error("No authorization header or OPENAI_API_KEY found")
+            return fallback
+        clean_headers = {"Content-Type": "application/json"}
+
+    body: dict[str, Any] = {
+        "model": aggregator.model or "",
+        "messages": [{"role": "user", "content": prompt}],
+        "stream": False,
+    }
+    try:
+        result = await aggregator.complete(body, clean_headers, timeout)
+        if result.ok:
+            content = result.content
+            aggregation_logger.info("Aggregator response: %s", content)
+            return content
+        aggregation_logger.error("Aggregator backend failed: %s", result.body)
+        return fallback
+    except Exception as e:
+        aggregation_logger.error("Error calling aggregator backend: %s", e)
+        return fallback

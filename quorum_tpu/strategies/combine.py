@@ -1,0 +1,89 @@
+"""Non-streaming parallel combination.
+
+Parity with the reference's inline combine block
+(/root/reference/src/quorum/oai_proxy.py:1164-1355): strip thinking per
+``hide_final_think``, aggregate or separator-join, sum usage across backends,
+and rebuild one ``chat.completion`` object reusing the first successful
+response's id/created/model (oai_proxy.py:1315-1335).
+
+Same deliberate fixes as the streaming path (strategy cross-talk, honored
+``source_backends``, configurable aggregator timeout) — see
+:mod:`quorum_tpu.strategies.streaming`.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any
+
+from quorum_tpu import oai
+from quorum_tpu.backends.registry import BackendRegistry
+from quorum_tpu.config import Config
+from quorum_tpu.filtering import strip_thinking_tags
+from quorum_tpu.strategies.aggregate import aggregate_responses
+from quorum_tpu.strategies.fanout import BackendOutcome
+
+logger = logging.getLogger(__name__)
+aggregation_logger = logging.getLogger("aggregation")
+
+
+async def combine_outcomes(
+    cfg: Config,
+    registry: BackendRegistry,
+    outcomes: list[BackendOutcome],
+    body: dict[str, Any],
+    headers: dict[str, str],
+    aggregator_timeout: float,
+) -> dict[str, Any]:
+    """Combine successful outcomes into one chat.completion dict."""
+    successes = [o for o in outcomes if o.ok]
+    strategy = cfg.strategy_name
+
+    if strategy == "aggregate":
+        p = cfg.aggregate
+        thinking_tags = p.thinking_tags
+        hide_sources = p.strip_intermediate_thinking
+        labeled = [
+            (o.backend.name, strip_thinking_tags(o.content, thinking_tags, hide=hide_sources))
+            for o in successes
+        ]
+        aggregation_logger.info("Individual LLM responses for aggregation:")
+        for name, text in labeled:
+            aggregation_logger.info("%s response: %s", name, text)
+        aggregator = registry.get(p.aggregator_backend) if p.aggregator_backend else None
+        combined = await aggregate_responses(
+            labeled,
+            aggregator,
+            p,
+            oai.first_user_message(body),
+            headers,
+            aggregator_timeout,
+        )
+        if p.hide_aggregator_thinking:
+            combined = strip_thinking_tags(combined, thinking_tags, hide=True)
+    else:
+        p = cfg.concatenate
+        processed = [
+            strip_thinking_tags(o.content, p.thinking_tags, hide=p.hide_final_think)
+            for o in successes
+        ]
+        combined = p.separator.join(processed)
+
+    aggregation_logger.info("Final aggregated content: %s", combined)
+
+    usage = oai.sum_usage([o.usage for o in successes])
+    first = successes[0].result.body
+    return {
+        "id": first.get("id", oai.new_request_id()),
+        "object": "chat.completion",
+        "created": first.get("created", oai.now()),
+        "model": first.get("model", "parallel-proxy"),
+        "choices": [
+            {
+                "index": 0,
+                "message": {"role": "assistant", "content": combined},
+                "finish_reason": "stop",
+            }
+        ],
+        "usage": usage,
+    }

@@ -1,0 +1,75 @@
+"""Parallel fan-out of one request to N backends (non-streaming).
+
+Parity with the reference's ``asyncio.gather`` dispatch
+(/root/reference/src/quorum/oai_proxy.py:1132-1137) and its failure
+normalization: a failed backend yields an error outcome, never an exception
+(partial failure degrades to serving the survivors, oai_proxy.py:1138-1162).
+"""
+
+from __future__ import annotations
+
+import asyncio
+from dataclasses import dataclass
+from typing import Any
+
+from quorum_tpu.backends.base import Backend, BackendError, CompletionResult
+
+
+@dataclass
+class BackendOutcome:
+    backend: Backend
+    result: CompletionResult | None = None
+    error: BackendError | None = None
+
+    @property
+    def ok(self) -> bool:
+        return self.result is not None and self.result.ok
+
+    @property
+    def content(self) -> str:
+        return self.result.content if self.result else ""
+
+    @property
+    def usage(self) -> dict[str, Any] | None:
+        return self.result.usage if self.result else None
+
+    @property
+    def error_message(self) -> str:
+        """First-error extraction parity (oai_proxy.py:1141-1150)."""
+        if self.error is not None:
+            err = self.error.body.get("error")
+            if isinstance(err, dict):
+                return err.get("message", "Unknown error")
+            return str(self.error.body)
+        if self.result is not None:
+            err = self.result.body.get("error")
+            if isinstance(err, dict):
+                return err.get("message", "Unknown error")
+            return str(self.result.body)
+        return "Unknown error"
+
+
+async def _call_one(
+    backend: Backend, body: dict[str, Any], headers: dict[str, str], timeout: float
+) -> BackendOutcome:
+    try:
+        result = await backend.complete(body, headers, timeout)
+        return BackendOutcome(backend=backend, result=result)
+    except BackendError as e:
+        return BackendOutcome(backend=backend, error=e)
+    except Exception as e:  # normalize anything else (oai_proxy.py:252-259)
+        return BackendOutcome(backend=backend, error=BackendError(str(e)))
+
+
+async def fanout_complete(
+    backends: list[Backend],
+    body: dict[str, Any],
+    headers: dict[str, str],
+    timeout: float,
+) -> list[BackendOutcome]:
+    """Call every backend concurrently; outcomes in backend order."""
+    return list(
+        await asyncio.gather(
+            *[_call_one(b, body, headers, timeout) for b in backends]
+        )
+    )

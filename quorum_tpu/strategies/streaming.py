@@ -1,0 +1,212 @@
+"""Parallel streaming aggregator: N backend streams → one SSE stream.
+
+Re-design of the reference's ``progress_streaming_aggregator``
+(/root/reference/src/quorum/oai_proxy.py:489-885) around a merge queue: each
+backend stream runs as its own task pushing deltas into one queue, so chunks
+from different backends interleave **live**. The reference instead polled task
+completion every 0.1 s and replayed fully-buffered responses one backend at a
+time (quirks 1+3, oai_proxy.py:554, 747).
+
+SSE contract preserved (asserted by the reference test suite and ours):
+  - initial role chunk   id "chatcmpl-parallel",  model "parallel-proxy";
+  - per-backend deltas   id "chatcmpl-parallel-{i}" (i = backend index);
+  - final combined chunk id "chatcmpl-parallel-final", finish_reason "stop";
+  - all-failed error chunk id "error", content
+    "Error: All backends failed to provide content", finish_reason "error";
+  - terminating "data: [DONE]".
+
+Deliberate fixes over the reference (SURVEY.md §2 quirk list):
+  - quirk 4: ``source_backends`` is honored — in aggregate strategy only the
+    configured sources are fanned out to;
+  - quirk 5: ``suppress_individual_responses`` suppresses per-backend deltas;
+  - quirk 7: final fallback join uses ``separator.join`` (the reference used
+    ``f"\\n{separator}".join`` in streaming but ``separator.join`` elsewhere);
+  - quirk 8: ``created`` is epoch time, not the event-loop clock;
+  - quirk 9: the aggregation hop runs only when the *selected* strategy is
+    ``aggregate`` (the reference triggered it whenever an aggregator was
+    configured, regardless of strategy);
+  - ``strip_intermediate_thinking`` / ``hide_aggregator_thinking`` are honored
+    in aggregate strategy (documented in docs/aggregate_behaviour.md:113-151
+    but never read by the reference).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from dataclasses import dataclass
+from typing import Any, AsyncIterator
+
+from quorum_tpu import oai, sse
+from quorum_tpu.backends.base import Backend
+from quorum_tpu.backends.registry import BackendRegistry
+from quorum_tpu.config import AggregateParams, Config
+from quorum_tpu.filtering import ThinkingTagFilter, strip_thinking_tags
+from quorum_tpu.strategies.aggregate import aggregate_responses
+
+logger = logging.getLogger(__name__)
+aggregation_logger = logging.getLogger("aggregation")
+
+PROXY_MODEL_NAME = "parallel-proxy"
+
+_DONE = object()
+
+
+@dataclass
+class StreamPlan:
+    """Fan-out parameters resolved from config + request body."""
+
+    backends: list[Backend]
+    strategy_name: str
+    separator: str
+    hide_intermediate: bool
+    hide_final: bool
+    thinking_tags: list[str]
+    skip_final: bool
+    suppress_individual: bool
+    aggregator: Backend | None
+    aggregate_params: AggregateParams | None
+    user_query: str
+
+    @classmethod
+    def from_config(
+        cls,
+        cfg: Config,
+        registry: BackendRegistry,
+        body: dict[str, Any],
+    ) -> "StreamPlan":
+        strategy = cfg.strategy_name
+        user_query = oai.first_user_message(body)
+        if strategy == "aggregate":
+            p = cfg.aggregate
+            suppress = p.suppress_individual_responses
+            if "suppress_individual_responses" in body:  # per-request override
+                suppress = bool(body["suppress_individual_responses"])
+            return cls(
+                backends=registry.select(p.source_backends),
+                strategy_name=strategy,
+                separator=p.intermediate_separator,
+                hide_intermediate=p.strip_intermediate_thinking,
+                hide_final=p.hide_aggregator_thinking,
+                thinking_tags=p.thinking_tags,
+                skip_final=False,
+                suppress_individual=suppress,
+                aggregator=registry.get(p.aggregator_backend) if p.aggregator_backend else None,
+                aggregate_params=p,
+                user_query=user_query,
+            )
+        p = cfg.concatenate
+        return cls(
+            backends=registry.backends,
+            strategy_name=strategy,
+            separator=p.separator,
+            hide_intermediate=p.hide_intermediate_think,
+            hide_final=p.hide_final_think,
+            thinking_tags=p.thinking_tags,
+            skip_final=p.skip_final_aggregation,
+            suppress_individual=bool(body.get("suppress_individual_responses", False)),
+            aggregator=None,
+            aggregate_params=None,
+            user_query=user_query,
+        )
+
+
+async def _pump(
+    index: int,
+    backend: Backend,
+    body: dict[str, Any],
+    headers: dict[str, str],
+    timeout: float,
+    queue: asyncio.Queue,
+) -> None:
+    """Drive one backend stream, pushing (index, text | _DONE) into the queue."""
+    try:
+        async for chunk in backend.stream(body, headers, timeout):
+            text = oai.extract_delta_content(chunk)
+            if text:
+                await queue.put((index, text))
+    except Exception as e:
+        logger.warning("Backend %s (%d) stream failed: %s", backend.name, index, e)
+        aggregation_logger.error("Error processing backend %d: %s", index, e)
+    finally:
+        await queue.put((index, _DONE))
+
+
+async def parallel_stream(
+    plan: StreamPlan,
+    body: dict[str, Any],
+    headers: dict[str, str],
+    timeout: float,
+    aggregator_timeout: float | None = None,
+) -> AsyncIterator[bytes]:
+    """Merge N backend streams into one OpenAI-compatible SSE byte stream."""
+    aggregation_logger.info("Starting streaming aggregation process")
+    yield sse.encode_event(oai.role_chunk(PROXY_MODEL_NAME))
+
+    n = len(plan.backends)
+    filters = {i: ThinkingTagFilter(plan.thinking_tags) for i in range(n)}
+    collected = ["" for _ in range(n)]
+    queue: asyncio.Queue = asyncio.Queue()
+    tasks = [
+        asyncio.create_task(_pump(i, b, body, headers, timeout, queue))
+        for i, b in enumerate(plan.backends)
+    ]
+
+    try:
+        finished = 0
+        while finished < n:
+            index, item = await queue.get()
+            if item is _DONE:
+                finished += 1
+                text = filters[index].flush() if plan.hide_intermediate else ""
+            else:
+                text = filters[index].feed(item) if plan.hide_intermediate else item
+            if not text:
+                continue
+            collected[index] += text
+            if not plan.suppress_individual:
+                yield sse.encode_event(
+                    oai.content_chunk(text, model=PROXY_MODEL_NAME, backend_index=index)
+                )
+    finally:
+        for t in tasks:
+            t.cancel()
+
+    for i, content in enumerate(collected):
+        aggregation_logger.info(
+            "Backend %d content: %s", i, content or "No content received"
+        )
+
+    if not plan.skip_final:
+        labeled = [
+            (plan.backends[i].name, strip_thinking_tags(text, plan.thinking_tags, hide=plan.hide_final))
+            for i, text in enumerate(collected)
+            if text
+        ]
+        if labeled:
+            if plan.strategy_name == "aggregate" and plan.aggregator is not None and plan.aggregate_params:
+                combined = await aggregate_responses(
+                    labeled,
+                    plan.aggregator,
+                    plan.aggregate_params,
+                    plan.user_query,
+                    headers,
+                    aggregator_timeout or timeout,
+                )
+                if plan.hide_final:
+                    combined = strip_thinking_tags(combined, plan.thinking_tags, hide=True)
+            else:
+                combined = plan.separator.join(text for _, text in labeled)
+            aggregation_logger.info("Final aggregated streaming content: %s", combined)
+            yield sse.encode_event(oai.final_chunk(combined, model=PROXY_MODEL_NAME))
+        else:
+            yield sse.encode_event(
+                oai.chunk(
+                    id="error",
+                    model=PROXY_MODEL_NAME,
+                    delta={"content": "Error: All backends failed to provide content"},
+                    finish_reason="error",
+                )
+            )
+
+    yield sse.encode_done()

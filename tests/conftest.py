@@ -21,6 +21,53 @@ import inspect  # noqa: E402
 import pytest  # noqa: E402
 
 
+def make_client(config_raw: dict, **fake_backends):
+    """Build the ASGI app over FakeBackends and an httpx client bound to it.
+
+    The idiomatic replacement for the reference suite's httpx monkeypatching
+    (see SURVEY.md §4): tests inject Backend-protocol doubles by name.
+    """
+    import httpx
+
+    from quorum_tpu.config import Config
+    from quorum_tpu.server.app import create_app
+
+    app = create_app(Config(raw=config_raw), **fake_backends)
+    transport = httpx.ASGITransport(app=app)
+    return httpx.AsyncClient(transport=transport, base_url="http://testserver")
+
+
+def two_backend_parallel_config(strategy: str = "concatenate", **strategy_overrides):
+    """A 2-backend parallel config skeleton used across endpoint tests."""
+    concatenate = {
+        "separator": "\n---\n",
+        "hide_intermediate_think": True,
+        "hide_final_think": False,
+        "thinking_tags": ["think"],
+        "skip_final_aggregation": False,
+    }
+    aggregate = {
+        "source_backends": "all",
+        "aggregator_backend": "",
+        "intermediate_separator": "\n\n---\n\n",
+        "include_source_names": False,
+        "thinking_tags": ["think"],
+    }
+    if strategy == "concatenate":
+        concatenate.update(strategy_overrides)
+    else:
+        aggregate.update(strategy_overrides)
+    return {
+        "settings": {"timeout": 5},
+        "primary_backends": [
+            {"name": "LLM1", "url": "http://test1.example.com/v1", "model": "model-1"},
+            {"name": "LLM2", "url": "http://test2.example.com/v1", "model": "model-2"},
+        ],
+        "iterations": {"aggregation": {"strategy": strategy}},
+        "strategy": {"concatenate": concatenate, "aggregate": aggregate},
+    }
+
+
 # Minimal built-in async-test support (pytest-asyncio is not in this image):
 # run ``async def`` tests via asyncio.run.
 @pytest.hookimpl(tryfirst=True)

@@ -1,0 +1,179 @@
+"""Aggregate strategy parity (/root/reference/tests/test_aggregate_strategy.py):
+sources + aggregator call counts, prompt construction, auth propagation,
+fallbacks, source_backends selection (fixed quirk 4)."""
+
+import pytest
+
+from quorum_tpu import sse
+from quorum_tpu.backends import BackendError, FakeBackend
+from quorum_tpu.config import AggregateParams
+from quorum_tpu.strategies.aggregate import build_aggregation_prompt
+from tests.conftest import make_client, two_backend_parallel_config
+
+AUTH = {"Authorization": "Bearer sk-test"}
+
+
+def agg_cfg(**overrides):
+    base = {
+        "source_backends": ["LLM1", "LLM2"],
+        "aggregator_backend": "AGG",
+        "include_source_names": True,
+        "source_label_format": "Response from {backend_name}:\n",
+        "intermediate_separator": "\n---\n",
+        "include_original_query": True,
+        "query_format": "Original query: {query}\n\n",
+        "prompt_template": "Responses:\n{intermediate_results}\nSynthesize.",
+    }
+    base.update(overrides)
+    cfg = two_backend_parallel_config(strategy="aggregate", **base)
+    cfg["primary_backends"].append(
+        {"name": "AGG", "url": "http://agg.example.com/v1", "model": "agg-model"}
+    )
+    return cfg
+
+
+async def test_aggregator_called_and_output_returned():
+    f1 = FakeBackend("LLM1", text="alpha")
+    f2 = FakeBackend("LLM2", text="beta")
+    agg = FakeBackend("AGG", text="synthesized!")
+    async with make_client(agg_cfg(), LLM1=f1, LLM2=f2, AGG=agg) as client:
+        r = await client.post(
+            "/chat/completions",
+            json={"model": "m", "messages": [{"role": "user", "content": "the query"}]},
+            headers=AUTH,
+        )
+    assert r.status_code == 200
+    assert r.json()["choices"][0]["message"]["content"] == "synthesized!"
+    # 2 sources + 1 aggregator call
+    assert len(f1.calls) == 1 and len(f2.calls) == 1 and len(agg.calls) == 1
+
+
+async def test_aggregator_prompt_contains_labels_query_and_sources():
+    f1 = FakeBackend("LLM1", text="alpha")
+    f2 = FakeBackend("LLM2", text="beta")
+    agg = FakeBackend("AGG", text="done")
+    async with make_client(agg_cfg(), LLM1=f1, LLM2=f2, AGG=agg) as client:
+        await client.post(
+            "/chat/completions",
+            json={"model": "m", "messages": [{"role": "user", "content": "my question"}]},
+            headers=AUTH,
+        )
+    prompt = agg.calls[0].body["messages"][0]["content"]
+    assert "Response from LLM1:\nalpha" in prompt
+    assert "Response from LLM2:\nbeta" in prompt
+    assert "Original query: my question" in prompt
+    assert "{intermediate_results}" not in prompt
+    assert "Synthesize." in prompt
+
+
+async def test_auth_header_propagated_to_all_hops():
+    f1 = FakeBackend("LLM1", text="a")
+    f2 = FakeBackend("LLM2", text="b")
+    agg = FakeBackend("AGG", text="c")
+    async with make_client(agg_cfg(), LLM1=f1, LLM2=f2, AGG=agg) as client:
+        await client.post("/chat/completions", json={"model": "m"}, headers=AUTH)
+    for fake in (f1, f2, agg):
+        assert fake.calls[0].headers["Authorization"] == "Bearer sk-test"
+    # aggregator gets only sanitized headers
+    assert set(agg.calls[0].headers) == {"Authorization", "Content-Type"}
+
+
+async def test_env_key_fallback_for_aggregator(monkeypatch):
+    monkeypatch.setenv("OPENAI_API_KEY", "sk-env")
+    f1 = FakeBackend("LLM1", text="a")
+    f2 = FakeBackend("LLM2", text="b")
+    agg = FakeBackend("AGG", text="c")
+    async with make_client(agg_cfg(), LLM1=f1, LLM2=f2, AGG=agg) as client:
+        await client.post("/chat/completions", json={"model": "m"})
+    assert agg.calls[0].headers["Authorization"] == "Bearer sk-env"
+
+
+async def test_aggregator_failure_degrades_to_concatenation():
+    f1 = FakeBackend("LLM1", text="alpha")
+    f2 = FakeBackend("LLM2", text="beta")
+    agg = FakeBackend("AGG", fail_with=BackendError("agg down", status_code=500))
+    async with make_client(agg_cfg(), LLM1=f1, LLM2=f2, AGG=agg) as client:
+        r = await client.post("/chat/completions", json={"model": "m"}, headers=AUTH)
+    assert r.status_code == 200
+    assert r.json()["choices"][0]["message"]["content"] == "alpha\n---\nbeta"
+
+
+async def test_missing_aggregator_backend_degrades():
+    cfg = agg_cfg(aggregator_backend="GHOST")
+    f1 = FakeBackend("LLM1", text="a")
+    f2 = FakeBackend("LLM2", text="b")
+    async with make_client(cfg, LLM1=f1, LLM2=f2) as client:
+        r = await client.post("/chat/completions", json={"model": "m"}, headers=AUTH)
+    assert r.status_code == 200
+    assert r.json()["choices"][0]["message"]["content"] == "a\n---\nb"
+
+
+async def test_source_backends_honored():
+    """Fix of reference quirk 4: only configured sources are fanned out to."""
+    cfg = agg_cfg(source_backends=["LLM2"])
+    f1 = FakeBackend("LLM1", text="a")
+    f2 = FakeBackend("LLM2", text="b")
+    agg = FakeBackend("AGG", text="agg-out")
+    async with make_client(cfg, LLM1=f1, LLM2=f2, AGG=agg) as client:
+        r = await client.post("/chat/completions", json={"model": "m"}, headers=AUTH)
+    assert r.status_code == 200
+    assert f1.calls == []  # excluded source not called
+    assert len(f2.calls) == 1
+    assert r.json()["choices"][0]["message"]["content"] == "agg-out"
+
+
+async def test_all_sources_fail_500():
+    f1 = FakeBackend("LLM1", fail_with=BackendError("x", status_code=500))
+    f2 = FakeBackend("LLM2", fail_with=BackendError("y", status_code=500))
+    agg = FakeBackend("AGG", text="never")
+    async with make_client(agg_cfg(), LLM1=f1, LLM2=f2, AGG=agg) as client:
+        r = await client.post("/chat/completions", json={"model": "m"}, headers=AUTH)
+    assert r.status_code == 500
+    assert agg.calls == []
+
+
+async def test_streaming_aggregate_final_chunk_is_aggregator_output():
+    f1 = FakeBackend("LLM1", chunks=["al", "pha"])
+    f2 = FakeBackend("LLM2", chunks=["beta"])
+    agg = FakeBackend("AGG", text="the synthesis")
+    async with make_client(agg_cfg(), LLM1=f1, LLM2=f2, AGG=agg) as client:
+        r = await client.post(
+            "/chat/completions",
+            json={"model": "m", "stream": True, "messages": [{"role": "user", "content": "q"}]},
+            headers=AUTH,
+        )
+        events = list(sse.iter_data_events(r.content))
+    final = [e for e in events[:-1] if isinstance(e, dict) and e["id"] == "chatcmpl-parallel-final"]
+    assert len(final) == 1
+    assert final[0]["choices"][0]["delta"]["content"] == "the synthesis"
+    prompt = agg.calls[0].body["messages"][0]["content"]
+    assert "alpha" in prompt and "beta" in prompt
+
+
+async def test_aggregate_not_triggered_in_concatenate_strategy():
+    """Fix of reference quirk 9: the configured-but-unselected aggregate block
+    must not hijack the concatenate strategy."""
+    cfg = two_backend_parallel_config(strategy="concatenate", separator="|")
+    cfg["strategy"]["aggregate"]["aggregator_backend"] = "LLM1"
+    f1 = FakeBackend("LLM1", text="a")
+    f2 = FakeBackend("LLM2", text="b")
+    async with make_client(cfg, LLM1=f1, LLM2=f2) as client:
+        r = await client.post("/chat/completions", json={"model": "m"}, headers=AUTH)
+    assert r.json()["choices"][0]["message"]["content"] == "a|b"
+    assert len(f1.calls) == 1  # not called a second time as aggregator
+
+
+def test_prompt_builder_placeholder_variants():
+    params = AggregateParams()
+    params.include_original_query = False
+    for template in (
+        "X {intermediate_results} Y",
+        "X {{intermediate_results}} Y",
+        "X {responses} Y",
+    ):
+        params.prompt_template = template
+        out = build_aggregation_prompt([("A", "body")], params, "")
+        assert out == "X body Y"
+    params.prompt_template = "no placeholder at all"
+    out = build_aggregation_prompt([("A", "body")], params, "")
+    assert "body" in out

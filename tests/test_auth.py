@@ -1,0 +1,73 @@
+"""Auth semantics parity (/root/reference/tests/test_auth.py):
+401 without header+env; env key injected as Bearer toward upstream;
+header case normalization."""
+
+import pytest
+
+from quorum_tpu.backends import FakeBackend
+from tests.conftest import make_client
+
+
+CFG = {
+    "settings": {"timeout": 5},
+    "primary_backends": [
+        {"name": "LLM1", "url": "http://test1.example.com/v1", "model": "m"}
+    ],
+}
+
+
+async def test_401_without_header_and_env(monkeypatch):
+    monkeypatch.delenv("OPENAI_API_KEY", raising=False)
+    fake = FakeBackend("LLM1", text="hi")
+    async with make_client(CFG, LLM1=fake) as client:
+        r = await client.post("/chat/completions", json={"model": "m", "messages": []})
+        assert r.status_code == 401
+        err = r.json()["error"]
+        assert err["type"] == "auth_error"
+        assert "OPENAI_API_KEY" in err["message"]
+    assert fake.calls == []
+
+
+async def test_env_key_injected(monkeypatch):
+    monkeypatch.setenv("OPENAI_API_KEY", "sk-env-key")
+    fake = FakeBackend("LLM1", text="hi")
+    async with make_client(CFG, LLM1=fake) as client:
+        r = await client.post("/chat/completions", json={"model": "m", "messages": []})
+        assert r.status_code == 200
+    assert fake.calls[0].headers["Authorization"] == "Bearer sk-env-key"
+
+
+async def test_header_case_normalized(monkeypatch):
+    monkeypatch.delenv("OPENAI_API_KEY", raising=False)
+    fake = FakeBackend("LLM1", text="hi")
+    async with make_client(CFG, LLM1=fake) as client:
+        r = await client.post(
+            "/chat/completions",
+            json={"model": "m", "messages": []},
+            headers={"authorization": "Bearer sk-user"},
+        )
+        assert r.status_code == 200
+    auth_headers = {
+        k: v for k, v in fake.calls[0].headers.items() if k.lower() == "authorization"
+    }
+    assert auth_headers == {"Authorization": "Bearer sk-user"}
+
+
+async def test_header_takes_precedence_over_env(monkeypatch):
+    monkeypatch.setenv("OPENAI_API_KEY", "sk-env")
+    fake = FakeBackend("LLM1", text="hi")
+    async with make_client(CFG, LLM1=fake) as client:
+        await client.post(
+            "/chat/completions",
+            json={"model": "m", "messages": []},
+            headers={"Authorization": "Bearer sk-header"},
+        )
+    assert fake.calls[0].headers["Authorization"] == "Bearer sk-header"
+
+
+async def test_host_header_not_forwarded(monkeypatch):
+    monkeypatch.setenv("OPENAI_API_KEY", "sk-env")
+    fake = FakeBackend("LLM1", text="hi")
+    async with make_client(CFG, LLM1=fake) as client:
+        await client.post("/chat/completions", json={"model": "m", "messages": []})
+    assert "host" not in {k.lower() for k in fake.calls[0].headers}

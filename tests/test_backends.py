@@ -1,0 +1,193 @@
+"""Backend layer: model-override precedence, registry, HTTP + fake backends."""
+
+import httpx
+import pytest
+
+from quorum_tpu import oai, sse
+from quorum_tpu.backends import (
+    BackendError,
+    FakeBackend,
+    HttpBackend,
+    build_registry,
+    prepare_body,
+)
+from quorum_tpu.config import Config
+
+
+class TestPrepareBody:
+    def test_config_model_overrides_request(self):
+        out = prepare_body({"model": "req-model", "messages": []}, "cfg-model")
+        assert out["model"] == "cfg-model"
+
+    def test_request_model_used_when_config_blank(self):
+        out = prepare_body({"model": "req-model", "messages": []}, "")
+        assert out["model"] == "req-model"
+
+    def test_no_model_anywhere_raises_400(self):
+        with pytest.raises(BackendError) as ei:
+            prepare_body({"messages": []}, "")
+        assert ei.value.status_code == 400
+        assert ei.value.body["error"]["type"] == "invalid_request_error"
+
+    def test_original_body_not_mutated(self):
+        body = {"model": "a", "messages": [{"role": "user", "content": "x"}]}
+        prepare_body(body, "b")
+        assert body["model"] == "a"
+
+
+class TestFakeBackend:
+    async def test_complete(self):
+        b = FakeBackend("LLM1", text="hello", usage={"prompt_tokens": 2, "completion_tokens": 3, "total_tokens": 5})
+        r = await b.complete({"model": "m", "messages": []}, {}, 5.0)
+        assert r.ok
+        assert r.content == "hello"
+        assert r.usage["total_tokens"] == 5
+        assert r.body["backend"] == "LLM1"
+        assert b.calls[0].body["model"] == "m"
+
+    async def test_stream_shape(self):
+        b = FakeBackend("LLM1", chunks=["he", "llo"])
+        events = [e async for e in b.stream({"model": "m", "messages": []}, {}, 5.0)]
+        assert events[0]["choices"][0]["delta"] == {"role": "assistant"}
+        contents = [oai.extract_delta_content(e) for e in events]
+        assert "".join(contents) == "hello"
+        assert events[-1]["choices"][0]["finish_reason"] == "stop"
+
+    async def test_failure(self):
+        b = FakeBackend("bad", fail_with=BackendError("boom", status_code=503))
+        with pytest.raises(BackendError) as ei:
+            await b.complete({"model": "m"}, {}, 5.0)
+        assert ei.value.status_code == 503
+
+    async def test_mid_stream_failure(self):
+        b = FakeBackend("bad", chunks=["a", "b", "c"], fail_mid_stream=2)
+        got = []
+        with pytest.raises(BackendError):
+            async for e in b.stream({"model": "m"}, {}, 5.0):
+                got.append(oai.extract_delta_content(e))
+        assert "".join(got) == "ab"
+
+
+def _mock_client(handler):
+    return httpx.AsyncClient(transport=httpx.MockTransport(handler))
+
+
+class TestHttpBackend:
+    async def test_complete_tags_backend_and_parses(self):
+        def handler(request: httpx.Request) -> httpx.Response:
+            assert request.url.path.endswith("/chat/completions")
+            import json
+
+            body = json.loads(request.content)
+            assert body["model"] == "cfg-model"  # override applied
+            assert "content-length" not in dict(request.headers).get("x-echo", "")
+            return httpx.Response(200, json=oai.completion(content="hi", model="cfg-model"))
+
+        b = HttpBackend("LLM1", "http://up.example/v1", "cfg-model", client=_mock_client(handler))
+        r = await b.complete({"model": "other", "messages": []}, {"host": "x", "authorization": "Bearer k"}, 5.0)
+        assert r.ok and r.content == "hi"
+        assert r.body["backend"] == "LLM1"
+
+    async def test_upstream_error_status_passthrough(self):
+        def handler(request):
+            return httpx.Response(429, json={"error": {"message": "rate limited"}})
+
+        b = HttpBackend("LLM1", "http://up.example/v1", "m", client=_mock_client(handler))
+        r = await b.complete({"model": "m"}, {}, 5.0)
+        assert not r.ok
+        assert r.status_code == 429
+        assert r.body["error"]["message"] == "rate limited"
+
+    async def test_transport_exception_becomes_backend_error(self):
+        def handler(request):
+            raise httpx.ConnectError("nope")
+
+        b = HttpBackend("LLM1", "http://up.example/v1", "m", client=_mock_client(handler))
+        with pytest.raises(BackendError) as ei:
+            await b.complete({"model": "m"}, {}, 5.0)
+        assert ei.value.status_code == 500
+        assert ei.value.body["error"]["type"] == "proxy_error"
+
+    async def test_invalid_json_normalized(self):
+        def handler(request):
+            return httpx.Response(200, content=b"<html>oops</html>")
+
+        b = HttpBackend("LLM1", "http://up.example/v1", "m", client=_mock_client(handler))
+        r = await b.complete({"model": "m"}, {}, 5.0)
+        assert not r.ok
+        assert "error" in r.body
+
+    async def test_stream_yields_incremental_chunks(self):
+        frames = (
+            sse.encode_event(oai.chunk(id="c", model="m", delta={"role": "assistant"}))
+            + sse.encode_event(oai.chunk(id="c", model="m", delta={"content": "he"}))
+            + sse.encode_event(oai.chunk(id="c", model="m", delta={"content": "llo"}))
+            + sse.encode_done()
+        )
+
+        def handler(request):
+            import json
+
+            assert json.loads(request.content)["stream"] is True
+            return httpx.Response(
+                200,
+                headers={"content-type": "text/event-stream"},
+                content=frames,
+            )
+
+        b = HttpBackend("LLM1", "http://up.example/v1", "m", client=_mock_client(handler))
+        events = [e async for e in b.stream({"model": "m"}, {}, 5.0)]
+        assert "".join(oai.extract_delta_content(e) for e in events) == "hello"
+        # DONE sentinel consumed, not yielded
+        assert all(isinstance(e, dict) for e in events)
+
+    async def test_stream_http_error_raises_with_body(self):
+        def handler(request):
+            return httpx.Response(500, json={"error": {"message": "upstream down"}})
+
+        b = HttpBackend("LLM1", "http://up.example/v1", "m", client=_mock_client(handler))
+        with pytest.raises(BackendError) as ei:
+            async for _ in b.stream({"model": "m"}, {}, 5.0):
+                pass
+        assert ei.value.status_code == 500
+        assert ei.value.body["error"]["message"] == "upstream down"
+
+
+class TestRegistry:
+    def cfg(self):
+        return Config(raw={
+            "primary_backends": [
+                {"name": "LLM1", "url": "http://a.example/v1", "model": "m1"},
+                {"name": "LLM2", "url": "http://b.example/v1", "model": "m2"},
+                {"name": "SKIP", "url": "", "model": ""},
+            ],
+            "settings": {"timeout": 5},
+        })
+
+    def test_build_skips_invalid_and_keeps_order(self):
+        reg = build_registry(self.cfg())
+        assert [b.name for b in reg.backends] == ["LLM1", "LLM2"]
+        assert isinstance(reg.get("LLM1"), HttpBackend)
+
+    def test_overrides_inject_fakes(self):
+        fake = FakeBackend("LLM2", text="x")
+        reg = build_registry(self.cfg(), LLM2=fake)
+        assert reg.get("LLM2") is fake
+        assert isinstance(reg.get("LLM1"), HttpBackend)
+
+    def test_select_all_and_subset(self):
+        reg = build_registry(self.cfg())
+        assert [b.name for b in reg.select("all")] == ["LLM1", "LLM2"]
+        assert [b.name for b in reg.select(None)] == ["LLM1", "LLM2"]
+        assert [b.name for b in reg.select(["LLM2"])] == ["LLM2"]
+        # unknown names resolve to nothing — callers surface a config error
+        # instead of silently fanning out to excluded backends
+        assert reg.select(["nope"]) == []
+
+    def test_unsupported_scheme_skipped(self):
+        cfg = Config(raw={
+            "primary_backends": [{"name": "X", "url": "ftp://weird"}],
+            "settings": {},
+        })
+        reg = build_registry(cfg)
+        assert len(reg) == 0

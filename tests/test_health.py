@@ -1,0 +1,16 @@
+"""GET /health parity (/root/reference/tests/test_health.py)."""
+
+from tests.conftest import make_client
+
+
+async def test_health():
+    async with make_client({"primary_backends": [], "settings": {}}) as client:
+        r = await client.get("/health")
+        assert r.status_code == 200
+        assert r.json() == {"status": "healthy"}
+
+
+async def test_health_v1_alias():
+    async with make_client({"primary_backends": [], "settings": {}}) as client:
+        r = await client.get("/v1/health")
+        assert r.status_code == 200

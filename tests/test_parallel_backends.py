@@ -1,0 +1,85 @@
+"""Parallel non-streaming concatenate parity
+(/root/reference/tests/test_parallel_backends.py): exact joined content,
+summed usage, partial failure, think stripping."""
+
+import pytest
+
+from quorum_tpu.backends import BackendError, FakeBackend
+from tests.conftest import make_client, two_backend_parallel_config
+
+AUTH = {"Authorization": "Bearer sk-test"}
+
+
+async def test_concatenate_joins_and_sums_usage():
+    cfg = two_backend_parallel_config(separator="\nSEP\n")
+    f1 = FakeBackend(
+        "LLM1", text="one", usage={"prompt_tokens": 10, "completion_tokens": 5, "total_tokens": 15}
+    )
+    f2 = FakeBackend(
+        "LLM2", text="two", usage={"prompt_tokens": 7, "completion_tokens": 3, "total_tokens": 10}
+    )
+    async with make_client(cfg, LLM1=f1, LLM2=f2) as client:
+        r = await client.post("/chat/completions", json={"model": "m", "messages": []}, headers=AUTH)
+    assert r.status_code == 200
+    data = r.json()
+    assert data["choices"][0]["message"]["content"] == "one\nSEP\ntwo"
+    assert data["usage"] == {
+        "prompt_tokens": 17,
+        "completion_tokens": 8,
+        "total_tokens": 25,
+    }
+    assert data["object"] == "chat.completion"
+    assert data["choices"][0]["finish_reason"] == "stop"
+
+
+async def test_partial_failure_serves_survivors():
+    cfg = two_backend_parallel_config(separator="|")
+    f1 = FakeBackend("LLM1", fail_with=BackendError("down", status_code=500))
+    f2 = FakeBackend("LLM2", text="survivor")
+    async with make_client(cfg, LLM1=f1, LLM2=f2) as client:
+        r = await client.post("/chat/completions", json={"model": "m"}, headers=AUTH)
+    assert r.status_code == 200
+    assert r.json()["choices"][0]["message"]["content"] == "survivor"
+
+
+async def test_all_fail_500():
+    cfg = two_backend_parallel_config()
+    f1 = FakeBackend("LLM1", fail_with=BackendError("e1", status_code=500))
+    f2 = FakeBackend("LLM2", fail_with=BackendError("e2", status_code=500))
+    async with make_client(cfg, LLM1=f1, LLM2=f2) as client:
+        r = await client.post("/chat/completions", json={"model": "m"}, headers=AUTH)
+    assert r.status_code == 500
+    err = r.json()["error"]
+    assert "All backends failed" in err["message"]
+    assert "e1" in err["message"]  # first error
+
+
+async def test_hide_final_think_strips_tags():
+    cfg = two_backend_parallel_config(separator="|", hide_final_think=True)
+    f1 = FakeBackend("LLM1", text="<think>secret</think>clean1")
+    f2 = FakeBackend("LLM2", text="clean2")
+    async with make_client(cfg, LLM1=f1, LLM2=f2) as client:
+        r = await client.post("/chat/completions", json={"model": "m"}, headers=AUTH)
+    assert r.json()["choices"][0]["message"]["content"] == "clean1|clean2"
+
+
+async def test_think_preserved_when_disabled():
+    cfg = two_backend_parallel_config(separator="|", hide_final_think=False)
+    f1 = FakeBackend("LLM1", text="<think>x</think>y")
+    f2 = FakeBackend("LLM2", text="z")
+    async with make_client(cfg, LLM1=f1, LLM2=f2) as client:
+        r = await client.post("/chat/completions", json={"model": "m"}, headers=AUTH)
+    assert r.json()["choices"][0]["message"]["content"] == "<think>x</think>y|z"
+
+
+async def test_response_reuses_first_success_identity():
+    cfg = two_backend_parallel_config(separator="|")
+    f1 = FakeBackend("LLM1", text="a")
+    f2 = FakeBackend("LLM2", text="b")
+    async with make_client(cfg, LLM1=f1, LLM2=f2) as client:
+        r = await client.post("/chat/completions", json={"model": "m"}, headers=AUTH)
+    data = r.json()
+    # id/model/created come from the first successful backend response
+    # (oai_proxy.py:1315-1335)
+    first = await f1.complete({"model": "m"}, {}, 5)
+    assert data["model"] == first.body["model"]

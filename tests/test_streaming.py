@@ -1,0 +1,215 @@
+"""Streaming SSE contract parity (/root/reference/tests/test_streaming.py):
+chunk sequence role→content→stop→[DONE], multi-backend stream shape, all-fail
+error chunk, [DONE] guarantee, event ordering."""
+
+import pytest
+
+from quorum_tpu import oai, sse
+from quorum_tpu.backends import BackendError, FakeBackend
+from tests.conftest import make_client, two_backend_parallel_config
+
+AUTH = {"Authorization": "Bearer sk-test"}
+
+
+async def collect_events(client, body):
+    r = await client.post("/chat/completions", json=body, headers=AUTH)
+    assert r.status_code == 200
+    assert r.headers["content-type"].startswith("text/event-stream")
+    return list(sse.iter_data_events(r.content))
+
+
+def single_cfg():
+    return {
+        "settings": {"timeout": 5},
+        "primary_backends": [
+            {"name": "LLM1", "url": "http://test1.example.com/v1", "model": "m1"}
+        ],
+    }
+
+
+class TestSingleBackendStreaming:
+    async def test_role_then_content_then_done(self):
+        fake = FakeBackend("LLM1", chunks=["Hel", "lo"])
+        async with make_client(single_cfg(), LLM1=fake) as client:
+            events = await collect_events(client, {"model": "m", "messages": [], "stream": True})
+        assert events[-1] == sse.DONE
+        # First event: synthetic role chunk
+        assert events[0]["id"] == "chatcmpl-role"
+        assert events[0]["choices"][0]["delta"] == {"role": "assistant"}
+        # Upstream's own role-only chunk was deduplicated
+        role_events = [
+            e
+            for e in events[:-1]
+            if e["choices"][0]["delta"].get("role") and not e["choices"][0]["delta"].get("content")
+        ]
+        assert len(role_events) == 1
+        content = "".join(oai.extract_delta_content(e) for e in events[:-1])
+        assert content == "Hello"
+        finish = [e["choices"][0].get("finish_reason") for e in events[:-1]]
+        assert finish[-1] == "stop"
+
+    async def test_backend_error_returns_json_error(self):
+        fake = FakeBackend("LLM1", fail_with=BackendError("no stream", status_code=502))
+        async with make_client(single_cfg(), LLM1=fake) as client:
+            r = await client.post(
+                "/chat/completions",
+                json={"model": "m", "stream": True},
+                headers=AUTH,
+            )
+        assert r.status_code == 502
+        err = r.json()["error"]
+        assert err["type"] == "proxy_error"
+        assert "Backend failed" in err["message"]
+
+    async def test_mid_stream_failure_emits_error_chunk_and_done(self):
+        fake = FakeBackend("LLM1", chunks=["a", "b", "c"], fail_mid_stream=2)
+        async with make_client(single_cfg(), LLM1=fake) as client:
+            events = await collect_events(client, {"model": "m", "stream": True})
+        assert events[-1] == sse.DONE
+        error_events = [
+            e for e in events[:-1] if e["choices"][0].get("finish_reason") == "error"
+        ]
+        assert len(error_events) == 1
+
+
+class TestParallelStreaming:
+    async def test_chunk_id_contract(self):
+        cfg = two_backend_parallel_config()
+        f1 = FakeBackend("LLM1", chunks=["A1", "A2"])
+        f2 = FakeBackend("LLM2", chunks=["B1"])
+        async with make_client(cfg, LLM1=f1, LLM2=f2) as client:
+            events = await collect_events(client, {"model": "m", "stream": True})
+        assert events[0]["id"] == "chatcmpl-parallel"
+        assert events[0]["choices"][0]["delta"] == {"role": "assistant"}
+        ids = {e["id"] for e in events[:-1] if isinstance(e, dict)}
+        assert "chatcmpl-parallel-0" in ids
+        assert "chatcmpl-parallel-1" in ids
+        final = [e for e in events[:-1] if e["id"] == "chatcmpl-parallel-final"]
+        assert len(final) == 1
+        assert final[0]["choices"][0]["finish_reason"] == "stop"
+        assert events[-1] == sse.DONE
+        # model name parity
+        assert events[0]["model"] == "parallel-proxy"
+
+    async def test_final_chunk_joins_with_separator(self):
+        cfg = two_backend_parallel_config(separator="\n===\n")
+        f1 = FakeBackend("LLM1", chunks=["Alpha"])
+        f2 = FakeBackend("LLM2", chunks=["Beta"])
+        async with make_client(cfg, LLM1=f1, LLM2=f2) as client:
+            events = await collect_events(client, {"model": "m", "stream": True})
+        final = [e for e in events[:-1] if e["id"] == "chatcmpl-parallel-final"][0]
+        assert final["choices"][0]["delta"]["content"] == "Alpha\n===\nBeta"
+
+    async def test_skip_final_aggregation(self):
+        cfg = two_backend_parallel_config(skip_final_aggregation=True)
+        f1 = FakeBackend("LLM1", chunks=["A"])
+        f2 = FakeBackend("LLM2", chunks=["B"])
+        async with make_client(cfg, LLM1=f1, LLM2=f2) as client:
+            events = await collect_events(client, {"model": "m", "stream": True})
+        assert not [e for e in events[:-1] if e["id"] == "chatcmpl-parallel-final"]
+        assert events[-1] == sse.DONE
+
+    async def test_all_fail_error_chunk(self):
+        cfg = two_backend_parallel_config()
+        f1 = FakeBackend("LLM1", fail_with=BackendError("x", status_code=500))
+        f2 = FakeBackend("LLM2", fail_with=BackendError("y", status_code=500))
+        async with make_client(cfg, LLM1=f1, LLM2=f2) as client:
+            events = await collect_events(client, {"model": "m", "stream": True})
+        error = [e for e in events[:-1] if e.get("id") == "error"]
+        assert len(error) == 1
+        assert error[0]["choices"][0]["finish_reason"] == "error"
+        assert "All backends failed" in error[0]["choices"][0]["delta"]["content"]
+        assert events[-1] == sse.DONE
+
+    async def test_partial_failure_serves_survivor(self):
+        cfg = two_backend_parallel_config()
+        f1 = FakeBackend("LLM1", fail_with=BackendError("dead", status_code=500))
+        f2 = FakeBackend("LLM2", chunks=["still here"])
+        async with make_client(cfg, LLM1=f1, LLM2=f2) as client:
+            events = await collect_events(client, {"model": "m", "stream": True})
+        final = [e for e in events[:-1] if e["id"] == "chatcmpl-parallel-final"][0]
+        assert final["choices"][0]["delta"]["content"] == "still here"
+
+    async def test_live_interleaving(self):
+        """Chunks from a slow and fast backend interleave rather than being
+        drained sequentially (fix of reference quirks 1+3)."""
+        cfg = two_backend_parallel_config()
+        slow = FakeBackend("LLM1", chunks=["s1", "s2", "s3"], chunk_delay=0.03)
+        fast = FakeBackend("LLM2", chunks=["f1", "f2", "f3"], chunk_delay=0.001)
+        async with make_client(cfg, LLM1=slow, LLM2=fast) as client:
+            events = await collect_events(client, {"model": "m", "stream": True})
+        order = [
+            e["id"]
+            for e in events[:-1]
+            if isinstance(e, dict) and e["id"].startswith("chatcmpl-parallel-") and e["id"] != "chatcmpl-parallel-final"
+        ]
+        # fast backend's chunks must all arrive before the slow one's last chunk
+        assert order.index("chatcmpl-parallel-1") < len(order) - 1
+        first_slow = order.index("chatcmpl-parallel-0")
+        last_fast = len(order) - 1 - order[::-1].index("chatcmpl-parallel-1")
+        assert last_fast < len(order)  # fast completed
+        # interleaving: not all slow chunks come before all fast chunks
+        assert order != sorted(order)
+
+    async def test_suppress_individual_responses_request_override(self):
+        cfg = two_backend_parallel_config()
+        f1 = FakeBackend("LLM1", chunks=["A"])
+        f2 = FakeBackend("LLM2", chunks=["B"])
+        async with make_client(cfg, LLM1=f1, LLM2=f2) as client:
+            events = await collect_events(
+                client,
+                {"model": "m", "stream": True, "suppress_individual_responses": True},
+            )
+        per_backend = [
+            e
+            for e in events[:-1]
+            if isinstance(e, dict)
+            and e["id"].startswith("chatcmpl-parallel-")
+            and e["id"] != "chatcmpl-parallel-final"
+        ]
+        assert per_backend == []
+        final = [e for e in events[:-1] if e["id"] == "chatcmpl-parallel-final"]
+        assert len(final) == 1
+
+
+class TestStreamingThinkFilter:
+    async def test_intermediate_think_hidden_and_final_clean(self):
+        cfg = two_backend_parallel_config(hide_intermediate_think=True)
+        f1 = FakeBackend("LLM1", chunks=["vis<thi", "nk>hidden</think>ible"])
+        f2 = FakeBackend("LLM2", chunks=["plain"])
+        async with make_client(cfg, LLM1=f1, LLM2=f2) as client:
+            events = await collect_events(client, {"model": "m", "stream": True})
+        streamed_0 = "".join(
+            oai.extract_delta_content(e)
+            for e in events[:-1]
+            if isinstance(e, dict) and e["id"] == "chatcmpl-parallel-0"
+        )
+        assert streamed_0 == "visible"
+        final = [e for e in events[:-1] if e["id"] == "chatcmpl-parallel-final"][0]
+        assert "hidden" not in final["choices"][0]["delta"]["content"]
+
+    async def test_think_preserved_when_disabled(self):
+        cfg = two_backend_parallel_config(hide_intermediate_think=False, hide_final_think=False)
+        f1 = FakeBackend("LLM1", chunks=["<think>x</think>y"])
+        f2 = FakeBackend("LLM2", chunks=["z"])
+        async with make_client(cfg, LLM1=f1, LLM2=f2) as client:
+            events = await collect_events(client, {"model": "m", "stream": True})
+        streamed_0 = "".join(
+            oai.extract_delta_content(e)
+            for e in events[:-1]
+            if isinstance(e, dict) and e["id"] == "chatcmpl-parallel-0"
+        )
+        assert streamed_0 == "<think>x</think>y"
+
+    async def test_unterminated_think_discarded(self):
+        cfg = two_backend_parallel_config(hide_intermediate_think=True)
+        f1 = FakeBackend("LLM1", chunks=["ok<think>never closed"])
+        f2 = FakeBackend("LLM2", chunks=["fine"])
+        async with make_client(cfg, LLM1=f1, LLM2=f2) as client:
+            events = await collect_events(client, {"model": "m", "stream": True})
+        streamed_0 = "".join(
+            oai.extract_delta_content(e)
+            for e in events[:-1]
+            if isinstance(e, dict) and e["id"] == "chatcmpl-parallel-0"
+        )
+        assert streamed_0 == "ok"
