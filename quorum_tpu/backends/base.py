@@ -12,7 +12,6 @@ Contract parity with the reference dispatcher ``call_backend``
 
 from __future__ import annotations
 
-import copy
 from dataclasses import dataclass, field
 from typing import Any, AsyncIterator, Protocol, runtime_checkable
 
@@ -56,11 +55,11 @@ def prepare_body(
 ) -> dict[str, Any]:
     """Apply the model-override precedence (oai_proxy.py:161-176).
 
-    Returns a deep-copied body with the effective model set. Raises
-    :class:`BackendError` (400) when neither the backend config nor the request
-    specifies a model.
+    Returns a copied body with the effective model set (shallow copy — only
+    top-level keys are ever modified). Raises :class:`BackendError` (400) when
+    neither the backend config nor the request specifies a model.
     """
-    out = copy.deepcopy(body)
+    out = dict(body)
     if backend_model:
         out["model"] = backend_model
     elif not out.get("model"):

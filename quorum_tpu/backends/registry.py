@@ -56,6 +56,8 @@ class BackendRegistry:
         operator excluded."""
         if names is None or names == "all" or names == []:
             return self.backends
+        if isinstance(names, str):  # a single backend name, not a list
+            names = [names]
         out = []
         for n in names:
             b = self.get(n)

@@ -1,0 +1,25 @@
+"""Model zoo: decoder-only transformer families, TPU-first.
+
+One transformer implementation (scanned layers, static shapes, bf16-by-default)
+is parameterized by :class:`ModelSpec` to cover every family the BASELINE.json
+configs name: GPT-2 (learned pos + LayerNorm + GELU), Llama/Mistral/Gemma/Qwen
+(RoPE + RMSNorm + SwiGLU + GQA), and Mixtral (MoE experts over the tp axis).
+
+The reference has no models in-process at all — every "model" there is a
+remote HTTP endpoint (/root/reference/src/quorum/oai_proxy.py:182-192). This
+package is the north-star replacement: ``tpu://`` backends run these.
+"""
+
+from quorum_tpu.models.model_config import MODEL_PRESETS, ModelSpec, resolve_spec
+from quorum_tpu.models.init import init_params
+from quorum_tpu.models.transformer import decode_step, forward_logits, prefill
+
+__all__ = [
+    "MODEL_PRESETS",
+    "ModelSpec",
+    "resolve_spec",
+    "init_params",
+    "prefill",
+    "decode_step",
+    "forward_logits",
+]

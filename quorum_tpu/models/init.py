@@ -1,0 +1,78 @@
+"""Random parameter initialization for any :class:`ModelSpec`.
+
+Produces the exact pytree layout quorum_tpu.models.transformer consumes and
+quorum_tpu.parallel.sharding knows how to shard. Init is seeded and scaled
+(normal, 1/sqrt(fan_in)) so generated text is stable across runs and logits
+stay O(1) — what the serving tests and benchmarks need; real weights come
+from quorum_tpu.models.hf_loader when a local checkpoint exists.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from quorum_tpu.models.model_config import ModelSpec
+from quorum_tpu.models.transformer import Params
+
+
+def init_params(spec: ModelSpec, seed: int = 0) -> Params:
+    spec.validate()
+    dt = jnp.dtype(spec.dtype)
+    key = jax.random.PRNGKey(seed)
+    keys = iter(jax.random.split(key, 32))
+
+    def w(k, *shape, fan_in=None):
+        fan = fan_in if fan_in is not None else shape[-2] if len(shape) >= 2 else shape[-1]
+        return (jax.random.normal(k, shape, jnp.float32) * (fan ** -0.5)).astype(dt)
+
+    L, D, V = spec.n_layers, spec.d_model, spec.vocab_size
+    H = spec.n_heads * spec.head_dim
+    K = spec.n_kv_heads * spec.head_dim
+    F, E = spec.d_ff, spec.n_experts
+
+    blocks: dict = {
+        "attn_norm_w": jnp.ones((L, D), dt),
+        "attn_norm_b": jnp.zeros((L, D), dt) if spec.norm == "layernorm" else None,
+        "wq": w(next(keys), L, D, H),
+        "wk": w(next(keys), L, D, K),
+        "wv": w(next(keys), L, D, K),
+        "wo": w(next(keys), L, H, D),
+        "bq": jnp.zeros((L, H), dt) if spec.use_bias else None,
+        "bk": jnp.zeros((L, K), dt) if spec.use_bias else None,
+        "bv": jnp.zeros((L, K), dt) if spec.use_bias else None,
+        "bo": jnp.zeros((L, D), dt) if spec.use_bias else None,
+        "mlp_norm_w": jnp.ones((L, D), dt),
+        "mlp_norm_b": jnp.zeros((L, D), dt) if spec.norm == "layernorm" else None,
+    }
+    if spec.is_moe:
+        blocks.update(
+            router=w(next(keys), L, D, E),
+            moe_w_gate=w(next(keys), L, E, D, F, fan_in=D),
+            moe_w_up=w(next(keys), L, E, D, F, fan_in=D),
+            moe_w_down=w(next(keys), L, E, F, D, fan_in=F),
+        )
+    else:
+        blocks.update(
+            w_gate=w(next(keys), L, D, F) if spec.act == "swiglu" else None,
+            w_up=w(next(keys), L, D, F),
+            w_down=w(next(keys), L, F, D),
+            b_up=jnp.zeros((L, F), dt) if spec.use_bias else None,
+            b_down=jnp.zeros((L, D), dt) if spec.use_bias else None,
+        )
+
+    params: Params = {
+        "tok_emb": w(next(keys), V, D, fan_in=D),
+        "pos_emb": w(next(keys), spec.max_seq, D, fan_in=D) if spec.pos == "learned" else None,
+        "final_norm_w": jnp.ones((D,), dt),
+        "final_norm_b": jnp.zeros((D,), dt) if spec.norm == "layernorm" else None,
+        "lm_head": None if spec.tied_lm_head else w(next(keys), D, V),
+        "blocks": blocks,
+    }
+    return params
+
+
+def param_count(params: Params) -> int:
+    return sum(
+        x.size for x in jax.tree.leaves(params) if hasattr(x, "size")
+    )

@@ -1,0 +1,134 @@
+"""ModelSpec: one dataclass describes every supported decoder-only family.
+
+Presets cover the models named in BASELINE.json's configs. Architecture
+hyperparameters match the public model cards; weights are randomly
+initialized unless a local checkpoint is provided (see
+quorum_tpu.models.hf_loader) — the framework's job is serving mechanics and
+performance, which depend on architecture, not on particular weight values.
+
+``tpu://<model-id>?key=value&...`` URLs resolve through :func:`resolve_spec`:
+the model id picks a preset and query parameters override any field, so tests
+and operators can scale any family down (e.g. ``tpu://llama-tiny?n_layers=2``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass
+
+
+@dataclass(frozen=True)
+class ModelSpec:
+    family: str = "llama"          # "gpt2" | "llama" | "mixtral"
+    vocab_size: int = 32000
+    d_model: int = 4096
+    n_layers: int = 32
+    n_heads: int = 32
+    n_kv_heads: int = 8
+    head_dim: int = 128
+    d_ff: int = 14336
+    max_seq: int = 4096
+    norm: str = "rmsnorm"          # "rmsnorm" | "layernorm"
+    norm_eps: float = 1e-5
+    pos: str = "rope"              # "rope" | "learned"
+    rope_theta: float = 10000.0
+    act: str = "swiglu"            # "swiglu" | "gelu"
+    use_bias: bool = False         # attention/MLP biases (gpt2, qwen2-qkv)
+    tied_lm_head: bool = True
+    n_experts: int = 0             # 0 = dense
+    experts_per_token: int = 2
+    dtype: str = "bfloat16"
+
+    @property
+    def is_moe(self) -> bool:
+        return self.n_experts > 0
+
+    def validate(self) -> "ModelSpec":
+        assert self.n_heads % self.n_kv_heads == 0, "n_heads must divide by n_kv_heads"
+        assert self.head_dim % 2 == 0, "RoPE needs even head_dim"
+        assert self.act in ("swiglu", "gelu")
+        assert self.norm in ("rmsnorm", "layernorm")
+        assert self.pos in ("rope", "learned")
+        return self
+
+
+def _gpt2(**kw) -> ModelSpec:
+    base = dict(
+        family="gpt2", vocab_size=50257, d_model=768, n_layers=12, n_heads=12,
+        n_kv_heads=12, head_dim=64, d_ff=3072, max_seq=1024, norm="layernorm",
+        pos="learned", act="gelu", use_bias=True, tied_lm_head=True,
+    )
+    base.update(kw)
+    return ModelSpec(**base)
+
+
+MODEL_PRESETS: dict[str, ModelSpec] = {
+    # BASELINE.json config[0]: GPT-2-124M CPU-runnable reference model
+    "gpt2": _gpt2(),
+    "gpt2-medium": _gpt2(d_model=1024, n_layers=24, n_heads=16, n_kv_heads=16, d_ff=4096),
+    # BASELINE.json configs 2-3: 7-8B dense models
+    "llama-3-8b": ModelSpec(
+        family="llama", vocab_size=128256, d_model=4096, n_layers=32, n_heads=32,
+        n_kv_heads=8, head_dim=128, d_ff=14336, max_seq=8192, rope_theta=500000.0,
+        tied_lm_head=False,
+    ),
+    "mistral-7b": ModelSpec(
+        family="llama", vocab_size=32000, d_model=4096, n_layers=32, n_heads=32,
+        n_kv_heads=8, head_dim=128, d_ff=14336, max_seq=8192, rope_theta=1000000.0,
+        tied_lm_head=False,
+    ),
+    "gemma-7b": ModelSpec(
+        family="llama", vocab_size=256000, d_model=3072, n_layers=28, n_heads=16,
+        n_kv_heads=16, head_dim=256, d_ff=24576, max_seq=8192, act="gelu",
+        tied_lm_head=True,
+    ),
+    # BASELINE.json config[3]: DeepSeek-R1-Distill-Qwen-7B (qwen2 arch, qkv bias)
+    "deepseek-r1-distill-7b": ModelSpec(
+        family="llama", vocab_size=152064, d_model=3584, n_layers=28, n_heads=28,
+        n_kv_heads=4, head_dim=128, d_ff=18944, max_seq=8192, rope_theta=10000.0,
+        use_bias=True, tied_lm_head=False,
+    ),
+    # BASELINE.json config[4]: Mixtral-8x7B MoE
+    "mixtral-8x7b": ModelSpec(
+        family="mixtral", vocab_size=32000, d_model=4096, n_layers=32, n_heads=32,
+        n_kv_heads=8, head_dim=128, d_ff=14336, max_seq=8192, rope_theta=1000000.0,
+        n_experts=8, experts_per_token=2, tied_lm_head=False,
+    ),
+    # Scaled-down test/dev presets (CPU-fast, same code paths)
+    "gpt2-tiny": _gpt2(vocab_size=512, d_model=64, n_layers=2, n_heads=4,
+                       n_kv_heads=4, head_dim=16, d_ff=128, max_seq=128),
+    "llama-tiny": ModelSpec(
+        family="llama", vocab_size=512, d_model=64, n_layers=2, n_heads=4,
+        n_kv_heads=2, head_dim=16, d_ff=128, max_seq=128, tied_lm_head=False,
+    ),
+    "mixtral-tiny": ModelSpec(
+        family="mixtral", vocab_size=512, d_model=64, n_layers=2, n_heads=4,
+        n_kv_heads=2, head_dim=16, d_ff=128, max_seq=128, n_experts=4,
+        experts_per_token=2, tied_lm_head=False,
+    ),
+}
+
+_FIELD_TYPES = {f.name: f.type for f in dataclasses.fields(ModelSpec)}
+
+
+def resolve_spec(model_id: str, options: dict[str, str] | None = None) -> ModelSpec:
+    """Preset lookup + query-string overrides (``tpu://`` URL semantics)."""
+    spec = MODEL_PRESETS.get(model_id)
+    if spec is None:
+        raise KeyError(
+            f"Unknown tpu:// model id {model_id!r}; known: {sorted(MODEL_PRESETS)}"
+        )
+    overrides: dict[str, object] = {}
+    for k, v in (options or {}).items():
+        if k not in _FIELD_TYPES:
+            continue  # engine-level options (e.g. tp=, batch=) are handled upstream
+        t = _FIELD_TYPES[k]
+        if t in ("int", int):
+            overrides[k] = int(v)
+        elif t in ("float", float):
+            overrides[k] = float(v)
+        elif t in ("bool", bool):
+            overrides[k] = v.lower() in ("1", "true", "yes")
+        else:
+            overrides[k] = v
+    return dataclasses.replace(spec, **overrides).validate()

@@ -1,0 +1,268 @@
+"""Decoder-only transformer forward: pure functions over a parameter pytree.
+
+TPU-first design choices (SURVEY.md §7):
+
+  - **Scanned layers**: all per-layer weights are stacked with a leading
+    ``n_layers`` dim and the depth loop is one ``lax.scan`` — compile time and
+    HLO size are O(1) in depth, and XLA pipelines the layers.
+  - **Static shapes everywhere**: prompts are right-padded to a bucket length
+    and masked by ``lengths``; the KV cache is a preallocated ``max_seq``
+    buffer indexed by position *data*. One compiled program per (batch,
+    bucket) serves every request.
+  - **bf16 activations/weights, f32 softmax & norms**; matmuls request
+    ``preferred_element_type=float32`` so the MXU accumulates in f32.
+  - **GQA without repeat_kv copies** (see quorum_tpu.ops.attention).
+  - **MoE as dense einsum over an ``experts`` axis** sharded on the tp/ep mesh
+    axis: every expert's matmul is an MXU-shaped contraction; the top-k gate
+    only weights the combine. No gather/scatter in the hot path.
+
+Parameter pytree layout (leaf names are what the sharding table in
+quorum_tpu.parallel.sharding keys on):
+
+  tok_emb [V, D] · pos_emb [max_seq, D]? · final_norm_w/b [D] · lm_head [D, V]?
+  blocks: attn_norm_w/b [L,D] · wq [L,D,H·hd] · wk/wv [L,D,K·hd] · wo [L,H·hd,D]
+          bq/bk/bv/bo? · mlp_norm_w/b [L,D]
+          dense: w_gate? w_up [L,D,F] · w_down [L,F,D] · b_up/b_down?
+          moe:   router [L,D,E] · moe_w_gate/up [L,E,D,F] · moe_w_down [L,E,F,D]
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from quorum_tpu.models.model_config import ModelSpec
+from quorum_tpu.ops.attention import attention, causal_mask, decode_attention
+from quorum_tpu.ops.norms import layernorm, rmsnorm
+from quorum_tpu.ops.rotary import apply_rope, rope_cos_sin
+
+Params = dict[str, Any]
+
+
+def _norm(x, w, b, spec: ModelSpec):
+    if spec.norm == "rmsnorm":
+        return rmsnorm(x, w, spec.norm_eps)
+    return layernorm(x, w, b, spec.norm_eps)
+
+
+def _maybe(block: Params, name: str, layer_slice):
+    v = block.get(name)
+    return None if v is None else layer_slice(v)
+
+
+def _dense_mlp(x, block, spec: ModelSpec):
+    if spec.act == "swiglu":
+        gate = jnp.einsum("btd,df->btf", x, block["w_gate"],
+                          preferred_element_type=jnp.float32)
+        up = jnp.einsum("btd,df->btf", x, block["w_up"],
+                        preferred_element_type=jnp.float32)
+        h = (jax.nn.silu(gate) * up).astype(x.dtype)
+    else:
+        up = jnp.einsum("btd,df->btf", x, block["w_up"],
+                        preferred_element_type=jnp.float32)
+        if block.get("b_up") is not None:
+            up = up + block["b_up"]
+        h = jax.nn.gelu(up, approximate=True).astype(x.dtype)
+    out = jnp.einsum("btf,fd->btd", h, block["w_down"],
+                     preferred_element_type=jnp.float32)
+    if block.get("b_down") is not None:
+        out = out + block["b_down"]
+    return out.astype(x.dtype)
+
+
+def _moe_mlp(x, block, spec: ModelSpec):
+    """Mixtral-style top-k MoE, computed densely over a sharded experts axis.
+
+    Router softmax is over the selected top-k logits (Mixtral convention).
+    The combine weight tensor [B,T,E] is zero outside the top-k, so the
+    einsum-combine reproduces sparse routing exactly while every expert
+    matmul stays a static MXU contraction (expert-parallel over tp).
+    """
+    router_logits = jnp.einsum("btd,de->bte", x, block["router"],
+                               preferred_element_type=jnp.float32)
+    top_vals, top_idx = lax.top_k(router_logits, spec.experts_per_token)
+    top_probs = jax.nn.softmax(top_vals, axis=-1)  # [B,T,k]
+    # scatter top-k probs back to a dense [B,T,E] combine weight
+    one_hot = jax.nn.one_hot(top_idx, spec.n_experts, dtype=top_probs.dtype)
+    combine = jnp.einsum("btk,btke->bte", top_probs, one_hot)
+
+    gate = jnp.einsum("btd,edf->ebtf", x, block["moe_w_gate"],
+                      preferred_element_type=jnp.float32)
+    up = jnp.einsum("btd,edf->ebtf", x, block["moe_w_up"],
+                    preferred_element_type=jnp.float32)
+    h = (jax.nn.silu(gate) * up).astype(x.dtype)
+    expert_out = jnp.einsum("ebtf,efd->ebtd", h, block["moe_w_down"],
+                            preferred_element_type=jnp.float32)
+    out = jnp.einsum("bte,ebtd->btd", combine.astype(expert_out.dtype), expert_out)
+    return out.astype(x.dtype)
+
+
+def _qkv(x, block, spec: ModelSpec):
+    """Project to q [B,H,T,hd], k/v [B,K,T,hd]."""
+    b, t, _ = x.shape
+    q = jnp.einsum("btd,dh->bth", x, block["wq"], preferred_element_type=jnp.float32)
+    k = jnp.einsum("btd,dh->bth", x, block["wk"], preferred_element_type=jnp.float32)
+    v = jnp.einsum("btd,dh->bth", x, block["wv"], preferred_element_type=jnp.float32)
+    if block.get("bq") is not None:
+        q, k, v = q + block["bq"], k + block["bk"], v + block["bv"]
+    q = q.astype(x.dtype).reshape(b, t, spec.n_heads, spec.head_dim).transpose(0, 2, 1, 3)
+    k = k.astype(x.dtype).reshape(b, t, spec.n_kv_heads, spec.head_dim).transpose(0, 2, 1, 3)
+    v = v.astype(x.dtype).reshape(b, t, spec.n_kv_heads, spec.head_dim).transpose(0, 2, 1, 3)
+    return q, k, v
+
+
+def _attn_out(attn, block, x_dtype):
+    b, h, t, d = attn.shape
+    merged = attn.transpose(0, 2, 1, 3).reshape(b, t, h * d)
+    out = jnp.einsum("bth,hd->btd", merged, block["wo"],
+                     preferred_element_type=jnp.float32)
+    if block.get("bo") is not None:
+        out = out + block["bo"]
+    return out.astype(x_dtype)
+
+
+def _embed(params, spec: ModelSpec, tokens, positions):
+    x = params["tok_emb"][tokens].astype(jnp.dtype(spec.dtype))
+    if spec.pos == "learned":
+        x = x + params["pos_emb"][positions][None, :, :].astype(x.dtype)
+    return x
+
+
+def _unembed(params, spec: ModelSpec, x):
+    w = params.get("lm_head")
+    if w is None:  # tied
+        w = params["tok_emb"].T
+    return jnp.einsum("...d,dv->...v", x, w, preferred_element_type=jnp.float32)
+
+
+def _final_norm(params, spec: ModelSpec, x):
+    return _norm(x, params["final_norm_w"], params.get("final_norm_b"), spec)
+
+
+def prefill(
+    params: Params,
+    spec: ModelSpec,
+    tokens: jnp.ndarray,   # [B, T] right-padded
+    lengths: jnp.ndarray,  # [B] true prompt lengths
+    cache_k: jnp.ndarray,  # [L, B, K, max_seq, hd]
+    cache_v: jnp.ndarray,
+    remat: bool = False,
+):
+    """Process the full prompt; returns (last-token logits [B,V], cache_k, cache_v)."""
+    b, t = tokens.shape
+    positions = jnp.arange(t)
+    x = _embed(params, spec, tokens, positions)
+    cos, sin = rope_cos_sin(spec.max_seq, spec.head_dim, spec.rope_theta)
+    mask = causal_mask(t, t) & (jnp.arange(t)[None, :] < lengths[:, None])[:, None, None, None, :]
+
+    def body(carry_x, per_layer):
+        block, ck, cv = per_layer  # ck/cv: [B, K, max_seq, hd]
+        h = _norm(carry_x, block["attn_norm_w"], block.get("attn_norm_b"), spec)
+        q, k, v = _qkv(h, block, spec)
+        if spec.pos == "rope":
+            q = apply_rope(q, cos, sin, positions)
+            k = apply_rope(k, cos, sin, positions)
+        attn = attention(q, k, v, mask)
+        carry_x = carry_x + _attn_out(attn, block, carry_x.dtype)
+        h2 = _norm(carry_x, block["mlp_norm_w"], block.get("mlp_norm_b"), spec)
+        mlp = _moe_mlp(h2, block, spec) if spec.is_moe else _dense_mlp(h2, block, spec)
+        carry_x = carry_x + mlp
+        new_ck = lax.dynamic_update_slice(ck, k.astype(ck.dtype), (0, 0, 0, 0))
+        new_cv = lax.dynamic_update_slice(cv, v.astype(cv.dtype), (0, 0, 0, 0))
+        return carry_x, (new_ck, new_cv)
+
+    if remat:
+        body = jax.checkpoint(body)
+    x, (cache_k, cache_v) = lax.scan(body, x, (params["blocks"], cache_k, cache_v))
+    x = _final_norm(params, spec, x)
+    # Only the last real token's logits matter for generation; gather per row.
+    last = jnp.take_along_axis(x, (lengths - 1)[:, None, None], axis=1)[:, 0, :]
+    return _unembed(params, spec, last), cache_k, cache_v
+
+
+def decode_step(
+    params: Params,
+    spec: ModelSpec,
+    token: jnp.ndarray,    # [B] current token ids
+    lengths: jnp.ndarray,  # [B] #tokens already in cache (current token's position)
+    cache_k: jnp.ndarray,  # [L, B, K, max_seq, hd] (donated by the engine's jit)
+    cache_v: jnp.ndarray,
+):
+    """One autoregressive step. Returns (logits [B,V], cache_k, cache_v)."""
+    b = token.shape[0]
+    x = params["tok_emb"][token][:, None, :].astype(jnp.dtype(spec.dtype))  # [B,1,D]
+    if spec.pos == "learned":
+        x = x + params["pos_emb"][lengths][:, None, :].astype(x.dtype)
+    cos, sin = rope_cos_sin(spec.max_seq, spec.head_dim, spec.rope_theta)
+
+    def write_row(cache_row, new_row, idx):
+        # cache_row [K, max_seq, hd], new_row [K, 1, hd]
+        return lax.dynamic_update_slice(cache_row, new_row, (0, idx, 0))
+
+    write = jax.vmap(write_row)  # over batch
+
+    def body(carry_x, per_layer):
+        block, ck, cv = per_layer
+        h = _norm(carry_x, block["attn_norm_w"], block.get("attn_norm_b"), spec)
+        q, k, v = _qkv(h, block, spec)  # q [B,H,1,hd], k/v [B,K,1,hd]
+        if spec.pos == "rope":
+            # per-row positions: vmap the table gather over the batch
+            rope_row = jax.vmap(lambda xr, p: apply_rope(xr[None], cos, sin, p[None])[0])
+            q = rope_row(q, lengths)
+            k = rope_row(k, lengths)
+        new_ck = write(ck, k.astype(ck.dtype), lengths)
+        new_cv = write(cv, v.astype(cv.dtype), lengths)
+        attn = decode_attention(q, new_ck, new_cv, lengths + 1)
+        carry_x = carry_x + _attn_out(attn, block, carry_x.dtype)
+        h2 = _norm(carry_x, block["mlp_norm_w"], block.get("mlp_norm_b"), spec)
+        mlp = _moe_mlp(h2, block, spec) if spec.is_moe else _dense_mlp(h2, block, spec)
+        carry_x = carry_x + mlp
+        return carry_x, (new_ck, new_cv)
+
+    x, (cache_k, cache_v) = lax.scan(body, x, (params["blocks"], cache_k, cache_v))
+    x = _final_norm(params, spec, x)
+    return _unembed(params, spec, x[:, 0, :]), cache_k, cache_v
+
+
+def forward_logits(
+    params: Params,
+    spec: ModelSpec,
+    tokens: jnp.ndarray,  # [B, T]
+    remat: bool = False,
+) -> jnp.ndarray:
+    """Full-sequence logits [B, T, V] — the training-step / eval forward
+    (no KV cache; used by the multi-chip dry run's loss+grad and by tests
+    that check prefill/decode consistency against a cache-free ground truth)."""
+    b, t = tokens.shape
+    positions = jnp.arange(t)
+    x = _embed(params, spec, tokens, positions)
+    cos, sin = rope_cos_sin(spec.max_seq, spec.head_dim, spec.rope_theta)
+    mask = causal_mask(t, t)
+
+    def body(carry_x, block):
+        h = _norm(carry_x, block["attn_norm_w"], block.get("attn_norm_b"), spec)
+        q, k, v = _qkv(h, block, spec)
+        if spec.pos == "rope":
+            q = apply_rope(q, cos, sin, positions)
+            k = apply_rope(k, cos, sin, positions)
+        attn = attention(q, k, v, mask)
+        carry_x = carry_x + _attn_out(attn, block, carry_x.dtype)
+        h2 = _norm(carry_x, block["mlp_norm_w"], block.get("mlp_norm_b"), spec)
+        mlp = _moe_mlp(h2, block, spec) if spec.is_moe else _dense_mlp(h2, block, spec)
+        return carry_x + mlp, None
+
+    if remat:
+        body = jax.checkpoint(body)
+    x, _ = lax.scan(body, x, params["blocks"])
+    x = _final_norm(params, spec, x)
+    return _unembed(params, spec, x)
+
+
+def init_cache(spec: ModelSpec, batch: int, dtype=None):
+    """Preallocated KV cache: [L, B, K, max_seq, hd] × 2."""
+    dt = jnp.dtype(dtype or spec.dtype)
+    shape = (spec.n_layers, batch, spec.n_kv_heads, spec.max_seq, spec.head_dim)
+    return jnp.zeros(shape, dt), jnp.zeros(shape, dt)

@@ -79,14 +79,11 @@ def final_chunk(content: str, *, model: str) -> dict[str, Any]:
     )
 
 
-def stop_chunk(model: str, id: str) -> dict[str, Any]:
-    return chunk(id=id, model=model, delta={}, finish_reason="stop")
-
-
 def error_chunk(message: str, *, model: str) -> dict[str, Any]:
-    # Parity with the all-backends-failed SSE error chunk (oai_proxy.py:864-881).
+    # The all-backends-failed / mid-stream-failure SSE chunk: id "error",
+    # finish_reason "error" (contract asserted by the streaming tests).
     return chunk(
-        id=PARALLEL_FINAL_ID,
+        id="error",
         model=model,
         delta={"content": message},
         finish_reason="error",

@@ -1,0 +1,20 @@
+"""TPU-friendly primitive ops for the model runtime.
+
+Everything here is shape-static, jit-traceable, and written so XLA can fuse
+elementwise work into the surrounding matmuls (MXU) — see SURVEY.md §7.
+"""
+
+from quorum_tpu.ops.norms import layernorm, rmsnorm
+from quorum_tpu.ops.rotary import apply_rope, rope_cos_sin
+from quorum_tpu.ops.attention import attention, decode_attention
+from quorum_tpu.ops.sampling import sample_token
+
+__all__ = [
+    "layernorm",
+    "rmsnorm",
+    "apply_rope",
+    "rope_cos_sin",
+    "attention",
+    "decode_attention",
+    "sample_token",
+]

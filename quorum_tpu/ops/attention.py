@@ -1,0 +1,81 @@
+"""Causal attention with grouped-query (GQA) support, XLA-native reference path.
+
+Layout: q [B, H, S, hd]; k/v [B, K, S_kv, hd] with H = K * G query groups.
+GQA is expressed by reshaping q to [B, K, G, S, hd] and contracting against
+the shared K/V heads — no materialized repeat_kv copies (which would burn HBM
+bandwidth); the grouping lives in the einsum and XLA tiles it onto the MXU.
+
+Softmax runs in float32 regardless of activation dtype. The Pallas
+flash-attention kernel (quorum_tpu.ops.flash_attention) replaces the prefill
+path on real TPUs; this module is the always-available fallback and the
+numerical ground truth the kernel is tested against.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+NEG_INF = -2.0**30  # large-but-finite: keeps masked softmax NaN-free in bf16/f32
+
+
+def _group_heads(q: jnp.ndarray, n_kv: int) -> jnp.ndarray:
+    b, h, s, d = q.shape
+    return q.reshape(b, n_kv, h // n_kv, s, d)
+
+
+def attention(
+    q: jnp.ndarray,  # [B, H, S, hd]
+    k: jnp.ndarray,  # [B, K, S_kv, hd]
+    v: jnp.ndarray,  # [B, K, S_kv, hd]
+    mask: jnp.ndarray | None = None,  # broadcastable to [B, 1, 1, S, S_kv], bool (True=keep)
+) -> jnp.ndarray:
+    """Full attention over the given K/V. Returns [B, H, S, hd]."""
+    n_kv = k.shape[1]
+    qg = _group_heads(q, n_kv)  # [B, K, G, S, hd]
+    scale = q.shape[-1] ** -0.5
+    logits = jnp.einsum(
+        "bkgsd,bktd->bkgst", qg, k, preferred_element_type=jnp.float32
+    ) * scale
+    if mask is not None:
+        logits = jnp.where(mask, logits, NEG_INF)
+    probs = jnp.exp(logits - jnp.max(logits, axis=-1, keepdims=True))
+    probs = probs / jnp.sum(probs, axis=-1, keepdims=True)
+    out = jnp.einsum("bkgst,bktd->bkgsd", probs.astype(v.dtype), v)
+    b, k_, g, s, d = out.shape
+    return out.reshape(b, k_ * g, s, d)
+
+
+def causal_mask(s_q: int, s_kv: int, q_offset: jnp.ndarray | int = 0) -> jnp.ndarray:
+    """[1, 1, 1, s_q, s_kv] boolean causal mask; query i sits at absolute
+    position q_offset + i."""
+    qi = jnp.arange(s_q)[:, None] + q_offset
+    ki = jnp.arange(s_kv)[None, :]
+    return (ki <= qi)[None, None, None, :, :]
+
+
+def prefill_attention(q, k, v, lengths: jnp.ndarray | None = None) -> jnp.ndarray:
+    """Causal self-attention over a [B, ·, S, hd] prompt block.
+
+    ``lengths`` ([B]) masks out right-padding so batched prompts of unequal
+    length share one compiled program (static shapes — SURVEY.md §7).
+    """
+    mask = causal_mask(q.shape[2], k.shape[2])
+    if lengths is not None:
+        valid = (jnp.arange(k.shape[2])[None, :] < lengths[:, None])  # [B, S_kv]
+        mask = mask & valid[:, None, None, None, :]
+    return attention(q, k, v, mask)
+
+
+def decode_attention(
+    q: jnp.ndarray,  # [B, H, 1, hd]
+    k_cache: jnp.ndarray,  # [B, K, max_seq, hd]
+    v_cache: jnp.ndarray,
+    length: jnp.ndarray,  # [B] or scalar: #valid cache entries (incl. current token)
+) -> jnp.ndarray:
+    """One decode step against the KV cache (static max_seq, masked by length)."""
+    length = jnp.asarray(length)
+    if length.ndim == 0:
+        length = length[None]
+    valid = jnp.arange(k_cache.shape[2])[None, :] < length[:, None]  # [B, max_seq]
+    mask = valid[:, None, None, None, :]
+    return attention(q, k_cache, v_cache, mask)

@@ -1,0 +1,24 @@
+"""Normalization layers. Computed in float32, cast back to the input dtype —
+the standard mixed-precision discipline for bf16 activations on TPU."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def rmsnorm(x: jnp.ndarray, w: jnp.ndarray, eps: float = 1e-5) -> jnp.ndarray:
+    """RMSNorm (Llama/Mistral family)."""
+    xf = x.astype(jnp.float32)
+    scale = jnp.reciprocal(jnp.sqrt(jnp.mean(xf * xf, axis=-1, keepdims=True) + eps))
+    return (xf * scale).astype(x.dtype) * w
+
+
+def layernorm(
+    x: jnp.ndarray, w: jnp.ndarray, b: jnp.ndarray | None, eps: float = 1e-5
+) -> jnp.ndarray:
+    """LayerNorm (GPT-2 family)."""
+    xf = x.astype(jnp.float32)
+    mu = jnp.mean(xf, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(xf - mu), axis=-1, keepdims=True)
+    y = ((xf - mu) * jnp.reciprocal(jnp.sqrt(var + eps))).astype(x.dtype) * w
+    return y + b if b is not None else y

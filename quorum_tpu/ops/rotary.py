@@ -1,0 +1,36 @@
+"""Rotary position embeddings (RoPE), precomputed-table style.
+
+The cos/sin tables are computed once per model (static max_seq) and gathered
+by position inside jit — no trig in the decode hot loop, and positions are
+data (not shapes), so one compiled program serves every request length.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def rope_cos_sin(
+    max_seq: int, head_dim: int, theta: float = 10000.0
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Tables of shape [max_seq, head_dim//2] in float32."""
+    inv_freq = 1.0 / (theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
+    t = jnp.arange(max_seq, dtype=jnp.float32)
+    freqs = jnp.outer(t, inv_freq)
+    return jnp.cos(freqs), jnp.sin(freqs)
+
+
+def apply_rope(
+    x: jnp.ndarray,  # [..., seq, head_dim]
+    cos: jnp.ndarray,  # [max_seq, head_dim//2]
+    sin: jnp.ndarray,
+    positions: jnp.ndarray,  # [seq] absolute positions
+) -> jnp.ndarray:
+    """Rotate pairs (x[..., :d/2], x[..., d/2:]) — the Llama/NeoX convention."""
+    c = cos[positions][None, None, :, :]  # [1, 1, seq, d/2]
+    s = sin[positions][None, None, :, :]
+    d2 = x.shape[-1] // 2
+    x1, x2 = x[..., :d2], x[..., d2:]
+    xf1, xf2 = x1.astype(jnp.float32), x2.astype(jnp.float32)
+    out = jnp.concatenate([xf1 * c - xf2 * s, xf2 * c + xf1 * s], axis=-1)
+    return out.astype(x.dtype)

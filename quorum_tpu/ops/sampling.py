@@ -1,0 +1,53 @@
+"""On-device token sampling: greedy, temperature, top-k, top-p.
+
+All branches are trace-time-static (the sampler config is Python), so each
+configuration compiles to one fixed XLA program — no data-dependent control
+flow in the decode loop.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclass(frozen=True)
+class SamplerConfig:
+    temperature: float = 1.0
+    top_k: int = 0        # 0 = disabled
+    top_p: float = 1.0    # 1.0 = disabled
+
+    @property
+    def greedy(self) -> bool:
+        return self.temperature <= 0.0
+
+
+def sample_token(
+    logits: jnp.ndarray,  # [B, V] float
+    key: jax.Array,
+    cfg: SamplerConfig,
+) -> jnp.ndarray:
+    """Returns [B] int32 token ids."""
+    logits = logits.astype(jnp.float32)
+    if cfg.greedy:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+    logits = logits / cfg.temperature
+
+    if cfg.top_k > 0 and cfg.top_k < logits.shape[-1]:
+        kth = jax.lax.top_k(logits, cfg.top_k)[0][..., -1:]
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+
+    if cfg.top_p < 1.0:
+        sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        # keep the smallest prefix with cumulative prob >= top_p (always >= 1 token)
+        keep_sorted = cum - probs < cfg.top_p
+        kth = jnp.sum(keep_sorted, axis=-1, keepdims=True)  # #kept per row
+        cutoff = jnp.take_along_axis(sorted_logits, kth - 1, axis=-1)
+        logits = jnp.where(logits < cutoff, -jnp.inf, logits)
+
+    return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)

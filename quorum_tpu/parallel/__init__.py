@@ -1,0 +1,25 @@
+"""Device-mesh and sharding utilities (TPU-first, GSPMD).
+
+The reference has no distributed-ML parallelism at all (SURVEY.md §2.9 —
+its only "parallelism" is asyncio request fan-out). This package is the
+TPU-native substrate the new framework's model runtime is built on: a named
+:class:`jax.sharding.Mesh` over the slice, PartitionSpec rules for model
+parameters / activations / KV caches, and helpers shared by the engine,
+the ring-attention path, and the multi-chip dry run.
+"""
+
+from quorum_tpu.parallel.mesh import MeshConfig, best_mesh, make_mesh
+from quorum_tpu.parallel.sharding import (
+    logical_to_sharding,
+    param_partition_specs,
+    shard_pytree,
+)
+
+__all__ = [
+    "MeshConfig",
+    "best_mesh",
+    "make_mesh",
+    "logical_to_sharding",
+    "param_partition_specs",
+    "shard_pytree",
+]

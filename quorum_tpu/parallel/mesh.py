@@ -1,0 +1,74 @@
+"""Mesh construction over the TPU slice.
+
+Axis conventions (used consistently across the framework):
+
+  ``dp``  data parallel      — batch dimension of activations and KV caches
+  ``sp``  sequence parallel  — sequence blocks for ring attention / long context
+  ``tp``  tensor parallel    — attention heads, MLP hidden, vocab shards;
+                               doubles as ``ep`` (expert parallel) for MoE —
+                               experts are sharded over the same axis so dense
+                               and MoE layers share one mesh.
+
+All communication happens as XLA collectives over these axes (psum /
+all_gather / ppermute inserted by GSPMD or written explicitly in shard_map),
+riding ICI within a slice. There is no NCCL/MPI analog to port — the
+reference's only communication backend is HTTP (SURVEY.md §5.8).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+AXIS_DP = "dp"
+AXIS_SP = "sp"
+AXIS_TP = "tp"
+MESH_AXES = (AXIS_DP, AXIS_SP, AXIS_TP)
+
+
+@dataclass(frozen=True)
+class MeshConfig:
+    """Requested mesh shape. Any axis left at 1 is effectively disabled."""
+
+    dp: int = 1
+    sp: int = 1
+    tp: int = 1
+
+    @property
+    def n_devices(self) -> int:
+        return self.dp * self.sp * self.tp
+
+
+def make_mesh(cfg: MeshConfig | None = None, devices=None) -> Mesh:
+    """Build a ``(dp, sp, tp)`` mesh over ``devices`` (default: all local).
+
+    The tp axis is placed innermost so tensor-parallel collectives (the
+    highest-traffic ones: all-reduce after attention/MLP) map onto
+    nearest-neighbour ICI links.
+    """
+    if devices is None:
+        devices = jax.devices()
+    cfg = cfg or MeshConfig(tp=len(devices))
+    if cfg.n_devices > len(devices):
+        raise ValueError(
+            f"mesh {cfg} needs {cfg.n_devices} devices, have {len(devices)}"
+        )
+    arr = np.asarray(devices[: cfg.n_devices]).reshape(cfg.dp, cfg.sp, cfg.tp)
+    return Mesh(arr, MESH_AXES)
+
+
+def best_mesh(n_devices: int | None = None, *, want_dp: bool = False) -> Mesh:
+    """A sensible default mesh: all devices on tp, or split dp×tp if asked."""
+    devices = jax.devices()
+    n = len(devices) if n_devices is None else n_devices
+    if want_dp and n % 2 == 0 and n > 1:
+        return make_mesh(MeshConfig(dp=2, tp=n // 2), devices)
+    return make_mesh(MeshConfig(tp=n), devices)
+
+
+def single_device_mesh() -> Mesh:
+    """A 1×1×1 mesh — lets all code paths be mesh-agnostic."""
+    return make_mesh(MeshConfig(), jax.devices()[:1])

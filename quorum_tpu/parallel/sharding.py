@@ -1,0 +1,156 @@
+"""Logical-axis → PartitionSpec rules for model state.
+
+The scaling-book recipe: name the logical axes of every array once, map
+logical axes to mesh axes in one table, and let GSPMD insert collectives.
+Megatron-style tensor parallelism falls out of two rules:
+
+  - project *into* parallel subspaces (heads, MLP hidden, experts, vocab)
+    with the output dimension sharded over ``tp``  → no communication;
+  - project *back* to the model dimension with the input dimension sharded
+    over ``tp`` → one psum (all-reduce) per block, inserted by XLA.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Mapping
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from quorum_tpu.parallel.mesh import AXIS_DP, AXIS_SP, AXIS_TP
+
+# Logical axis name → mesh axis (None = replicated).
+LOGICAL_RULES: dict[str, str | None] = {
+    "batch": AXIS_DP,
+    "seq": None,          # sequence is replicated except under ring attention
+    "seq_shard": AXIS_SP,  # ring attention: sequence blocks over the sp axis
+    "model": None,         # d_model stays replicated (activations all-reduced)
+    "heads": AXIS_TP,
+    "kv_heads": AXIS_TP,
+    "head_dim": None,
+    "ff": AXIS_TP,         # MLP hidden
+    "experts": AXIS_TP,    # expert parallelism shares the tp axis
+    "vocab": AXIS_TP,
+    "layers": None,        # scanned-layer leading dim
+    "pos": None,
+}
+
+
+def logical_to_spec(axes: tuple[str | None, ...]) -> P:
+    """``("layers", "model", "ff")`` → ``P(None, None, "tp")``."""
+    return P(*(LOGICAL_RULES.get(a) if a else None for a in axes))
+
+
+def logical_to_sharding(mesh: Mesh, axes: tuple[str | None, ...]) -> NamedSharding:
+    return NamedSharding(mesh, logical_to_spec(axes))
+
+
+# Logical axes for every parameter leaf the transformer uses
+# (see quorum_tpu.models.transformer for the pytree layout).
+PARAM_LOGICAL_AXES: dict[str, tuple[str | None, ...]] = {
+    # embeddings
+    "tok_emb": ("vocab", "model"),
+    "pos_emb": ("pos", "model"),
+    "lm_head": ("model", "vocab"),
+    "final_norm_w": ("model",),
+    "final_norm_b": ("model",),
+    # per-layer (leading "layers" dim — scanned)
+    "attn_norm_w": ("layers", "model"),
+    "attn_norm_b": ("layers", "model"),
+    "wq": ("layers", "model", "heads"),
+    "wk": ("layers", "model", "kv_heads"),
+    "wv": ("layers", "model", "kv_heads"),
+    "wo": ("layers", "heads", "model"),
+    "bq": ("layers", "heads"),
+    "bk": ("layers", "kv_heads"),
+    "bv": ("layers", "kv_heads"),
+    "bo": ("layers", "model"),
+    "mlp_norm_w": ("layers", "model"),
+    "mlp_norm_b": ("layers", "model"),
+    "w_gate": ("layers", "model", "ff"),
+    "w_up": ("layers", "model", "ff"),
+    "w_down": ("layers", "ff", "model"),
+    "b_up": ("layers", "ff"),
+    "b_down": ("layers", "model"),
+    # MoE
+    "router": ("layers", "model", "experts"),
+    "moe_w_gate": ("layers", "experts", "model", None),
+    "moe_w_up": ("layers", "experts", "model", None),
+    "moe_w_down": ("layers", "experts", None, "model"),
+}
+
+# KV cache: [layers, batch, kv_heads, max_seq, head_dim]
+KV_CACHE_AXES: tuple[str | None, ...] = ("layers", "batch", "kv_heads", "seq", "head_dim")
+
+
+def kv_cache_sharding(mesh: Mesh, n_kv_heads: int, batch: int | None = None) -> "NamedSharding":
+    """KV-cache sharding that degrades gracefully for GQA: when the kv-head
+    count doesn't divide the tp axis (e.g. 2 KV heads on tp=4), the head axis
+    is replicated — attention q·K still runs tp-sharded over query heads."""
+    axes = list(KV_CACHE_AXES)
+    if n_kv_heads % mesh.shape[AXIS_TP] != 0:
+        axes[2] = None
+    if batch is not None and batch % mesh.shape[AXIS_DP] != 0:
+        axes[1] = None
+    return logical_to_sharding(mesh, tuple(axes))
+# Activations: [batch, seq, model]
+ACT_AXES: tuple[str | None, ...] = ("batch", "seq", "model")
+# Token ids: [batch, seq]
+TOKEN_AXES: tuple[str | None, ...] = ("batch", "seq")
+
+
+def param_partition_specs(params: Mapping[str, Any]) -> dict[str, Any]:
+    """PartitionSpec pytree matching a parameter pytree (same nesting)."""
+
+    def spec_for(name: str) -> P:
+        axes = PARAM_LOGICAL_AXES.get(name)
+        if axes is None:
+            return P()  # unknown leaf → replicate
+        return logical_to_spec(axes)
+
+    def walk(tree: Mapping[str, Any]) -> dict[str, Any]:
+        out: dict[str, Any] = {}
+        for k, v in tree.items():
+            if isinstance(v, Mapping):
+                out[k] = walk(v)
+            elif v is None:
+                out[k] = None
+            else:
+                out[k] = spec_for(k)
+        return out
+
+    return walk(params)
+
+
+def _fit_spec(spec: P, shape: tuple[int, ...], mesh: Mesh) -> P:
+    """Drop sharding on any dim the mesh doesn't divide (e.g. vocab 50257 on
+    tp=4) — replicate that dim instead of failing. XLA still shards the rest."""
+    fitted = []
+    for dim, axis in zip(shape, tuple(spec) + (None,) * (len(shape) - len(spec))):
+        if axis is None:
+            fitted.append(None)
+        else:
+            size = mesh.shape[axis] if isinstance(axis, str) else 1
+            fitted.append(axis if dim % size == 0 else None)
+    return P(*fitted)
+
+
+def param_shardings(mesh: Mesh, params: Mapping[str, Any]) -> dict[str, Any]:
+    specs = param_partition_specs(params)
+    return jax.tree.map(
+        lambda x, s: None if x is None else NamedSharding(mesh, _fit_spec(s, x.shape, mesh)),
+        dict(params),
+        specs,
+        is_leaf=lambda x: x is None or not isinstance(x, Mapping),
+    )
+
+
+def shard_pytree(mesh: Mesh, params: Mapping[str, Any]) -> dict[str, Any]:
+    """Place a host/param pytree onto the mesh with the standard TP layout."""
+    shardings = param_shardings(mesh, params)
+    return jax.tree.map(
+        lambda x, s: x if x is None else jax.device_put(x, s),
+        dict(params),
+        shardings,
+        is_leaf=lambda x: x is None or not isinstance(x, Mapping),
+    )

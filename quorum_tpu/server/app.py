@@ -107,14 +107,7 @@ async def _stream_with_role(
             yield sse.encode_event(chunk)
     except BackendError as e:
         # Mid-stream failure: surface as an SSE error chunk, then terminate.
-        yield sse.encode_event(
-            oai.chunk(
-                id="error",
-                model=model,
-                delta={"content": f"Backend failed: {e}"},
-                finish_reason="error",
-            )
-        )
+        yield sse.encode_event(oai.error_chunk(f"Backend failed: {e}", model=model))
     yield sse.encode_done()
 
 
@@ -161,32 +154,14 @@ def create_app(
                 status_code=500,
             )
 
-        if "model" not in body and not any(b.model for b in reg.backends):
-            return JSONResponse(
-                {
-                    "error": {
-                        "message": "Model must be specified when config.yaml model is blank",
-                        "type": "invalid_request_error",
-                    }
-                },
-                status_code=400,
-            )
-
         is_streaming = bool(body.get("stream", False))
         is_parallel = cfg.parallel_enabled(len(reg))
         timeout = cfg.timeout
 
-        if is_streaming:
-            if is_parallel:
-                plan = StreamPlan.from_config(cfg, reg, body)
-                return StreamingResponse(
-                    parallel_stream(plan, body, headers, timeout)
-                )
-            return await _single_stream(reg.backends[0], body, headers, timeout)
-
-        # Non-streaming. Parity: every backend is called even in non-parallel
-        # mode (oai_proxy.py:1132-1137); in aggregate strategy only the
-        # configured source_backends are (fix of quirk 4).
+        # Resolve the actual fan-out targets first: in aggregate strategy only
+        # the configured source_backends are called (fix of quirk 4), and both
+        # the model check and the empty-selection guard must look at *them*,
+        # not the whole registry.
         if is_parallel and cfg.strategy_name == "aggregate":
             targets = reg.select(cfg.aggregate.source_backends)
             if not targets:
@@ -201,6 +176,30 @@ def create_app(
                 )
         else:
             targets = reg.backends
+
+        # 400 only when every target call would fail the model check; with a
+        # mixed config (some backends carry a model) partial success applies.
+        if "model" not in body and not any(b.model for b in targets):
+            return JSONResponse(
+                {
+                    "error": {
+                        "message": "Model must be specified when config.yaml model is blank",
+                        "type": "invalid_request_error",
+                    }
+                },
+                status_code=400,
+            )
+
+        if is_streaming:
+            if is_parallel:
+                plan = StreamPlan.from_config(cfg, reg, body)
+                return StreamingResponse(
+                    parallel_stream(plan, body, headers, timeout)
+                )
+            return await _single_stream(targets[0], body, headers, timeout)
+
+        # Non-streaming. Parity: every backend is called even in non-parallel
+        # mode (oai_proxy.py:1132-1137).
         outcomes = await fanout_complete(targets, body, headers, timeout)
         successes = [o for o in outcomes if o.ok]
         if not successes:

@@ -178,11 +178,23 @@ async def parallel_stream(
         )
 
     if not plan.skip_final:
-        labeled = [
-            (plan.backends[i].name, strip_thinking_tags(text, plan.thinking_tags, hide=plan.hide_final))
-            for i, text in enumerate(collected)
-            if text
-        ]
+        # Aggregate strategy: sources were already live-filtered per
+        # strip_intermediate_thinking; hide_aggregator_thinking applies only to
+        # the aggregator's own output below (matches combine.py's split).
+        # Concatenate strategy: final join is stripped per hide_final_think
+        # (reference quirk 6 semantics).
+        if plan.strategy_name == "aggregate":
+            labeled = [
+                (plan.backends[i].name, text)
+                for i, text in enumerate(collected)
+                if text
+            ]
+        else:
+            labeled = [
+                (plan.backends[i].name, strip_thinking_tags(text, plan.thinking_tags, hide=plan.hide_final))
+                for i, text in enumerate(collected)
+                if text
+            ]
         if labeled:
             if plan.strategy_name == "aggregate" and plan.aggregator is not None and plan.aggregate_params:
                 combined = await aggregate_responses(
@@ -201,11 +213,9 @@ async def parallel_stream(
             yield sse.encode_event(oai.final_chunk(combined, model=PROXY_MODEL_NAME))
         else:
             yield sse.encode_event(
-                oai.chunk(
-                    id="error",
+                oai.error_chunk(
+                    "Error: All backends failed to provide content",
                     model=PROXY_MODEL_NAME,
-                    delta={"content": "Error: All backends failed to provide content"},
-                    finish_reason="error",
                 )
             )
 

@@ -8,12 +8,22 @@ this conftest sets them at import time.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force, don't setdefault: this image exports JAX_PLATFORMS=axon and a
+# sitecustomize that imports jax and registers the real TPU at interpreter
+# startup (before conftest runs). Tests must run on the virtual CPU mesh, so
+# flip the already-imported jax config — backends initialize lazily, so this
+# is effective as long as no jax computation has run yet.
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import asyncio  # noqa: E402
 import inspect  # noqa: E402
