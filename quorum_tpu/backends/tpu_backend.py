@@ -1,0 +1,298 @@
+"""TpuBackend: an in-process JAX model behind the Backend protocol.
+
+The reference's only backend type is a remote HTTP service
+(/root/reference/src/quorum/oai_proxy.py:142-259). ``tpu://`` URLs replace the
+network hop with a local compiled model: requests are tokenized, run through
+the engine's prefill/decode programs on the TPU mesh, and detokenized back
+into OpenAI-shaped responses — with *true* incremental streaming (tokens leave
+the device per decode-chunk), fixing the reference's pseudo-streaming
+(SURVEY.md §2 quirk 1).
+
+URL grammar:  ``tpu://<model-id>?<spec overrides>&<engine options>``
+  spec overrides   any ModelSpec field (n_layers=2, d_model=64, ...)
+  tp=, dp=         mesh shape (default: single device)
+  seed=            weight-init seed (distinct seeds ≈ distinct ensemble members)
+  decode_chunk=    tokens per device dispatch (default 8)
+  max_tokens=      default completion budget when the request has none
+
+Contract parity with the dispatcher: configured model overrides the request
+model (oai_proxy.py:161-176 via prepare_body); responses are tagged with
+``"backend"`` (:212); failures normalize to BackendError (:231-259).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import threading
+from typing import Any, AsyncIterator
+
+from quorum_tpu import oai
+from quorum_tpu.backends.base import BackendError, CompletionResult, prepare_body
+from quorum_tpu.config import BackendSpec
+from quorum_tpu.engine.engine import GenerationResult, InferenceEngine, get_engine
+from quorum_tpu.engine.tokenizer import get_tokenizer, render_chat
+from quorum_tpu.models.model_config import resolve_spec
+from quorum_tpu.ops.sampling import SamplerConfig
+from quorum_tpu.parallel.mesh import MeshConfig, make_mesh, single_device_mesh
+
+logger = logging.getLogger(__name__)
+
+
+def _request_sampler(body: dict[str, Any]) -> SamplerConfig:
+    """Map OpenAI request knobs onto the on-device sampler.
+
+    Knobs are quantized to 2 decimals: each distinct SamplerConfig is a
+    distinct compiled program, and these values are client-controlled — the
+    quantization (plus the engine's bounded program cache) keeps recompiles
+    finite regardless of what clients send."""
+    temperature = body.get("temperature")
+    top_p = body.get("top_p")
+    return SamplerConfig(
+        temperature=round(1.0 if temperature is None else float(temperature), 2),
+        top_p=round(1.0 if top_p is None else float(top_p), 2),
+    )
+
+
+def _stop_list(body: dict[str, Any]) -> list[str]:
+    stop = body.get("stop")
+    if stop is None:
+        return []
+    if isinstance(stop, str):
+        return [stop]
+    return [s for s in stop if isinstance(s, str)]
+
+
+class _StopMatcher:
+    """Incremental stop-string scanner: withholds text that could be the
+    start of a stop sequence across delta boundaries."""
+
+    def __init__(self, stops: list[str]):
+        self.stops = [s for s in stops if s]
+        self._tail = ""
+        self.hit = False
+        self._max = max((len(s) for s in self.stops), default=0)
+
+    def feed(self, text: str) -> str:
+        if not self.stops:
+            return text
+        if self.hit:
+            return ""
+        buf = self._tail + text
+        # earliest occurrence across all stop strings (OpenAI semantics)
+        first = min((i for i in (buf.find(s) for s in self.stops) if i >= 0), default=-1)
+        if first >= 0:
+            self.hit = True
+            self._tail = ""
+            return buf[:first]
+        # emit all but the longest suffix that prefixes some stop string
+        keep = 0
+        for k in range(min(self._max - 1, len(buf)), 0, -1):
+            if any(s.startswith(buf[-k:]) for s in self.stops):
+                keep = k
+                break
+        self._tail = buf[len(buf) - keep :] if keep else ""
+        return buf[: len(buf) - keep] if keep else buf
+
+    def flush(self) -> str:
+        out, self._tail = self._tail, ""
+        return "" if self.hit else out
+
+
+class TpuBackend:
+    """One local model (engine + tokenizer) serving the Backend protocol."""
+
+    requires_auth = False  # local model: no upstream credential needed
+
+    def __init__(
+        self,
+        name: str,
+        engine: InferenceEngine,
+        *,
+        model: str = "",
+        model_id: str = "",
+        default_max_tokens: int = 64,
+    ):
+        self.name = name
+        self.engine = engine
+        self.model_id = model_id or "tpu-model"
+        self.model = model or self.model_id
+        self.default_max_tokens = default_max_tokens
+        self.tokenizer = get_tokenizer(engine.spec.vocab_size)
+
+    @classmethod
+    def from_spec(cls, bspec: BackendSpec) -> "TpuBackend":
+        model_id = bspec.tpu_model_id
+        opts = bspec.tpu_options
+        spec = resolve_spec(model_id, opts)
+        tp = int(opts.get("tp", 1))
+        dp = int(opts.get("dp", 1))
+        if tp * dp > 1:
+            mesh = make_mesh(MeshConfig(dp=dp, tp=tp))
+        else:
+            mesh = single_device_mesh()
+        engine = get_engine(
+            spec,
+            mesh,
+            seed=int(opts.get("seed", 0)),
+            decode_chunk=int(opts.get("decode_chunk", 8)),
+        )
+        return cls(
+            bspec.name,
+            engine,
+            model=bspec.model,
+            model_id=model_id,
+            default_max_tokens=int(opts.get("max_tokens", 64)),
+        )
+
+    # ---- request plumbing -------------------------------------------------
+
+    def _plan(self, body: dict[str, Any]) -> dict[str, Any]:
+        effective = prepare_body(body, self.model)
+        prompt = render_chat(body.get("messages") or [])
+        ids = self.tokenizer.encode(prompt)
+        max_new = body.get("max_completion_tokens") or body.get("max_tokens")
+        return {
+            "model": effective["model"],
+            "prompt_ids": ids,
+            "max_new": int(max_new) if max_new else self.default_max_tokens,
+            "sampler": _request_sampler(body),
+            "seed": int(body.get("seed") or 0),
+            "stops": _stop_list(body),
+        }
+
+    def _usage(self, n_prompt: int, n_completion: int) -> dict[str, int]:
+        return {
+            "prompt_tokens": n_prompt,
+            "completion_tokens": n_completion,
+            "total_tokens": n_prompt + n_completion,
+        }
+
+    # ---- Backend protocol -------------------------------------------------
+
+    async def complete(
+        self, body: dict[str, Any], headers: dict[str, str], timeout: float
+    ) -> CompletionResult:
+        plan = self._plan(body)
+        cancel = threading.Event()
+
+        def run():
+            result = GenerationResult()
+            detok = self.tokenizer.detokenizer()
+            pieces = []
+            for t in self.engine.generate_stream(
+                plan["prompt_ids"],
+                max_new_tokens=plan["max_new"],
+                sampler=plan["sampler"],
+                seed=plan["seed"],
+                eos_id=self.tokenizer.eos_id,
+                cancel=cancel,
+            ):
+                if t == self.tokenizer.eos_id:
+                    result.finish_reason = "stop"
+                    break
+                result.token_ids.append(t)
+                pieces.append(detok.feed(t))
+            pieces.append(detok.flush())
+            return result, "".join(pieces)
+
+        task = asyncio.create_task(asyncio.to_thread(run))
+        # If we abandon the task on timeout, still retrieve its eventual
+        # exception so asyncio doesn't log "exception was never retrieved".
+        task.add_done_callback(lambda t: t.cancelled() or t.exception())
+        try:
+            result, text = await asyncio.wait_for(asyncio.shield(task), timeout=timeout)
+        except asyncio.TimeoutError:
+            # Abort the on-device loop at the next chunk boundary; don't hold
+            # the request open waiting for the full generation.
+            cancel.set()
+            raise BackendError(f"Backend {self.name} timed out after {timeout}s")
+        except BackendError:
+            raise
+        except Exception as e:
+            cancel.set()
+            logger.exception("TPU backend %s failed", self.name)
+            raise BackendError(f"Backend {self.name} failed: {e}") from e
+
+        matcher = _StopMatcher(plan["stops"])
+        clipped = matcher.feed(text) + matcher.flush()
+        finish = "stop" if matcher.hit else result.finish_reason
+        resp = oai.completion(
+            content=clipped,
+            model=plan["model"],
+            usage=self._usage(len(plan["prompt_ids"]), result.completion_tokens),
+            finish_reason=finish,
+        )
+        resp["backend"] = self.name
+        return CompletionResult(backend_name=self.name, status_code=200, body=resp)
+
+    async def stream(
+        self, body: dict[str, Any], headers: dict[str, str], timeout: float
+    ) -> AsyncIterator[dict[str, Any]]:
+        plan = self._plan(body)
+        model = plan["model"]
+        chunk_id = oai.new_request_id()
+        loop = asyncio.get_running_loop()
+        queue: asyncio.Queue = asyncio.Queue()
+        detok = self.tokenizer.detokenizer()
+        matcher = _StopMatcher(plan["stops"])
+        state = {"n": 0, "finish": "length"}
+        cancel = threading.Event()
+
+        def produce():
+            try:
+                for tok in self.engine.generate_stream(
+                    plan["prompt_ids"],
+                    max_new_tokens=plan["max_new"],
+                    sampler=plan["sampler"],
+                    seed=plan["seed"],
+                    eos_id=self.tokenizer.eos_id,
+                    cancel=cancel,
+                ):
+                    if tok == self.tokenizer.eos_id:
+                        state["finish"] = "stop"
+                        break
+                    state["n"] += 1
+                    text = matcher.feed(detok.feed(tok))
+                    if matcher.hit:
+                        state["finish"] = "stop"
+                        if text:
+                            loop.call_soon_threadsafe(queue.put_nowait, ("text", text))
+                        break
+                    if text:
+                        loop.call_soon_threadsafe(queue.put_nowait, ("text", text))
+                tail = matcher.feed(detok.flush()) + matcher.flush()
+                if tail:
+                    loop.call_soon_threadsafe(queue.put_nowait, ("text", tail))
+                loop.call_soon_threadsafe(queue.put_nowait, ("end", None))
+            except Exception as e:  # normalized below on the consumer side
+                loop.call_soon_threadsafe(queue.put_nowait, ("err", e))
+
+        producer = loop.run_in_executor(None, produce)
+        yield oai.chunk(id=chunk_id, model=model, delta={"role": "assistant"})
+        try:
+            while True:
+                kind, val = await asyncio.wait_for(queue.get(), timeout=timeout)
+                if kind == "text":
+                    yield oai.chunk(id=chunk_id, model=model, delta={"content": val})
+                elif kind == "end":
+                    break
+                else:
+                    raise BackendError(f"Backend {self.name} failed: {val}") from val
+        except asyncio.TimeoutError:
+            cancel.set()  # abort the device loop at the next chunk boundary
+            raise BackendError(f"Backend {self.name} timed out after {timeout}s")
+        except BaseException:
+            # Client disconnect (GeneratorExit) or cancellation: release the
+            # engine within one decode chunk; the producer thread exits on its
+            # own — an async generator being closed must not await.
+            cancel.set()
+            raise
+        cancel.set()
+        await producer  # producer already sent "end" — returns immediately
+        yield oai.chunk(
+            id=chunk_id, model=model, delta={}, finish_reason=state["finish"]
+        )
+
+    async def aclose(self) -> None:
+        return None

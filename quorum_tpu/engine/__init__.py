@@ -1,0 +1,21 @@
+"""Inference engine: compiled prefill/decode programs + token streaming.
+
+This is the genuinely new layer relative to the reference (SURVEY.md §7 L2):
+the reference's "backends" are remote HTTP services
+(/root/reference/src/quorum/oai_proxy.py:182-192); here a backend can be an
+in-process JAX program on the local TPU mesh, and this package owns the
+model-serving mechanics: bucketed prefill, chunked autoregressive decode,
+sampling, incremental detokenization, and KV-cache lifecycle.
+"""
+
+from quorum_tpu.engine.engine import GenerationResult, InferenceEngine, get_engine
+from quorum_tpu.engine.tokenizer import ByteTokenizer, IncrementalDetokenizer, render_chat
+
+__all__ = [
+    "ByteTokenizer",
+    "GenerationResult",
+    "IncrementalDetokenizer",
+    "InferenceEngine",
+    "get_engine",
+    "render_chat",
+]

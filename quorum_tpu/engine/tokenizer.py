@@ -1,0 +1,169 @@
+"""Tokenization for in-process ``tpu://`` backends.
+
+The default is a deterministic **byte-level tokenizer** (pad/bos/eos + one id
+per UTF-8 byte). It needs no vocabulary files or network access, works with
+every :class:`~quorum_tpu.models.model_config.ModelSpec` (vocab ≥ 259 maps
+bytes 1:1; smaller vocabs fold bytes modulo the available slots), and makes
+generated text a pure function of (weights, prompt, sampler, seed) — exactly
+what serving tests and benchmarks need.
+
+Real checkpoints bring their own subword tokenizer: point
+``$QUORUM_TPU_TOKENIZER_PATH`` at a local HuggingFace tokenizer directory and
+:func:`get_tokenizer` loads it via ``transformers`` (no network fetch is ever
+attempted — the environment has no egress).
+
+Incremental detokenization is UTF-8-boundary-safe: a multi-byte character
+split across decode steps is buffered until complete, so streamed deltas never
+contain broken characters (the analog of the reference's chunk-boundary-safe
+thinking-tag filter, /root/reference/src/quorum/oai_proxy.py:262-371).
+"""
+
+from __future__ import annotations
+
+import codecs
+import logging
+import os
+from typing import Protocol, Sequence
+
+from quorum_tpu.oai import flatten_content
+
+logger = logging.getLogger(__name__)
+
+PAD_ID = 0
+BOS_ID = 1
+EOS_ID = 2
+_OFFSET = 3
+
+
+class Tokenizer(Protocol):
+    eos_id: int
+
+    def encode(self, text: str) -> list[int]: ...
+
+    def decode(self, ids: Sequence[int]) -> str: ...
+
+    def detokenizer(self) -> "IncrementalDetokenizer": ...
+
+
+class ByteTokenizer:
+    """UTF-8 byte tokenizer with pad/bos/eos specials."""
+
+    def __init__(self, vocab_size: int):
+        if vocab_size < _OFFSET + 1:
+            raise ValueError(f"vocab_size {vocab_size} too small (need ≥ {_OFFSET + 1})")
+        self.vocab_size = vocab_size
+        self.byte_slots = min(256, vocab_size - _OFFSET)
+        self.pad_id = PAD_ID
+        self.bos_id = BOS_ID
+        self.eos_id = EOS_ID
+
+    def encode(self, text: str) -> list[int]:
+        return [_OFFSET + (b % self.byte_slots) for b in text.encode("utf-8")]
+
+    def token_byte(self, token_id: int) -> bytes:
+        if token_id < _OFFSET or token_id >= _OFFSET + self.byte_slots:
+            return b""
+        return bytes([token_id - _OFFSET])
+
+    def decode(self, ids: Sequence[int]) -> str:
+        return b"".join(self.token_byte(t) for t in ids).decode("utf-8", errors="replace")
+
+    def detokenizer(self) -> "IncrementalDetokenizer":
+        return IncrementalDetokenizer(self)
+
+
+class IncrementalDetokenizer:
+    """Feed token ids one at a time; get back only *complete* UTF-8 text."""
+
+    def __init__(self, tokenizer: ByteTokenizer):
+        self._tok = tokenizer
+        self._decoder = codecs.getincrementaldecoder("utf-8")(errors="replace")
+
+    def feed(self, token_id: int) -> str:
+        return self._decoder.decode(self._tok.token_byte(token_id))
+
+    def flush(self) -> str:
+        return self._decoder.decode(b"", final=True)
+
+
+class HFTokenizer:
+    """A local HuggingFace tokenizer directory (no downloads)."""
+
+    def __init__(self, path: str):
+        from transformers import AutoTokenizer  # lazy; heavy import
+
+        self._t = AutoTokenizer.from_pretrained(path, local_files_only=True)
+        self.eos_id = int(self._t.eos_token_id or EOS_ID)
+
+    def encode(self, text: str) -> list[int]:
+        return list(self._t.encode(text, add_special_tokens=False))
+
+    def decode(self, ids: Sequence[int]) -> str:
+        return self._t.decode(list(ids), skip_special_tokens=True)
+
+    def detokenizer(self) -> "HFIncrementalDetokenizer":
+        return HFIncrementalDetokenizer(self)
+
+
+class HFIncrementalDetokenizer:
+    """Prefix-diff incremental detokenizer for subword vocabularies.
+
+    Withholds text while the decoded suffix ends in a replacement character
+    (a partially-emitted multi-byte sequence in byte-fallback vocabs).
+    """
+
+    def __init__(self, tokenizer: HFTokenizer):
+        self._tok = tokenizer
+        self._ids: list[int] = []
+        self._emitted = 0
+
+    def feed(self, token_id: int) -> str:
+        self._ids.append(token_id)
+        text = self._tok.decode(self._ids)
+        if text.endswith("�"):
+            return ""
+        out = text[self._emitted :]
+        self._emitted = len(text)
+        return out
+
+    def flush(self) -> str:
+        text = self._tok.decode(self._ids)
+        out = text[self._emitted :]
+        self._emitted = len(text)
+        return out
+
+
+def get_tokenizer(vocab_size: int) -> Tokenizer:
+    path = os.environ.get("QUORUM_TPU_TOKENIZER_PATH", "")
+    if path:
+        try:
+            hf = HFTokenizer(path)
+            hf_vocab = len(hf._t)
+            if hf_vocab > vocab_size:
+                logger.warning(
+                    "Tokenizer at %s has %d ids but the model vocab is %d — "
+                    "falling back to the byte tokenizer", path, hf_vocab, vocab_size,
+                )
+            else:
+                return hf
+        except Exception:
+            logger.warning(
+                "Failed to load tokenizer from QUORUM_TPU_TOKENIZER_PATH=%s — "
+                "falling back to the byte tokenizer", path, exc_info=True,
+            )
+    return ByteTokenizer(vocab_size)
+
+
+def render_chat(messages: Sequence[dict]) -> str:
+    """Deterministic chat template: ``role: content`` lines + assistant cue.
+
+    The reference never templates — prompts pass through opaquely to remote
+    APIs (oai_proxy.py:185-192). In-process models need *some* template; real
+    checkpoints override this with their tokenizer's own chat template.
+    """
+    lines = []
+    for m in messages:
+        role = m.get("role", "user")
+        lines.append(f"{role}: {flatten_content(m.get('content'))}")
+    lines.append("assistant:")
+    return "\n".join(lines)

@@ -150,6 +150,17 @@ def extract_delta_content(chunk_: dict[str, Any]) -> str:
         return ""
 
 
+def flatten_content(content: Any) -> str:
+    """OpenAI message content → plain text (str or content-part array)."""
+    if isinstance(content, str):
+        return content
+    if isinstance(content, list):
+        return "".join(
+            p.get("text", "") for p in content if isinstance(p, dict) and p.get("type") == "text"
+        )
+    return ""
+
+
 def first_user_message(body: dict[str, Any]) -> str:
     """The user query used for the aggregation prompt.
 
@@ -159,13 +170,7 @@ def first_user_message(body: dict[str, Any]) -> str:
     messages = body.get("messages") or []
     for m in messages:
         if isinstance(m, dict) and m.get("role") == "user":
-            c = m.get("content")
-            if isinstance(c, str):
-                return c
-            if isinstance(c, list):
-                return "".join(
-                    p.get("text", "") for p in c if isinstance(p, dict) and p.get("type") == "text"
-                )
+            return flatten_content(m.get("content"))
     return ""
 
 
