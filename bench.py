@@ -1,0 +1,115 @@
+"""Benchmark: p50 time-to-first-token through the full serving stack.
+
+Shape of the run (north-star config, BASELINE.json): one OpenAI-compatible
+``/chat/completions`` request fanned out to THREE in-process ``tpu://``
+model backends (distinct weight seeds ≈ distinct ensemble members) with the
+``concatenate`` strategy, SSE streaming — measured end-to-end through the
+ASGI app, SSE encoder, and the engines' prefill/decode programs on whatever
+``jax.devices()`` provides (the real TPU chip under the driver; CPU anywhere
+else).
+
+Metric: p50 TTFT (ms) — time from request start to the first *content* delta.
+``vs_baseline``: the reference design buffers the entire upstream response
+before re-streaming (/root/reference/src/quorum/oai_proxy.py:187-203), so on
+identical hardware its TTFT equals the full completion latency. We therefore
+report p50(total latency) / p50(TTFT) — how many times earlier the first
+token arrives than the reference architecture could deliver it.
+
+Prints ONE JSON line:
+  {"metric": "p50_ttft_ms", "value": ..., "unit": "ms", "vs_baseline": ...}
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import statistics
+import sys
+import time
+
+N_WARMUP = 1
+N_REQUESTS = 6
+MAX_TOKENS = 32
+MODEL = "gpt2"  # BASELINE.json config[0] model family, real 124M size
+
+
+def build_app():
+    from quorum_tpu.config import Config
+    from quorum_tpu.server.app import create_app
+
+    raw = {
+        "settings": {"timeout": 600},
+        "primary_backends": [
+            {"name": f"LLM{i}", "url": f"tpu://{MODEL}?seed={i}&max_tokens={MAX_TOKENS}",
+             "model": MODEL}
+            for i in range(3)
+        ],
+        "iterations": {"aggregation": {"strategy": "concatenate"}},
+        "strategy": {
+            "concatenate": {
+                "separator": "\n-------------\n",
+                "hide_intermediate_think": True,
+                "hide_final_think": False,
+                "thinking_tags": ["think"],
+            },
+            "aggregate": {"source_backends": "all", "aggregator_backend": ""},
+        },
+    }
+    return create_app(Config(raw=raw))
+
+
+async def one_request(client) -> tuple[float, float]:
+    """Returns (ttft_s, total_s) for one streaming fan-out request."""
+    body = {
+        "model": MODEL,
+        "messages": [{"role": "user", "content": "Benchmark prompt: say something."}],
+        "stream": True,
+        "max_tokens": MAX_TOKENS,
+    }
+    t0 = time.perf_counter()
+    ttft = None
+    async with client.stream(
+        "POST", "/chat/completions", json=body,
+        headers={"Authorization": "Bearer bench"},
+    ) as resp:
+        assert resp.status_code == 200, f"HTTP {resp.status_code}"
+        async for line in resp.aiter_lines():
+            if not line.startswith("data: ") or line == "data: [DONE]":
+                continue
+            chunk = json.loads(line[len("data: "):])
+            delta = (chunk.get("choices") or [{}])[0].get("delta") or {}
+            if ttft is None and delta.get("content"):
+                ttft = time.perf_counter() - t0
+    total = time.perf_counter() - t0
+    assert ttft is not None, "no content chunk received"
+    return ttft, total
+
+
+async def main() -> None:
+    import httpx
+
+    app = build_app()
+    transport = httpx.ASGITransport(app=app)
+    async with httpx.AsyncClient(
+        transport=transport, base_url="http://bench", timeout=600
+    ) as client:
+        for _ in range(N_WARMUP):  # compile prefill/decode programs
+            await one_request(client)
+        ttfts, totals = [], []
+        for _ in range(N_REQUESTS):
+            ttft, total = await one_request(client)
+            ttfts.append(ttft)
+            totals.append(total)
+
+    p50_ttft_ms = statistics.median(ttfts) * 1000
+    p50_total_ms = statistics.median(totals) * 1000
+    print(json.dumps({
+        "metric": "p50_ttft_ms",
+        "value": round(p50_ttft_ms, 2),
+        "unit": "ms",
+        "vs_baseline": round(p50_total_ms / p50_ttft_ms, 2),
+    }))
+
+
+if __name__ == "__main__":
+    sys.exit(asyncio.run(main()))

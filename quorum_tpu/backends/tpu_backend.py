@@ -24,6 +24,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import math
 import threading
 from typing import Any, AsyncIterator
 
@@ -46,11 +47,33 @@ def _request_sampler(body: dict[str, Any]) -> SamplerConfig:
     distinct compiled program, and these values are client-controlled — the
     quantization (plus the engine's bounded program cache) keeps recompiles
     finite regardless of what clients send."""
-    temperature = body.get("temperature")
-    top_p = body.get("top_p")
+    temperature = _request_number(body, "temperature", 1.0)
+    top_p = _request_number(body, "top_p", 1.0)
     return SamplerConfig(
-        temperature=round(1.0 if temperature is None else float(temperature), 2),
-        top_p=round(1.0 if top_p is None else float(top_p), 2),
+        temperature=round(temperature, 2),
+        top_p=round(top_p, 2),
+    )
+
+
+def _request_number(body: dict[str, Any], key: str, default: float) -> float:
+    """Client-controlled numeric knob → float, or a 400 (not a 500) on junk."""
+    val = body.get(key)
+    if val is None:
+        return default
+    try:
+        out = float(val)
+        if not math.isfinite(out):
+            raise ValueError("must be finite")
+    except (TypeError, ValueError):
+        raise _invalid_request(f"Invalid value for {key!r}: {val!r}") from None
+    return out
+
+
+def _invalid_request(message: str) -> BackendError:
+    return BackendError(
+        message,
+        status_code=400,
+        body=oai.error_body(message, type_="invalid_request_error", code=400),
     )
 
 
@@ -60,7 +83,9 @@ def _stop_list(body: dict[str, Any]) -> list[str]:
         return []
     if isinstance(stop, str):
         return [stop]
-    return [s for s in stop if isinstance(s, str)]
+    if isinstance(stop, list):
+        return [s for s in stop if isinstance(s, str)]
+    raise _invalid_request(f"Invalid value for 'stop': {stop!r}")
 
 
 class _StopMatcher:
@@ -112,12 +137,14 @@ class TpuBackend:
         model: str = "",
         model_id: str = "",
         default_max_tokens: int = 64,
+        decode_chunk: int | None = None,
     ):
         self.name = name
         self.engine = engine
         self.model_id = model_id or "tpu-model"
         self.model = model or self.model_id
         self.default_max_tokens = default_max_tokens
+        self.decode_chunk = decode_chunk  # None → engine default
         self.tokenizer = get_tokenizer(engine.spec.vocab_size)
 
     @classmethod
@@ -131,18 +158,14 @@ class TpuBackend:
             mesh = make_mesh(MeshConfig(dp=dp, tp=tp))
         else:
             mesh = single_device_mesh()
-        engine = get_engine(
-            spec,
-            mesh,
-            seed=int(opts.get("seed", 0)),
-            decode_chunk=int(opts.get("decode_chunk", 8)),
-        )
+        engine = get_engine(spec, mesh, seed=int(opts.get("seed", 0)))
         return cls(
             bspec.name,
             engine,
             model=bspec.model,
             model_id=model_id,
             default_max_tokens=int(opts.get("max_tokens", 64)),
+            decode_chunk=int(opts["decode_chunk"]) if "decode_chunk" in opts else None,
         )
 
     # ---- request plumbing -------------------------------------------------
@@ -151,13 +174,14 @@ class TpuBackend:
         effective = prepare_body(body, self.model)
         prompt = render_chat(body.get("messages") or [])
         ids = self.tokenizer.encode(prompt)
-        max_new = body.get("max_completion_tokens") or body.get("max_tokens")
+        key = "max_completion_tokens" if body.get("max_completion_tokens") else "max_tokens"
+        max_new = _request_number(body, key, float(self.default_max_tokens))
         return {
             "model": effective["model"],
             "prompt_ids": ids,
-            "max_new": int(max_new) if max_new else self.default_max_tokens,
+            "max_new": max(1, int(max_new)),
             "sampler": _request_sampler(body),
-            "seed": int(body.get("seed") or 0),
+            "seed": int(_request_number(body, "seed", 0.0)),
             "stops": _stop_list(body),
         }
 
@@ -176,6 +200,8 @@ class TpuBackend:
         plan = self._plan(body)
         cancel = threading.Event()
 
+        matcher = _StopMatcher(plan["stops"])
+
         def run():
             result = GenerationResult()
             detok = self.tokenizer.detokenizer()
@@ -187,13 +213,18 @@ class TpuBackend:
                 seed=plan["seed"],
                 eos_id=self.tokenizer.eos_id,
                 cancel=cancel,
+                decode_chunk=self.decode_chunk,
             ):
                 if t == self.tokenizer.eos_id:
                     result.finish_reason = "stop"
                     break
                 result.token_ids.append(t)
-                pieces.append(detok.feed(t))
-            pieces.append(detok.flush())
+                pieces.append(matcher.feed(detok.feed(t)))
+                if matcher.hit:
+                    # stop string matched: abort decoding now, not at budget
+                    result.finish_reason = "stop"
+                    break
+            pieces.append(matcher.feed(detok.flush()) + matcher.flush())
             return result, "".join(pieces)
 
         task = asyncio.create_task(asyncio.to_thread(run))
@@ -213,15 +244,18 @@ class TpuBackend:
             cancel.set()
             logger.exception("TPU backend %s failed", self.name)
             raise BackendError(f"Backend {self.name} failed: {e}") from e
+        except BaseException:
+            # Request cancellation (client disconnect): abort the shielded
+            # generation thread too, or it would decode to completion while
+            # holding the engine lock.
+            cancel.set()
+            raise
 
-        matcher = _StopMatcher(plan["stops"])
-        clipped = matcher.feed(text) + matcher.flush()
-        finish = "stop" if matcher.hit else result.finish_reason
         resp = oai.completion(
-            content=clipped,
+            content=text,
             model=plan["model"],
             usage=self._usage(len(plan["prompt_ids"]), result.completion_tokens),
-            finish_reason=finish,
+            finish_reason=result.finish_reason,
         )
         resp["backend"] = self.name
         return CompletionResult(backend_name=self.name, status_code=200, body=resp)
@@ -248,6 +282,7 @@ class TpuBackend:
                     seed=plan["seed"],
                     eos_id=self.tokenizer.eos_id,
                     cancel=cancel,
+                    decode_chunk=self.decode_chunk,
                 ):
                     if tok == self.tokenizer.eos_id:
                         state["finish"] = "stop"
@@ -269,8 +304,10 @@ class TpuBackend:
                 loop.call_soon_threadsafe(queue.put_nowait, ("err", e))
 
         producer = loop.run_in_executor(None, produce)
-        yield oai.chunk(id=chunk_id, model=model, delta={"role": "assistant"})
         try:
+            # inside the try: a disconnect at this first yield must still
+            # cancel the producer thread (it already holds the engine lock)
+            yield oai.role_chunk(model, chunk_id)
             while True:
                 kind, val = await asyncio.wait_for(queue.get(), timeout=timeout)
                 if kind == "text":
@@ -293,6 +330,15 @@ class TpuBackend:
         yield oai.chunk(
             id=chunk_id, model=model, delta={}, finish_reason=state["finish"]
         )
+        if (body.get("stream_options") or {}).get("include_usage"):
+            # OpenAI stream_options.include_usage: one extra chunk with empty
+            # choices carrying the token counts (a real count — the local
+            # engine generated the tokens, api_reference/chat_completions.yaml
+            # stream_options schema).
+            usage_chunk = oai.chunk(id=chunk_id, model=model, delta={})
+            usage_chunk["choices"] = []
+            usage_chunk["usage"] = self._usage(len(plan["prompt_ids"]), state["n"])
+            yield usage_chunk
 
     async def aclose(self) -> None:
         return None

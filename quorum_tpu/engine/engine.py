@@ -88,7 +88,11 @@ class InferenceEngine:
         self.params = shard_pytree(self.mesh, host_params)
         self._cache_sharding = kv_cache_sharding(self.mesh, spec.n_kv_heads, batch=1)
         self._rep = NamedSharding(self.mesh, P())
-        self._prefill_cache: dict[int, object] = {}
+        # One jitted prefill: jax.jit already specializes per bucket shape.
+        self._prefill = jax.jit(
+            partial(prefill, spec=self.spec),
+            donate_argnames=("cache_k", "cache_v"),
+        )
         # Sampler-keyed executable caches are bounded: SamplerConfig values come
         # from requests, so without eviction arbitrary temperature/top_p values
         # would grow compiled-program memory without limit (callers additionally
@@ -99,16 +103,6 @@ class InferenceEngine:
 
     # ---- compiled programs ------------------------------------------------
 
-    def _prefill_fn(self, bucket: int):
-        fn = self._prefill_cache.get(bucket)
-        if fn is None:
-            fn = jax.jit(
-                partial(prefill, spec=self.spec),
-                donate_argnames=("cache_k", "cache_v"),
-            )
-            self._prefill_cache[bucket] = fn
-        return fn
-
     def _sample_fn(self, sampler: SamplerConfig):
         fn = self._sample_cache.get(sampler)
         if fn is None:
@@ -116,6 +110,8 @@ class InferenceEngine:
             self._sample_cache[sampler] = fn
             while len(self._sample_cache) > self._max_sampler_programs:
                 self._sample_cache.popitem(last=False)
+        else:
+            self._sample_cache.move_to_end(sampler)  # LRU, not FIFO
         return fn
 
     def _decode_fn(self, n_steps: int, sampler: SamplerConfig):
@@ -123,6 +119,7 @@ class InferenceEngine:
         key_ = (n_steps, sampler)
         fn = self._decode_cache.get(key_)
         if fn is not None:
+            self._decode_cache.move_to_end(key_)  # LRU, not FIFO
             return fn
         spec = self.spec
 
@@ -157,11 +154,14 @@ class InferenceEngine:
         seed: int = 0,
         eos_id: int | None = None,
         cancel: threading.Event | None = None,
+        decode_chunk: int | None = None,
     ) -> Iterator[int]:
         """Yield generated token ids one at a time (blocking; device-synced
         once per chunk). Stops at EOS, max_new_tokens, context exhaustion, or
         when ``cancel`` is set (checked at each chunk boundary — the way a
-        host thread can abort a compiled on-device loop)."""
+        host thread can abort a compiled on-device loop). ``decode_chunk``
+        overrides the engine default per call — a dispatch knob, not part of
+        the engine's weight identity (see :func:`get_engine`)."""
         with self._lock:
             yield from self._generate_locked(
                 prompt_ids,
@@ -170,9 +170,11 @@ class InferenceEngine:
                 seed=seed,
                 eos_id=eos_id,
                 cancel=cancel,
+                decode_chunk=decode_chunk or self.decode_chunk,
             )
 
-    def _generate_locked(self, prompt_ids, *, max_new_tokens, sampler, seed, eos_id, cancel=None):
+    def _generate_locked(self, prompt_ids, *, max_new_tokens, sampler, seed, eos_id,
+                         cancel=None, decode_chunk=None):
         spec = self.spec
         # Keep the most recent context if the prompt exceeds the window,
         # reserving at least one position to generate into.
@@ -195,7 +197,7 @@ class InferenceEngine:
         ck = jax.device_put(ck, self._cache_sharding)
         cv = jax.device_put(cv, self._cache_sharding)
 
-        logits, ck, cv = self._prefill_fn(bucket)(
+        logits, ck, cv = self._prefill(
             self.params, tokens=tokens, lengths=lengths, cache_k=ck, cache_v=cv
         )
         rng = jax.random.PRNGKey(seed)
@@ -207,10 +209,11 @@ class InferenceEngine:
         if eos_id is not None and first == eos_id:
             return
 
+        chunk_len = decode_chunk or self.decode_chunk
         while emitted < budget:
             if cancel is not None and cancel.is_set():
                 return
-            n = min(self.decode_chunk, budget - emitted)
+            n = min(chunk_len, budget - emitted)
             toks, tok, lengths, ck, cv, rng = self._decode_fn(n, sampler)(
                 self.params, tok, lengths, ck, cv, rng
             )
@@ -262,13 +265,15 @@ def get_engine(
     mesh: Mesh | None = None,
     *,
     seed: int = 0,
-    decode_chunk: int = 8,
 ) -> InferenceEngine:
+    """Engines are keyed by weight identity (spec, seed, mesh) ONLY — dispatch
+    knobs like decode_chunk are per-call, so two backends that differ only in
+    chunking share one set of weights on device."""
     mesh = mesh or single_device_mesh()
-    key = (spec, seed, decode_chunk, tuple(sorted(mesh.shape.items())), tuple(map(str, mesh.devices.flat)))
+    key = (spec, seed, tuple(sorted(mesh.shape.items())), tuple(map(str, mesh.devices.flat)))
     with _ENGINES_LOCK:
         eng = _ENGINES.get(key)
         if eng is None:
-            eng = InferenceEngine(spec, mesh, seed=seed, decode_chunk=decode_chunk)
+            eng = InferenceEngine(spec, mesh, seed=seed)
             _ENGINES[key] = eng
         return eng

@@ -93,7 +93,8 @@ class HFTokenizer:
         from transformers import AutoTokenizer  # lazy; heavy import
 
         self._t = AutoTokenizer.from_pretrained(path, local_files_only=True)
-        self.eos_id = int(self._t.eos_token_id or EOS_ID)
+        eos = self._t.eos_token_id  # 0 is a legitimate eos id — no `or`
+        self.eos_id = EOS_ID if eos is None else int(eos)
 
     def encode(self, text: str) -> list[int]:
         return list(self._t.encode(text, add_special_tokens=False))

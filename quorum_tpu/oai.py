@@ -170,7 +170,10 @@ def first_user_message(body: dict[str, Any]) -> str:
     messages = body.get("messages") or []
     for m in messages:
         if isinstance(m, dict) and m.get("role") == "user":
-            return flatten_content(m.get("content"))
+            content = m.get("content")
+            if isinstance(content, (str, list)):
+                return flatten_content(content)
+            # malformed content (e.g. null): keep scanning for a usable query
     return ""
 
 

@@ -1,0 +1,3 @@
+from quorum_tpu.training.trainer import TrainState, loss_fn, make_train_step, train_init
+
+__all__ = ["TrainState", "loss_fn", "make_train_step", "train_init"]

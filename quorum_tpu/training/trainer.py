@@ -1,0 +1,109 @@
+"""Training step: next-token cross-entropy + AdamW over the sharded pytree.
+
+The reference proxy has no training of any kind (SURVEY.md "What quorum is
+NOT", /root/reference/src/quorum/oai_proxy.py has no torch/jax imports), but a
+TPU-native framework's model runtime must be trainable to be complete — the
+same ``forward_logits`` that serves requests is differentiated here, so the
+serving and training paths can never drift apart.
+
+TPU-first design:
+
+  - grads/optimizer run under the SAME GSPMD shardings as serving: params are
+    placed by quorum_tpu.parallel.sharding and optimizer state inherits the
+    layout via jit sharding propagation — Megatron-style TP falls out with no
+    extra code, XLA inserts the psums.
+  - tokens are sharded ``[dp, sp]``: batch over the data-parallel axis and
+    sequence over the sequence-parallel axis, so long-context training
+    shards activation memory the way the scaling-book recipe prescribes.
+  - ``remat=True`` wraps each scanned layer in ``jax.checkpoint`` — the
+    standard FLOPs-for-HBM trade for long sequences.
+  - the train step donates params + opt state: XLA updates them in place.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from quorum_tpu.models.init import init_params
+from quorum_tpu.models.model_config import ModelSpec
+from quorum_tpu.models.transformer import forward_logits
+from quorum_tpu.parallel.mesh import AXIS_DP, AXIS_SP
+from quorum_tpu.parallel.sharding import shard_pytree
+
+
+class TrainState(NamedTuple):
+    params: Any
+    opt_state: Any
+    step: jnp.ndarray
+
+
+def loss_fn(params, spec: ModelSpec, tokens: jnp.ndarray, remat: bool = True):
+    """Mean next-token cross-entropy over ``tokens`` [B, T] (0 = pad).
+
+    Computed in f32 off bf16 activations; the pad mask keeps padded positions
+    out of the mean so bucketed batches train correctly.
+    """
+    logits = forward_logits(params, spec, tokens[:, :-1], remat=remat)  # [B,T-1,V]
+    targets = tokens[:, 1:]
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    mask = (targets != 0).astype(jnp.float32)
+    return jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+
+
+def make_optimizer(lr: float = 3e-4, weight_decay: float = 0.1) -> optax.GradientTransformation:
+    return optax.adamw(lr, b1=0.9, b2=0.95, weight_decay=weight_decay)
+
+
+def train_init(
+    spec: ModelSpec,
+    mesh: Mesh,
+    *,
+    seed: int = 0,
+    optimizer: optax.GradientTransformation | None = None,
+) -> TrainState:
+    """Initialize a sharded TrainState on ``mesh``.
+
+    Params get the explicit TP/EP layout from the sharding table; optimizer
+    moments inherit it through jit output-sharding propagation (they are
+    elementwise over params, so GSPMD keeps them aligned).
+    """
+    opt = optimizer or make_optimizer()
+    params = shard_pytree(mesh, init_params(spec, seed))
+    opt_state = jax.jit(opt.init)(params)
+    return TrainState(params=params, opt_state=opt_state, step=jnp.zeros((), jnp.int32))
+
+
+def make_train_step(
+    spec: ModelSpec,
+    mesh: Mesh,
+    *,
+    optimizer: optax.GradientTransformation | None = None,
+    remat: bool = True,
+):
+    """Compile one SGD step over the mesh: returns ``step(state, tokens)``.
+
+    ``tokens`` must be [B, T] with B divisible by the dp axis and T by the sp
+    axis; the returned callable is jitted with donated state.
+    """
+    opt = optimizer or make_optimizer()
+    token_sharding = NamedSharding(mesh, P(AXIS_DP, AXIS_SP))
+
+    @partial(jax.jit, donate_argnums=(0,))
+    def step(state: TrainState, tokens: jnp.ndarray):
+        loss, grads = jax.value_and_grad(loss_fn)(state.params, spec, tokens, remat)
+        updates, opt_state = opt.update(grads, state.opt_state, state.params)
+        params = optax.apply_updates(state.params, updates)
+        return TrainState(params, opt_state, state.step + 1), loss
+
+    def run(state: TrainState, tokens) -> tuple[TrainState, jnp.ndarray]:
+        tokens = jax.device_put(jnp.asarray(tokens, jnp.int32), token_sharding)
+        return step(state, tokens)
+
+    return run

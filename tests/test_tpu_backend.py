@@ -207,3 +207,65 @@ async def test_e2e_streaming_parallel_tpu():
     assert any(i.startswith("chatcmpl-parallel-") for i in ids)
     final = [p for p in parsed if p["id"] == "chatcmpl-parallel-final"]
     assert final and final[0]["choices"][0]["finish_reason"] == "stop"
+
+
+# ---- request validation / usage reporting ---------------------------------
+
+async def test_bad_temperature_is_400_not_500():
+    from quorum_tpu.backends.base import BackendError
+
+    b = tiny_backend()
+    with pytest.raises(BackendError) as ei:
+        await b.complete(
+            {"messages": [{"role": "user", "content": "hi"}], "temperature": "abc"},
+            {}, 30.0,
+        )
+    assert ei.value.status_code == 400
+    assert ei.value.body["error"]["type"] == "invalid_request_error"
+
+
+async def test_stream_include_usage_appends_usage_chunk():
+    b = tiny_backend()
+    chunks = []
+    async for c in b.stream(
+        {
+            "messages": [{"role": "user", "content": "hi"}],
+            "max_tokens": 5,
+            "stream_options": {"include_usage": True},
+        },
+        {}, 30.0,
+    ):
+        chunks.append(c)
+    last = chunks[-1]
+    assert last["choices"] == []
+    assert last["usage"]["completion_tokens"] >= 1
+    assert last["usage"]["total_tokens"] == (
+        last["usage"]["prompt_tokens"] + last["usage"]["completion_tokens"]
+    )
+    # the finish_reason chunk still precedes it
+    assert chunks[-2]["choices"][0]["finish_reason"] in ("stop", "length")
+
+
+def test_first_user_message_skips_null_content():
+    from quorum_tpu import oai
+
+    body = {
+        "messages": [
+            {"role": "user", "content": None},
+            {"role": "user", "content": "real question"},
+        ]
+    }
+    assert oai.first_user_message(body) == "real question"
+
+
+async def test_engines_shared_despite_decode_chunk_difference():
+    """decode_chunk is a dispatch knob, not weight identity: two backends that
+    differ only in decode_chunk share one engine (one copy of weights)."""
+    a = TpuBackend.from_spec(
+        BackendSpec(name="A", url="tpu://llama-tiny?seed=7&decode_chunk=2")
+    )
+    b = TpuBackend.from_spec(
+        BackendSpec(name="B", url="tpu://llama-tiny?seed=7&decode_chunk=8")
+    )
+    assert a.engine is b.engine
+    assert a.decode_chunk == 2 and b.decode_chunk == 8
