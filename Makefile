@@ -1,0 +1,32 @@
+# Parity with the reference's Makefile targets (install/run/dev/test/coverage/
+# clean — /root/reference/Makefile:1-25), adapted to this environment: no uv,
+# no uvicorn — the bundled h11 ASGI server serves the app.
+
+.PHONY: install run dev test coverage bench dryrun clean
+
+install:
+	pip install -e .
+
+run:
+	python -m quorum_tpu.server.serve --port 8000
+
+dev:
+	python -m quorum_tpu.server.serve --port 8001 --log-level DEBUG
+
+test:
+	python -m pytest tests/ -x -q
+
+coverage:
+	python -m pytest tests/ --cov=quorum_tpu --cov-report=term-missing
+
+bench:
+	python bench.py
+
+# Multi-chip sharding validation on a virtual 8-device CPU mesh.
+dryrun:
+	XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+		python __graft_entry__.py
+
+clean:
+	rm -rf build dist *.egg-info .pytest_cache .coverage logs
+	find . -name __pycache__ -type d -exec rm -rf {} +

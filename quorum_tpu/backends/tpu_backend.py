@@ -174,12 +174,18 @@ class TpuBackend:
         effective = prepare_body(body, self.model)
         prompt = render_chat(body.get("messages") or [])
         ids = self.tokenizer.encode(prompt)
-        key = "max_completion_tokens" if body.get("max_completion_tokens") else "max_tokens"
+        key = (
+            "max_completion_tokens"
+            if body.get("max_completion_tokens") is not None
+            else "max_tokens"
+        )
         max_new = _request_number(body, key, float(self.default_max_tokens))
+        if max_new < 1:
+            raise _invalid_request(f"Invalid value for {key!r}: must be >= 1")
         return {
             "model": effective["model"],
             "prompt_ids": ids,
-            "max_new": max(1, int(max_new)),
+            "max_new": int(max_new),
             "sampler": _request_sampler(body),
             "seed": int(_request_number(body, "seed", 0.0)),
             "stops": _stop_list(body),

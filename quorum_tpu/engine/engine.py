@@ -174,7 +174,7 @@ class InferenceEngine:
             )
 
     def _generate_locked(self, prompt_ids, *, max_new_tokens, sampler, seed, eos_id,
-                         cancel=None, decode_chunk=None):
+                         cancel, decode_chunk):
         spec = self.spec
         # Keep the most recent context if the prompt exceeds the window,
         # reserving at least one position to generate into.
@@ -209,11 +209,10 @@ class InferenceEngine:
         if eos_id is not None and first == eos_id:
             return
 
-        chunk_len = decode_chunk or self.decode_chunk
         while emitted < budget:
             if cancel is not None and cancel.is_set():
                 return
-            n = min(chunk_len, budget - emitted)
+            n = min(decode_chunk, budget - emitted)
             toks, tok, lengths, ck, cv, rng = self._decode_fn(n, sampler)(
                 self.params, tok, lengths, ck, cv, rng
             )

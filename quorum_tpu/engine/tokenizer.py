@@ -61,9 +61,12 @@ class ByteTokenizer:
         return [_OFFSET + (b % self.byte_slots) for b in text.encode("utf-8")]
 
     def token_byte(self, token_id: int) -> bytes:
-        if token_id < _OFFSET or token_id >= _OFFSET + self.byte_slots:
-            return b""
-        return bytes([token_id - _OFFSET])
+        """Any non-special id maps to a byte by folding modulo the byte slots
+        — the model samples over its FULL vocab (e.g. 50257), so ids above 258
+        must still produce text or generation streams mostly-empty deltas."""
+        if token_id < _OFFSET or token_id >= self.vocab_size:
+            return b""  # pad/bos/eos and out-of-vocab produce no text
+        return bytes([(token_id - _OFFSET) % self.byte_slots])
 
     def decode(self, ids: Sequence[int]) -> str:
         return b"".join(self.token_byte(t) for t in ids).decode("utf-8", errors="replace")

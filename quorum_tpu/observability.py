@@ -1,0 +1,107 @@
+"""Observability: aggregation log channel, request ids, per-phase timing.
+
+Parity with the reference's two-channel logging (SURVEY.md §5.5):
+the ``aggregation`` logger records individual backend responses, aggregator
+prompts, and final combined output; :func:`setup_aggregation_log` attaches the
+``logs/aggregation.log`` file handler the reference configured at import time
+(/root/reference/src/quorum/oai_proxy.py:17-37) — here it is explicit and
+lazy, so importing the package has no filesystem side effects.
+
+Beyond parity (the reference had static ``chatcmpl-parallel*`` ids and no
+timing): every request gets a unique id surfaced in the ``X-Request-Id``
+response header, and :class:`PhaseTimer` records wall-clock per phase
+(fanout / aggregate / stream) into one structured log line per request.
+
+TPU profiling: when ``QUORUM_TPU_PROFILE_DIR`` is set, :func:`maybe_profile`
+wraps a request in ``jax.profiler.trace`` so device timelines land in
+TensorBoard-readable traces — the TPU-native analog of a CPU profiler.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import os
+import time
+from pathlib import Path
+
+logger = logging.getLogger(__name__)
+aggregation_logger = logging.getLogger("aggregation")
+
+_configured_paths: set[Path] = set()
+
+
+def setup_aggregation_log(log_dir: str | os.PathLike = "logs") -> Path:
+    """Attach the ``logs/aggregation.log`` file handler (idempotent per path —
+    a later call with a *different* directory attaches an additional handler
+    rather than silently keeping only the first location).
+
+    Mirrors the reference's channel: dir auto-created, a test write performed
+    so misconfiguration fails loudly at startup, INFO level, not propagated to
+    the root logger's console output.
+    """
+    path = (Path(log_dir) / "aggregation.log").resolve()
+    if path in _configured_paths:
+        return path
+    path.parent.mkdir(parents=True, exist_ok=True)
+    handler = logging.FileHandler(path)
+    handler.setFormatter(
+        logging.Formatter("%(asctime)s - %(name)s - %(levelname)s - %(message)s")
+    )
+    aggregation_logger.addHandler(handler)
+    aggregation_logger.setLevel(logging.INFO)
+    aggregation_logger.propagate = False
+    aggregation_logger.info("Aggregation logging initialized")  # test write
+    _configured_paths.add(path)
+    return path
+
+
+class PhaseTimer:
+    """Accumulates named phase durations for one request.
+
+    Usage::
+
+        timer = PhaseTimer(request_id)
+        with timer.phase("fanout"):
+            ...
+        timer.log("parallel", n_backends=3)
+    """
+
+    def __init__(self, request_id: str):
+        self.request_id = request_id
+        self._start = time.perf_counter()
+        self.phases: dict[str, float] = {}
+
+    @contextlib.contextmanager
+    def phase(self, name: str):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.phases[name] = self.phases.get(name, 0.0) + (time.perf_counter() - t0)
+
+    @property
+    def total(self) -> float:
+        return time.perf_counter() - self._start
+
+    def log(self, mode: str, **extra) -> None:
+        detail = " ".join(f"{k}={v}" for k, v in extra.items())
+        phases = " ".join(f"{k}={v * 1000:.1f}ms" for k, v in self.phases.items())
+        logger.info(
+            "request %s mode=%s total=%.1fms %s %s",
+            self.request_id, mode, self.total * 1000, phases, detail,
+        )
+
+
+@contextlib.contextmanager
+def maybe_profile(request_id: str):
+    """jax.profiler device trace for this request when QUORUM_TPU_PROFILE_DIR
+    is set; no-op (and no jax import) otherwise."""
+    profile_dir = os.environ.get("QUORUM_TPU_PROFILE_DIR", "")
+    if not profile_dir:
+        yield
+        return
+    import jax
+
+    with jax.profiler.trace(os.path.join(profile_dir, request_id)):
+        yield

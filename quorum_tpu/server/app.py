@@ -25,12 +25,15 @@ handler turned it into a 500).
 
 from __future__ import annotations
 
+import contextlib
 import json
 import logging
 import os
+import uuid
 from typing import Any, AsyncIterator
 
 from quorum_tpu import oai, sse
+from quorum_tpu.observability import PhaseTimer, maybe_profile
 from quorum_tpu.backends.base import Backend, BackendError
 from quorum_tpu.backends.registry import BackendRegistry, build_registry
 from quorum_tpu.config import Config, load_config
@@ -134,6 +137,46 @@ def create_app(
 
     @app.route("POST", "/chat/completions", "/v1/chat/completions")
     async def chat_completions(request: Request) -> Response:
+        """Request-id + timing + profiling wrapper around the dispatch logic.
+        The id is echoed in X-Request-Id (the reference only had static
+        chatcmpl-parallel* ids, SURVEY.md §5.5). For SSE the profiler/timer
+        scope must cover the *stream* — the device work happens while the ASGI
+        server drives the iterator, after this handler returns — so the scope
+        is closed from the iterator's finally, not here."""
+        rid = f"req-{uuid.uuid4().hex[:16]}"
+        timer = PhaseTimer(rid)
+        scope = contextlib.ExitStack()
+        scope.enter_context(maybe_profile(rid))
+        try:
+            response = await _chat_impl(request, timer)
+        except BaseException:
+            scope.close()
+            raise
+        response.headers.setdefault("X-Request-Id", rid)
+        if isinstance(response, StreamingResponse):
+            response.iterator = _finish_scope_after(
+                response.iterator, scope, timer, response.status_code
+            )
+        else:
+            scope.close()
+            timer.log("complete", status=response.status_code)
+        return response
+
+    async def _finish_scope_after(
+        iterator: AsyncIterator[bytes],
+        scope: contextlib.ExitStack,
+        timer: PhaseTimer,
+        status: int,
+    ) -> AsyncIterator[bytes]:
+        try:
+            with timer.phase("stream"):
+                async for chunk in iterator:
+                    yield chunk
+        finally:
+            scope.close()
+            timer.log("stream", status=status)
+
+    async def _chat_impl(request: Request, timer: PhaseTimer) -> Response:
         try:
             body = await request.json()
             if not isinstance(body, dict):
@@ -200,7 +243,8 @@ def create_app(
 
         # Non-streaming. Parity: every backend is called even in non-parallel
         # mode (oai_proxy.py:1132-1137).
-        outcomes = await fanout_complete(targets, body, headers, timeout)
+        with timer.phase("fanout"):
+            outcomes = await fanout_complete(targets, body, headers, timeout)
         successes = [o for o in outcomes if o.ok]
         if not successes:
             return JSONResponse(
@@ -214,9 +258,10 @@ def create_app(
             )
 
         if is_parallel:
-            combined = await combine_outcomes(
-                cfg, reg, outcomes, body, headers, aggregator_timeout=timeout
-            )
+            with timer.phase("combine"):
+                combined = await combine_outcomes(
+                    cfg, reg, outcomes, body, headers, aggregator_timeout=timeout
+                )
             return JSONResponse(combined)
 
         # Non-parallel: first successful response verbatim (oai_proxy.py:1356-1380).

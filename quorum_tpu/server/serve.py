@@ -18,6 +18,7 @@ from typing import Any
 import h11
 
 from quorum_tpu.config import load_config
+from quorum_tpu.observability import setup_aggregation_log
 from quorum_tpu.server.app import create_app
 
 logger = logging.getLogger(__name__)
@@ -160,12 +161,17 @@ def main() -> None:
     parser.add_argument("--port", type=int, default=8000)
     parser.add_argument("--config", default=None, help="path to config.yaml")
     parser.add_argument("--log-level", default="INFO")
+    parser.add_argument(
+        "--log-dir", default="logs",
+        help="directory for the aggregation log channel (logs/aggregation.log)",
+    )
     args = parser.parse_args()
 
     logging.basicConfig(
         level=getattr(logging, args.log_level.upper(), logging.INFO),
         format="%(levelname)s:%(asctime)s:%(name)s: %(message)s",
     )
+    setup_aggregation_log(args.log_dir)
     cfg = load_config(args.config)
     app = create_app(cfg)
     asyncio.run(serve(app, args.host, args.port))

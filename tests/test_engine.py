@@ -141,3 +141,20 @@ def test_get_engine_shared():
     c = get_engine(TINY, seed=1)
     assert a is b
     assert a is not c
+
+
+def test_byte_tokenizer_maps_full_vocab_to_text():
+    """Sampled ids above 258 (models sample the FULL vocab) must still
+    detokenize to text — regression for mostly-empty streamed deltas."""
+    from quorum_tpu.engine.tokenizer import ByteTokenizer
+
+    tok = ByteTokenizer(50257)
+    assert tok.token_byte(20410) != b""
+    assert tok.token_byte(50256) != b""
+    assert tok.token_byte(0) == b"" and tok.token_byte(2) == b""  # specials
+    assert tok.token_byte(60000) == b""  # out of vocab
+    d = tok.detokenizer()
+    text = "".join(d.feed(t) for t in [20410, 41954, 26670]) + d.flush()
+    assert len(text) >= 1
+    # encode→decode roundtrip still exact for real text
+    assert tok.decode(tok.encode("hello world")) == "hello world"
