@@ -31,7 +31,12 @@ from typing import Any, AsyncIterator
 from quorum_tpu import oai
 from quorum_tpu.backends.base import BackendError, CompletionResult, prepare_body
 from quorum_tpu.config import BackendSpec
-from quorum_tpu.engine.engine import GenerationResult, InferenceEngine, get_engine
+from quorum_tpu.engine.engine import (
+    GenerationResult,
+    InferenceEngine,
+    get_engine,
+    get_engine_from_ckpt,
+)
 from quorum_tpu.engine.tokenizer import get_tokenizer, render_chat
 from quorum_tpu.models.model_config import resolve_spec
 from quorum_tpu.ops.sampling import SamplerConfig
@@ -138,6 +143,8 @@ class TpuBackend:
         model_id: str = "",
         default_max_tokens: int = 64,
         decode_chunk: int | None = None,
+        tokenizer_path: str | None = None,
+        rng_offset: int = 0,
     ):
         self.name = name
         self.engine = engine
@@ -145,20 +152,42 @@ class TpuBackend:
         self.model = model or self.model_id
         self.default_max_tokens = default_max_tokens
         self.decode_chunk = decode_chunk  # None → engine default
-        self.tokenizer = get_tokenizer(engine.spec.vocab_size)
+        # Sampling-RNG offset: ckpt backends share one set of weights, so
+        # ensemble diversity must come from the sampler stream, not the init
+        # seed. Offset 0 for random-init backends (their weights differ).
+        self.rng_offset = rng_offset
+        self.tokenizer = get_tokenizer(engine.spec.vocab_size, tokenizer_path)
 
     @classmethod
     def from_spec(cls, bspec: BackendSpec) -> "TpuBackend":
         model_id = bspec.tpu_model_id
         opts = bspec.tpu_options
-        spec = resolve_spec(model_id, opts)
         tp = int(opts.get("tp", 1))
         dp = int(opts.get("dp", 1))
         if tp * dp > 1:
             mesh = make_mesh(MeshConfig(dp=dp, tp=tp))
         else:
             mesh = single_device_mesh()
-        engine = get_engine(spec, mesh, seed=int(opts.get("seed", 0)))
+        ckpt = opts.get("ckpt", "")
+        tokenizer_path = None
+        rng_offset = 0
+        if ckpt:
+            # seed= still differentiates ensemble members: it offsets the
+            # sampling RNG (weights are shared — one checkpoint on device).
+            rng_offset = int(opts.get("seed", 0))
+            # Real weights from a local HF checkpoint dir; its tokenizer files
+            # (tokenizer.json / tokenizer_config.json) are used when present.
+            engine = get_engine_from_ckpt(ckpt, mesh, dtype=opts.get("dtype"))
+            import os
+
+            if any(
+                os.path.exists(os.path.join(ckpt, f))
+                for f in ("tokenizer.json", "tokenizer_config.json", "vocab.json")
+            ):
+                tokenizer_path = ckpt
+        else:
+            spec = resolve_spec(model_id, opts)
+            engine = get_engine(spec, mesh, seed=int(opts.get("seed", 0)))
         return cls(
             bspec.name,
             engine,
@@ -166,6 +195,8 @@ class TpuBackend:
             model_id=model_id,
             default_max_tokens=int(opts.get("max_tokens", 64)),
             decode_chunk=int(opts["decode_chunk"]) if "decode_chunk" in opts else None,
+            tokenizer_path=tokenizer_path,
+            rng_offset=rng_offset,
         )
 
     # ---- request plumbing -------------------------------------------------
@@ -187,7 +218,7 @@ class TpuBackend:
             "prompt_ids": ids,
             "max_new": int(max_new),
             "sampler": _request_sampler(body),
-            "seed": int(_request_number(body, "seed", 0.0)),
+            "seed": int(_request_number(body, "seed", 0.0)) + self.rng_offset,
             "stops": _stop_list(body),
         }
 

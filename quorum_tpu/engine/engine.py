@@ -276,3 +276,28 @@ def get_engine(
             eng = InferenceEngine(spec, mesh, seed=seed)
             _ENGINES[key] = eng
         return eng
+
+
+def get_engine_from_ckpt(
+    ckpt_path: str,
+    mesh: Mesh | None = None,
+    *,
+    dtype: str | None = None,
+) -> InferenceEngine:
+    """Engine over a local HF checkpoint; keyed by (resolved path, mesh) so N
+    backends pointing at one checkpoint share the loaded weights on device."""
+    import os
+
+    from quorum_tpu.models.hf_loader import load_hf_checkpoint
+
+    mesh = mesh or single_device_mesh()
+    resolved = os.path.realpath(ckpt_path)
+    key = ("ckpt", resolved, dtype, tuple(sorted(mesh.shape.items())),
+           tuple(map(str, mesh.devices.flat)))
+    with _ENGINES_LOCK:
+        eng = _ENGINES.get(key)
+        if eng is None:
+            spec, params = load_hf_checkpoint(resolved, dtype=dtype)
+            eng = InferenceEngine(spec, mesh, params=params)
+            _ENGINES[key] = eng
+        return eng

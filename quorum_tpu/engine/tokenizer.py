@@ -137,8 +137,11 @@ class HFIncrementalDetokenizer:
         return out
 
 
-def get_tokenizer(vocab_size: int) -> Tokenizer:
-    path = os.environ.get("QUORUM_TPU_TOKENIZER_PATH", "")
+def get_tokenizer(vocab_size: int, path: str | None = None) -> Tokenizer:
+    """Tokenizer for a model: an explicit local HF directory (e.g. the
+    checkpoint dir of a ``ckpt=`` backend), else ``$QUORUM_TPU_TOKENIZER_PATH``,
+    else the deterministic byte tokenizer."""
+    path = path or os.environ.get("QUORUM_TPU_TOKENIZER_PATH", "")
     if path:
         try:
             hf = HFTokenizer(path)

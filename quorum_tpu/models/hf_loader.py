@@ -1,0 +1,293 @@
+"""Local HuggingFace checkpoint → quorum_tpu parameter pytree.
+
+The reference has no model loading of any kind — its "models" are remote HTTP
+endpoints (/root/reference/src/quorum/oai_proxy.py:182-192). A TPU-native
+framework must load real weights: this module reads a *local* HF checkpoint
+directory (safetensors, sharded safetensors, or pytorch_model.bin — no
+network fetch is ever attempted) and produces
+
+  - a :class:`~quorum_tpu.models.model_config.ModelSpec` inferred from
+    ``config.json`` (gpt2 / llama / mistral / qwen2 / mixtral), and
+  - the scanned-layer parameter pytree the transformer consumes, with all
+    per-layer weights stacked on a leading ``n_layers`` axis and projection
+    matrices laid out input-major (``[d_in, d_out]``, what the ``btd,dh``
+    einsums expect) in the configured compute dtype (bf16 by default).
+
+Conventions handled:
+  - HF ``nn.Linear`` stores ``[out, in]`` → transposed on load; GPT-2's
+    ``Conv1D`` already stores ``[in, out]`` → taken as-is;
+  - GPT-2's fused ``c_attn`` is split into q/k/v;
+  - RoPE needs no permutation: quorum_tpu's rotary uses the same half-split
+    convention as HF Llama (see quorum_tpu.ops.rotary.apply_rope);
+  - Mixtral expert weights are stacked onto a leading ``experts`` axis so the
+    MoE einsums stay static MXU contractions.
+
+Wire-up: ``tpu://<model-id>?ckpt=/path/to/dir`` (see TpuBackend.from_spec);
+the checkpoint's own tokenizer is used when present.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+from pathlib import Path
+from typing import Any, Callable
+
+import numpy as np
+
+from quorum_tpu.models.model_config import ModelSpec
+from quorum_tpu.models.transformer import Params
+
+logger = logging.getLogger(__name__)
+
+
+# ---- raw tensor access -----------------------------------------------------
+
+
+class _TensorDir:
+    """Lazy name→np.ndarray access over a checkpoint directory."""
+
+    def __init__(self, path: Path):
+        self.path = path
+        self._sources: list[Callable[[str], np.ndarray | None]] = []
+        self._names: set[str] = set()
+        self._load_index()
+
+    def _load_index(self) -> None:
+        st_files = sorted(self.path.glob("*.safetensors"))
+        if st_files:
+            from safetensors import safe_open
+
+            handles = {}
+            for f in st_files:
+                h = safe_open(str(f), framework="np")
+                handles[f.name] = h
+                self._names.update(h.keys())
+            by_name = {
+                name: h for h in handles.values() for name in h.keys()
+            }
+            self._sources.append(
+                lambda n: np.asarray(by_name[n].get_tensor(n)) if n in by_name else None
+            )
+            return
+        bins = sorted(self.path.glob("pytorch_model*.bin"))
+        if bins:
+            import torch
+
+            tensors: dict[str, Any] = {}
+            for f in bins:
+                tensors.update(torch.load(f, map_location="cpu", weights_only=True))
+            self._names.update(tensors.keys())
+            self._sources.append(
+                lambda n: tensors[n].float().numpy() if n in tensors else None
+            )
+            return
+        raise FileNotFoundError(f"No *.safetensors or pytorch_model*.bin in {self.path}")
+
+    def __contains__(self, name: str) -> bool:
+        return name in self._names
+
+    def get(self, name: str) -> np.ndarray | None:
+        for src in self._sources:
+            t = src(name)
+            if t is not None:
+                # bf16 checkpoints arrive as ml_dtypes.bfloat16 — normalize to
+                # f32 here; the mapper casts to the spec dtype at the end.
+                return t if t.dtype == np.float32 else t.astype(np.float32)
+        return None
+
+    def req(self, name: str) -> np.ndarray:
+        t = self.get(name)
+        if t is None:
+            raise KeyError(f"Missing tensor {name!r} in {self.path}")
+        return t
+
+
+# ---- spec inference --------------------------------------------------------
+
+
+def spec_from_hf_config(cfg: dict[str, Any]) -> ModelSpec:
+    """``config.json`` → ModelSpec for the supported families."""
+    mt = cfg.get("model_type", "")
+    if mt == "gpt2":
+        d = cfg["n_embd"]
+        heads = cfg["n_head"]
+        return ModelSpec(
+            family="gpt2", vocab_size=cfg["vocab_size"], d_model=d,
+            n_layers=cfg["n_layer"], n_heads=heads, n_kv_heads=heads,
+            head_dim=d // heads, d_ff=cfg.get("n_inner") or 4 * d,
+            max_seq=cfg.get("n_positions", 1024), norm="layernorm",
+            norm_eps=cfg.get("layer_norm_epsilon", 1e-5), pos="learned",
+            act="gelu", use_bias=True, tied_lm_head=True,
+        ).validate()
+    if mt in ("llama", "mistral", "qwen2"):
+        d = cfg["hidden_size"]
+        heads = cfg["num_attention_heads"]
+        return ModelSpec(
+            family="llama", vocab_size=cfg["vocab_size"], d_model=d,
+            n_layers=cfg["num_hidden_layers"], n_heads=heads,
+            n_kv_heads=cfg.get("num_key_value_heads", heads),
+            head_dim=cfg.get("head_dim") or d // heads,
+            d_ff=cfg["intermediate_size"],
+            max_seq=cfg.get("max_position_embeddings", 4096),
+            norm="rmsnorm", norm_eps=cfg.get("rms_norm_eps", 1e-5),
+            pos="rope", rope_theta=float(cfg.get("rope_theta", 10000.0)),
+            act="swiglu",
+            use_bias=bool(cfg.get("attention_bias", mt == "qwen2")),
+            tied_lm_head=bool(cfg.get("tie_word_embeddings", False)),
+        ).validate()
+    if mt == "mixtral":
+        d = cfg["hidden_size"]
+        heads = cfg["num_attention_heads"]
+        return ModelSpec(
+            family="mixtral", vocab_size=cfg["vocab_size"], d_model=d,
+            n_layers=cfg["num_hidden_layers"], n_heads=heads,
+            n_kv_heads=cfg.get("num_key_value_heads", heads),
+            head_dim=cfg.get("head_dim") or d // heads,
+            d_ff=cfg["intermediate_size"],
+            max_seq=cfg.get("max_position_embeddings", 4096),
+            norm="rmsnorm", norm_eps=cfg.get("rms_norm_eps", 1e-5),
+            pos="rope", rope_theta=float(cfg.get("rope_theta", 1e6)),
+            act="swiglu", use_bias=False,
+            tied_lm_head=bool(cfg.get("tie_word_embeddings", False)),
+            n_experts=cfg["num_local_experts"],
+            experts_per_token=cfg["num_experts_per_tok"],
+        ).validate()
+    raise ValueError(f"Unsupported model_type {mt!r}")
+
+
+# ---- weight mapping --------------------------------------------------------
+
+
+def _stack(arrs: list[np.ndarray], dt) -> np.ndarray:
+    return np.stack([a.astype(np.float32) for a in arrs]).astype(dt)
+
+
+def _load_gpt2(t: _TensorDir, spec: ModelSpec, dt) -> Params:
+    # transformers may prefix with "transformer."
+    p = "transformer." if "transformer.wte.weight" in t else ""
+    d = spec.d_model
+    qs, ks, vs, bqs, bks, bvs = [], [], [], [], [], []
+    blocks: dict[str, list[np.ndarray]] = {k: [] for k in (
+        "attn_norm_w", "attn_norm_b", "wo", "bo", "mlp_norm_w", "mlp_norm_b",
+        "w_up", "b_up", "w_down", "b_down",
+    )}
+    for i in range(spec.n_layers):
+        pre = f"{p}h.{i}."
+        blocks["attn_norm_w"].append(t.req(pre + "ln_1.weight"))
+        blocks["attn_norm_b"].append(t.req(pre + "ln_1.bias"))
+        w = t.req(pre + "attn.c_attn.weight")  # Conv1D [in, 3D]
+        b = t.req(pre + "attn.c_attn.bias")    # [3D]
+        qs.append(w[:, :d]); ks.append(w[:, d:2 * d]); vs.append(w[:, 2 * d:])
+        bqs.append(b[:d]); bks.append(b[d:2 * d]); bvs.append(b[2 * d:])
+        blocks["wo"].append(t.req(pre + "attn.c_proj.weight"))  # [in=D, out=D]
+        blocks["bo"].append(t.req(pre + "attn.c_proj.bias"))
+        blocks["mlp_norm_w"].append(t.req(pre + "ln_2.weight"))
+        blocks["mlp_norm_b"].append(t.req(pre + "ln_2.bias"))
+        blocks["w_up"].append(t.req(pre + "mlp.c_fc.weight"))     # [D, F]
+        blocks["b_up"].append(t.req(pre + "mlp.c_fc.bias"))
+        blocks["w_down"].append(t.req(pre + "mlp.c_proj.weight"))  # [F, D]
+        blocks["b_down"].append(t.req(pre + "mlp.c_proj.bias"))
+    return {
+        "tok_emb": t.req(p + "wte.weight").astype(dt),
+        "pos_emb": t.req(p + "wpe.weight").astype(dt),
+        "final_norm_w": t.req(p + "ln_f.weight").astype(dt),
+        "final_norm_b": t.req(p + "ln_f.bias").astype(dt),
+        "lm_head": None,  # tied
+        "blocks": {
+            **{k: _stack(v, dt) for k, v in blocks.items()},
+            "wq": _stack(qs, dt), "wk": _stack(ks, dt), "wv": _stack(vs, dt),
+            "bq": _stack(bqs, dt), "bk": _stack(bks, dt), "bv": _stack(bvs, dt),
+            "w_gate": None,
+        },
+    }
+
+
+def _load_llama_family(t: _TensorDir, spec: ModelSpec, dt) -> Params:
+    p = "model." if "model.embed_tokens.weight" in t else ""
+    blocks: dict[str, list[np.ndarray] | None] = {
+        "attn_norm_w": [], "wq": [], "wk": [], "wv": [], "wo": [],
+        "mlp_norm_w": [],
+    }
+    if spec.use_bias:
+        blocks.update(bq=[], bk=[], bv=[])
+    if spec.is_moe:
+        blocks.update(router=[], moe_w_gate=[], moe_w_up=[], moe_w_down=[])
+    else:
+        blocks.update(w_gate=[], w_up=[], w_down=[])
+    for i in range(spec.n_layers):
+        pre = f"{p}layers.{i}."
+        blocks["attn_norm_w"].append(t.req(pre + "input_layernorm.weight"))
+        blocks["wq"].append(t.req(pre + "self_attn.q_proj.weight").T)
+        blocks["wk"].append(t.req(pre + "self_attn.k_proj.weight").T)
+        blocks["wv"].append(t.req(pre + "self_attn.v_proj.weight").T)
+        blocks["wo"].append(t.req(pre + "self_attn.o_proj.weight").T)
+        if spec.use_bias:
+            blocks["bq"].append(t.req(pre + "self_attn.q_proj.bias"))
+            blocks["bk"].append(t.req(pre + "self_attn.k_proj.bias"))
+            blocks["bv"].append(t.req(pre + "self_attn.v_proj.bias"))
+        blocks["mlp_norm_w"].append(t.req(pre + "post_attention_layernorm.weight"))
+        if spec.is_moe:
+            blocks["router"].append(t.req(pre + "block_sparse_moe.gate.weight").T)
+            gates, ups, downs = [], [], []
+            for e in range(spec.n_experts):
+                epre = pre + f"block_sparse_moe.experts.{e}."
+                gates.append(t.req(epre + "w1.weight").T)  # [D, F]
+                downs.append(t.req(epre + "w2.weight").T)  # [F, D]
+                ups.append(t.req(epre + "w3.weight").T)    # [D, F]
+            blocks["moe_w_gate"].append(np.stack(gates))
+            blocks["moe_w_up"].append(np.stack(ups))
+            blocks["moe_w_down"].append(np.stack(downs))
+        else:
+            blocks["w_gate"].append(t.req(pre + "mlp.gate_proj.weight").T)
+            blocks["w_up"].append(t.req(pre + "mlp.up_proj.weight").T)
+            blocks["w_down"].append(t.req(pre + "mlp.down_proj.weight").T)
+    tok_emb = t.req(p + "embed_tokens.weight")
+    lm_head = None
+    if not spec.tied_lm_head:
+        lm = t.get("lm_head.weight")
+        lm_head = (tok_emb.T if lm is None else lm.T).astype(dt)
+    out_blocks: dict[str, Any] = {
+        k: (_stack(v, dt) if isinstance(v, list) else v) for k, v in blocks.items()
+    }
+    if spec.norm == "rmsnorm":
+        out_blocks.setdefault("attn_norm_b", None)
+        out_blocks.setdefault("mlp_norm_b", None)
+    if not spec.use_bias:
+        out_blocks.update(bq=None, bk=None, bv=None)
+    out_blocks.setdefault("bo", None)
+    if not spec.is_moe:
+        out_blocks.setdefault("b_up", None)
+        out_blocks.setdefault("b_down", None)
+    return {
+        "tok_emb": tok_emb.astype(dt),
+        "pos_emb": None,
+        "final_norm_w": t.req(p + "norm.weight").astype(dt),
+        "final_norm_b": None,
+        "lm_head": lm_head,
+        "blocks": out_blocks,
+    }
+
+
+def load_hf_checkpoint(
+    path: str | Path, dtype: str | None = None
+) -> tuple[ModelSpec, Params]:
+    """Load (spec, params) from a local HF checkpoint directory."""
+    path = Path(path)
+    cfg = json.loads((path / "config.json").read_text())
+    spec = spec_from_hf_config(cfg)
+    if dtype:
+        import dataclasses
+
+        spec = dataclasses.replace(spec, dtype=dtype)
+    import jax.numpy as jnp
+
+    dt = jnp.dtype(spec.dtype)
+    tensors = _TensorDir(path)
+    if spec.family == "gpt2":
+        params = _load_gpt2(tensors, spec, dt)
+    else:
+        params = _load_llama_family(tensors, spec, dt)
+    logger.info("Loaded %s checkpoint from %s (%d layers, vocab %d)",
+                cfg.get("model_type"), path, spec.n_layers, spec.vocab_size)
+    return spec, params

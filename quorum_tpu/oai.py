@@ -161,6 +161,38 @@ def flatten_content(content: Any) -> str:
     return ""
 
 
+def validate_request_body(body: dict[str, Any]) -> str | None:
+    """Request-level sanity of the knobs the proxy interprets (docs/api.md):
+    returns an error message for a 400, or None when the body is acceptable.
+
+    Runs BEFORE fan-out — a malformed request must be a single 400, not N
+    backend failures collapsing into a 500 proxy_error. Backends keep their
+    own validation as defense in depth.
+    """
+    import math
+
+    for key in ("temperature", "top_p", "seed", "max_tokens", "max_completion_tokens"):
+        val = body.get(key)
+        if val is None:
+            continue
+        if isinstance(val, bool):
+            return f"Invalid value for {key!r}: {val!r}"
+        try:
+            num = float(val)
+            if not math.isfinite(num):
+                raise ValueError
+        except (TypeError, ValueError):
+            return f"Invalid value for {key!r}: {val!r}"
+        if key in ("max_tokens", "max_completion_tokens") and num < 1:
+            return f"Invalid value for {key!r}: must be >= 1"
+    stop = body.get("stop")
+    if stop is not None and not isinstance(stop, (str, list)):
+        return f"Invalid value for 'stop': {stop!r}"
+    if "messages" in body and not isinstance(body["messages"], list):
+        return "Invalid value for 'messages': must be an array"
+    return None
+
+
 def first_user_message(body: dict[str, Any]) -> str:
     """The user query used for the aggregation prompt.
 

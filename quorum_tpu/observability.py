@@ -22,6 +22,7 @@ from __future__ import annotations
 import contextlib
 import logging
 import os
+import threading
 import time
 from pathlib import Path
 
@@ -93,15 +94,29 @@ class PhaseTimer:
         )
 
 
+_profile_lock = threading.Lock()
+
+
 @contextlib.contextmanager
 def maybe_profile(request_id: str):
     """jax.profiler device trace for this request when QUORUM_TPU_PROFILE_DIR
-    is set; no-op (and no jax import) otherwise."""
+    is set; no-op (and no jax import) otherwise.
+
+    The jax profiler is process-global and cannot nest: when another request
+    is already being traced, this one proceeds untraced (logged at DEBUG)
+    instead of erroring the request."""
     profile_dir = os.environ.get("QUORUM_TPU_PROFILE_DIR", "")
     if not profile_dir:
         yield
         return
-    import jax
-
-    with jax.profiler.trace(os.path.join(profile_dir, request_id)):
+    if not _profile_lock.acquire(blocking=False):
+        logger.debug("profiler busy — request %s runs untraced", request_id)
         yield
+        return
+    try:
+        import jax
+
+        with jax.profiler.trace(os.path.join(profile_dir, request_id)):
+            yield
+    finally:
+        _profile_lock.release()

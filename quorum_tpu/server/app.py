@@ -191,6 +191,15 @@ def create_app(
         if headers is None:
             return _auth_error()
 
+        # After auth (reference ordering, oai_proxy.py:976 then :1026): a
+        # malformed knob is one 400 up front, not N backend failures → 500.
+        invalid = oai.validate_request_body(body)
+        if invalid is not None:
+            return JSONResponse(
+                {"error": {"message": invalid, "type": "invalid_request_error"}},
+                status_code=400,
+            )
+
         if len(reg) == 0:
             return JSONResponse(
                 {"error": {"message": "No valid backends configured", "type": "configuration_error"}},

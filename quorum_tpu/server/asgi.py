@@ -124,7 +124,19 @@ class StreamingResponse(Response):
                         {"type": "http.response.body", "body": chunk, "more_body": True}
                     )
         finally:
-            await send({"type": "http.response.body", "body": b""})
+            # Client disconnects surface as send() raising: close the
+            # iterator NOW so its finally blocks (backend cancellation,
+            # profiler scope, timing) run deterministically, not at GC.
+            aclose = getattr(self.iterator, "aclose", None)
+            if aclose is not None:
+                try:
+                    await aclose()
+                except Exception:
+                    pass
+            try:
+                await send({"type": "http.response.body", "body": b""})
+            except Exception:
+                pass  # peer already gone; the original exception propagates
 
 
 Handler = Callable[[Request], Awaitable[Response]]

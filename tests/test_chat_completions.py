@@ -154,3 +154,31 @@ async def test_unknown_route_404_and_wrong_method_405():
     async with make_client(single_cfg(), LLM1=fake) as client:
         assert (await client.get("/nope")).status_code == 404
         assert (await client.get("/chat/completions")).status_code == 405
+
+
+async def test_malformed_max_tokens_is_single_400():
+    """Request-level junk must be one 400 up front, not N backend failures
+    collapsing into a 500 proxy_error (docs/api.md contract)."""
+    fake = FakeBackend("LLM1", text="never reached")
+    async with make_client(single_cfg(), LLM1=fake) as client:
+        r = await client.post(
+            "/chat/completions",
+            json={"model": "m", "messages": [{"role": "user", "content": "q"}],
+                  "max_tokens": 0},
+            headers=AUTH,
+        )
+    assert r.status_code == 400
+    assert r.json()["error"]["type"] == "invalid_request_error"
+
+
+async def test_malformed_temperature_is_single_400():
+    fake = FakeBackend("LLM1", text="never reached")
+    async with make_client(single_cfg(), LLM1=fake) as client:
+        r = await client.post(
+            "/chat/completions",
+            json={"model": "m", "messages": [{"role": "user", "content": "q"}],
+                  "temperature": "abc"},
+            headers=AUTH,
+        )
+    assert r.status_code == 400
+    assert r.json()["error"]["type"] == "invalid_request_error"
