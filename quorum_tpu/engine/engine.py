@@ -292,7 +292,10 @@ def get_engine_from_ckpt(
 
     mesh = mesh or single_device_mesh()
     resolved = os.path.realpath(ckpt_path)
-    key = ("ckpt", resolved, dtype, tuple(sorted(mesh.shape.items())),
+    # Normalize: dtype=None and an explicit dtype equal to the default must
+    # hit the same cache entry (else the checkpoint sits in HBM twice).
+    eff_dtype = dtype or ModelSpec().dtype
+    key = ("ckpt", resolved, eff_dtype, tuple(sorted(mesh.shape.items())),
            tuple(map(str, mesh.devices.flat)))
     with _ENGINES_LOCK:
         eng = _ENGINES.get(key)

@@ -209,8 +209,11 @@ def _load_llama_family(t: _TensorDir, spec: ModelSpec, dt) -> Params:
         "attn_norm_w": [], "wq": [], "wk": [], "wv": [], "wo": [],
         "mlp_norm_w": [],
     }
+    has_o_bias = f"{p}layers.0.self_attn.o_proj.bias" in t
     if spec.use_bias:
         blocks.update(bq=[], bk=[], bv=[])
+        if has_o_bias:
+            blocks.update(bo=[])
     if spec.is_moe:
         blocks.update(router=[], moe_w_gate=[], moe_w_up=[], moe_w_down=[])
     else:
@@ -226,6 +229,8 @@ def _load_llama_family(t: _TensorDir, spec: ModelSpec, dt) -> Params:
             blocks["bq"].append(t.req(pre + "self_attn.q_proj.bias"))
             blocks["bk"].append(t.req(pre + "self_attn.k_proj.bias"))
             blocks["bv"].append(t.req(pre + "self_attn.v_proj.bias"))
+            if has_o_bias:  # llama attention_bias puts one on o_proj too
+                blocks["bo"].append(t.req(pre + "self_attn.o_proj.bias"))
         blocks["mlp_norm_w"].append(t.req(pre + "post_attention_layernorm.weight"))
         if spec.is_moe:
             blocks["router"].append(t.req(pre + "block_sparse_moe.gate.weight").T)

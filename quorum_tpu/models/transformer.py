@@ -36,6 +36,7 @@ from jax import lax
 
 from quorum_tpu.models.model_config import ModelSpec
 from quorum_tpu.ops.attention import attention, causal_mask, decode_attention
+from quorum_tpu.ops.flash_attention import flash_prefill_attention
 from quorum_tpu.ops.norms import layernorm, rmsnorm
 from quorum_tpu.ops.rotary import apply_rope, rope_cos_sin
 
@@ -156,7 +157,6 @@ def prefill(
     positions = jnp.arange(t)
     x = _embed(params, spec, tokens, positions)
     cos, sin = rope_cos_sin(spec.max_seq, spec.head_dim, spec.rope_theta)
-    mask = causal_mask(t, t) & (jnp.arange(t)[None, :] < lengths[:, None])[:, None, None, None, :]
 
     def body(carry_x, per_layer):
         block, ck, cv = per_layer  # ck/cv: [B, K, max_seq, hd]
@@ -165,7 +165,9 @@ def prefill(
         if spec.pos == "rope":
             q = apply_rope(q, cos, sin, positions)
             k = apply_rope(k, cos, sin, positions)
-        attn = attention(q, k, v, mask)
+        # Flash kernel on TPU (causal + length mask fused, O(S) VMEM);
+        # XLA-native reference path elsewhere.
+        attn = flash_prefill_attention(q, k, v, lengths)
         carry_x = carry_x + _attn_out(attn, block, carry_x.dtype)
         h2 = _norm(carry_x, block["mlp_norm_w"], block.get("mlp_norm_b"), spec)
         mlp = _moe_mlp(h2, block, spec) if spec.is_moe else _dense_mlp(h2, block, spec)

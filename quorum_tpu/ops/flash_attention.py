@@ -1,0 +1,177 @@
+"""Pallas flash attention for the prefill path (TPU kernel).
+
+Blockwise causal attention with online softmax — O(S) VMEM instead of
+materializing the [S, S] score matrix in HBM, the standard memory-bandwidth
+win for long-prompt prefill on TPU. Design per /opt/skills/guides/
+pallas_guide.md:
+
+  - grid = (batch, q_heads, q_blocks); each program owns one [BLOCK_Q, hd]
+    query tile in VMEM and streams K/V tiles of the matching **KV head**
+    (GQA is pure index mapping — head h reads kv head h//group — so no
+    repeat_kv copies exist anywhere);
+  - the KV loop trip count is the causal frontier ``ceil((iq+1)·BQ / BK)``:
+    blocks strictly above the diagonal are never read from HBM at all;
+  - online softmax carries (m, l, acc) in f32 through a ``fori_loop``; both
+    matmuls run on the MXU with f32 accumulation;
+  - right-padding is masked via the per-row ``lengths`` so bucketed batches
+    share one compiled program (same contract as ops.attention).
+
+`flash_prefill_attention` falls back to the XLA-native reference path
+(quorum_tpu.ops.attention) off-TPU or for shapes the kernel doesn't cover;
+tests run the kernel in interpreter mode on CPU against that reference.
+The reference proxy has no attention at all (models are remote HTTP calls,
+/root/reference/src/quorum/oai_proxy.py:182-192) — this kernel exists for the
+tpu:// backends' performance, not behavioral parity.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+DEFAULT_BLOCK_Q = 128
+DEFAULT_BLOCK_K = 128
+
+
+def _flash_kernel(
+    len_ref,   # SMEM [B, 1] — valid lengths, indexed by program_id(0)
+    q_ref,     # VMEM [1, 1, BQ, hd]
+    k_ref,     # VMEM [1, 1, S_kv, hd] (the matching KV head)
+    v_ref,     # VMEM [1, 1, S_kv, hd]
+    o_ref,     # VMEM [1, 1, BQ, hd]
+    *,
+    scale: float,
+    block_k: int,
+):
+    iq = pl.program_id(2)
+    bq = q_ref.shape[2]
+    hd = q_ref.shape[3]
+    length = len_ref[pl.program_id(0), 0]
+    q_start = iq * bq
+
+    q = q_ref[0, 0, :, :].astype(jnp.float32) * scale
+    row_ids = q_start + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
+
+    # Causal frontier: KV columns ≥ (iq+1)·BQ can never be attended to by
+    # this query tile — skip those blocks entirely (dynamic trip count).
+    n_blocks = pl.cdiv((iq + 1) * bq, block_k)
+
+    def body(j, carry):
+        m, l, acc = carry
+        k_blk = k_ref[0, 0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[0, 0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        logits = jax.lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [BQ, BK]
+        col_ids = j * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (bq, block_k), 1
+        )
+        keep = (col_ids <= row_ids) & (col_ids < length)
+        logits = jnp.where(keep, logits, NEG_INF)
+
+        m_new = jnp.maximum(m, jnp.max(logits, axis=-1, keepdims=True))
+        p = jnp.exp(logits - m_new)
+        corr = jnp.exp(m - m_new)
+        l_new = corr * l + jnp.sum(p, axis=-1, keepdims=True)
+        acc_new = corr * acc + jnp.dot(p, v_blk, preferred_element_type=jnp.float32)
+        return m_new, l_new, acc_new
+
+    m0 = jnp.full((bq, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((bq, 1), jnp.float32)
+    acc0 = jnp.zeros((bq, hd), jnp.float32)
+    _, l, acc = jax.lax.fori_loop(0, n_blocks, body, (m0, l0, acc0))
+    # Fully-masked rows (right-padding past `length`) have l == 0; their
+    # output is irrelevant downstream but must not be NaN.
+    out = acc / jnp.maximum(l, 1e-30)
+    o_ref[0, 0, :, :] = out.astype(o_ref.dtype)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("block_q", "block_k", "interpret")
+)
+def _flash_call(
+    q, k, v, lengths, *, block_q: int, block_k: int, interpret: bool
+):
+    b, h, s_q, hd = q.shape
+    n_kv = k.shape[1]
+    s_kv = k.shape[2]
+    group = h // n_kv
+    grid = (b, h, s_q // block_q)
+
+    kernel = functools.partial(
+        _flash_kernel, scale=hd**-0.5, block_k=block_k
+    )
+    return pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            # Scalars live 2D in SMEM (pallas guide); the whole [B, 1] array
+            # is one block (Mosaic requires block dims divisible by (8, 128)
+            # OR equal to the array dims — per-row (1, 1) blocks are not).
+            pl.BlockSpec((b, 1), lambda ib, ih, iq: (0, 0),
+                         memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, 1, block_q, hd), lambda ib, ih, iq: (ib, ih, iq, 0)),
+            pl.BlockSpec((1, 1, s_kv, hd), lambda ib, ih, iq: (ib, ih // group, 0, 0)),
+            pl.BlockSpec((1, 1, s_kv, hd), lambda ib, ih, iq: (ib, ih // group, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, 1, block_q, hd), lambda ib, ih, iq: (ib, ih, iq, 0)
+        ),
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        interpret=interpret,
+    )(lengths.reshape(b, 1), q, k, v)
+
+
+def flash_supported(q_shape: tuple, k_shape: tuple, block_q: int, block_k: int) -> bool:
+    b, h, s_q, hd = q_shape
+    n_kv, s_kv = k_shape[1], k_shape[2]
+    return (
+        s_q % block_q == 0
+        and s_kv % block_k == 0
+        and s_q >= block_q
+        and h % n_kv == 0
+        and hd % 8 == 0
+    )
+
+
+def flash_enabled() -> bool:
+    """Kernel path on TPU unless QUORUM_TPU_FLASH=0; off-TPU the XLA
+    reference path runs (interpret mode is for tests only — too slow to
+    serve with)."""
+    flag = os.environ.get("QUORUM_TPU_FLASH", "1")
+    return flag != "0" and jax.default_backend() == "tpu"
+
+
+def flash_prefill_attention(
+    q: jnp.ndarray,        # [B, H, S, hd]
+    k: jnp.ndarray,        # [B, K, S_kv, hd]
+    v: jnp.ndarray,
+    lengths: jnp.ndarray,  # [B] valid prompt lengths
+    *,
+    block_q: int = DEFAULT_BLOCK_Q,
+    block_k: int = DEFAULT_BLOCK_K,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Causal, length-masked prefill attention; flash kernel when supported,
+    XLA-native reference otherwise. Returns [B, H, S, hd]."""
+    # Clamp tiles to the sequence (buckets are powers of two, so they divide).
+    block_q = min(block_q, q.shape[2])
+    block_k = min(block_k, k.shape[2])
+    if (interpret or flash_enabled()) and flash_supported(
+        q.shape, k.shape, block_q, block_k
+    ):
+        return _flash_call(
+            q, k, v, jnp.asarray(lengths, jnp.int32),
+            block_q=block_q, block_k=block_k, interpret=interpret,
+        )
+    from quorum_tpu.ops.attention import prefill_attention
+
+    return prefill_attention(q, k, v, lengths)

@@ -69,6 +69,16 @@ def test_llama_attention_bias_parity(tmp_path):
         tie_word_embeddings=True,
     )
     model = LlamaForCausalLM(cfg).eval()
+    # transformers zero-inits biases — randomize them so the bias mapping
+    # (bq/bk/bv/bo) is actually exercised, not vacuously compared against 0.
+    import torch
+
+    with torch.no_grad():
+        for layer in model.model.layers:
+            for proj in ("q_proj", "k_proj", "v_proj", "o_proj"):
+                b = getattr(layer.self_attn, proj).bias
+                if b is not None:
+                    b.normal_(0.0, 0.5)
     model.save_pretrained(tmp_path, safe_serialization=True)
     assert_close(our_logits(tmp_path), torch_logits(model, TOKENS))
 
