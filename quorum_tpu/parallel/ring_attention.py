@@ -1,0 +1,116 @@
+"""Ring attention: sequence-parallel causal attention over the ``sp`` mesh axis.
+
+Long-context design (SURVEY.md §5.7): the sequence dimension is sharded over
+``sp`` devices, so no device ever materializes full-length K/V — activation
+memory per chip is O(S/sp). Each device computes blockwise attention of its
+local query block against the K/V block it currently holds, then passes that
+K/V block to its ring neighbor with ``lax.ppermute`` (ICI nearest-neighbor
+traffic), repeating sp times. Online softmax (the same math as the flash
+kernel, quorum_tpu.ops.flash_attention) merges the partial results exactly.
+
+Composition with the rest of the mesh: the wrapper is a ``shard_map`` over the
+FULL (dp, sp, tp) mesh — batch stays sharded on dp and heads on tp; only the
+ring loop communicates, and only over sp. Blocks entirely above the causal
+diagonal contribute nothing but still take a ring step (the permutation must
+stay collective); their work is masked out.
+
+The reference proxy has no sequence handling at all (prompts are opaque
+strings relayed over HTTP, /root/reference/src/quorum/oai_proxy.py:185-192) —
+this module is north-star functionality, not behavioral parity.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+try:
+    from jax import shard_map  # jax ≥ 0.8
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map
+
+from quorum_tpu.ops.attention import NEG_INF
+from quorum_tpu.parallel.mesh import AXIS_DP, AXIS_SP, AXIS_TP
+
+
+def _ring_local(q, k, v, lengths, *, axis: str, sp_size: int, _mesh_axes=()):
+    """Per-device ring loop. q/k/v: [B, H_local, S_local, hd]; lengths [B]."""
+    idx = lax.axis_index(axis)
+    s_local = q.shape[2]
+    scale = q.shape[-1] ** -0.5
+    qf = q.astype(jnp.float32) * scale
+    row_global = idx * s_local + jnp.arange(s_local)  # [S_local]
+
+    perm = [(j, (j + 1) % sp_size) for j in range(sp_size)]
+
+    def step(carry, i):
+        m, l, acc, k_cur, v_cur = carry
+        # The block we hold at step i originated on device (idx - i) mod sp.
+        src = (idx - i) % sp_size
+        col_global = src * s_local + jnp.arange(s_local)  # [S_local]
+        logits = jnp.einsum(
+            "bhsd,bhtd->bhst", qf, k_cur.astype(jnp.float32),
+            preferred_element_type=jnp.float32,
+        )
+        causal = col_global[None, :] <= row_global[:, None]   # [S, T]
+        valid = col_global[None, :] < lengths[:, None]         # [B, T]
+        keep = causal[None, :, :] & valid[:, None, :]          # [B, S, T]
+        logits = jnp.where(keep[:, None, :, :], logits, NEG_INF)
+
+        m_new = jnp.maximum(m, jnp.max(logits, axis=-1, keepdims=True))
+        p = jnp.exp(logits - m_new)
+        corr = jnp.exp(m - m_new)
+        l_new = corr * l + jnp.sum(p, axis=-1, keepdims=True)
+        acc_new = corr * acc + jnp.einsum(
+            "bhst,bhtd->bhsd", p, v_cur.astype(jnp.float32),
+            preferred_element_type=jnp.float32,
+        )
+        k_nxt = lax.ppermute(k_cur, axis, perm)
+        v_nxt = lax.ppermute(v_cur, axis, perm)
+        return (m_new, l_new, acc_new, k_nxt, v_nxt), None
+
+    b, h, s, hd = q.shape
+    m0 = jnp.full((b, h, s, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, h, s, 1), jnp.float32)
+    acc0 = jnp.zeros((b, h, s, hd), jnp.float32)
+    # Mark the freshly-created carries as device-varying so the scan carry
+    # type matches its (varying) outputs under shard_map's vma typing.
+    try:
+        m0, l0, acc0 = jax.lax.pcast((m0, l0, acc0), tuple(_mesh_axes), to="varying")
+    except (AttributeError, TypeError):  # older jax spells it pvary
+        m0, l0, acc0 = jax.lax.pvary((m0, l0, acc0), tuple(_mesh_axes))
+    (m, l, acc, _, _), _ = lax.scan(
+        step, (m0, l0, acc0, k, v), jnp.arange(sp_size)
+    )
+    out = acc / jnp.maximum(l, 1e-30)
+    return out.astype(q.dtype)
+
+
+def ring_prefill_attention(
+    q: jnp.ndarray,        # [B, H, S, hd] (global view)
+    k: jnp.ndarray,        # [B, H, S, hd] — KV heads pre-broadcast to H
+    v: jnp.ndarray,
+    lengths: jnp.ndarray,  # [B]
+    mesh: Mesh,
+    *,
+    sp: str = AXIS_SP,
+) -> jnp.ndarray:
+    """Causal, length-masked attention with the sequence sharded over ``sp``.
+
+    Batch rides dp, heads ride tp, sequence rides sp; only sp communicates
+    (one ppermute of the local K/V block per ring step).
+    """
+    sp_size = mesh.shape[sp]
+    qs = P(AXIS_DP, AXIS_TP, sp, None)
+    inner = partial(_ring_local, axis=sp, sp_size=sp_size,
+                    _mesh_axes=tuple(mesh.axis_names))
+    fn = shard_map(
+        inner,
+        mesh=mesh,
+        in_specs=(qs, qs, qs, P(AXIS_DP)),
+        out_specs=qs,
+    )
+    return fn(q, k, v, lengths)

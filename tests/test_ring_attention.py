@@ -1,0 +1,85 @@
+"""Ring attention vs full attention on the virtual 8-device CPU mesh.
+
+Sequence sharded over sp; batch over dp; heads over tp — only sp
+communicates (ppermute per ring step). Reference: the XLA-native
+prefill_attention, itself validated against transformers' forward.
+"""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from quorum_tpu.ops.attention import prefill_attention
+from quorum_tpu.parallel.mesh import MeshConfig, make_mesh
+from quorum_tpu.parallel.ring_attention import ring_prefill_attention
+
+
+def rand(seed, shape):
+    return jax.random.normal(jax.random.PRNGKey(seed), shape, jnp.float32)
+
+
+def run_case(mesh_cfg, b, h, s, hd, lengths):
+    mesh = make_mesh(mesh_cfg)
+    q = rand(0, (b, h, s, hd))
+    k = rand(1, (b, h, s, hd))
+    v = rand(2, (b, h, s, hd))
+    lengths = jnp.asarray(lengths, jnp.int32)
+    out = ring_prefill_attention(q, k, v, lengths, mesh)
+    ref = prefill_attention(q, k, v, lengths)
+    return np.asarray(out), np.asarray(ref), np.asarray(lengths)
+
+
+def check_valid(out, ref, lengths, atol=2e-5):
+    for bi, n in enumerate(lengths):
+        np.testing.assert_allclose(
+            out[bi, :, :n, :], ref[bi, :, :n, :], atol=atol, rtol=1e-4
+        )
+
+
+def test_ring_sp4_matches_full():
+    out, ref, lengths = run_case(MeshConfig(sp=4), 1, 2, 64, 16, [64])
+    check_valid(out, ref, lengths)
+
+
+def test_ring_sp8_long_sequence():
+    out, ref, lengths = run_case(MeshConfig(sp=8), 1, 2, 128, 16, [128])
+    check_valid(out, ref, lengths)
+
+
+def test_ring_composes_with_dp_and_tp():
+    """Full dp2 × sp2 × tp2 mesh: batch and heads shard too; only the ring
+    communicates across sp."""
+    out, ref, lengths = run_case(MeshConfig(dp=2, sp=2, tp=2), 2, 2, 64, 16, [64, 64])
+    check_valid(out, ref, lengths)
+
+
+def test_ring_respects_lengths():
+    out, ref, lengths = run_case(MeshConfig(sp=4), 2, 2, 64, 16, [30, 55])
+    check_valid(out, ref, lengths)
+    assert not np.isnan(out).any()
+
+
+def test_forward_logits_sp_matches_dense():
+    """The full sequence-parallel model forward (ring attention per layer,
+    GQA, under jit on a dp2×sp2×tp2 mesh) matches the dense forward."""
+    from quorum_tpu.models.init import init_params
+    from quorum_tpu.models.model_config import resolve_spec
+    from quorum_tpu.models.transformer import forward_logits, forward_logits_sp
+
+    spec = resolve_spec("llama-tiny", {"max_seq": "64", "dtype": "float32"})
+    params = init_params(spec, seed=0)
+    mesh = make_mesh(MeshConfig(dp=2, sp=2, tp=2))
+    tokens = jax.random.randint(jax.random.PRNGKey(0), (2, 32), 1, spec.vocab_size)
+    lengths = jnp.asarray([32, 20], jnp.int32)
+
+    dense = forward_logits(params, spec, tokens)
+    sp_out = jax.jit(
+        lambda p, t, l: forward_logits_sp(p, spec, t, l, mesh)
+    )(params, tokens, lengths)
+    dense, sp_out = np.asarray(dense), np.asarray(sp_out)
+    # dense forward has no length mask; compare valid rows only
+    for bi, n in enumerate([32, 20]):
+        np.testing.assert_allclose(
+            sp_out[bi, :n], dense[bi, :n], atol=2e-4, rtol=1e-3
+        )
