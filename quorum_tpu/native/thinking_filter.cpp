@@ -1,0 +1,193 @@
+// Native incremental thinking-tag filter.
+//
+// Byte-exact port of quorum_tpu.filtering.ThinkingTagFilter (itself the
+// behavioral twin of the reference's filter,
+// /root/reference/src/quorum/oai_proxy.py:262-371): feed arbitrarily-chunked
+// UTF-8 text, get back the text provably outside every <tag>...</tag>
+// thinking block; partial tags buffer across chunk boundaries; nesting
+// tracked; unterminated blocks discarded at flush. This runs once per SSE
+// delta on the streaming hot path — the one per-token Python loop worth
+// taking native. Tag matching is ASCII-case-insensitive, matching Python's
+// re.IGNORECASE over the ASCII tag names used in configs.
+//
+// C ABI (driven from quorum_tpu/native/__init__.py via ctypes):
+//   ttf_create(tags)  tags = '\n'-separated names     -> handle
+//   ttf_feed(h, text, len, &out_len)                  -> malloc'd buffer
+//   ttf_flush(h, &out_len)                            -> malloc'd buffer
+//   ttf_free(buf), ttf_destroy(h)
+
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <vector>
+
+namespace {
+
+char ascii_lower(char c) {
+    return (c >= 'A' && c <= 'Z') ? static_cast<char>(c - 'A' + 'a') : c;
+}
+
+bool ci_equal(const char* text, size_t n, const std::string& form) {
+    if (form.size() != n) return false;
+    for (size_t i = 0; i < n; ++i) {
+        if (ascii_lower(text[i]) != form[i]) return false;
+    }
+    return true;
+}
+
+// Is lowercase(text[0..n)) a PROPER prefix of form?
+bool ci_proper_prefix(const char* text, size_t n, const std::string& form) {
+    if (n >= form.size()) return false;
+    for (size_t i = 0; i < n; ++i) {
+        if (ascii_lower(text[i]) != form[i]) return false;
+    }
+    return true;
+}
+
+struct Filter {
+    std::vector<std::string> open_forms;   // "<tag>" lowercase
+    std::vector<std::string> close_forms;  // "</tag>" lowercase
+    std::string buf;
+    int depth = 0;
+
+    // First complete match of any form in buf at/after `from`; returns
+    // (pos, end) or pos == npos.
+    std::pair<size_t, size_t> find_first(
+        const std::vector<std::string>& forms, size_t from) const {
+        for (size_t i = from; i < buf.size(); ++i) {
+            if (buf[i] != '<') continue;
+            for (const auto& f : forms) {
+                if (i + f.size() <= buf.size() &&
+                    ci_equal(buf.data() + i, f.size(), f)) {
+                    return {i, i + f.size()};
+                }
+            }
+        }
+        return {std::string::npos, std::string::npos};
+    }
+
+    // Python parity: only the LAST '<' is considered a partial-tag candidate
+    // (filtering.py _partial_open_at_end uses rfind).
+    size_t partial_at_end(bool include_close) const {
+        size_t pos = buf.rfind('<');
+        if (pos == std::string::npos) return std::string::npos;
+        const char* cand = buf.data() + pos;
+        size_t n = buf.size() - pos;
+        for (const auto& f : open_forms) {
+            if (ci_proper_prefix(cand, n, f)) return pos;
+        }
+        if (include_close) {
+            for (const auto& f : close_forms) {
+                if (ci_proper_prefix(cand, n, f)) return pos;
+            }
+        }
+        return std::string::npos;
+    }
+
+    std::string feed(const char* text, size_t len) {
+        buf.append(text, len);
+        std::string out;
+        for (;;) {
+            if (depth == 0) {
+                auto m = find_first(open_forms, 0);
+                if (m.first != std::string::npos) {
+                    out.append(buf, 0, m.first);
+                    buf.erase(0, m.second);
+                    depth = 1;
+                    continue;
+                }
+                size_t cut = partial_at_end(false);
+                if (cut != std::string::npos) {
+                    out.append(buf, 0, cut);
+                    buf.erase(0, cut);
+                } else {
+                    out.append(buf);
+                    buf.clear();
+                }
+                break;
+            } else {
+                auto mo = find_first(open_forms, 0);
+                auto mc = find_first(close_forms, 0);
+                if (mc.first != std::string::npos &&
+                    (mo.first == std::string::npos || mc.first < mo.first)) {
+                    buf.erase(0, mc.second);
+                    if (depth > 0) --depth;
+                    continue;
+                }
+                if (mo.first != std::string::npos) {
+                    buf.erase(0, mo.second);
+                    ++depth;
+                    continue;
+                }
+                size_t cut = partial_at_end(true);
+                if (cut != std::string::npos) {
+                    buf.erase(0, cut);
+                } else {
+                    buf.clear();
+                }
+                break;
+            }
+        }
+        return out;
+    }
+
+    std::string flush() {
+        std::string out;
+        if (depth > 0) {
+            buf.clear();
+            depth = 0;
+            return out;
+        }
+        size_t cut = partial_at_end(false);
+        out = (cut != std::string::npos) ? buf.substr(0, cut) : buf;
+        buf.clear();
+        return out;
+    }
+};
+
+char* dup_result(const std::string& s, size_t* out_len) {
+    char* p = static_cast<char*>(std::malloc(s.size() + 1));
+    if (p == nullptr) {
+        if (out_len != nullptr) *out_len = 0;
+        return nullptr;
+    }
+    std::memcpy(p, s.data(), s.size());
+    p[s.size()] = '\0';
+    if (out_len != nullptr) *out_len = s.size();
+    return p;
+}
+
+}  // namespace
+
+extern "C" {
+
+void* ttf_create(const char* tags) {
+    auto* f = new Filter();
+    const char* p = tags;
+    while (p != nullptr && *p != '\0') {
+        const char* nl = std::strchr(p, '\n');
+        size_t n = (nl != nullptr) ? static_cast<size_t>(nl - p) : std::strlen(p);
+        if (n > 0) {
+            std::string t(p, n);
+            for (auto& c : t) c = ascii_lower(c);
+            f->open_forms.push_back("<" + t + ">");
+            f->close_forms.push_back("</" + t + ">");
+        }
+        p = (nl != nullptr) ? nl + 1 : nullptr;
+    }
+    return f;
+}
+
+char* ttf_feed(void* h, const char* text, size_t len, size_t* out_len) {
+    return dup_result(static_cast<Filter*>(h)->feed(text, len), out_len);
+}
+
+char* ttf_flush(void* h, size_t* out_len) {
+    return dup_result(static_cast<Filter*>(h)->flush(), out_len);
+}
+
+void ttf_free(char* p) { std::free(p); }
+
+void ttf_destroy(void* h) { delete static_cast<Filter*>(h); }
+
+}  // extern "C"

@@ -46,8 +46,7 @@ def _ring_local(q, k, v, lengths, *, axis: str, sp_size: int, _mesh_axes=()):
 
     perm = [(j, (j + 1) % sp_size) for j in range(sp_size)]
 
-    def step(carry, i):
-        m, l, acc, k_cur, v_cur = carry
+    def update(m, l, acc, k_cur, v_cur, i):
         # The block we hold at step i originated on device (idx - i) mod sp.
         src = (idx - i) % sp_size
         col_global = src * s_local + jnp.arange(s_local)  # [S_local]
@@ -68,9 +67,14 @@ def _ring_local(q, k, v, lengths, *, axis: str, sp_size: int, _mesh_axes=()):
             "bhst,bhtd->bhsd", p, v_cur.astype(jnp.float32),
             preferred_element_type=jnp.float32,
         )
+        return m_new, l_new, acc_new
+
+    def step(carry, i):
+        m, l, acc, k_cur, v_cur = carry
+        m, l, acc = update(m, l, acc, k_cur, v_cur, i)
         k_nxt = lax.ppermute(k_cur, axis, perm)
         v_nxt = lax.ppermute(v_cur, axis, perm)
-        return (m_new, l_new, acc_new, k_nxt, v_nxt), None
+        return (m, l, acc, k_nxt, v_nxt), None
 
     b, h, s, hd = q.shape
     m0 = jnp.full((b, h, s, 1), NEG_INF, jnp.float32)
@@ -82,9 +86,13 @@ def _ring_local(q, k, v, lengths, *, axis: str, sp_size: int, _mesh_axes=()):
         m0, l0, acc0 = jax.lax.pcast((m0, l0, acc0), tuple(_mesh_axes), to="varying")
     except (AttributeError, TypeError):  # older jax spells it pvary
         m0, l0, acc0 = jax.lax.pvary((m0, l0, acc0), tuple(_mesh_axes))
-    (m, l, acc, _, _), _ = lax.scan(
-        step, (m0, l0, acc0, k, v), jnp.arange(sp_size)
+    # sp_size-1 (compute + permute) steps, then one final compute with the
+    # last-held block OUTSIDE the scan — the ring's last permutation would
+    # only be thrown away, so it is never sent.
+    (m, l, acc, k_last, v_last), _ = lax.scan(
+        step, (m0, l0, acc0, k, v), jnp.arange(sp_size - 1)
     )
+    m, l, acc = update(m, l, acc, k_last, v_last, sp_size - 1)
     out = acc / jnp.maximum(l, 1e-30)
     return out.astype(q.dtype)
 

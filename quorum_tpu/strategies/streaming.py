@@ -41,7 +41,8 @@ from quorum_tpu import oai, sse
 from quorum_tpu.backends.base import Backend
 from quorum_tpu.backends.registry import BackendRegistry
 from quorum_tpu.config import AggregateParams, Config
-from quorum_tpu.filtering import ThinkingTagFilter, strip_thinking_tags
+from quorum_tpu.filtering import strip_thinking_tags
+from quorum_tpu.native import make_thinking_filter
 from quorum_tpu.strategies.aggregate import aggregate_responses
 
 logger = logging.getLogger(__name__)
@@ -144,7 +145,8 @@ async def parallel_stream(
     yield sse.encode_event(oai.role_chunk(PROXY_MODEL_NAME))
 
     n = len(plan.backends)
-    filters = {i: ThinkingTagFilter(plan.thinking_tags) for i in range(n)}
+    # Native C++ filter when it loads; Python reference implementation else.
+    filters = {i: make_thinking_filter(plan.thinking_tags) for i in range(n)}
     collected = ["" for _ in range(n)]
     queue: asyncio.Queue = asyncio.Queue()
     tasks = [

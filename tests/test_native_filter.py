@@ -1,0 +1,88 @@
+"""Native C++ thinking-tag filter: byte-exact equivalence with the Python
+reference implementation (which encodes the reference proxy's semantics,
+tests/test_filtering.py)."""
+
+import random
+
+import pytest
+
+from quorum_tpu.filtering import DEFAULT_THINKING_TAGS, ThinkingTagFilter
+from quorum_tpu.native import (
+    NativeThinkingTagFilter,
+    make_thinking_filter,
+    native_available,
+)
+
+pytestmark = pytest.mark.skipif(
+    not native_available(), reason="no C++ toolchain in this environment"
+)
+
+TAGS = list(DEFAULT_THINKING_TAGS)
+
+CASES = [
+    ["plain text, no tags"],
+    ["before <think>hidden</think> after"],
+    ["a<think>b</think>c<reason>d</reason>e"],
+    ["split <thi", "nk>hidden</think> visible"],
+    ["open <think>h", "idden</th", "ink> done"],
+    ["nested <think>a<think>b</think>c</think>d"],
+    ["<THINK>case</THINK>ok"],
+    ["stray close</think> passes through"],
+    ["unterminated <think>never closed"],
+    ["trailing partial <thi"],
+    ["< not a tag <th!nk> also not"],
+    ["<think></think>empty"],
+    ["a<reasoning>x</reasoning>b<thought>y</thought>c"],
+    ["multi\nline <think>hid\nden</think> text\n"],
+    ["unicode ✓ <think>héllo</think> wörld"],
+]
+
+
+def run_pair(chunks, tags=TAGS):
+    py = ThinkingTagFilter(tags)
+    cc = NativeThinkingTagFilter(tags)
+    py_out = [py.feed(c) for c in chunks] + [py.flush()]
+    cc_out = [cc.feed(c) for c in chunks] + [cc.flush()]
+    return py_out, cc_out
+
+
+@pytest.mark.parametrize("chunks", CASES, ids=[c[0][:28] for c in CASES])
+def test_native_matches_python(chunks):
+    py_out, cc_out = run_pair(chunks)
+    assert cc_out == py_out
+
+
+def test_native_matches_python_fuzz():
+    """Randomized corpus re-chunked at random boundaries: every feed() must
+    return byte-identical output to the Python reference."""
+    rng = random.Random(42)
+    alphabet = ["<", ">", "/", "think", "reason", "t", "x ", "<think>",
+                "</think>", "<reasoning>", "</reasoning>", "✓", "\n"]
+    for _ in range(200):
+        text = "".join(rng.choice(alphabet) for _ in range(rng.randint(1, 60)))
+        chunks, i = [], 0
+        while i < len(text):
+            j = min(len(text), i + rng.randint(1, 7))
+            chunks.append(text[i:j])
+            i = j
+        py_out, cc_out = run_pair(chunks)
+        assert cc_out == py_out, (text, chunks, py_out, cc_out)
+
+
+def test_no_tags_passthrough():
+    py_out, cc_out = run_pair(["anything <think> goes"], tags=[])
+    assert cc_out == py_out
+    assert cc_out[0] == "anything <think> goes"
+
+
+def test_make_thinking_filter_defaults_to_python(monkeypatch):
+    """Python is the measured-faster default at SSE-delta granularity."""
+    monkeypatch.delenv("QUORUM_TPU_NATIVE", raising=False)
+    f = make_thinking_filter(TAGS)
+    assert isinstance(f, ThinkingTagFilter)
+
+
+def test_make_thinking_filter_native_opt_in(monkeypatch):
+    monkeypatch.setenv("QUORUM_TPU_NATIVE", "1")
+    f = make_thinking_filter(TAGS)
+    assert isinstance(f, NativeThinkingTagFilter)
