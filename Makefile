@@ -23,9 +23,10 @@ bench:
 	python bench.py
 
 # Multi-chip sharding validation on a virtual 8-device CPU mesh.
+# dryrun_multichip re-execs itself with a clean env (JAX_PLATFORMS=cpu,
+# axon TPU hook cleared), so this works in the bench image unchanged.
 dryrun:
-	XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
-		python __graft_entry__.py
+	python -c "from __graft_entry__ import dryrun_multichip; dryrun_multichip(8)"
 
 clean:
 	rm -rf build dist *.egg-info .pytest_cache .coverage logs
