@@ -1,36 +1,66 @@
-"""Benchmark: p50 time-to-first-token through the full serving stack.
+"""Benchmark: the north-star serving metrics through a real TCP socket.
 
-Shape of the run (north-star config, BASELINE.json): one OpenAI-compatible
-``/chat/completions`` request fanned out to THREE in-process ``tpu://``
+Shape of the run (north-star config, BASELINE.json): OpenAI-compatible
+``/chat/completions`` requests fanned out to THREE in-process ``tpu://``
 model backends (distinct weight seeds ≈ distinct ensemble members) with the
-``concatenate`` strategy, SSE streaming — measured end-to-end through the
-ASGI app, SSE encoder, and the engines' prefill/decode programs on whatever
-``jax.devices()`` provides (the real TPU chip under the driver; CPU anywhere
-else).
+``concatenate`` strategy — served by the bundled h11 server on a localhost
+socket and driven by a real httpx client, so every number includes the full
+stack: TCP, HTTP parsing, ASGI, SSE encoding, strategy merge, and the
+engines' prefill/decode programs on whatever ``jax.devices()`` provides
+(the real TPU chip under the driver; CPU anywhere else).
 
-Metric: p50 TTFT (ms) — time from request start to the first *content* delta.
+Measured:
+  p50_ttft_ms    time from request start to the first *content* SSE delta,
+                 sequential streaming requests. A real socket is load-bearing:
+                 httpx.ASGITransport buffers the entire ASGI response, which
+                 made the round-1 number an artifact (VERDICT.md).
+  p50_total_ms   full completion latency of those same requests.
+  req_per_s      concurrent non-streaming requests / wall time.
+  tokens_per_s   decoded completion tokens (summed usage across the 3
+                 backends, real counts from the local engines) / wall time.
+  mfu_pct        tokens_per_s x 2 x params-per-model / chip peak FLOPs
+                 (TPU v5e bf16 peak 197e12; reported as 0.0 off-TPU).
+
 ``vs_baseline``: the reference design buffers the entire upstream response
 before re-streaming (/root/reference/src/quorum/oai_proxy.py:187-203), so on
-identical hardware its TTFT equals the full completion latency. We therefore
-report p50(total latency) / p50(TTFT) — how many times earlier the first
-token arrives than the reference architecture could deliver it.
+identical hardware its TTFT equals the full completion latency. We report
+p50(total) / p50(TTFT) — how many times earlier the first token arrives than
+the reference architecture could deliver it.
 
 Prints ONE JSON line:
-  {"metric": "p50_ttft_ms", "value": ..., "unit": "ms", "vs_baseline": ...}
+  {"metric": "p50_ttft_ms", "value": ..., "unit": "ms", "vs_baseline": ...,
+   "p50_total_ms": ..., "req_per_s": ..., "tokens_per_s": ..., "mfu_pct": ...}
 """
 
 from __future__ import annotations
 
 import asyncio
 import json
+import os
 import statistics
 import sys
 import time
 
+# A requested CPU run must also disable this image's axon TPU hook: the
+# sitecustomize imports jax and registers the real chip at interpreter startup
+# whenever PALLAS_AXON_POOL_IPS is set, and that wins over JAX_PLATFORMS=cpu.
+# Backends initialize lazily, so flipping the already-imported jax config here
+# (the same recipe as tests/conftest.py) still takes effect.
+if os.environ.get("JAX_PLATFORMS", "").startswith("cpu"):
+    os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+# Env overrides exist for quick smoke runs on CPU (the full 124M config is
+# TPU-sized); the driver runs the defaults on the real chip.
 N_WARMUP = 1
-N_REQUESTS = 6
-MAX_TOKENS = 32
-MODEL = "gpt2"  # BASELINE.json config[0] model family, real 124M size
+N_TTFT_REQUESTS = int(os.environ.get("QUORUM_TPU_BENCH_TTFT_REQUESTS", "6"))
+CONCURRENCY = int(os.environ.get("QUORUM_TPU_BENCH_CONCURRENCY", "4"))
+N_THROUGHPUT_REQUESTS = int(os.environ.get("QUORUM_TPU_BENCH_THROUGHPUT_REQUESTS", "12"))
+MAX_TOKENS = int(os.environ.get("QUORUM_TPU_BENCH_MAX_TOKENS", "32"))
+MODEL = os.environ.get("QUORUM_TPU_BENCH_MODEL", "gpt2")  # BASELINE config[0], real 124M
+V5E_PEAK_FLOPS = 197e12  # bf16 peak, one v5e chip
 
 
 def build_app():
@@ -58,18 +88,21 @@ def build_app():
     return create_app(Config(raw=raw))
 
 
-async def one_request(client) -> tuple[float, float]:
-    """Returns (ttft_s, total_s) for one streaming fan-out request."""
-    body = {
+def _body(stream: bool) -> dict:
+    return {
         "model": MODEL,
         "messages": [{"role": "user", "content": "Benchmark prompt: say something."}],
-        "stream": True,
+        "stream": stream,
         "max_tokens": MAX_TOKENS,
     }
+
+
+async def one_stream(client) -> tuple[float, float]:
+    """Returns (ttft_s, total_s) for one streaming fan-out request."""
     t0 = time.perf_counter()
     ttft = None
     async with client.stream(
-        "POST", "/chat/completions", json=body,
+        "POST", "/chat/completions", json=_body(stream=True),
         headers={"Authorization": "Bearer bench"},
     ) as resp:
         assert resp.status_code == 200, f"HTTP {resp.status_code}"
@@ -85,29 +118,93 @@ async def one_request(client) -> tuple[float, float]:
     return ttft, total
 
 
+async def one_complete(client) -> int:
+    """One non-streaming fan-out request; returns summed completion tokens."""
+    resp = await client.post(
+        "/chat/completions", json=_body(stream=False),
+        headers={"Authorization": "Bearer bench"},
+    )
+    assert resp.status_code == 200, f"HTTP {resp.status_code}: {resp.text[:200]}"
+    return int(resp.json()["usage"]["completion_tokens"])
+
+
+def _params_per_model() -> int:
+    """Parameter count of one ensemble member, from the live engine cache."""
+    import jax
+
+    from quorum_tpu.engine.engine import _ENGINES
+
+    for eng in _ENGINES.values():
+        return sum(x.size for x in jax.tree_util.tree_leaves(eng.params))
+    return 0
+
+
+def _on_tpu() -> bool:
+    import jax
+
+    return jax.default_backend() not in ("cpu",)
+
+
 async def main() -> None:
     import httpx
 
+    from quorum_tpu.server.serve import start_server
+
     app = build_app()
-    transport = httpx.ASGITransport(app=app)
-    async with httpx.AsyncClient(
-        transport=transport, base_url="http://bench", timeout=600
-    ) as client:
-        for _ in range(N_WARMUP):  # compile prefill/decode programs
-            await one_request(client)
-        ttfts, totals = [], []
-        for _ in range(N_REQUESTS):
-            ttft, total = await one_request(client)
-            ttfts.append(ttft)
-            totals.append(total)
+    server = await start_server(app, "127.0.0.1", 0)
+    port = server.sockets[0].getsockname()[1]
+    try:
+        async with httpx.AsyncClient(
+            base_url=f"http://127.0.0.1:{port}", timeout=600
+        ) as client:
+            for _ in range(N_WARMUP):  # compile prefill/decode programs
+                await one_stream(client)
+                await one_complete(client)
+
+            # Phase 1 — latency: sequential streaming requests.
+            ttfts, totals = [], []
+            for _ in range(N_TTFT_REQUESTS):
+                ttft, total = await one_stream(client)
+                ttfts.append(ttft)
+                totals.append(total)
+
+            # Phase 2 — throughput: CONCURRENCY in-flight non-streaming
+            # requests, N_THROUGHPUT_REQUESTS total (sliding window).
+            sem = asyncio.Semaphore(CONCURRENCY)
+
+            async def bounded():
+                async with sem:
+                    return await one_complete(client)
+
+            t0 = time.perf_counter()
+            token_counts = await asyncio.gather(
+                *[bounded() for _ in range(N_THROUGHPUT_REQUESTS)]
+            )
+            wall = time.perf_counter() - t0
+    finally:
+        server.close()
+        await server.wait_closed()
 
     p50_ttft_ms = statistics.median(ttfts) * 1000
     p50_total_ms = statistics.median(totals) * 1000
+    req_per_s = N_THROUGHPUT_REQUESTS / wall
+    tokens_per_s = sum(token_counts) / wall
+    n_params = _params_per_model()
+    mfu = (tokens_per_s * 2 * n_params / V5E_PEAK_FLOPS * 100) if _on_tpu() else 0.0
     print(json.dumps({
         "metric": "p50_ttft_ms",
         "value": round(p50_ttft_ms, 2),
         "unit": "ms",
         "vs_baseline": round(p50_total_ms / p50_ttft_ms, 2),
+        "p50_total_ms": round(p50_total_ms, 2),
+        "req_per_s": round(req_per_s, 3),
+        "tokens_per_s": round(tokens_per_s, 1),
+        "mfu_pct": round(mfu, 4),
+        "concurrency": CONCURRENCY,
+        "model": MODEL,
+        "n_models": 3,
+        "max_tokens": MAX_TOKENS,
+        "params_per_model": n_params,
     }))
 
 

@@ -144,11 +144,20 @@ class _ConnectionHandler:
             self.writer.write(data)
 
 
-async def serve(app, host: str = "0.0.0.0", port: int = 8000) -> None:
+async def start_server(app, host: str = "127.0.0.1", port: int = 0) -> asyncio.Server:
+    """Bind and return the asyncio server (port 0 → ephemeral; read the bound
+    port from ``server.sockets[0].getsockname()[1]``). Used by bench.py and the
+    socket-level tests, which need a real TCP socket — httpx.ASGITransport
+    buffers entire responses and cannot observe streaming incrementality."""
+
     async def on_connect(reader, writer):
         await _ConnectionHandler(app, reader, writer).run()
 
-    server = await asyncio.start_server(on_connect, host, port)
+    return await asyncio.start_server(on_connect, host, port)
+
+
+async def serve(app, host: str = "0.0.0.0", port: int = 8000) -> None:
+    server = await start_server(app, host, port)
     addrs = ", ".join(str(s.getsockname()) for s in server.sockets)
     logger.info("quorum_tpu serving on %s", addrs)
     async with server:

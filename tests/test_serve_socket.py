@@ -1,0 +1,107 @@
+"""Socket-level streaming tests: incremental delivery through the real server.
+
+Round 1's benchmark drove the app through httpx.ASGITransport, which buffers
+the entire ASGI response before the client sees byte one — so TTFT silently
+equaled total latency and nothing caught it (VERDICT.md round 1, weakness 2).
+These tests pin the property that matters: through the bundled h11 server on
+a real TCP socket, the first SSE content delta arrives while the rest of the
+stream is still being produced.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import httpx
+import pytest
+
+from quorum_tpu.backends.fake import FakeBackend
+from quorum_tpu.config import Config
+from quorum_tpu.server.app import create_app
+from quorum_tpu.server.serve import start_server
+
+from tests.conftest import two_backend_parallel_config
+
+N_CHUNKS = 5
+CHUNK_DELAY = 0.08
+# A stream of N chunks spaced CHUNK_DELAY apart takes ~N*CHUNK_DELAY end to
+# end; genuinely incremental delivery puts the first delta ~1 chunk in. The
+# 0.5 threshold leaves slack for slow CI while still failing hard on any
+# buffer-the-whole-response regression (where ttft == total).
+MAX_TTFT_FRACTION = 0.5
+
+
+def single_backend_config() -> dict:
+    return {
+        "settings": {"timeout": 10},
+        "primary_backends": [
+            {"name": "LLM1", "url": "http://test1.example.com/v1", "model": "m"}
+        ],
+    }
+
+
+async def _stream_timing(app, body) -> tuple[float, float]:
+    """Drive one streaming request over a real socket; return (ttft, total)."""
+    server = await start_server(app, "127.0.0.1", 0)
+    port = server.sockets[0].getsockname()[1]
+    try:
+        async with httpx.AsyncClient(
+            base_url=f"http://127.0.0.1:{port}", timeout=30
+        ) as client:
+            t0 = time.perf_counter()
+            ttft = None
+            async with client.stream(
+                "POST", "/chat/completions", json=body,
+                headers={"Authorization": "Bearer t"},
+            ) as resp:
+                assert resp.status_code == 200
+                async for line in resp.aiter_lines():
+                    if not line.startswith("data: ") or line == "data: [DONE]":
+                        continue
+                    delta = (json.loads(line[6:]).get("choices") or [{}])[0].get(
+                        "delta"
+                    ) or {}
+                    if ttft is None and delta.get("content"):
+                        ttft = time.perf_counter() - t0
+            total = time.perf_counter() - t0
+    finally:
+        server.close()
+        await server.wait_closed()
+    assert ttft is not None, "no content delta received"
+    return ttft, total
+
+
+def _slow_backends(names: tuple[str, ...]) -> dict[str, FakeBackend]:
+    return {
+        name: FakeBackend(
+            name, chunks=["tok"] * N_CHUNKS, chunk_delay=CHUNK_DELAY,
+            requires_auth=False,
+        )
+        for name in names
+    }
+
+
+def _single_app():
+    return create_app(
+        Config(raw=single_backend_config()), **_slow_backends(("LLM1",))
+    )
+
+
+def _parallel_app():
+    return create_app(
+        Config(raw=two_backend_parallel_config()),
+        **_slow_backends(("LLM1", "LLM2")),
+    )
+
+
+@pytest.mark.parametrize("app_factory", [_single_app, _parallel_app],
+                         ids=["single", "parallel"])
+async def test_stream_is_incremental_over_socket(app_factory):
+    body = {"model": "m", "messages": [{"role": "user", "content": "hi"}],
+            "stream": True}
+    ttft, total = await _stream_timing(app_factory(), body)
+    assert total >= N_CHUNKS * CHUNK_DELAY * 0.8
+    assert ttft < total * MAX_TTFT_FRACTION, (
+        f"first delta at {ttft:.3f}s of {total:.3f}s — stream is buffered"
+    )
