@@ -13,6 +13,9 @@ URL grammar:  ``tpu://<model-id>?<spec overrides>&<engine options>``
   tp=, dp=         mesh shape (default: single device)
   seed=            weight-init seed (distinct seeds ≈ distinct ensemble members)
   decode_chunk=    tokens per device dispatch (default 8)
+  slots=           concurrent batch width of the engine's KV cache (default 4;
+                   applies when this backend constructs the engine — backends
+                   sharing an engine share its slot count)
   max_tokens=      default completion budget when the request has none
 
 Contract parity with the dispatcher: configured model overrides the request
@@ -32,6 +35,7 @@ from quorum_tpu import oai
 from quorum_tpu.backends.base import BackendError, CompletionResult, prepare_body
 from quorum_tpu.config import BackendSpec
 from quorum_tpu.engine.engine import (
+    DEFAULT_SLOTS,
     GenerationResult,
     InferenceEngine,
     get_engine,
@@ -171,13 +175,16 @@ class TpuBackend:
         ckpt = opts.get("ckpt", "")
         tokenizer_path = None
         rng_offset = 0
+        n_slots = int(opts.get("slots", DEFAULT_SLOTS))
         if ckpt:
             # seed= still differentiates ensemble members: it offsets the
             # sampling RNG (weights are shared — one checkpoint on device).
             rng_offset = int(opts.get("seed", 0))
             # Real weights from a local HF checkpoint dir; its tokenizer files
             # (tokenizer.json / tokenizer_config.json) are used when present.
-            engine = get_engine_from_ckpt(ckpt, mesh, dtype=opts.get("dtype"))
+            engine = get_engine_from_ckpt(
+                ckpt, mesh, dtype=opts.get("dtype"), n_slots=n_slots
+            )
             import os
 
             if any(
@@ -187,7 +194,9 @@ class TpuBackend:
                 tokenizer_path = ckpt
         else:
             spec = resolve_spec(model_id, opts)
-            engine = get_engine(spec, mesh, seed=int(opts.get("seed", 0)))
+            engine = get_engine(
+                spec, mesh, seed=int(opts.get("seed", 0)), n_slots=n_slots
+            )
         return cls(
             bspec.name,
             engine,
@@ -284,7 +293,7 @@ class TpuBackend:
         except BaseException:
             # Request cancellation (client disconnect): abort the shielded
             # generation thread too, or it would decode to completion while
-            # holding the engine lock.
+            # occupying an engine slot.
             cancel.set()
             raise
 
@@ -343,7 +352,7 @@ class TpuBackend:
         producer = loop.run_in_executor(None, produce)
         try:
             # inside the try: a disconnect at this first yield must still
-            # cancel the producer thread (it already holds the engine lock)
+            # cancel the producer thread (it already occupies an engine slot)
             yield oai.role_chunk(model, chunk_id)
             while True:
                 kind, val = await asyncio.wait_for(queue.get(), timeout=timeout)

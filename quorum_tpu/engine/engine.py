@@ -1,16 +1,29 @@
-"""The inference engine: compiled prefill + chunked decode over a mesh.
+"""The inference engine: continuous batching over a slot-based KV cache.
 
-TPU-first design (SURVEY.md §7, hard parts 1-3):
+TPU-first design (SURVEY.md §7, hard parts 1-3; redesigned in round 2 per
+VERDICT.md weakness 4 — the round-1 engine allocated a fresh KV cache on the
+host per request and held a lock for the whole generation, fully serializing
+concurrent requests):
 
-  - **Bucketed prefill**: prompts are right-padded to a power-of-two bucket so
-    one compiled program per (batch, bucket) serves every request — no
-    dynamic shapes, no per-request recompiles.
-  - **Chunked decode**: ``decode_chunk`` steps run inside one ``lax.scan`` per
-    dispatch, so the host syncs with the device once per *chunk*, not once
-    per token. Chunk size trades TTFT (first dispatch) against dispatch
-    overhead; sampling happens on-device inside the scan.
-  - **Donated KV cache**: the cache is donated to each jitted call, so XLA
-    updates it in place — no per-step cache copies in HBM.
+  - **Slot-batched KV cache, allocated once**: ``[L, n_slots, K, max_seq, hd]``
+    × 2 lives on device for the engine's lifetime and is donated through every
+    compiled call — no per-request host zeros, no 1 GB device_put per request.
+  - **Continuous batching**: a scheduler thread admits requests into free
+    slots (prefill writes the prompt's K/V *directly into the slot* — see
+    transformer.prefill_into_slot) and runs batched decode chunks over all
+    active slots. Decode is HBM-bound on the weights, so co-batched requests
+    decode at nearly the latency of one; N concurrent requests complete in
+    ≪ N× serial time.
+  - **Per-slot sampler state as arrays**: temperature/top_p/top_k/PRNG-key
+    live in [n_slots] device arrays, so ONE compiled decode program serves
+    every sampler configuration (sampling is row-independent — see
+    ops.sampling.sample_token_rows). No per-config program cache.
+  - **Chunked decode**: each dispatch scans ``decode_chunk`` steps, so the
+    host syncs once per chunk, not per token; admission happens at chunk
+    boundaries (a new request waits at most one chunk + its own prefill).
+  - **Determinism**: each request's sampling stream is keyed by its own seed
+    at admission, and every op is row-independent, so results don't depend on
+    which slot a request lands in or what else is co-batched with it.
   - **Mesh-agnostic**: parameters and cache are placed with NamedShardings
     from quorum_tpu.parallel.sharding; the same code runs on a 1-device CPU
     mesh (tests), a single TPU chip (bench), or a tp×dp slice (GSPMD inserts
@@ -23,25 +36,26 @@ The reference has no analog — its "backends" are HTTP calls
 
 from __future__ import annotations
 
+import queue
 import threading
-from collections import OrderedDict
 from dataclasses import dataclass, field
-from functools import partial
 from typing import Iterator
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from quorum_tpu.models.init import init_params
 from quorum_tpu.models.model_config import ModelSpec
 from quorum_tpu.models.transformer import decode_step, init_cache, prefill
-from quorum_tpu.ops.sampling import SamplerConfig, sample_token
+from quorum_tpu.ops.sampling import SamplerConfig, sample_token_rows
 from quorum_tpu.parallel.mesh import single_device_mesh
 from quorum_tpu.parallel.sharding import kv_cache_sharding, shard_pytree
 
 MIN_BUCKET = 16
+DEFAULT_SLOTS = 4
 
 
 def prefill_bucket(n: int, max_seq: int) -> int:
@@ -62,13 +76,37 @@ class GenerationResult:
         return len(self.token_ids)
 
 
-class InferenceEngine:
-    """One loaded model on one mesh; serves generations serially (batch=1).
+class _Request:
+    """One queued/active generation; tokens flow to the consumer via ``out``."""
 
-    Thread-safe: a lock serializes generations so concurrent requests from
-    the server's executor threads don't interleave cache state. Fan-out
-    across *different* engines (the quorum case: N backends) runs truly
-    concurrently — each engine owns its params and cache.
+    __slots__ = (
+        "prompt_ids", "budget", "temperature", "top_p", "top_k", "seed",
+        "eos_id", "cancel", "chunk_hint", "out", "emitted",
+    )
+
+    def __init__(self, prompt_ids, budget, sampler: SamplerConfig, seed, eos_id,
+                 cancel, chunk_hint):
+        self.prompt_ids = prompt_ids
+        self.budget = budget
+        self.temperature = sampler.temperature
+        self.top_p = sampler.top_p
+        self.top_k = sampler.top_k
+        self.seed = seed
+        self.eos_id = eos_id
+        self.cancel = cancel
+        self.chunk_hint = chunk_hint
+        self.out: queue.Queue = queue.Queue()
+        self.emitted = 0
+
+
+class InferenceEngine:
+    """One loaded model on one mesh, serving many requests concurrently.
+
+    All device work happens on the engine's scheduler thread; callers talk to
+    it through thread-safe queues, so ``generate_stream`` can be called from
+    any number of threads at once. Concurrent requests co-batch into one
+    decode program (continuous batching) instead of serializing — including
+    fan-out backends that share one checkpoint's engine.
     """
 
     def __init__(
@@ -79,71 +117,134 @@ class InferenceEngine:
         seed: int = 0,
         decode_chunk: int = 8,
         params=None,
+        n_slots: int = DEFAULT_SLOTS,
     ):
         self.spec = spec.validate()
         self.mesh = mesh or single_device_mesh()
         self.decode_chunk = max(1, decode_chunk)
-        self._lock = threading.Lock()
+        self.n_slots = max(1, n_slots)
         host_params = params if params is not None else init_params(spec, seed)
         self.params = shard_pytree(self.mesh, host_params)
-        self._cache_sharding = kv_cache_sharding(self.mesh, spec.n_kv_heads, batch=1)
+        self._cache_sh = kv_cache_sharding(self.mesh, spec.n_kv_heads, batch=self.n_slots)
         self._rep = NamedSharding(self.mesh, P())
-        # One jitted prefill: jax.jit already specializes per bucket shape.
-        self._prefill = jax.jit(
-            partial(prefill, spec=self.spec),
-            donate_argnames=("cache_k", "cache_v"),
+        self._init_device_state()
+
+        self._admit_cache: dict[int, object] = {}   # bucket → compiled admit
+        self._decode_cache: dict[int, object] = {}  # n_steps → compiled chunk
+
+        # Scheduler state, guarded by _cond's lock.
+        self._pending: list[_Request] = []
+        self._slots: list[_Request | None] = [None] * self.n_slots
+        self._cond = threading.Condition()
+        self._thread = threading.Thread(
+            target=self._scheduler, name=f"engine-{id(self):x}", daemon=True
         )
-        # Sampler-keyed executable caches are bounded: SamplerConfig values come
-        # from requests, so without eviction arbitrary temperature/top_p values
-        # would grow compiled-program memory without limit (callers additionally
-        # quantize the knobs — see tpu_backend._request_sampler).
-        self._decode_cache: OrderedDict[tuple, object] = OrderedDict()
-        self._sample_cache: OrderedDict[SamplerConfig, object] = OrderedDict()
-        self._max_sampler_programs = 32
+        self._thread.start()
+
+    def _init_device_state(self) -> None:
+        """(Re)allocate the slot-batched cache and per-slot state on device.
+
+        Called at construction and after any failed compiled call: the jitted
+        programs donate the cache/state buffers, so an exception mid-dispatch
+        can leave ``self._ck`` & co. pointing at deleted arrays — without a
+        reset, one poisoned request would brick the (shared) engine forever.
+        The cache is allocated by a compiled zero-fill — no host-side
+        materialization or transfer of the multi-GB buffer.
+        """
+        self._ck, self._cv = jax.jit(
+            lambda: init_cache(self.spec, batch=self.n_slots),
+            out_shardings=(self._cache_sh, self._cache_sh),
+        )()
+        s = self.n_slots
+        rep = self._rep
+        self._token = jax.device_put(np.zeros((s,), np.int32), rep)
+        self._lengths = jax.device_put(np.zeros((s,), np.int32), rep)
+        self._keys = jax.device_put(np.zeros((s, 2), np.uint32), rep)
+        self._temp = jax.device_put(np.ones((s,), np.float32), rep)
+        self._topp = jax.device_put(np.ones((s,), np.float32), rep)
+        self._topk = jax.device_put(np.zeros((s,), np.int32), rep)
 
     # ---- compiled programs ------------------------------------------------
 
-    def _sample_fn(self, sampler: SamplerConfig):
-        fn = self._sample_cache.get(sampler)
-        if fn is None:
-            fn = jax.jit(partial(sample_token, cfg=sampler))
-            self._sample_cache[sampler] = fn
-            while len(self._sample_cache) > self._max_sampler_programs:
-                self._sample_cache.popitem(last=False)
-        else:
-            self._sample_cache.move_to_end(sampler)  # LRU, not FIFO
-        return fn
-
-    def _decode_fn(self, n_steps: int, sampler: SamplerConfig):
-        """Jitted: run ``n_steps`` decode+sample steps in one lax.scan."""
-        key_ = (n_steps, sampler)
-        fn = self._decode_cache.get(key_)
+    def _admit_fn(self, bucket: int):
+        """Jitted: prefill one prompt into a slot + sample its first token."""
+        fn = self._admit_cache.get(bucket)
         if fn is not None:
-            self._decode_cache.move_to_end(key_)  # LRU, not FIFO
             return fn
         spec = self.spec
 
-        def chunk(params, token, lengths, cache_k, cache_v, rng):
-            def step(carry, _):
-                tok, lens, ck, cv, k = carry
-                logits, ck, cv = decode_step(params, spec, tok, lens, ck, cv)
-                k, sub = jax.random.split(k)
-                nxt = sample_token(logits, sub, sampler)
-                return (nxt, lens + 1, ck, cv, k), nxt
-
-            (token, lengths, cache_k, cache_v, rng), toks = lax.scan(
-                step, (token, lengths, cache_k, cache_v, rng), None, length=n_steps
+        def admit(params, tokens, lengths1, slot, seed, temp1, topp1, topk1,
+                  ck, cv, token_s, lengths_s, keys_s, temp_s, topp_s, topk_s):
+            logits, ck, cv = prefill(
+                params, spec, tokens, lengths1, ck, cv, slot=slot
             )
-            # toks: [n_steps, B] → [B, n_steps]
-            return toks.T, token, lengths, cache_k, cache_v, rng
+            key = jax.random.PRNGKey(seed)
+            key, sub = jax.random.split(key)
+            first = sample_token_rows(
+                logits, sub[None], temp1[None], topp1[None], topk1[None]
+            )[0]
+            return (
+                first,
+                ck,
+                cv,
+                token_s.at[slot].set(first),
+                lengths_s.at[slot].set(lengths1[0]),
+                keys_s.at[slot].set(key),
+                temp_s.at[slot].set(temp1),
+                topp_s.at[slot].set(topp1),
+                topk_s.at[slot].set(topk1),
+            )
 
-        fn = jax.jit(chunk, donate_argnames=("cache_k", "cache_v"))
-        self._decode_cache[key_] = fn
-        while len(self._decode_cache) > self._max_sampler_programs:
-            self._decode_cache.popitem(last=False)
+        fn = jax.jit(
+            admit,
+            donate_argnames=(
+                "ck", "cv", "token_s", "lengths_s", "keys_s",
+                "temp_s", "topp_s", "topk_s",
+            ),
+        )
+        self._admit_cache[bucket] = fn
         return fn
 
-    # ---- generation -------------------------------------------------------
+    def _decode_fn(self, n_steps: int):
+        """Jitted: ``n_steps`` batched decode+sample steps over all slots."""
+        fn = self._decode_cache.get(n_steps)
+        if fn is not None:
+            return fn
+        spec = self.spec
+
+        def chunk(params, active, ck, cv, token_s, lengths_s, keys_s,
+                  temp_s, topp_s, topk_s):
+            live = active > 0
+
+            def step(carry, _):
+                tok, lens, ck, cv, keys = carry
+                # Inactive slots write their (discarded) K/V at position 0,
+                # which the next admission's prefill overwrites before any
+                # read — every cache position is written before it is read.
+                pos = jnp.where(live, lens, 0)
+                logits, ck, cv = decode_step(params, spec, tok, pos, ck, cv)
+                split = jax.vmap(jax.random.split)(keys)  # [S, 2, 2]
+                nxt = sample_token_rows(
+                    logits, split[:, 1], temp_s, topp_s, topk_s
+                )
+                nxt = jnp.where(live, nxt, tok)
+                lens = lens + live.astype(lens.dtype)
+                return (nxt, lens, ck, cv, split[:, 0]), nxt
+
+            (token_s, lengths_s, ck, cv, keys_s), toks = lax.scan(
+                step, (token_s, lengths_s, ck, cv, keys_s), None, length=n_steps
+            )
+            # toks: [n_steps, S] → [S, n_steps]
+            return toks.T, ck, cv, token_s, lengths_s, keys_s
+
+        fn = jax.jit(
+            chunk,
+            donate_argnames=("ck", "cv", "token_s", "lengths_s", "keys_s"),
+        )
+        self._decode_cache[n_steps] = fn
+        return fn
+
+    # ---- public API -------------------------------------------------------
 
     def generate_stream(
         self,
@@ -156,74 +257,36 @@ class InferenceEngine:
         cancel: threading.Event | None = None,
         decode_chunk: int | None = None,
     ) -> Iterator[int]:
-        """Yield generated token ids one at a time (blocking; device-synced
-        once per chunk). Stops at EOS, max_new_tokens, context exhaustion, or
-        when ``cancel`` is set (checked at each chunk boundary — the way a
-        host thread can abort a compiled on-device loop). ``decode_chunk``
-        overrides the engine default per call — a dispatch knob, not part of
-        the engine's weight identity (see :func:`get_engine`)."""
-        with self._lock:
-            yield from self._generate_locked(
-                prompt_ids,
-                max_new_tokens=max_new_tokens,
-                sampler=sampler or SamplerConfig(),
-                seed=seed,
-                eos_id=eos_id,
-                cancel=cancel,
-                decode_chunk=decode_chunk or self.decode_chunk,
-            )
-
-    def _generate_locked(self, prompt_ids, *, max_new_tokens, sampler, seed, eos_id,
-                         cancel, decode_chunk):
-        spec = self.spec
-        # Keep the most recent context if the prompt exceeds the window,
-        # reserving at least one position to generate into.
-        room = spec.max_seq - 1
-        if len(prompt_ids) > room:
-            prompt_ids = prompt_ids[-room:]
-        if not prompt_ids:
-            prompt_ids = [0]
-        n_prompt = len(prompt_ids)
-        budget = min(max_new_tokens, spec.max_seq - n_prompt)
-        if budget <= 0 or (cancel is not None and cancel.is_set()):
-            return
-
-        bucket = prefill_bucket(n_prompt, spec.max_seq)
-        tokens = jnp.zeros((1, bucket), jnp.int32).at[0, :n_prompt].set(
-            jnp.asarray(prompt_ids, jnp.int32)
+        """Yield generated token ids as the scheduler produces them (the EOS
+        token, when hit, is the last id yielded). Stops at EOS,
+        max_new_tokens, context exhaustion, or when ``cancel`` is set
+        (honored at the next chunk boundary). ``decode_chunk`` is a latency
+        hint: the scheduler chunks by the smallest hint among active
+        requests. Abandoning the iterator early cancels the request's
+        remaining device work."""
+        req = self._submit(
+            prompt_ids,
+            max_new_tokens=max_new_tokens,
+            sampler=sampler or SamplerConfig(),
+            seed=seed,
+            eos_id=eos_id,
+            cancel=cancel,
+            decode_chunk=decode_chunk,
         )
-        lengths = jnp.asarray([n_prompt], jnp.int32)
-        ck, cv = init_cache(spec, batch=1)
-        ck = jax.device_put(ck, self._cache_sharding)
-        cv = jax.device_put(cv, self._cache_sharding)
-
-        logits, ck, cv = self._prefill(
-            self.params, tokens=tokens, lengths=lengths, cache_k=ck, cache_v=cv
-        )
-        rng = jax.random.PRNGKey(seed)
-        rng, sub = jax.random.split(rng)
-        tok = self._sample_fn(sampler)(logits, sub)
-        first = int(tok[0])
-        emitted = 1
-        yield first
-        if eos_id is not None and first == eos_id:
+        if req is None:
             return
-
-        while emitted < budget:
-            if cancel is not None and cancel.is_set():
-                return
-            n = min(decode_chunk, budget - emitted)
-            toks, tok, lengths, ck, cv, rng = self._decode_fn(n, sampler)(
-                self.params, tok, lengths, ck, cv, rng
-            )
-            for t in jax.device_get(toks[0]).tolist():
-                t = int(t)
-                emitted += 1
-                yield t
-                if eos_id is not None and t == eos_id:
+        try:
+            while True:
+                kind, val = req.out.get()
+                if kind == "tok":
+                    yield val
+                elif kind == "end":
                     return
-                if emitted >= budget:
-                    return
+                else:
+                    raise val
+        finally:
+            # Consumer gone (or done): release the slot at the next boundary.
+            req.cancel.set()
 
     def generate(
         self,
@@ -248,12 +311,158 @@ class InferenceEngine:
             out.finish_reason = "stop"
         return out
 
+    # ---- scheduler --------------------------------------------------------
+
+    def _submit(self, prompt_ids, *, max_new_tokens, sampler, seed, eos_id,
+                cancel, decode_chunk) -> _Request | None:
+        spec = self.spec
+        # Keep the most recent context if the prompt exceeds the window,
+        # reserving at least one position to generate into.
+        prompt = list(prompt_ids)[-(spec.max_seq - 1):]
+        if not prompt:
+            prompt = [0]
+        budget = min(max_new_tokens, spec.max_seq - len(prompt))
+        if budget <= 0 or (cancel is not None and cancel.is_set()):
+            return None
+        req = _Request(
+            prompt, budget, sampler, seed, eos_id,
+            cancel if cancel is not None else threading.Event(),
+            decode_chunk,
+        )
+        with self._cond:
+            self._pending.append(req)
+            self._cond.notify()
+        return req
+
+    def _scheduler(self) -> None:
+        while True:
+            with self._cond:
+                while not self._pending and not any(self._slots):
+                    self._cond.wait()
+            try:
+                self._admit_pending()
+                if any(self._slots):
+                    self._run_chunk()
+            except Exception as e:  # fail open: wake every waiting consumer
+                try:
+                    self._fail_all(e)
+                except Exception:
+                    # Device-state rebuild failed too (e.g. persistent OOM).
+                    # Keep the scheduler alive: waiting consumers were already
+                    # failed or will fail fast on their next admission.
+                    pass
+
+    def _admit_pending(self) -> None:
+        while True:
+            with self._cond:
+                try:
+                    slot = self._slots.index(None)
+                except ValueError:
+                    return
+                if not self._pending:
+                    return
+                req = self._pending.pop(0)
+            if req.cancel.is_set():
+                req.out.put(("end", None))
+                continue
+            self._admit(req, slot)
+
+    def _admit(self, req: _Request, slot: int) -> None:
+        n_prompt = len(req.prompt_ids)
+        bucket = prefill_bucket(n_prompt, self.spec.max_seq)
+        tokens = np.zeros((1, bucket), np.int32)
+        tokens[0, :n_prompt] = req.prompt_ids
+        (first, self._ck, self._cv, self._token, self._lengths, self._keys,
+         self._temp, self._topp, self._topk) = self._admit_fn(bucket)(
+            self.params,
+            tokens,
+            np.asarray([n_prompt], np.int32),
+            np.int32(slot),
+            np.int32(req.seed),
+            np.float32(req.temperature),
+            np.float32(req.top_p),
+            np.int32(req.top_k),
+            self._ck, self._cv, self._token, self._lengths, self._keys,
+            self._temp, self._topp, self._topk,
+        )
+        done = self._emit(req, int(first))
+        if not done:
+            with self._cond:
+                self._slots[slot] = req
+
+    def _run_chunk(self) -> None:
+        with self._cond:
+            active = [(i, r) for i, r in enumerate(self._slots) if r is not None]
+        # Drop cancelled requests before spending device time on them.
+        for i, r in active:
+            if r.cancel.is_set():
+                r.out.put(("end", None))
+                with self._cond:
+                    self._slots[i] = None
+        with self._cond:
+            active = [(i, r) for i, r in enumerate(self._slots) if r is not None]
+        if not active:
+            return
+        # Fixed chunk size per hint value: tailoring n_steps to remaining
+        # budgets would compile a program per distinct tail length; a few
+        # over-generated (discarded) steps at the end of a request are cheaper
+        # than surprise XLA compiles inside a serving window.
+        n_steps = max(1, min(r.chunk_hint or self.decode_chunk for _, r in active))
+        mask = np.zeros((self.n_slots,), np.int32)
+        for i, _ in active:
+            mask[i] = 1
+        (toks, self._ck, self._cv, self._token, self._lengths,
+         self._keys) = self._decode_fn(n_steps)(
+            self.params, mask, self._ck, self._cv, self._token, self._lengths,
+            self._keys, self._temp, self._topp, self._topk,
+        )
+        toks_host = jax.device_get(toks)
+        for i, req in active:
+            finished = False
+            for t in toks_host[i]:
+                if self._emit(req, int(t)):
+                    finished = True
+                    break
+            if finished:
+                with self._cond:
+                    self._slots[i] = None
+
+    def _emit(self, req: _Request, tok: int) -> bool:
+        """Deliver one token; returns True when the request just finished."""
+        if req.cancel.is_set():
+            req.out.put(("end", None))
+            return True
+        req.emitted += 1
+        req.out.put(("tok", tok))
+        if req.eos_id is not None and tok == req.eos_id:
+            req.out.put(("end", "stop"))
+            return True
+        if req.emitted >= req.budget:
+            req.out.put(("end", "length"))
+            return True
+        return False
+
+    def _fail_all(self, exc: Exception) -> None:
+        with self._cond:
+            doomed = [r for r in self._slots if r is not None] + self._pending
+            self._slots = [None] * self.n_slots
+            self._pending = []
+        # Wake consumers first — the state rebuild below can itself fail, and
+        # doomed requests must never hang on their queues.
+        for r in doomed:
+            r.out.put(("err", exc))
+        # The failed call may have consumed its donated buffers; rebuild the
+        # device state so the engine survives for subsequent requests.
+        self._init_device_state()
+
 
 # ---- engine sharing -------------------------------------------------------
 #
 # N configured backends frequently reference the same model (the reference's
 # shipped config points all 3 backends at one provider, config.yaml:6-20).
-# Engines are cached so those backends share one set of weights on device.
+# Engines are cached so those backends share one set of weights on device —
+# and, with continuous batching, their concurrent requests co-batch instead
+# of serializing.
 
 _ENGINES: dict[tuple, InferenceEngine] = {}
 _ENGINES_LOCK = threading.Lock()
@@ -264,16 +473,19 @@ def get_engine(
     mesh: Mesh | None = None,
     *,
     seed: int = 0,
+    n_slots: int = DEFAULT_SLOTS,
 ) -> InferenceEngine:
     """Engines are keyed by weight identity (spec, seed, mesh) ONLY — dispatch
     knobs like decode_chunk are per-call, so two backends that differ only in
-    chunking share one set of weights on device."""
+    chunking share one set of weights on device. ``n_slots`` (the concurrent
+    batch width, a structural property of the preallocated cache) applies at
+    first construction; later callers share the existing engine as-is."""
     mesh = mesh or single_device_mesh()
     key = (spec, seed, tuple(sorted(mesh.shape.items())), tuple(map(str, mesh.devices.flat)))
     with _ENGINES_LOCK:
         eng = _ENGINES.get(key)
         if eng is None:
-            eng = InferenceEngine(spec, mesh, seed=seed)
+            eng = InferenceEngine(spec, mesh, seed=seed, n_slots=n_slots)
             _ENGINES[key] = eng
         return eng
 
@@ -283,6 +495,7 @@ def get_engine_from_ckpt(
     mesh: Mesh | None = None,
     *,
     dtype: str | None = None,
+    n_slots: int = DEFAULT_SLOTS,
 ) -> InferenceEngine:
     """Engine over a local HF checkpoint; keyed by (resolved path, mesh) so N
     backends pointing at one checkpoint share the loaded weights on device."""
@@ -301,6 +514,6 @@ def get_engine_from_ckpt(
         eng = _ENGINES.get(key)
         if eng is None:
             spec, params = load_hf_checkpoint(resolved, dtype=dtype)
-            eng = InferenceEngine(spec, mesh, params=params)
+            eng = InferenceEngine(spec, mesh, params=params, n_slots=n_slots)
             _ENGINES[key] = eng
         return eng

@@ -148,18 +148,28 @@ def prefill(
     spec: ModelSpec,
     tokens: jnp.ndarray,   # [B, T] right-padded
     lengths: jnp.ndarray,  # [B] true prompt lengths
-    cache_k: jnp.ndarray,  # [L, B, K, max_seq, hd]
+    cache_k: jnp.ndarray,  # [L, B, K, max_seq, hd]; [L, S, K, max_seq, hd] with slot
     cache_v: jnp.ndarray,
     remat: bool = False,
+    slot: jnp.ndarray | None = None,
 ):
-    """Process the full prompt; returns (last-token logits [B,V], cache_k, cache_v)."""
+    """Process the full prompt; returns (last-token logits [B,V], cache_k, cache_v).
+
+    With ``slot`` (a traced int32 scalar), K/V is written into cache position
+    ``slot`` of a slot-batched cache instead of position 0 — the continuous-
+    batching admission path: no per-request cache allocation, no host↔device
+    cache transfer; the compiled program fills the preallocated slot in place
+    (the engine donates the cache args). One program per prompt bucket serves
+    every slot. ``tokens`` must then be batch-1.
+    """
     b, t = tokens.shape
+    cache_row = slot if slot is not None else 0
     positions = jnp.arange(t)
     x = _embed(params, spec, tokens, positions)
     cos, sin = rope_cos_sin(spec.max_seq, spec.head_dim, spec.rope_theta)
 
     def body(carry_x, per_layer):
-        block, ck, cv = per_layer  # ck/cv: [B, K, max_seq, hd]
+        block, ck, cv = per_layer  # ck/cv: [B or S, K, max_seq, hd]
         h = _norm(carry_x, block["attn_norm_w"], block.get("attn_norm_b"), spec)
         q, k, v = _qkv(h, block, spec)
         if spec.pos == "rope":
@@ -172,8 +182,8 @@ def prefill(
         h2 = _norm(carry_x, block["mlp_norm_w"], block.get("mlp_norm_b"), spec)
         mlp = _moe_mlp(h2, block, spec) if spec.is_moe else _dense_mlp(h2, block, spec)
         carry_x = carry_x + mlp
-        new_ck = lax.dynamic_update_slice(ck, k.astype(ck.dtype), (0, 0, 0, 0))
-        new_cv = lax.dynamic_update_slice(cv, v.astype(cv.dtype), (0, 0, 0, 0))
+        new_ck = lax.dynamic_update_slice(ck, k.astype(ck.dtype), (cache_row, 0, 0, 0))
+        new_cv = lax.dynamic_update_slice(cv, v.astype(cv.dtype), (cache_row, 0, 0, 0))
         return carry_x, (new_ck, new_cv)
 
     if remat:
