@@ -271,6 +271,10 @@ class TpuBackend:
                     result.finish_reason = "stop"
                     break
             pieces.append(matcher.feed(detok.flush()) + matcher.flush())
+            if matcher.hit:
+                # A stop string can complete only in the flushed detokenizer
+                # tail; the finish reason must still say "stop", not "length".
+                result.finish_reason = "stop"
             return result, "".join(pieces)
 
         task = asyncio.create_task(asyncio.to_thread(run))
@@ -343,6 +347,9 @@ class TpuBackend:
                     if text:
                         loop.call_soon_threadsafe(queue.put_nowait, ("text", text))
                 tail = matcher.feed(detok.flush()) + matcher.flush()
+                if matcher.hit:
+                    # Stop string completed in the flushed tail (see complete()).
+                    state["finish"] = "stop"
                 if tail:
                     loop.call_soon_threadsafe(queue.put_nowait, ("text", tail))
                 loop.call_soon_threadsafe(queue.put_nowait, ("end", None))
@@ -350,12 +357,18 @@ class TpuBackend:
                 loop.call_soon_threadsafe(queue.put_nowait, ("err", e))
 
         producer = loop.run_in_executor(None, produce)
+        # End-to-end deadline, matching complete()'s semantics: each queue
+        # wait gets the *remaining* time, so a generation that keeps emitting
+        # deltas still can't outlive the configured backend timeout.
+        deadline = loop.time() + timeout
         try:
             # inside the try: a disconnect at this first yield must still
             # cancel the producer thread (it already occupies an engine slot)
             yield oai.role_chunk(model, chunk_id)
             while True:
-                kind, val = await asyncio.wait_for(queue.get(), timeout=timeout)
+                kind, val = await asyncio.wait_for(
+                    queue.get(), timeout=max(0.0, deadline - loop.time())
+                )
                 if kind == "text":
                     yield oai.chunk(id=chunk_id, model=model, delta={"content": val})
                 elif kind == "end":

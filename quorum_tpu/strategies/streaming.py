@@ -145,7 +145,9 @@ async def parallel_stream(
     yield sse.encode_event(oai.role_chunk(PROXY_MODEL_NAME))
 
     n = len(plan.backends)
-    # Native C++ filter when it loads; Python reference implementation else.
+    # Python filter by default; the native C++ twin is opt-in via
+    # QUORUM_TPU_NATIVE=1 (measured slower for typical delta sizes — see
+    # quorum_tpu/native/__init__.py).
     filters = {i: make_thinking_filter(plan.thinking_tags) for i in range(n)}
     collected = ["" for _ in range(n)]
     queue: asyncio.Queue = asyncio.Queue()

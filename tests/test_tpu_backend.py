@@ -269,3 +269,78 @@ async def test_engines_shared_despite_decode_chunk_difference():
     )
     assert a.engine is b.engine
     assert a.decode_chunk == 2 and b.decode_chunk == 8
+
+
+# ---- ADVICE round-1 regressions ------------------------------------------
+
+class _ScriptedEngine:
+    """Stub engine: yields a fixed token script (ids into a 512-vocab byte
+    tokenizer). Lets tests stage exact detokenizer/stop-matcher interactions
+    that a real model can't produce deterministically."""
+
+    def __init__(self, tokens, delay=0.0):
+        from quorum_tpu.models.model_config import MODEL_PRESETS
+
+        self.spec = MODEL_PRESETS["llama-tiny"]
+        self._tokens = list(tokens)
+        self._delay = delay
+
+    def generate_stream(self, prompt_ids, *, cancel=None, **kw):
+        import time as _time
+
+        for t in self._tokens:
+            if cancel is not None and cancel.is_set():
+                return
+            if self._delay:
+                _time.sleep(self._delay)
+            yield t
+
+
+def _byte_token(b: int) -> int:
+    return 3 + b  # ByteTokenizer: id = _OFFSET + byte
+
+
+async def test_stop_hit_in_flushed_tail_sets_finish_reason_stop():
+    """A stop string that only completes in the detokenizer's flush() tail
+    (dangling partial UTF-8 -> replacement char) must still report
+    finish_reason="stop" — in both complete() and stream()."""
+    # "X" then the first byte of a 2-byte UTF-8 char: flush() emits "X" + U+FFFD
+    tokens = [_byte_token(ord("X")), _byte_token(0xC3)]
+    body = {
+        "messages": [{"role": "user", "content": "hi"}],
+        "max_tokens": 8,
+        "stop": ["X�"],
+    }
+
+    b = TpuBackend("S", _ScriptedEngine(tokens), model="m")
+    res = await b.complete(body, {}, 30.0)
+    assert res.body["choices"][0]["finish_reason"] == "stop"
+    assert res.body["choices"][0]["message"]["content"] == ""
+
+    b2 = TpuBackend("S2", _ScriptedEngine(tokens), model="m")
+    finish = None
+    async for chunk in b2.stream(body, {}, 30.0):
+        for choice in chunk.get("choices", []):
+            if choice.get("finish_reason"):
+                finish = choice["finish_reason"]
+    assert finish == "stop"
+
+
+async def test_stream_timeout_is_end_to_end_not_per_delta():
+    """A generation that keeps emitting deltas must still be bounded by the
+    configured timeout (complete() parity), not granted a fresh timeout per
+    delta."""
+    import time
+
+    from quorum_tpu.backends.base import BackendError
+
+    # 200 tokens, 20ms apart: per-delta waits always succeed, but the
+    # end-to-end deadline (0.5s) must fire long before the ~4s total.
+    tokens = [_byte_token(ord("a"))] * 200
+    b = TpuBackend("T", _ScriptedEngine(tokens, delay=0.02), model="m")
+    body = {"messages": [{"role": "user", "content": "x"}], "max_tokens": 200}
+    t0 = time.monotonic()
+    with pytest.raises(BackendError):
+        async for _ in b.stream(body, {}, 0.5):
+            pass
+    assert time.monotonic() - t0 < 3.0
