@@ -41,7 +41,7 @@ from quorum_tpu.engine.engine import (
     get_engine,
     get_engine_from_ckpt,
 )
-from quorum_tpu.engine.tokenizer import get_tokenizer, render_chat
+from quorum_tpu.engine.tokenizer import get_tokenizer
 from quorum_tpu.models.model_config import resolve_spec
 from quorum_tpu.ops.sampling import SamplerConfig
 from quorum_tpu.parallel.mesh import MeshConfig, make_mesh, single_device_mesh
@@ -212,7 +212,9 @@ class TpuBackend:
 
     def _plan(self, body: dict[str, Any]) -> dict[str, Any]:
         effective = prepare_body(body, self.model)
-        prompt = render_chat(body.get("messages") or [])
+        # Tokenizer-aware templating: an instruct checkpoint's own chat
+        # template when present, the static fallback otherwise.
+        prompt = self.tokenizer.render_chat(body.get("messages") or [])
         ids = self.tokenizer.encode(prompt)
         key = (
             "max_completion_tokens"

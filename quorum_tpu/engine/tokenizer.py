@@ -44,6 +44,8 @@ class Tokenizer(Protocol):
 
     def detokenizer(self) -> "IncrementalDetokenizer": ...
 
+    def render_chat(self, messages: Sequence[dict]) -> str: ...
+
 
 class ByteTokenizer:
     """UTF-8 byte tokenizer with pad/bos/eos specials."""
@@ -73,6 +75,9 @@ class ByteTokenizer:
 
     def detokenizer(self) -> "IncrementalDetokenizer":
         return IncrementalDetokenizer(self)
+
+    def render_chat(self, messages: Sequence[dict]) -> str:
+        return render_chat(messages)
 
 
 class IncrementalDetokenizer:
@@ -107,6 +112,29 @@ class HFTokenizer:
 
     def detokenizer(self) -> "HFIncrementalDetokenizer":
         return HFIncrementalDetokenizer(self)
+
+    def render_chat(self, messages: Sequence[dict]) -> str:
+        """The checkpoint's own chat template when it ships one (instruct
+        checkpoints get their exact prompt format — the whole point of
+        serving real weights); the static fallback otherwise."""
+        if getattr(self._t, "chat_template", None):
+            normalized = [
+                {
+                    "role": m.get("role", "user"),
+                    "content": flatten_content(m.get("content")),
+                }
+                for m in messages
+            ]
+            try:
+                return self._t.apply_chat_template(
+                    normalized, tokenize=False, add_generation_prompt=True
+                )
+            except Exception:
+                logger.warning(
+                    "chat_template failed; using the static fallback template",
+                    exc_info=True,
+                )
+        return render_chat(messages)
 
 
 class HFIncrementalDetokenizer:
@@ -162,11 +190,13 @@ def get_tokenizer(vocab_size: int, path: str | None = None) -> Tokenizer:
 
 
 def render_chat(messages: Sequence[dict]) -> str:
-    """Deterministic chat template: ``role: content`` lines + assistant cue.
+    """Deterministic fallback chat template: ``role: content`` lines +
+    assistant cue.
 
     The reference never templates — prompts pass through opaquely to remote
     APIs (oai_proxy.py:185-192). In-process models need *some* template; real
-    checkpoints override this with their tokenizer's own chat template.
+    checkpoints override this via HFTokenizer.render_chat, which applies the
+    tokenizer's own chat template when it ships one.
     """
     lines = []
     for m in messages:

@@ -136,6 +136,24 @@ def spec_from_hf_config(cfg: dict[str, Any]) -> ModelSpec:
             use_bias=bool(cfg.get("attention_bias", mt == "qwen2")),
             tied_lm_head=bool(cfg.get("tie_word_embeddings", False)),
         ).validate()
+    if mt == "gemma":
+        d = cfg["hidden_size"]
+        heads = cfg["num_attention_heads"]
+        return ModelSpec(
+            family="gemma", vocab_size=cfg["vocab_size"], d_model=d,
+            n_layers=cfg["num_hidden_layers"], n_heads=heads,
+            n_kv_heads=cfg.get("num_key_value_heads", heads),
+            head_dim=cfg.get("head_dim") or d // heads,
+            d_ff=cfg["intermediate_size"],
+            max_seq=cfg.get("max_position_embeddings", 8192),
+            norm="rmsnorm", norm_eps=cfg.get("rms_norm_eps", 1e-6),
+            norm_offset=1.0,                    # gemma RMSNorm applies (1 + w)
+            pos="rope", rope_theta=float(cfg.get("rope_theta", 10000.0)),
+            act="geglu",                        # GELU-gated MLP
+            emb_scale=float(d) ** 0.5,          # embeddings scaled by sqrt(d)
+            use_bias=bool(cfg.get("attention_bias", False)),
+            tied_lm_head=bool(cfg.get("tie_word_embeddings", True)),
+        ).validate()
     if mt == "mixtral":
         d = cfg["hidden_size"]
         heads = cfg["num_attention_heads"]

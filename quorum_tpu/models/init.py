@@ -30,9 +30,12 @@ def init_params(spec: ModelSpec, seed: int = 0) -> Params:
     H = spec.n_heads * spec.head_dim
     K = spec.n_kv_heads * spec.head_dim
     F, E = spec.d_ff, spec.n_experts
+    # Stored norm weight such that the effective multiplier (norm_offset + w)
+    # is identity: 1.0 for llama-style, 0.0 for gemma's (1 + w) convention.
+    norm_one = 1.0 - spec.norm_offset
 
     blocks: dict = {
-        "attn_norm_w": jnp.ones((L, D), dt),
+        "attn_norm_w": jnp.full((L, D), norm_one, dt),
         "attn_norm_b": jnp.zeros((L, D), dt) if spec.norm == "layernorm" else None,
         "wq": w(next(keys), L, D, H),
         "wk": w(next(keys), L, D, K),
@@ -42,7 +45,7 @@ def init_params(spec: ModelSpec, seed: int = 0) -> Params:
         "bk": jnp.zeros((L, K), dt) if spec.use_bias else None,
         "bv": jnp.zeros((L, K), dt) if spec.use_bias else None,
         "bo": jnp.zeros((L, D), dt) if spec.use_bias else None,
-        "mlp_norm_w": jnp.ones((L, D), dt),
+        "mlp_norm_w": jnp.full((L, D), norm_one, dt),
         "mlp_norm_b": jnp.zeros((L, D), dt) if spec.norm == "layernorm" else None,
     }
     if spec.is_moe:
@@ -54,7 +57,7 @@ def init_params(spec: ModelSpec, seed: int = 0) -> Params:
         )
     else:
         blocks.update(
-            w_gate=w(next(keys), L, D, F) if spec.act == "swiglu" else None,
+            w_gate=w(next(keys), L, D, F) if spec.gated_mlp else None,
             w_up=w(next(keys), L, D, F),
             w_down=w(next(keys), L, F, D),
             b_up=jnp.zeros((L, F), dt) if spec.use_bias else None,
@@ -64,7 +67,7 @@ def init_params(spec: ModelSpec, seed: int = 0) -> Params:
     params: Params = {
         "tok_emb": w(next(keys), V, D, fan_in=D),
         "pos_emb": w(next(keys), spec.max_seq, D, fan_in=D) if spec.pos == "learned" else None,
-        "final_norm_w": jnp.ones((D,), dt),
+        "final_norm_w": jnp.full((D,), norm_one, dt),
         "final_norm_b": jnp.zeros((D,), dt) if spec.norm == "layernorm" else None,
         "lm_head": None if spec.tied_lm_head else w(next(keys), D, V),
         "blocks": blocks,

@@ -19,7 +19,7 @@ from dataclasses import dataclass
 
 @dataclass(frozen=True)
 class ModelSpec:
-    family: str = "llama"          # "gpt2" | "llama" | "mixtral"
+    family: str = "llama"          # "gpt2" | "llama" | "mixtral" | "gemma"
     vocab_size: int = 32000
     d_model: int = 4096
     n_layers: int = 32
@@ -30,9 +30,11 @@ class ModelSpec:
     max_seq: int = 4096
     norm: str = "rmsnorm"          # "rmsnorm" | "layernorm"
     norm_eps: float = 1e-5
+    norm_offset: float = 0.0       # weight used as (offset + w); gemma: 1.0
     pos: str = "rope"              # "rope" | "learned"
     rope_theta: float = 10000.0
-    act: str = "swiglu"            # "swiglu" | "gelu"
+    act: str = "swiglu"            # "swiglu" | "gelu" | "geglu" (gemma)
+    emb_scale: float = 1.0         # embedding multiplier; gemma: sqrt(d_model)
     use_bias: bool = False         # attention/MLP biases (gpt2, qwen2-qkv)
     tied_lm_head: bool = True
     n_experts: int = 0             # 0 = dense
@@ -43,10 +45,14 @@ class ModelSpec:
     def is_moe(self) -> bool:
         return self.n_experts > 0
 
+    @property
+    def gated_mlp(self) -> bool:
+        return self.act in ("swiglu", "geglu")
+
     def validate(self) -> "ModelSpec":
         assert self.n_heads % self.n_kv_heads == 0, "n_heads must divide by n_kv_heads"
         assert self.head_dim % 2 == 0, "RoPE needs even head_dim"
-        assert self.act in ("swiglu", "gelu")
+        assert self.act in ("swiglu", "gelu", "geglu")
         assert self.norm in ("rmsnorm", "layernorm")
         assert self.pos in ("rope", "learned")
         return self
@@ -77,9 +83,12 @@ MODEL_PRESETS: dict[str, ModelSpec] = {
         n_kv_heads=8, head_dim=128, d_ff=14336, max_seq=8192, rope_theta=1000000.0,
         tied_lm_head=False,
     ),
+    # Gemma-7B: GeGLU MLP, (1 + w) RMSNorm, sqrt(d_model)-scaled embeddings,
+    # tied head (google/gemma-7b config.json / transformers GemmaConfig).
     "gemma-7b": ModelSpec(
-        family="llama", vocab_size=256000, d_model=3072, n_layers=28, n_heads=16,
-        n_kv_heads=16, head_dim=256, d_ff=24576, max_seq=8192, act="gelu",
+        family="gemma", vocab_size=256000, d_model=3072, n_layers=28, n_heads=16,
+        n_kv_heads=16, head_dim=256, d_ff=24576, max_seq=8192, act="geglu",
+        norm_offset=1.0, norm_eps=1e-6, emb_scale=3072.0 ** 0.5,
         tied_lm_head=True,
     ),
     # BASELINE.json config[3]: DeepSeek-R1-Distill-Qwen-7B (qwen2 arch, qkv bias)
@@ -105,6 +114,11 @@ MODEL_PRESETS: dict[str, ModelSpec] = {
         family="mixtral", vocab_size=512, d_model=64, n_layers=2, n_heads=4,
         n_kv_heads=2, head_dim=16, d_ff=128, max_seq=128, n_experts=4,
         experts_per_token=2, tied_lm_head=False,
+    ),
+    "gemma-tiny": ModelSpec(
+        family="gemma", vocab_size=512, d_model=64, n_layers=2, n_heads=4,
+        n_kv_heads=2, head_dim=16, d_ff=128, max_seq=128, act="geglu",
+        norm_offset=1.0, norm_eps=1e-6, emb_scale=64.0 ** 0.5, tied_lm_head=True,
     ),
 }
 

@@ -45,6 +45,10 @@ Params = dict[str, Any]
 
 def _norm(x, w, b, spec: ModelSpec):
     if spec.norm == "rmsnorm":
+        # gemma stores norm weights as w with the model applying (1 + w)
+        # (norm_offset=1.0); llama-family stores the multiplier directly.
+        if spec.norm_offset:
+            w = w + jnp.asarray(spec.norm_offset, w.dtype)
         return rmsnorm(x, w, spec.norm_eps)
     return layernorm(x, w, b, spec.norm_eps)
 
@@ -55,12 +59,15 @@ def _maybe(block: Params, name: str, layer_slice):
 
 
 def _dense_mlp(x, block, spec: ModelSpec):
-    if spec.act == "swiglu":
+    if spec.gated_mlp:
         gate = jnp.einsum("btd,df->btf", x, block["w_gate"],
                           preferred_element_type=jnp.float32)
         up = jnp.einsum("btd,df->btf", x, block["w_up"],
                         preferred_element_type=jnp.float32)
-        h = (jax.nn.silu(gate) * up).astype(x.dtype)
+        # swiglu (llama/mistral) gates with SiLU; geglu (gemma) with
+        # tanh-approximated GELU (HF act_fn "gelu_pytorch_tanh").
+        gated = jax.nn.silu(gate) if spec.act == "swiglu" else jax.nn.gelu(gate, approximate=True)
+        h = (gated * up).astype(x.dtype)
     else:
         up = jnp.einsum("btd,df->btf", x, block["w_up"],
                         preferred_element_type=jnp.float32)
@@ -127,6 +134,8 @@ def _attn_out(attn, block, x_dtype):
 
 def _embed(params, spec: ModelSpec, tokens, positions):
     x = params["tok_emb"][tokens].astype(jnp.dtype(spec.dtype))
+    if spec.emb_scale != 1.0:  # gemma scales embeddings by sqrt(d_model)
+        x = x * jnp.asarray(spec.emb_scale, x.dtype)
     if spec.pos == "learned":
         x = x + params["pos_emb"][positions][None, :, :].astype(x.dtype)
     return x
@@ -206,6 +215,8 @@ def decode_step(
     """One autoregressive step. Returns (logits [B,V], cache_k, cache_v)."""
     b = token.shape[0]
     x = params["tok_emb"][token][:, None, :].astype(jnp.dtype(spec.dtype))  # [B,1,D]
+    if spec.emb_scale != 1.0:  # gemma scales embeddings by sqrt(d_model)
+        x = x * jnp.asarray(spec.emb_scale, x.dtype)
     if spec.pos == "learned":
         x = x + params["pos_emb"][lengths][:, None, :].astype(x.dtype)
     cos, sin = rope_cos_sin(spec.max_seq, spec.head_dim, spec.rope_theta)

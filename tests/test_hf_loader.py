@@ -171,3 +171,85 @@ async def test_ckpt_ensemble_members_diverge(tmp_path):
     m1 = TpuBackend.from_spec(BackendSpec(name="B", url=f"tpu://gpt2?ckpt={tmp_path}&seed=1"))
     assert m0.engine is m1.engine  # weights shared
     assert outs[0] != outs[1]      # samples diverge
+
+
+def test_gemma_checkpoint_parity(tmp_path):
+    """Gemma: GeGLU MLP, (1 + w) RMSNorm, sqrt(d_model)-scaled embeddings,
+    tied lm_head — all three quirks must match transformers' forward."""
+    from transformers import GemmaConfig, GemmaForCausalLM
+
+    cfg = GemmaConfig(
+        vocab_size=512, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        head_dim=8, max_position_embeddings=64,
+    )
+    model = GemmaForCausalLM(cfg).eval()
+    model.save_pretrained(tmp_path, safe_serialization=True)
+    spec, _ = load_hf_checkpoint(tmp_path, dtype="float32")
+    assert spec.family == "gemma" and spec.act == "geglu"
+    assert spec.norm_offset == 1.0 and spec.emb_scale == 32.0 ** 0.5
+    assert_close(our_logits(tmp_path), torch_logits(model, TOKENS))
+
+
+def _write_chat_tokenizer(dirpath, template):
+    """A tiny offline word-level HF tokenizer with a chat template."""
+    import json as _json
+
+    from tokenizers import Tokenizer as RawTokenizer
+    from tokenizers.models import WordLevel
+    from tokenizers.pre_tokenizers import Whitespace
+
+    vocab = {"<unk>": 0, "hello": 1, "world": 2, "hi": 3, "be": 4, "brief": 5}
+    raw = RawTokenizer(WordLevel(vocab, unk_token="<unk>"))
+    raw.pre_tokenizer = Whitespace()
+    raw.save(str(dirpath / "tokenizer.json"))
+    (dirpath / "tokenizer_config.json").write_text(_json.dumps({
+        "tokenizer_class": "PreTrainedTokenizerFast",
+        "unk_token": "<unk>",
+        "chat_template": template,
+    }))
+
+
+def test_hf_tokenizer_applies_chat_template(tmp_path):
+    """An instruct checkpoint's chat template must shape the prompt — not the
+    static 'role: content' fallback (round-1 always used the fallback even
+    when the checkpoint shipped a template, VERDICT.md weakness 5)."""
+    from quorum_tpu.engine.tokenizer import ByteTokenizer, HFTokenizer, render_chat
+
+    template = (
+        "{% for message in messages %}<|{{ message.role }}|>"
+        "{{ message.content }}{% endfor %}<|assistant|>"
+    )
+    _write_chat_tokenizer(tmp_path, template)
+    msgs = [
+        {"role": "system", "content": "be brief"},
+        {"role": "user", "content": [{"type": "text", "text": "hi"}]},
+    ]
+    hf = HFTokenizer(str(tmp_path))
+    assert hf.render_chat(msgs) == "<|system|>be brief<|user|>hi<|assistant|>"
+    # byte tokenizer (no template) keeps the deterministic fallback
+    assert ByteTokenizer(512).render_chat(msgs) == render_chat(msgs)
+
+
+async def test_ckpt_backend_uses_checkpoint_chat_template(tmp_path):
+    """End to end: a ckpt= backend with a templated tokenizer feeds the
+    templated prompt into the engine."""
+    from transformers import GPT2Config, GPT2LMHeadModel
+
+    cfg = GPT2Config(vocab_size=512, n_positions=64, n_embd=32, n_layer=2, n_head=4)
+    GPT2LMHeadModel(cfg).eval().save_pretrained(tmp_path, safe_serialization=True)
+    _write_chat_tokenizer(
+        tmp_path,
+        "{% for m in messages %}<|{{ m.role }}|>{{ m.content }}{% endfor %}<|assistant|>",
+    )
+
+    from quorum_tpu.backends.tpu_backend import TpuBackend
+    from quorum_tpu.config import BackendSpec
+
+    b = TpuBackend.from_spec(
+        BackendSpec(name="T", url=f"tpu://gpt2?ckpt={tmp_path}&max_tokens=4")
+    )
+    plan = b._plan({"messages": [{"role": "user", "content": "hello world"}]})
+    assert plan["prompt_ids"] == b.tokenizer.encode("<|user|>hello world<|assistant|>")
+    res = await b.complete({"messages": [{"role": "user", "content": "hello"}]}, {}, 60.0)
+    assert res.ok

@@ -21,7 +21,7 @@ from quorum_tpu.models.init import param_count
 from quorum_tpu.models.transformer import init_cache
 from quorum_tpu.ops.sampling import SamplerConfig, sample_token
 
-TINY = ["gpt2-tiny", "llama-tiny", "mixtral-tiny"]
+TINY = ["gpt2-tiny", "llama-tiny", "mixtral-tiny", "gemma-tiny"]
 
 
 def _toy_batch():
