@@ -16,6 +16,11 @@ URL grammar:  ``tpu://<model-id>?<spec overrides>&<engine options>``
   slots=           concurrent batch width of the engine's KV cache (default 4;
                    applies when this backend constructs the engine — backends
                    sharing an engine share its slot count)
+  prefill_chunk=   chunked-prefill segment size (default 512): prompts longer
+                   than this prefill in segments interleaved with decode
+                   chunks, so a long admission can't stall active streams
+  queue=           admission queue bound (default 128); a full queue rejects
+                   with 503 instead of growing without limit
   max_tokens=      default completion budget when the request has none
 
 Contract parity with the dispatcher: configured model overrides the request
@@ -35,9 +40,12 @@ from quorum_tpu import oai
 from quorum_tpu.backends.base import BackendError, CompletionResult, prepare_body
 from quorum_tpu.config import BackendSpec
 from quorum_tpu.engine.engine import (
+    DEFAULT_MAX_PENDING,
+    DEFAULT_PREFILL_CHUNK,
     DEFAULT_SLOTS,
     GenerationResult,
     InferenceEngine,
+    QueueFullError,
     get_engine,
     get_engine_from_ckpt,
 )
@@ -83,6 +91,14 @@ def _invalid_request(message: str) -> BackendError:
         message,
         status_code=400,
         body=oai.error_body(message, type_="invalid_request_error", code=400),
+    )
+
+
+def _overloaded(name: str) -> BackendError:
+    msg = f"Backend {name} is overloaded: admission queue full; retry later"
+    return BackendError(
+        msg, status_code=503,
+        body=oai.error_body(msg, type_="overloaded_error", code=503),
     )
 
 
@@ -176,6 +192,11 @@ class TpuBackend:
         tokenizer_path = None
         rng_offset = 0
         n_slots = int(opts.get("slots", DEFAULT_SLOTS))
+        eng_kw = dict(
+            n_slots=n_slots,
+            prefill_chunk=int(opts.get("prefill_chunk", DEFAULT_PREFILL_CHUNK)),
+            max_pending=int(opts.get("queue", DEFAULT_MAX_PENDING)),
+        )
         if ckpt:
             # seed= still differentiates ensemble members: it offsets the
             # sampling RNG (weights are shared — one checkpoint on device).
@@ -183,7 +204,7 @@ class TpuBackend:
             # Real weights from a local HF checkpoint dir; its tokenizer files
             # (tokenizer.json / tokenizer_config.json) are used when present.
             engine = get_engine_from_ckpt(
-                ckpt, mesh, dtype=opts.get("dtype"), n_slots=n_slots
+                ckpt, mesh, dtype=opts.get("dtype"), **eng_kw
             )
             import os
 
@@ -195,7 +216,7 @@ class TpuBackend:
         else:
             spec = resolve_spec(model_id, opts)
             engine = get_engine(
-                spec, mesh, seed=int(opts.get("seed", 0)), n_slots=n_slots
+                spec, mesh, seed=int(opts.get("seed", 0)), **eng_kw
             )
         return cls(
             bspec.name,
@@ -290,6 +311,8 @@ class TpuBackend:
             # the request open waiting for the full generation.
             cancel.set()
             raise BackendError(f"Backend {self.name} timed out after {timeout}s")
+        except QueueFullError:
+            raise _overloaded(self.name) from None
         except BackendError:
             raise
         except Exception as e:
@@ -325,17 +348,25 @@ class TpuBackend:
         state = {"n": 0, "finish": "length"}
         cancel = threading.Event()
 
+        # Submit BEFORE the first yield: a full admission queue must surface
+        # as a 503 response, not as an error chunk inside an already-started
+        # 200 stream.
+        try:
+            req = self.engine.submit(
+                plan["prompt_ids"],
+                max_new_tokens=plan["max_new"],
+                sampler=plan["sampler"],
+                seed=plan["seed"],
+                eos_id=self.tokenizer.eos_id,
+                cancel=cancel,
+                decode_chunk=self.decode_chunk,
+            )
+        except QueueFullError:
+            raise _overloaded(self.name) from None
+
         def produce():
             try:
-                for tok in self.engine.generate_stream(
-                    plan["prompt_ids"],
-                    max_new_tokens=plan["max_new"],
-                    sampler=plan["sampler"],
-                    seed=plan["seed"],
-                    eos_id=self.tokenizer.eos_id,
-                    cancel=cancel,
-                    decode_chunk=self.decode_chunk,
-                ):
+                for tok in self.engine.stream_results(req):
                     if tok == self.tokenizer.eos_id:
                         state["finish"] = "stop"
                         break
@@ -375,6 +406,8 @@ class TpuBackend:
                     yield oai.chunk(id=chunk_id, model=model, delta={"content": val})
                 elif kind == "end":
                     break
+                elif isinstance(val, QueueFullError):
+                    raise _overloaded(self.name) from val
                 else:
                     raise BackendError(f"Backend {self.name} failed: {val}") from val
         except asyncio.TimeoutError:

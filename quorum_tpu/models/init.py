@@ -75,6 +75,24 @@ def init_params(spec: ModelSpec, seed: int = 0) -> Params:
     return params
 
 
+def init_params_sharded(spec: ModelSpec, mesh, seed: int = 0) -> Params:
+    """Initialize parameters directly on the mesh, sharded, in ONE compiled
+    program.
+
+    At 7B scale the eager path (``init_params`` + ``shard_pytree``) dispatches
+    a dozen separate device ops and round-trips layouts; jitting the whole
+    init with the target shardings as ``out_shardings`` makes XLA materialize
+    every leaf in place — no host copy, no replicated intermediate, one
+    compile. This is how a 14 GB bf16 model comes up on a 16 GB chip."""
+    from quorum_tpu.parallel.sharding import param_shardings
+
+    shapes = jax.eval_shape(lambda: init_params(spec, seed))
+    shardings = param_shardings(mesh, shapes)
+    return jax.jit(
+        lambda: init_params(spec, seed), out_shardings=shardings
+    )()
+
+
 def param_count(params: Params) -> int:
     return sum(
         x.size for x in jax.tree.leaves(params) if hasattr(x, "size")

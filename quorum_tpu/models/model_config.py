@@ -39,6 +39,9 @@ class ModelSpec:
     tied_lm_head: bool = True
     n_experts: int = 0             # 0 = dense
     experts_per_token: int = 2
+    # Grouped sparse-MoE expert capacity = cf·k·N/E tokens (see
+    # transformer._moe_mlp_grouped); ≥ E/k means no pick can ever drop.
+    moe_capacity_factor: float = 2.0
     dtype: str = "bfloat16"
 
     @property

@@ -1,0 +1,200 @@
+"""Chunked prefill + bounded admission queue (VERDICT r2 weakness 6).
+
+Long-prompt admissions must not stall in-flight decodes: the scheduler
+advances each admission one prompt segment per iteration, running a decode
+chunk for active slots in between. And the pending queue is bounded —
+overload surfaces as a 503, not unbounded memory growth.
+"""
+
+import threading
+import time
+
+import pytest
+
+from quorum_tpu.engine.engine import InferenceEngine, QueueFullError
+from quorum_tpu.models.model_config import resolve_spec
+from quorum_tpu.ops.sampling import SamplerConfig
+
+TINY = resolve_spec("llama-tiny")  # max_seq 128
+
+
+def test_chunked_matches_single_shot_prefill():
+    """A long prompt admitted in 16-token segments must generate exactly the
+    same tokens as single-shot prefill: the segment path writes the same
+    K/V, and the first token is sampled from the same logits and the same
+    PRNG stream (see InferenceEngine._register_fn)."""
+    prompt = [(7 + 13 * i) % 500 for i in range(100)]
+    eng_one = InferenceEngine(TINY, decode_chunk=4, n_slots=2, prefill_chunk=0)
+    eng_seg = InferenceEngine(TINY, decode_chunk=4, n_slots=2, prefill_chunk=16)
+    assert eng_one.prefill_chunk == 0  # chunking disabled → single-shot
+    assert eng_seg.prefill_chunk == 16
+
+    for sampler in (SamplerConfig(temperature=0.0),
+                    SamplerConfig(temperature=0.8, top_p=0.9)):
+        one = eng_one.generate(prompt, max_new_tokens=12, sampler=sampler,
+                               seed=3).token_ids
+        seg = eng_seg.generate(prompt, max_new_tokens=12, sampler=sampler,
+                               seed=3).token_ids
+        assert seg == one
+
+
+def test_long_admission_does_not_stall_active_stream():
+    """While a 100-token prompt is being admitted in 16-token segments, an
+    already-active stream must keep emitting tokens (the round-2 engine ran
+    every admission to completion before the next decode chunk)."""
+    eng = InferenceEngine(TINY, decode_chunk=2, n_slots=2, prefill_chunk=16)
+    # Warm the compile caches so timing reflects scheduling, not XLA.
+    eng.generate([1] * 100, max_new_tokens=4)
+    eng.generate([1, 2, 3], max_new_tokens=4)
+
+    events = []  # (who, token-index) in arrival order
+    long_prompt = [(3 + 11 * i) % 500 for i in range(100)]
+    started = threading.Event()
+    submitted = threading.Event()
+
+    def active_stream():
+        for i, _ in enumerate(eng.generate_stream([5, 6, 7], max_new_tokens=40)):
+            events.append(("active", i))
+            started.set()
+            if submitted.is_set():
+                time.sleep(0.001)  # let the scheduler interleave
+
+    def long_admission():
+        started.wait(timeout=30)
+        submitted.set()
+        for i, _ in enumerate(eng.generate_stream(long_prompt, max_new_tokens=4)):
+            events.append(("long", i))
+
+    t1 = threading.Thread(target=active_stream)
+    t2 = threading.Thread(target=long_admission)
+    t1.start(); t2.start()
+    t1.join(timeout=60); t2.join(timeout=60)
+    assert not t1.is_alive() and not t2.is_alive()
+
+    # Tokens the active stream emitted strictly between the long request's
+    # submission window and its first token:
+    long_first = next(i for i, (who, _) in enumerate(events) if who == "long")
+    active_before = [e for e in events[:long_first] if e[0] == "active"]
+    assert len(active_before) >= 6, (
+        f"active stream starved during long admission: {events[:long_first]}"
+    )
+    # And the long request still completed correctly.
+    assert sum(1 for who, _ in events if who == "long") == 4
+
+
+def test_chunked_admission_correct_under_concurrent_decode():
+    """The critical interleaving property: while a chunked admission is in
+    progress, interleaved decode chunks for OTHER slots must not corrupt the
+    admitted prompt's K/V (decode's dummy writes for inactive rows used to
+    land at position 0 — exactly where segment 0 had just written). The long
+    request's tokens under load must equal its tokens when run alone."""
+    long_prompt = [(3 + 11 * i) % 500 for i in range(100)]
+    solo = InferenceEngine(TINY, decode_chunk=2, n_slots=2, prefill_chunk=16)
+    expect = solo.generate(long_prompt, max_new_tokens=6,
+                           sampler=SamplerConfig(temperature=0.0)).token_ids
+
+    eng = InferenceEngine(TINY, decode_chunk=2, n_slots=2, prefill_chunk=16)
+    eng.generate([1] * 100, max_new_tokens=4)  # warm compile caches
+    eng.generate([1, 2, 3], max_new_tokens=4)
+
+    got = {}
+    started = threading.Event()
+
+    def active_stream():
+        for i, _ in enumerate(eng.generate_stream([5, 6, 7], max_new_tokens=60)):
+            started.set()
+            time.sleep(0.001)
+
+    def long_request():
+        started.wait(timeout=30)
+        got["toks"] = eng.generate(long_prompt, max_new_tokens=6,
+                                   sampler=SamplerConfig(temperature=0.0)).token_ids
+
+    t1 = threading.Thread(target=active_stream)
+    t2 = threading.Thread(target=long_request)
+    t1.start(); t2.start()
+    t1.join(timeout=120); t2.join(timeout=120)
+    assert not t1.is_alive() and not t2.is_alive()
+    assert got["toks"] == expect
+
+
+def test_admission_queue_bound_raises_queue_full():
+    eng = InferenceEngine(TINY, decode_chunk=2, n_slots=1, max_pending=2)
+    blocker = threading.Event()
+    threads = []
+
+    def occupy():
+        for _ in eng.generate_stream([1, 2], max_new_tokens=64):
+            if blocker.wait(timeout=30):
+                return
+
+    t = threading.Thread(target=occupy)
+    t.start()
+    threads.append(t)
+    time.sleep(0.5)  # let it claim the only slot
+    # Fill the pending queue to its bound...
+    queued = [eng._submit([3], max_new_tokens=1, sampler=SamplerConfig(),
+                          seed=0, eos_id=None, cancel=None, decode_chunk=None)
+              for _ in range(2)]
+    # ...and the next submission must be rejected, not enqueued.
+    with pytest.raises(QueueFullError):
+        eng.generate([4], max_new_tokens=1)
+    blocker.set()
+    for q in queued:
+        q.cancel.set()
+    t.join(timeout=30)
+    assert not t.is_alive()
+
+
+def test_queue_full_maps_to_503():
+    import asyncio
+
+    from quorum_tpu.backends.base import BackendError
+    from quorum_tpu.backends.tpu_backend import TpuBackend
+    from quorum_tpu.config import BackendSpec
+
+    backend = TpuBackend.from_spec(BackendSpec(
+        name="busy", url="tpu://llama-tiny?slots=1&queue=1&seed=9", model="t"))
+    eng = backend.engine
+    blocker = threading.Event()
+
+    def occupy():
+        for _ in eng.generate_stream([1, 2], max_new_tokens=64):
+            if blocker.wait(timeout=30):
+                return
+
+    t = threading.Thread(target=occupy)
+    t.start()
+    time.sleep(0.5)
+    held = eng._submit([3], max_new_tokens=1, sampler=SamplerConfig(),
+                       seed=0, eos_id=None, cancel=None, decode_chunk=None)
+
+    async def call():
+        body = {"model": "t", "messages": [{"role": "user", "content": "x"}],
+                "max_tokens": 4}
+        with pytest.raises(BackendError) as exc:
+            await backend.complete(body, {}, timeout=30)
+        return exc.value
+
+    err = asyncio.run(call())
+    assert err.status_code == 503
+    assert err.body["error"]["type"] == "overloaded_error"
+
+    async def call_stream():
+        body = {"model": "t", "messages": [{"role": "user", "content": "x"}],
+                "max_tokens": 4, "stream": True}
+        chunks = []
+        with pytest.raises(BackendError) as exc:
+            async for c in backend.stream(body, {}, timeout=30):
+                chunks.append(c)
+        # the 503 must arrive BEFORE any SSE chunk — a started 200 stream
+        # can't be turned into an error status
+        assert chunks == []
+        return exc.value
+
+    err2 = asyncio.run(call_stream())
+    assert err2.status_code == 503
+    blocker.set()
+    held.cancel.set()
+    t.join(timeout=30)
+    assert not t.is_alive()

@@ -295,6 +295,16 @@ class _ScriptedEngine:
                 _time.sleep(self._delay)
             yield t
 
+    # New engine API (submit-then-stream, so backends can 503 a full queue
+    # before the first SSE byte): the stub has no queue, so submit just
+    # captures the args and stream_results replays the script.
+    def submit(self, prompt_ids, *, cancel=None, **kw):
+        return (prompt_ids, cancel)
+
+    def stream_results(self, req):
+        prompt_ids, cancel = req
+        yield from self.generate_stream(prompt_ids, cancel=cancel)
+
 
 def _byte_token(b: int) -> int:
     return 3 + b  # ByteTokenizer: id = _OFFSET + byte
