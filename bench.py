@@ -27,9 +27,19 @@ identical hardware its TTFT equals the full completion latency. We report
 p50(total) / p50(TTFT) — how many times earlier the first token arrives than
 the reference architecture could deliver it.
 
+Phase 3 (TPU only, ``QUORUM_TPU_BENCH_7B``): the same socket stack serving a
+**7B-class model** (mistral-7b architecture, bf16 random init, max_seq/slots
+trimmed to fit one v5e's 16 GB HBM beside the slot cache). Decode at 7B is
+HBM-bandwidth-bound — every generated token streams the full bf16 weights
+plus the slot's KV cache through the chip — so alongside MFU (the wrong lens
+for decode) we report **decode HBM-bandwidth utilization**:
+    tokens/s × bytes-touched-per-token ÷ 819 GB/s (v5e HBM BW).
+
 Prints ONE JSON line:
   {"metric": "p50_ttft_ms", "value": ..., "unit": "ms", "vs_baseline": ...,
-   "p50_total_ms": ..., "req_per_s": ..., "tokens_per_s": ..., "mfu_pct": ...}
+   "p50_total_ms": ..., "req_per_s": ..., "tokens_per_s": ..., "mfu_pct": ...,
+   "b7_model": ..., "b7_decode_tok_s": ..., "b7_ttft_ms": ...,
+   "b7_hbm_bw_util_pct": ..., "b7_mfu_pct": ...}
 """
 
 from __future__ import annotations
@@ -61,6 +71,16 @@ N_THROUGHPUT_REQUESTS = int(os.environ.get("QUORUM_TPU_BENCH_THROUGHPUT_REQUESTS
 MAX_TOKENS = int(os.environ.get("QUORUM_TPU_BENCH_MAX_TOKENS", "32"))
 MODEL = os.environ.get("QUORUM_TPU_BENCH_MODEL", "gpt2")  # BASELINE config[0], real 124M
 V5E_PEAK_FLOPS = 197e12  # bf16 peak, one v5e chip
+V5E_HBM_BW = 819e9       # bytes/s, one v5e chip
+# Phase 3: 7B-class decode benchmark. "auto" = run when a real TPU is
+# attached (a 7B forward on CPU takes minutes/token); "1"/"0" force/skip.
+BENCH_7B = os.environ.get("QUORUM_TPU_BENCH_7B", "auto")
+B7_MODEL = os.environ.get("QUORUM_TPU_BENCH_7B_MODEL", "mistral-7b")
+# max_seq and slots trimmed so bf16 weights (~14.5 GB) + slot cache fit in
+# one v5e's 16 GB HBM: cache = 32L x 2 slots x 8 kvh x 1024 x 128 x 2B x 2
+# = 0.27 GB.
+B7_URL = f"tpu://{B7_MODEL}?max_seq=1024&slots=2&decode_chunk=16&max_tokens=64"
+B7_MAX_TOKENS = int(os.environ.get("QUORUM_TPU_BENCH_7B_MAX_TOKENS", "64"))
 
 
 def build_app():
@@ -145,10 +165,160 @@ def _on_tpu() -> bool:
     return jax.default_backend() not in ("cpu",)
 
 
+def build_7b_app():
+    from quorum_tpu.config import Config
+    from quorum_tpu.server.app import create_app
+
+    raw = {
+        "settings": {"timeout": 600},
+        "primary_backends": [
+            {"name": "B7", "url": B7_URL, "model": B7_MODEL},
+        ],
+    }
+    return create_app(Config(raw=raw))
+
+
+def _b7_bytes_per_token() -> tuple[int, int]:
+    """(weight_bytes, kv_bytes) streamed from HBM per decoded token at
+    batch 1: every step reads the full bf16 weights plus the slot's (masked-
+    dense) KV cache — the decode bandwidth floor the chip must sustain."""
+    from quorum_tpu.models.model_config import resolve_spec
+
+    spec = resolve_spec(B7_MODEL, {"max_seq": "1024"})
+    from quorum_tpu.models.init import init_params
+
+    import jax
+
+    shapes = jax.eval_shape(lambda: init_params(spec, 0))
+    n_params = sum(
+        x.size for x in jax.tree.leaves(shapes) if hasattr(x, "size"))
+    weight_bytes = n_params * 2  # bf16
+    kv_bytes = (spec.n_layers * spec.n_kv_heads * spec.max_seq
+                * spec.head_dim * 2 * 2)  # k+v, bf16, one slot row
+    return weight_bytes, kv_bytes
+
+
+async def bench_7b() -> dict:
+    """Serve the 7B-class model through the full socket stack; return the
+    decode-side metrics (VERDICT r2 task 1)."""
+    import httpx
+
+    from quorum_tpu.server.serve import start_server
+
+    app = build_7b_app()
+    server = await start_server(app, "127.0.0.1", 0)
+    port = server.sockets[0].getsockname()[1]
+    body = {
+        "model": B7_MODEL,
+        "messages": [{"role": "user", "content": "Benchmark prompt: say something."}],
+        "stream": True,
+        "max_tokens": B7_MAX_TOKENS,
+    }
+    try:
+        async with httpx.AsyncClient(
+            base_url=f"http://127.0.0.1:{port}", timeout=3600
+        ) as client:
+
+            async def one() -> tuple[float, float, int, float]:
+                """(ttft_s, decode_s, n_tokens, total_s): decode_s spans
+                first→last content delta — pure decode, no prefill/HTTP."""
+                t0 = time.perf_counter()
+                first = last = None
+                n = 0
+                async with client.stream(
+                    "POST", "/chat/completions", json=body,
+                    headers={"Authorization": "Bearer bench"},
+                ) as resp:
+                    assert resp.status_code == 200, f"HTTP {resp.status_code}"
+                    async for line in resp.aiter_lines():
+                        if not line.startswith("data: ") or line == "data: [DONE]":
+                            continue
+                        chunk = json.loads(line[len("data: "):])
+                        delta = (chunk.get("choices") or [{}])[0].get("delta") or {}
+                        if delta.get("content"):
+                            now = time.perf_counter()
+                            if first is None:
+                                first = now
+                            last = now
+                            n += 1
+                total = time.perf_counter() - t0
+                assert first is not None and n > 1, "no content deltas"
+                return first - t0, last - first, n, total
+
+            await one()  # warmup: compile prefill bucket + decode chunk
+            ttfts, rates = [], []
+            for _ in range(3):
+                ttft, decode_s, n, _total = await one()
+                ttfts.append(ttft)
+                # deltas arrive per decode_chunk dispatch; (n-1) inter-delta
+                # tokens over decode_s seconds
+                rates.append((n - 1) / decode_s)
+    finally:
+        server.close()
+        await server.wait_closed()
+
+    tok_s = statistics.median(rates)
+    weight_bytes, kv_bytes = _b7_bytes_per_token()
+    n_params = weight_bytes // 2
+    bw_util = tok_s * (weight_bytes + kv_bytes) / V5E_HBM_BW * 100
+    return {
+        "b7_model": B7_MODEL,
+        "b7_decode_tok_s": round(tok_s, 2),
+        "b7_ttft_ms": round(statistics.median(ttfts) * 1000, 2),
+        "b7_hbm_bw_util_pct": round(bw_util, 1),
+        "b7_mfu_pct": round(tok_s * 2 * n_params / V5E_PEAK_FLOPS * 100, 3),
+        "b7_params": n_params,
+    }
+
+
+def run_7b_phase() -> dict:
+    """Run the 7B bench in a SUBPROCESS, before this process touches jax.
+
+    Two reasons it can't run in-process after phases 1/2: the phase-1/2
+    engines (3 × 124M weights + slot caches, > 1 GB) stay resident in the
+    module-global engine cache — their scheduler threads hold them — while
+    the 7B weights alone need ~14.5 GB of the v5e's 16 GB HBM; and only one
+    process can hold the TPU client at a time, so the child must finish
+    before the parent initializes jax."""
+    import subprocess
+
+    proc = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--7b"],
+        capture_output=True, text=True, timeout=3000,
+        cwd=os.path.dirname(os.path.abspath(__file__)),
+    )
+    for line in reversed((proc.stdout or "").splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                return json.loads(line)
+            except json.JSONDecodeError:
+                break
+    return {"b7_model": B7_MODEL,
+            "b7_error": f"subprocess rc={proc.returncode}: "
+                        f"{(proc.stderr or '')[-300:]}"}
+
+
+async def seven_b_main() -> None:
+    """--7b child entry: prints one JSON line with the b7_* metrics."""
+    if not (BENCH_7B == "1" or (BENCH_7B == "auto" and _on_tpu())):
+        print(json.dumps({}))
+        return
+    try:
+        print(json.dumps(await bench_7b()))
+    except Exception as e:
+        print(json.dumps(
+            {"b7_model": B7_MODEL, "b7_error": f"{type(e).__name__}: {e}"}))
+
+
 async def main() -> None:
     import httpx
 
     from quorum_tpu.server.serve import start_server
+
+    # Phase 3 first (subprocess — see run_7b_phase): skipped entirely when
+    # 7B is disabled so CPU smoke runs don't pay a subprocess spawn.
+    b7: dict = run_7b_phase() if BENCH_7B != "0" else {}
 
     app = build_app()
     server = await start_server(app, "127.0.0.1", 0)
@@ -191,6 +361,7 @@ async def main() -> None:
     tokens_per_s = sum(token_counts) / wall
     n_params = _params_per_model()
     mfu = (tokens_per_s * 2 * n_params / V5E_PEAK_FLOPS * 100) if _on_tpu() else 0.0
+
     print(json.dumps({
         "metric": "p50_ttft_ms",
         "value": round(p50_ttft_ms, 2),
@@ -205,8 +376,11 @@ async def main() -> None:
         "n_models": 3,
         "max_tokens": MAX_TOKENS,
         "params_per_model": n_params,
+        **b7,
     }))
 
 
 if __name__ == "__main__":
+    if "--7b" in sys.argv:
+        sys.exit(asyncio.run(seven_b_main()))
     sys.exit(asyncio.run(main()))

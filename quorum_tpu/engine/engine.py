@@ -63,6 +63,7 @@ MIN_BUCKET = 16
 DEFAULT_SLOTS = 4
 DEFAULT_PREFILL_CHUNK = 512
 DEFAULT_MAX_PENDING = 128
+TOP_LOGPROBS = 20  # top alternatives computed per step (OpenAI's API maximum)
 
 
 class QueueFullError(Exception):
@@ -88,15 +89,21 @@ class GenerationResult:
 
 
 class _Request:
-    """One queued/active generation; tokens flow to the consumer via ``out``."""
+    """One queued/active generation; tokens flow to the consumer via ``out``.
+
+    When ``want_lp`` ≥ 0, per-token logprob records ``(logprob, ids, lps)``
+    (the sampled token's logprob plus the step's TOP_LOGPROBS alternatives)
+    are appended to ``lp`` *before* the token is queued, so a consumer that
+    sees token i can always read ``lp[i]``."""
 
     __slots__ = (
         "prompt_ids", "budget", "temperature", "top_p", "top_k", "seed",
         "eos_id", "cancel", "chunk_hint", "out", "emitted",
+        "pp", "fp", "bias_row", "want_lp", "lp",
     )
 
     def __init__(self, prompt_ids, budget, sampler: SamplerConfig, seed, eos_id,
-                 cancel, chunk_hint):
+                 cancel, chunk_hint, pp=0.0, fp=0.0, bias_row=None, want_lp=-1):
         self.prompt_ids = prompt_ids
         self.budget = budget
         self.temperature = sampler.temperature
@@ -108,6 +115,11 @@ class _Request:
         self.chunk_hint = chunk_hint
         self.out: queue.Queue = queue.Queue()
         self.emitted = 0
+        self.pp = pp                  # presence_penalty
+        self.fp = fp                  # frequency_penalty
+        self.bias_row = bias_row      # np [V] f32 logit_bias, or None
+        self.want_lp = want_lp        # -1 = no logprobs; else #top alternatives
+        self.lp: list = []
 
 
 class _Admission:
@@ -159,6 +171,15 @@ class InferenceEngine:
         while c >= MIN_BUCKET and spec.max_seq % c:
             c //= 2
         self.prefill_chunk = c if c >= MIN_BUCKET and spec.max_seq % c == 0 else 0
+        # Sequence-parallel serving (tpu://…&sp=N): admission prefill runs
+        # ring attention with the prompt sharded over the sp axis. Chunked
+        # admission is disabled there — the ring IS the long-prompt answer
+        # (O(T/sp) attention memory per device, one compiled program).
+        from quorum_tpu.parallel.mesh import AXIS_SP
+
+        self._use_sp = dict(self.mesh.shape).get(AXIS_SP, 1) > 1
+        if self._use_sp:
+            self.prefill_chunk = 0
         if params is not None:
             self.params = shard_pytree(self.mesh, params)
         else:
@@ -206,6 +227,18 @@ class InferenceEngine:
         self._temp = jax.device_put(np.ones((s,), np.float32), rep)
         self._topp = jax.device_put(np.ones((s,), np.float32), rep)
         self._topk = jax.device_put(np.zeros((s,), np.int32), rep)
+        # OpenAI sampling knobs (docs/api.md): per-slot presence/frequency
+        # penalties, generated-token counts (what the penalties act on), and
+        # a per-slot logit-bias row. Allocated by compiled zero-fill — the
+        # [S, V] buffers never cross the host boundary.
+        self._pp = jax.device_put(np.zeros((s,), np.float32), rep)
+        self._fp = jax.device_put(np.zeros((s,), np.float32), rep)
+        v = self.spec.vocab_size
+        self._counts, self._bias = jax.jit(
+            lambda: (jnp.zeros((s, v), jnp.int32), jnp.zeros((s, v), jnp.float32)),
+            out_shardings=(self._rep, self._rep),
+        )()
+        self._zero_bias = np.zeros((v,), np.float32)
 
     # ---- compiled programs ------------------------------------------------
 
@@ -216,18 +249,32 @@ class InferenceEngine:
             return fn
         spec = self.spec
 
+        mesh = self.mesh if self._use_sp else None
+        n_top = min(TOP_LOGPROBS, spec.vocab_size)
+
         def admit(params, tokens, lengths1, slot, seed, temp1, topp1, topk1,
-                  ck, cv, token_s, lengths_s, keys_s, temp_s, topp_s, topk_s):
+                  pp1, fp1, bias_row,
+                  ck, cv, token_s, lengths_s, keys_s, temp_s, topp_s, topk_s,
+                  pp_s, fp_s, counts_s, bias_s):
             logits, ck, cv = prefill(
-                params, spec, tokens, lengths1, ck, cv, slot=slot
+                params, spec, tokens, lengths1, ck, cv, slot=slot, mesh=mesh
             )
+            # First sampled token: no generated text yet → penalties are
+            # zero; only the logit bias applies.
+            adj = logits.astype(jnp.float32) + bias_row[None, :]
             key = jax.random.PRNGKey(seed)
             key, sub = jax.random.split(key)
             first = sample_token_rows(
-                logits, sub[None], temp1[None], topp1[None], topk1[None]
+                adj, sub[None], temp1[None], topp1[None], topk1[None]
             )[0]
+            lp_all = jax.nn.log_softmax(adj[0])
+            top_lp, top_ix = lax.top_k(lp_all, n_top)
+            counts_row = jnp.zeros((spec.vocab_size,), jnp.int32).at[first].add(1)
             return (
                 first,
+                lp_all[first],
+                top_ix,
+                top_lp,
                 ck,
                 cv,
                 token_s.at[slot].set(first),
@@ -236,6 +283,10 @@ class InferenceEngine:
                 temp_s.at[slot].set(temp1),
                 topp_s.at[slot].set(topp1),
                 topk_s.at[slot].set(topk1),
+                pp_s.at[slot].set(pp1),
+                fp_s.at[slot].set(fp1),
+                counts_s.at[slot].set(counts_row),
+                bias_s.at[slot].set(bias_row),
             )
 
         fn = jax.jit(
@@ -243,6 +294,7 @@ class InferenceEngine:
             donate_argnames=(
                 "ck", "cv", "token_s", "lengths_s", "keys_s",
                 "temp_s", "topp_s", "topk_s",
+                "pp_s", "fp_s", "counts_s", "bias_s",
             ),
         )
         self._admit_cache[bucket] = fn
@@ -285,8 +337,12 @@ class InferenceEngine:
         if fn is not None:
             return fn
 
+        vocab = self.spec.vocab_size
+
         def register(slot, last_tok, n_minus1, seed, temp1, topp1, topk1,
-                     token_s, lengths_s, keys_s, temp_s, topp_s, topk_s):
+                     pp1, fp1, bias_row,
+                     token_s, lengths_s, keys_s, temp_s, topp_s, topk_s,
+                     pp_s, fp_s, counts_s, bias_s):
             return (
                 token_s.at[slot].set(last_tok),
                 lengths_s.at[slot].set(n_minus1),
@@ -294,30 +350,43 @@ class InferenceEngine:
                 temp_s.at[slot].set(temp1),
                 topp_s.at[slot].set(topp1),
                 topk_s.at[slot].set(topk1),
+                pp_s.at[slot].set(pp1),
+                fp_s.at[slot].set(fp1),
+                counts_s.at[slot].set(jnp.zeros((vocab,), jnp.int32)),
+                bias_s.at[slot].set(bias_row),
             )
 
         fn = jax.jit(
             register,
             donate_argnames=(
                 "token_s", "lengths_s", "keys_s", "temp_s", "topp_s", "topk_s",
+                "pp_s", "fp_s", "counts_s", "bias_s",
             ),
         )
         self._admit_cache["register"] = fn
         return fn
 
-    def _decode_fn(self, n_steps: int):
-        """Jitted: ``n_steps`` batched decode+sample steps over all slots."""
-        fn = self._decode_cache.get(n_steps)
+    def _decode_fn(self, n_steps: int, want_lp: bool):
+        """Jitted: ``n_steps`` batched decode+sample steps over all slots.
+
+        Two variants per chunk size: the ``want_lp`` one additionally emits
+        per-step logprobs (log_softmax over [S, V] + top-k) — compiled and
+        paid only when some active request asked for logprobs, keeping the
+        common decode path free of the extra vocab-wide passes."""
+        fn = self._decode_cache.get((n_steps, want_lp))
         if fn is not None:
             return fn
         spec = self.spec
 
+        n_top = min(TOP_LOGPROBS, spec.vocab_size)
+        n_slots = self.n_slots
+
         def chunk(params, active, ck, cv, token_s, lengths_s, keys_s,
-                  temp_s, topp_s, topk_s):
+                  temp_s, topp_s, topk_s, pp_s, fp_s, counts_s, bias_s):
             live = active > 0
 
             def step(carry, _):
-                tok, lens, ck, cv, keys = carry
+                tok, lens, ck, cv, keys, counts = carry
                 # Inactive slots run the forward (batch is static) but their
                 # K/V write is masked off — a slot mid-chunked-admission must
                 # not have its freshly prefilled cache clobbered by the dummy
@@ -326,25 +395,51 @@ class InferenceEngine:
                 logits, ck, cv = decode_step(
                     params, spec, tok, pos, ck, cv, write_mask=live
                 )
+                # OpenAI sampling knobs, applied per row on the f32 logits:
+                # logit_bias adds; presence/frequency penalties subtract
+                # based on the slot's generated-token counts.
+                adj = (logits.astype(jnp.float32) + bias_s
+                       - fp_s[:, None] * counts
+                       - pp_s[:, None] * (counts > 0))
                 split = jax.vmap(jax.random.split)(keys)  # [S, 2, 2]
                 nxt = sample_token_rows(
-                    logits, split[:, 1], temp_s, topp_s, topk_s
+                    adj, split[:, 1], temp_s, topp_s, topk_s
                 )
                 nxt = jnp.where(live, nxt, tok)
+                counts = counts.at[jnp.arange(n_slots), nxt].add(
+                    live.astype(jnp.int32))
                 lens = lens + live.astype(lens.dtype)
-                return (nxt, lens, ck, cv, split[:, 0]), nxt
+                if want_lp:
+                    lp_all = jax.nn.log_softmax(adj)        # [S, V]
+                    s_lp = jnp.take_along_axis(
+                        lp_all, nxt[:, None], axis=1)[:, 0]
+                    top_lp, top_ix = lax.top_k(lp_all, n_top)  # [S, n_top]
+                    out = (nxt, s_lp, top_ix, top_lp)
+                else:
+                    out = nxt
+                return (nxt, lens, ck, cv, split[:, 0], counts), out
 
-            (token_s, lengths_s, ck, cv, keys_s), toks = lax.scan(
-                step, (token_s, lengths_s, ck, cv, keys_s), None, length=n_steps
+            (token_s, lengths_s, ck, cv, keys_s, counts_s), ys = lax.scan(
+                step, (token_s, lengths_s, ck, cv, keys_s, counts_s),
+                None, length=n_steps,
             )
-            # toks: [n_steps, S] → [S, n_steps]
-            return toks.T, ck, cv, token_s, lengths_s, keys_s
+            if want_lp:
+                toks, s_lp, top_ix, top_lp = ys
+                lp_out = (s_lp.T, top_ix.transpose(1, 0, 2),
+                          top_lp.transpose(1, 0, 2))
+            else:
+                toks = ys
+                lp_out = ()
+            # [n_steps, S, ...] → [S, n_steps, ...]
+            return ((toks.T,) + lp_out
+                    + (ck, cv, token_s, lengths_s, keys_s, counts_s))
 
         fn = jax.jit(
             chunk,
-            donate_argnames=("ck", "cv", "token_s", "lengths_s", "keys_s"),
+            donate_argnames=("ck", "cv", "token_s", "lengths_s", "keys_s",
+                             "counts_s"),
         )
-        self._decode_cache[n_steps] = fn
+        self._decode_cache[(n_steps, want_lp)] = fn
         return fn
 
     # ---- public API -------------------------------------------------------
@@ -388,12 +483,20 @@ class InferenceEngine:
         eos_id: int | None = None,
         cancel: threading.Event | None = None,
         decode_chunk: int | None = None,
+        presence_penalty: float = 0.0,
+        frequency_penalty: float = 0.0,
+        logit_bias: "np.ndarray | None" = None,  # [vocab] f32 additive bias
+        logprobs: int = -1,  # ≥ 0 → record per-token logprobs + that many tops
     ) -> _Request | None:
         """Enqueue a generation and return its handle (``None`` when there is
         nothing to generate). Raises :class:`QueueFullError` *synchronously*
         when the admission queue is at capacity — callers can reject the
         request (e.g. with a 503) before committing to a response stream.
-        Consume tokens with :meth:`stream_results`."""
+        Consume tokens with :meth:`stream_results`; when ``logprobs`` ≥ 0 the
+        handle's ``lp`` list carries one ``(logprob, top_ids, top_lps)``
+        record per yielded token. Penalties follow the OpenAI contract
+        (presence: flat once a token has been generated; frequency: scaled
+        by its count), applied over this request's generated tokens."""
         return self._submit(
             prompt_ids,
             max_new_tokens=max_new_tokens,
@@ -402,6 +505,10 @@ class InferenceEngine:
             eos_id=eos_id,
             cancel=cancel,
             decode_chunk=decode_chunk,
+            pp=presence_penalty,
+            fp=frequency_penalty,
+            bias_row=logit_bias,
+            want_lp=logprobs,
         )
 
     def stream_results(self, req: _Request | None) -> Iterator[int]:
@@ -447,7 +554,8 @@ class InferenceEngine:
     # ---- scheduler --------------------------------------------------------
 
     def _submit(self, prompt_ids, *, max_new_tokens, sampler, seed, eos_id,
-                cancel, decode_chunk) -> _Request | None:
+                cancel, decode_chunk, pp=0.0, fp=0.0, bias_row=None,
+                want_lp=-1) -> _Request | None:
         spec = self.spec
         # Keep the most recent context if the prompt exceeds the window,
         # reserving at least one position to generate into.
@@ -461,6 +569,7 @@ class InferenceEngine:
             prompt, budget, sampler, seed, eos_id,
             cancel if cancel is not None else threading.Event(),
             decode_chunk,
+            pp=pp, fp=fp, bias_row=bias_row, want_lp=want_lp,
         )
         with self._cond:
             if len(self._pending) >= self.max_pending:
@@ -541,8 +650,11 @@ class InferenceEngine:
             )
             adm.offset += len(seg)
             if adm.offset >= len(prompt):
+                bias = (req.bias_row if req.bias_row is not None
+                        else self._zero_bias)
                 (self._token, self._lengths, self._keys, self._temp,
-                 self._topp, self._topk) = self._register_fn()(
+                 self._topp, self._topk, self._pp, self._fp,
+                 self._counts, self._bias) = self._register_fn()(
                     np.int32(adm.slot),
                     np.int32(prompt[-1]),
                     np.int32(len(prompt) - 1),
@@ -550,8 +662,12 @@ class InferenceEngine:
                     np.float32(req.temperature),
                     np.float32(req.top_p),
                     np.int32(req.top_k),
+                    np.float32(req.pp),
+                    np.float32(req.fp),
+                    bias,
                     self._token, self._lengths, self._keys,
                     self._temp, self._topp, self._topk,
+                    self._pp, self._fp, self._counts, self._bias,
                 )
                 with self._cond:
                     self._slots[adm.slot] = req
@@ -568,8 +684,11 @@ class InferenceEngine:
         bucket = prefill_bucket(n_prompt, self.spec.max_seq)
         tokens = np.zeros((1, bucket), np.int32)
         tokens[0, :n_prompt] = req.prompt_ids
-        (first, self._ck, self._cv, self._token, self._lengths, self._keys,
-         self._temp, self._topp, self._topk) = self._admit_fn(bucket)(
+        bias = req.bias_row if req.bias_row is not None else self._zero_bias
+        (first, s_lp, top_ix, top_lp,
+         self._ck, self._cv, self._token, self._lengths, self._keys,
+         self._temp, self._topp, self._topk,
+         self._pp, self._fp, self._counts, self._bias) = self._admit_fn(bucket)(
             self.params,
             tokens,
             np.asarray([n_prompt], np.int32),
@@ -578,9 +697,16 @@ class InferenceEngine:
             np.float32(req.temperature),
             np.float32(req.top_p),
             np.int32(req.top_k),
+            np.float32(req.pp),
+            np.float32(req.fp),
+            bias,
             self._ck, self._cv, self._token, self._lengths, self._keys,
             self._temp, self._topp, self._topk,
+            self._pp, self._fp, self._counts, self._bias,
         )
+        if req.want_lp >= 0:
+            req.lp.append((float(s_lp),
+                           np.asarray(top_ix), np.asarray(top_lp)))
         done = self._emit(req, int(first))
         if not done:
             with self._cond:
@@ -604,18 +730,28 @@ class InferenceEngine:
         # over-generated (discarded) steps at the end of a request are cheaper
         # than surprise XLA compiles inside a serving window.
         n_steps = max(1, min(r.chunk_hint or self.decode_chunk for _, r in active))
+        want_lp = any(r.want_lp >= 0 for _, r in active)
         mask = np.zeros((self.n_slots,), np.int32)
         for i, _ in active:
             mask[i] = 1
-        (toks, self._ck, self._cv, self._token, self._lengths,
-         self._keys) = self._decode_fn(n_steps)(
+        out = self._decode_fn(n_steps, want_lp)(
             self.params, mask, self._ck, self._cv, self._token, self._lengths,
             self._keys, self._temp, self._topp, self._topk,
+            self._pp, self._fp, self._counts, self._bias,
         )
+        if want_lp:
+            (toks, s_lp, top_ix, top_lp, self._ck, self._cv, self._token,
+             self._lengths, self._keys, self._counts) = out
+            s_lp, top_ix, top_lp = jax.device_get((s_lp, top_ix, top_lp))
+        else:
+            (toks, self._ck, self._cv, self._token, self._lengths,
+             self._keys, self._counts) = out
         toks_host = jax.device_get(toks)
         for i, req in active:
             finished = False
-            for t in toks_host[i]:
+            for j, t in enumerate(toks_host[i]):
+                if req.want_lp >= 0:
+                    req.lp.append((float(s_lp[i, j]), top_ix[i, j], top_lp[i, j]))
                 if self._emit(req, int(t)):
                     finished = True
                     break

@@ -37,6 +37,7 @@ from jax import lax
 from quorum_tpu.models.model_config import ModelSpec
 from quorum_tpu.ops.attention import attention, causal_mask, decode_attention
 from quorum_tpu.ops.flash_attention import flash_prefill_attention
+from quorum_tpu.parallel.ring_attention import ring_prefill_attention
 from quorum_tpu.ops.norms import layernorm, rmsnorm
 from quorum_tpu.ops.rotary import apply_rope, rope_cos_sin
 
@@ -248,6 +249,7 @@ def prefill(
     cache_v: jnp.ndarray,
     remat: bool = False,
     slot: jnp.ndarray | None = None,
+    mesh=None,
 ):
     """Process the full prompt; returns (last-token logits [B,V], cache_k, cache_v).
 
@@ -257,6 +259,13 @@ def prefill(
     cache transfer; the compiled program fills the preallocated slot in place
     (the engine donates the cache args). One program per prompt bucket serves
     every slot. ``tokens`` must then be batch-1.
+
+    With ``mesh`` (and its ``sp`` axis > 1), prompt attention runs as ring
+    attention with the sequence sharded over ``sp`` — the serving engine's
+    long-context admission path (SURVEY.md §5.7): per-device attention
+    memory is O(T/sp), KV blocks ride the ICI ring at KV-head width, and
+    the K/V written to the cache is unchanged (the cache's seq axis stays
+    replicated, so decode is sp-agnostic).
     """
     b, t = tokens.shape
     cache_row = slot if slot is not None else 0
@@ -272,9 +281,13 @@ def prefill(
         if spec.pos == "rope":
             q = apply_rope(q, cos, sin, positions)
             k = apply_rope(k, cos, sin, positions)
-        # Flash kernel on TPU (causal + length mask fused, O(S) VMEM);
-        # XLA-native reference path elsewhere.
-        attn = flash_prefill_attention(q, k, v, lengths)
+        if mesh is not None:
+            # Sequence-parallel admission: ring attention over the sp axis.
+            attn = ring_prefill_attention(q, k, v, lengths, mesh)
+        else:
+            # Flash kernel on TPU (causal + length mask fused, O(S) VMEM);
+            # XLA-native reference path elsewhere.
+            attn = flash_prefill_attention(q, k, v, lengths)
         carry_x = carry_x + _attn_out(attn, block, carry_x.dtype)
         h2 = _norm(carry_x, block["mlp_norm_w"], block.get("mlp_norm_b"), spec)
         mlp = (_moe_mlp(h2, block, spec, token_mask=moe_mask)
@@ -423,11 +436,13 @@ def decode_step(
     return _unembed(params, spec, x[:, 0, :]), cache_k, cache_v
 
 
-def _layer_body(carry_x, block, spec: ModelSpec, positions, cos, sin, attn_fn):
+def _layer_body(carry_x, block, spec: ModelSpec, positions, cos, sin, attn_fn,
+                token_mask=None):
     """One transformer block: norm → qkv(+rope) → attn_fn → norm → mlp.
 
     Shared by every cache-free forward variant; ``attn_fn(q, k, v)`` is the
     only thing that differs (dense XLA attention, ring attention, ...).
+    ``token_mask`` keeps right-padding rows out of MoE expert capacity.
     The prefill path has its own body — it additionally threads the KV cache
     through the scan carry."""
     h = _norm(carry_x, block["attn_norm_w"], block.get("attn_norm_b"), spec)
@@ -438,18 +453,23 @@ def _layer_body(carry_x, block, spec: ModelSpec, positions, cos, sin, attn_fn):
     attn = attn_fn(q, k, v)
     carry_x = carry_x + _attn_out(attn, block, carry_x.dtype)
     h2 = _norm(carry_x, block["mlp_norm_w"], block.get("mlp_norm_b"), spec)
-    mlp = _moe_mlp(h2, block, spec) if spec.is_moe else _dense_mlp(h2, block, spec)
+    mlp = (_moe_mlp(h2, block, spec, token_mask=token_mask)
+           if spec.is_moe else _dense_mlp(h2, block, spec))
     return carry_x + mlp, None
 
 
-def _scan_layers(params, spec: ModelSpec, tokens, attn_fn, remat: bool):
+def _scan_layers(params, spec: ModelSpec, tokens, attn_fn, remat: bool,
+                 lengths=None):
     b, t = tokens.shape
     positions = jnp.arange(t)
     x = _embed(params, spec, tokens, positions)
     cos, sin = rope_cos_sin(spec.max_seq, spec.head_dim, spec.rope_theta)
+    token_mask = (None if lengths is None
+                  else jnp.arange(t)[None, :] < lengths[:, None])
 
     def body(carry_x, block):
-        return _layer_body(carry_x, block, spec, positions, cos, sin, attn_fn)
+        return _layer_body(carry_x, block, spec, positions, cos, sin, attn_fn,
+                           token_mask=token_mask)
 
     if remat:
         body = jax.checkpoint(body)
@@ -486,19 +506,14 @@ def forward_logits_sp(
     Long-context path (SURVEY.md §5.7): attention runs under shard_map with
     the sequence sharded over the mesh's ``sp`` axis — per-device K/V memory
     is O(T/sp) inside the ring; everything else is left to GSPMD (dp/tp).
-    KV heads are broadcast to query heads before the ring (GQA grouping
-    inside the ring is a later optimization)."""
+    GQA is grouped inside the ring — the blocks riding the ICI ring stay at
+    KV-head width (no repeat_kv broadcast)."""
     from quorum_tpu.parallel.ring_attention import ring_prefill_attention
 
-    group = spec.n_heads // spec.n_kv_heads
-
     def ring_attn(q, k, v):
-        if group > 1:
-            k = jnp.repeat(k, group, axis=1)
-            v = jnp.repeat(v, group, axis=1)
         return ring_prefill_attention(q, k, v, lengths, mesh)
 
-    return _scan_layers(params, spec, tokens, ring_attn, remat)
+    return _scan_layers(params, spec, tokens, ring_attn, remat, lengths=lengths)
 
 
 def init_cache(spec: ModelSpec, batch: int, dtype=None):

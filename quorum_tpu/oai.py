@@ -171,7 +171,8 @@ def validate_request_body(body: dict[str, Any]) -> str | None:
     """
     import math
 
-    for key in ("temperature", "top_p", "seed", "max_tokens", "max_completion_tokens"):
+    for key in ("temperature", "top_p", "seed", "max_tokens", "max_completion_tokens",
+                "presence_penalty", "frequency_penalty"):
         val = body.get(key)
         if val is None:
             continue
@@ -185,6 +186,32 @@ def validate_request_body(body: dict[str, Any]) -> str | None:
             return f"Invalid value for {key!r}: {val!r}"
         if key in ("max_tokens", "max_completion_tokens") and num < 1:
             return f"Invalid value for {key!r}: must be >= 1"
+        if key in ("presence_penalty", "frequency_penalty") and not -2.0 <= num <= 2.0:
+            return f"Invalid value for {key!r}: {val!r} (must be in [-2, 2])"
+    n = body.get("n")
+    if n is not None and (not isinstance(n, int) or isinstance(n, bool) or n < 1):
+        return f"Invalid value for 'n': {n!r} (must be a positive integer)"
+    lp = body.get("logprobs")
+    if lp is not None and not isinstance(lp, bool):
+        return f"Invalid value for 'logprobs': {lp!r}"
+    top_lp = body.get("top_logprobs")
+    if top_lp is not None and (
+        not isinstance(top_lp, int) or isinstance(top_lp, bool)
+        or not 0 <= top_lp <= 20
+    ):
+        return f"Invalid value for 'top_logprobs': {top_lp!r} (must be an integer in [0, 20])"
+    bias = body.get("logit_bias")
+    if bias is not None:
+        if not isinstance(bias, dict):
+            return f"Invalid value for 'logit_bias': {bias!r}"
+        for k, v in bias.items():
+            try:
+                int(k)
+                fv = float(v)
+            except (TypeError, ValueError):
+                return f"Invalid logit_bias entry: {k!r}: {v!r}"
+            if not -100.0 <= fv <= 100.0:
+                return f"logit_bias value {fv} outside [-100, 100]"
     stop = body.get("stop")
     if stop is not None and not isinstance(stop, (str, list)):
         return f"Invalid value for 'stop': {stop!r}"

@@ -256,6 +256,22 @@ def create_app(
             outcomes = await fanout_complete(targets, body, headers, timeout)
         successes = [o for o in outcomes if o.ok]
         if not successes:
+            # When EVERY backend rejected the request as a client error
+            # (e.g. 'tools' on a tpu:// backend, docs/api.md knob table) or
+            # reported overload (503 queue-full), the status is meaningful to
+            # the client: relay the first error verbatim instead of
+            # collapsing it into a 500 proxy_error (which breaks retry logic
+            # keyed on 4xx-vs-503).
+            def relayable(o):
+                return o.error is not None and (
+                    400 <= o.error.status_code < 500
+                    or o.error.status_code == 503
+                )
+
+            if all(relayable(o) for o in outcomes):
+                first_err = outcomes[0].error
+                return JSONResponse(first_err.body,
+                                    status_code=first_err.status_code)
             return JSONResponse(
                 {
                     "error": {
@@ -293,10 +309,14 @@ def create_app(
             first_chunk = None
         except BackendError as e:
             # Failure before any token: JSON error with upstream status
-            # (oai_proxy.py:1107-1128 parity).
-            msg = e.body.get("error", {}).get("message", str(e)) if isinstance(
-                e.body.get("error"), dict
-            ) else str(e)
+            # (oai_proxy.py:1107-1128 parity). A typed client/overload error
+            # (400 invalid_request_error, 503 overloaded_error) keeps its
+            # body verbatim — stream and non-stream must present the same
+            # error contract (docs/api.md error table).
+            err = e.body.get("error")
+            if isinstance(err, dict) and err.get("type") not in (None, "proxy_error"):
+                return JSONResponse(e.body, status_code=e.status_code)
+            msg = err.get("message", str(e)) if isinstance(err, dict) else str(e)
             return JSONResponse(
                 {"error": {"message": f"Backend failed: {msg}", "type": "proxy_error"}},
                 status_code=e.status_code,

@@ -1,0 +1,270 @@
+"""Every worked example in docs/concatenate_behaviour.md and
+docs/aggregate_behaviour.md, executed against the real app (VERDICT r2
+missing item 3 — doc depth). If a doc example and the code disagree, these
+fail: the documents are contracts, not prose.
+
+The running examples match the docs:
+  LLM1 → "<think>check the docs</think>Paris."   (concatenate)
+  LLM1 → "<think>easy one</think>Paris."          (aggregate)
+  LLM2 → "The capital is Paris." / "It is Paris."
+  AGG  → "Both sources agree: Paris."
+"""
+
+import json
+
+import pytest
+
+from quorum_tpu.backends.fake import FakeBackend
+from tests.conftest import make_client
+
+QUESTION = "What is the capital of France?"
+
+
+def concat_config(**flags):
+    concatenate = {
+        "separator": "\n===\n",
+        "hide_intermediate_think": True,
+        "hide_final_think": False,
+        "thinking_tags": ["think", "reason", "reasoning", "thought"],
+        "skip_final_aggregation": False,
+        **flags,
+    }
+    return {
+        "settings": {"timeout": 30},
+        "primary_backends": [
+            {"name": "LLM1", "url": "http://one.test", "model": "m"},
+            {"name": "LLM2", "url": "http://two.test", "model": "m"},
+        ],
+        "iterations": {"aggregation": {"strategy": "concatenate"}},
+        "strategy": {"concatenate": concatenate,
+                     "aggregate": {"source_backends": "all",
+                                   "aggregator_backend": ""}},
+    }
+
+
+def agg_config(backends=3, **flags):
+    aggregate = {
+        "source_backends": "all",
+        "aggregator_backend": "AGG" if backends == 3 else "",
+        "intermediate_separator": "\n\n---\n\n",
+        "include_source_names": False,
+        "source_label_format": "Response from {backend_name}:\n",
+        "strip_intermediate_thinking": True,
+        "hide_aggregator_thinking": True,
+        "thinking_tags": ["think"],
+        "include_original_query": True,
+        "query_format": "Original query: {query}\n\n",
+        "suppress_individual_responses": False,
+        **flags,
+    }
+    primary = [
+        {"name": "LLM1", "url": "http://one.test", "model": "m"},
+        {"name": "LLM2", "url": "http://two.test", "model": "m"},
+    ]
+    if backends == 3:
+        primary.append({"name": "AGG", "url": "http://agg.test", "model": "m"})
+        aggregate.setdefault("source_backends", ["LLM1", "LLM2"])
+        aggregate["source_backends"] = flags.get(
+            "source_backends", ["LLM1", "LLM2"])
+    return {
+        "settings": {"timeout": 30},
+        "primary_backends": primary,
+        "iterations": {"aggregation": {"strategy": "aggregate"}},
+        "strategy": {"aggregate": aggregate,
+                     "concatenate": {"separator": "\n===\n"}},
+    }
+
+
+def concat_fakes():
+    return dict(
+        LLM1=FakeBackend("LLM1", text="<think>check the docs</think>Paris.",
+                         usage={"prompt_tokens": 9, "completion_tokens": 7,
+                                "total_tokens": 16}),
+        LLM2=FakeBackend("LLM2", text="The capital is Paris.",
+                         usage={"prompt_tokens": 9, "completion_tokens": 5,
+                                "total_tokens": 14}),
+    )
+
+
+def agg_fakes():
+    return dict(
+        LLM1=FakeBackend("LLM1", text="<think>easy one</think>Paris."),
+        LLM2=FakeBackend("LLM2", text="It is Paris."),
+        AGG=FakeBackend("AGG", text="Both sources agree: Paris."),
+    )
+
+
+async def ask(config, fakes, body_extra=None):
+    body = {"model": "m", "messages": [{"role": "user", "content": QUESTION}],
+            **(body_extra or {})}
+    async with make_client(config, **fakes) as client:
+        resp = await client.post("/v1/chat/completions", json=body,
+                                 headers={"Authorization": "Bearer doc"})
+    return resp
+
+
+async def sse_frames(config, fakes, body_extra=None):
+    body = {"model": "m", "stream": True,
+            "messages": [{"role": "user", "content": QUESTION}],
+            **(body_extra or {})}
+    async with make_client(config, **fakes) as client:
+        resp = await client.post("/v1/chat/completions", json=body,
+                                 headers={"Authorization": "Bearer doc"})
+    lines = [ln for ln in resp.text.splitlines() if ln.startswith("data: ")]
+    assert lines[-1] == "data: [DONE]"
+    return [json.loads(ln[6:]) for ln in lines[:-1]]
+
+
+# ---- concatenate examples --------------------------------------------------
+
+async def test_separator_example():
+    resp = await ask(concat_config(hide_final_think=True), concat_fakes())
+    content = resp.json()["choices"][0]["message"]["content"]
+    assert content == "Paris.\n===\nThe capital is Paris."
+
+
+async def test_partial_failure_example():
+    from quorum_tpu.backends.base import BackendError
+
+    fakes = concat_fakes()
+    fakes["LLM1"] = FakeBackend("LLM1", fail_with=BackendError("down"))
+    resp = await ask(concat_config(), fakes)
+    assert resp.json()["choices"][0]["message"]["content"] == "The capital is Paris."
+
+
+async def test_hide_final_think_table():
+    """Non-streaming stripping is governed by hide_final_think (quirk-6
+    parity); hide_intermediate_think is streaming-only."""
+    shown = await ask(concat_config(hide_final_think=False), concat_fakes())
+    assert shown.json()["choices"][0]["message"]["content"] == (
+        "<think>check the docs</think>Paris.\n===\nThe capital is Paris.")
+    hidden = await ask(concat_config(hide_final_think=True), concat_fakes())
+    assert hidden.json()["choices"][0]["message"]["content"] == (
+        "Paris.\n===\nThe capital is Paris.")
+
+
+async def test_hide_final_think_streaming_example():
+    frames = await sse_frames(
+        concat_config(hide_intermediate_think=False, hide_final_think=True),
+        concat_fakes())
+    backend0 = "".join(
+        f["choices"][0]["delta"].get("content") or ""
+        for f in frames if f["id"] == "chatcmpl-parallel-0")
+    assert backend0 == "<think>check the docs</think>Paris."
+    final = [f for f in frames if f["id"] == "chatcmpl-parallel-final"]
+    assert final[0]["choices"][0]["delta"]["content"] == (
+        "Paris.\n===\nThe capital is Paris.")
+
+
+async def test_thinking_tags_example():
+    fakes = concat_fakes()
+    fakes["LLM1"] = FakeBackend(
+        "LLM1", text="<think>a</think>b<scratch>c</scratch>d")
+    resp = await ask(concat_config(thinking_tags=["scratch"],
+                                   hide_final_think=True), fakes)
+    content = resp.json()["choices"][0]["message"]["content"]
+    assert content.startswith("<think>a</think>bd\n===\n")
+
+
+async def test_skip_final_aggregation_example():
+    frames = await sse_frames(concat_config(skip_final_aggregation=True),
+                              concat_fakes())
+    assert not any(f["id"] == "chatcmpl-parallel-final" for f in frames)
+    assert frames[-1]["id"].startswith("chatcmpl-parallel-")
+
+
+async def test_usage_summing_example():
+    resp = await ask(concat_config(), concat_fakes())
+    assert resp.json()["usage"] == {
+        "prompt_tokens": 18, "completion_tokens": 12, "total_tokens": 30}
+
+
+# ---- aggregate examples ----------------------------------------------------
+
+async def test_synthesis_prompt_exactly():
+    fakes = agg_fakes()
+    resp = await ask(agg_config(include_source_names=True), fakes)
+    assert resp.json()["choices"][0]["message"]["content"] == (
+        "Both sources agree: Paris.")
+    prompt = fakes["AGG"].calls[0].body["messages"][0]["content"]
+    assert prompt == (
+        "Original query: What is the capital of France?\n\n"
+        "You have received the following responses regarding the user's query:\n\n"
+        "Response from LLM1:\nParis.\n\n---\n\nResponse from LLM2:\nIt is Paris.\n\n"
+        "Synthesize these responses into a single, comprehensive answer that captures\n"
+        "the best information and insights from all sources. Resolve any contradictions\n"
+        "and provide a coherent, unified response."
+    )
+
+
+async def test_fallback_join_example():
+    from quorum_tpu.backends.base import BackendError
+
+    fakes = agg_fakes()
+    fakes["AGG"] = FakeBackend("AGG", fail_with=BackendError("agg down"))
+    resp = await ask(agg_config(), fakes)
+    assert resp.json()["choices"][0]["message"]["content"] == (
+        "Paris.\n\n---\n\nIt is Paris.")
+
+
+async def test_source_backends_example():
+    fakes = agg_fakes()
+    resp = await ask(agg_config(source_backends=["LLM2"],
+                                include_source_names=False), fakes)
+    assert resp.status_code == 200
+    assert fakes["LLM1"].calls == []  # not called at all
+    prompt = fakes["AGG"].calls[0].body["messages"][0]["content"]
+    assert "It is Paris." in prompt and "Paris.\n\n---" not in prompt
+
+
+async def test_intermediate_separator_example():
+    from quorum_tpu.backends.base import BackendError
+
+    fakes = agg_fakes()
+    fakes["AGG"] = FakeBackend("AGG", fail_with=BackendError("agg down"))
+    resp = await ask(agg_config(intermediate_separator=" | "), fakes)
+    assert resp.json()["choices"][0]["message"]["content"] == (
+        "Paris. | It is Paris.")
+
+
+async def test_source_label_format_example():
+    fakes = agg_fakes()
+    await ask(agg_config(include_source_names=True,
+                         source_label_format="[{backend_name}] says:\n"),
+              fakes)
+    prompt = fakes["AGG"].calls[0].body["messages"][0]["content"]
+    assert "[LLM1] says:\nParis." in prompt
+
+
+async def test_include_original_query_example():
+    fakes = agg_fakes()
+    body = {"messages": [
+        {"role": "user", "content": QUESTION},
+        {"role": "assistant", "content": "Paris."},
+        {"role": "user", "content": "Are you sure?"},
+    ]}
+    await ask(agg_config(), fakes, body_extra=body)
+    prompt = fakes["AGG"].calls[0].body["messages"][0]["content"]
+    assert prompt.startswith("Original query: What is the capital of France?")
+    assert "Are you sure?" not in prompt.split("\n")[0]
+
+    fakes2 = agg_fakes()
+    await ask(agg_config(include_original_query=False), fakes2)
+    prompt2 = fakes2["AGG"].calls[0].body["messages"][0]["content"]
+    assert not prompt2.startswith("Original query:")
+
+
+async def test_suppress_individual_responses_transcripts():
+    frames = await sse_frames(agg_config(suppress_individual_responses=True),
+                              agg_fakes())
+    ids = [f["id"] for f in frames]
+    assert ids[0] == "chatcmpl-parallel"
+    assert not any(i.startswith("chatcmpl-parallel-") and i[-1].isdigit()
+                   for i in ids)
+    final = [f for f in frames if f["id"] == "chatcmpl-parallel-final"]
+    assert final[0]["choices"][0]["delta"]["content"] == (
+        "Both sources agree: Paris.")
+
+    frames2 = await sse_frames(agg_config(suppress_individual_responses=False),
+                               agg_fakes())
+    assert any(f["id"] == "chatcmpl-parallel-0" for f in frames2)

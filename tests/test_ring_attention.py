@@ -60,6 +60,89 @@ def test_ring_respects_lengths():
     assert not np.isnan(out).any()
 
 
+def test_ring_gqa_grouped_inside_ring():
+    """GQA: k/v enter the ring at KV-head width (no repeat_kv broadcast,
+    VERDICT r2 weakness 3) and must match the grouped reference attention."""
+    mesh = make_mesh(MeshConfig(sp=4))
+    b, h, n_kv, s, hd = 2, 8, 2, 64, 16
+    q = rand(0, (b, h, s, hd))
+    k = rand(1, (b, n_kv, s, hd))
+    v = rand(2, (b, n_kv, s, hd))
+    lengths = jnp.asarray([64, 40], jnp.int32)
+    out = np.asarray(ring_prefill_attention(q, k, v, lengths, mesh))
+    ref = np.asarray(prefill_attention(q, k, v, lengths))
+    check_valid(out, ref, np.asarray(lengths))
+
+
+def test_ring_gqa_with_tp_sharded_heads():
+    """tp=2 shards 8 query heads and 2 KV heads; sp=2 rides the ring; KV
+    blocks stay at width 1 per device."""
+    mesh = make_mesh(MeshConfig(dp=2, sp=2, tp=2))
+    b, h, n_kv, s, hd = 2, 8, 2, 32, 16
+    q = rand(3, (b, h, s, hd))
+    k = rand(4, (b, n_kv, s, hd))
+    v = rand(5, (b, n_kv, s, hd))
+    lengths = jnp.asarray([32, 32], jnp.int32)
+    out = np.asarray(ring_prefill_attention(q, k, v, lengths, mesh))
+    ref = np.asarray(prefill_attention(q, k, v, lengths))
+    check_valid(out, ref, np.asarray(lengths))
+
+
+def test_ring_gqa_kv_heads_not_divisible_by_tp():
+    """2 KV heads on tp=4: KV (and therefore q's grouping) replicate over tp
+    instead of failing."""
+    mesh = make_mesh(MeshConfig(sp=2, tp=4))
+    b, h, n_kv, s, hd = 1, 8, 2, 32, 16
+    q = rand(6, (b, h, s, hd))
+    k = rand(7, (b, n_kv, s, hd))
+    v = rand(8, (b, n_kv, s, hd))
+    lengths = jnp.asarray([32], jnp.int32)
+    out = np.asarray(ring_prefill_attention(q, k, v, lengths, mesh))
+    ref = np.asarray(prefill_attention(q, k, v, lengths))
+    check_valid(out, ref, np.asarray(lengths))
+
+
+def test_engine_serves_through_ring_attention():
+    """Serving-path sequence parallelism (SURVEY §5.7): an engine on an
+    sp-mesh admits prompts through ring-attention prefill and generates the
+    same tokens as the single-device engine."""
+    from quorum_tpu.engine.engine import InferenceEngine
+    from quorum_tpu.models.model_config import resolve_spec
+    from quorum_tpu.ops.sampling import SamplerConfig
+
+    spec = resolve_spec("llama-tiny", {"n_kv_heads": "4"})
+    prompt = [(5 + 3 * i) % 500 for i in range(60)]
+    eng_1 = InferenceEngine(spec, decode_chunk=4, n_slots=2)
+    eng_sp = InferenceEngine(spec, make_mesh(MeshConfig(sp=4, tp=2)),
+                             decode_chunk=4, n_slots=2)
+    assert eng_sp._use_sp and eng_sp.prefill_chunk == 0
+    for sampler, seed in ((SamplerConfig(temperature=0.0), 0),
+                          (SamplerConfig(temperature=0.8, top_p=0.9), 7)):
+        one = eng_1.generate(prompt, max_new_tokens=10, sampler=sampler,
+                             seed=seed).token_ids
+        sp_toks = eng_sp.generate(prompt, max_new_tokens=10, sampler=sampler,
+                                  seed=seed).token_ids
+        assert sp_toks == one
+
+
+def test_tpu_backend_sp_url():
+    """tpu://…&sp=N builds an sp-mesh engine and serves through it."""
+    import asyncio
+
+    from quorum_tpu.backends.tpu_backend import TpuBackend
+    from quorum_tpu.config import BackendSpec
+
+    b = TpuBackend.from_spec(BackendSpec(
+        name="sp", url="tpu://llama-tiny?n_kv_heads=4&sp=4&tp=2&seed=2",
+        model="t"))
+    assert b.engine._use_sp
+    body = {"model": "t", "messages": [{"role": "user", "content": "hello " * 30}],
+            "max_tokens": 6}
+    res = asyncio.run(b.complete(body, {}, timeout=120))
+    assert res.status_code == 200
+    assert res.body["usage"]["completion_tokens"] >= 1
+
+
 def test_forward_logits_sp_matches_dense():
     """The full sequence-parallel model forward (ring attention per layer,
     GQA, under jit on a dp2×sp2×tp2 mesh) matches the dense forward."""
