@@ -62,10 +62,10 @@ logger = logging.getLogger(__name__)
 def _request_sampler(body: dict[str, Any]) -> SamplerConfig:
     """Map OpenAI request knobs onto the on-device sampler.
 
-    Knobs are quantized to 2 decimals: each distinct SamplerConfig is a
-    distinct compiled program, and these values are client-controlled — the
-    quantization (plus the engine's bounded program cache) keeps recompiles
-    finite regardless of what clients send."""
+    Sampler knobs are per-slot *arrays* in one shared decode program
+    (ops.sampling.sample_token_rows), so distinct values no longer compile
+    distinct programs; the 2-decimal quantization is kept purely as wire
+    hygiene (an output-visible contract since round 2)."""
     temperature = _request_number(body, "temperature", 1.0)
     top_p = _request_number(body, "top_p", 1.0)
     return SamplerConfig(
@@ -392,12 +392,20 @@ class TpuBackend:
         return entry
 
     def _consume(self, plan: dict[str, Any], req) -> tuple:
-        """Drain one submitted choice: returns (result, text, lp_content)."""
+        """Drain one submitted choice: returns (result, text, lp_content).
+
+        Logprob entries track *emitted content*: a token whose text the stop
+        matcher swallows (the stop string itself, or buffered text discarded
+        when the match lands) gets no entry — OpenAI's logprobs.content
+        aligns 1:1 with the tokens of the returned content. Entries are held
+        while the matcher is buffering a potential stop prefix and released
+        when that text is emitted."""
         result = GenerationResult()
         detok = self.tokenizer.detokenizer()
         matcher = _StopMatcher(plan["stops"])
         top_n = max(0, plan["logprobs"])
         lp_content = [] if plan["logprobs"] >= 0 else None
+        pending_lp: list = []
         pieces = []
         for i, t in enumerate(self.engine.stream_results(req)):
             if t == self.tokenizer.eos_id:
@@ -405,13 +413,20 @@ class TpuBackend:
                 break
             result.token_ids.append(t)
             if lp_content is not None and i < len(req.lp):
-                lp_content.append(self._lp_entry(t, req.lp[i], top_n))
-            pieces.append(matcher.feed(detok.feed(t)))
+                pending_lp.append(self._lp_entry(t, req.lp[i], top_n))
+            text = matcher.feed(detok.feed(t))
+            if text and lp_content is not None:
+                lp_content.extend(pending_lp)
+                pending_lp = []
+            pieces.append(text)
             if matcher.hit:
                 # stop string matched: abort decoding now, not at budget
                 result.finish_reason = "stop"
                 break
-        pieces.append(matcher.feed(detok.flush()) + matcher.flush())
+        tail = matcher.feed(detok.flush()) + matcher.flush()
+        pieces.append(tail)
+        if lp_content is not None and tail and not matcher.hit:
+            lp_content.extend(pending_lp)
         if matcher.hit:
             # A stop string can complete only in the flushed detokenizer
             # tail; the finish reason must still say "stop", not "length".
@@ -535,22 +550,26 @@ class TpuBackend:
                         finishes[idx] = "stop"
                         break
                     counts[idx] += 1
-                    if top_n >= 0 and plan["logprobs"] >= 0 and i < len(req.lp):
+                    if plan["logprobs"] >= 0 and i < len(req.lp):
                         pending_lp.append(
                             self._lp_entry(tok, req.lp[i], top_n))
                     text = matcher.feed(detok.feed(tok))
+                    # Logprob entries ride only with emitted content (see
+                    # _consume): text the matcher swallows drops its pending
+                    # entries, keeping streamed logprobs aligned with the
+                    # streamed content.
                     if matcher.hit:
                         finishes[idx] = "stop"
-                        if text or pending_lp:
+                        if text:
                             emit(text)
                         break
-                    if text or (pending_lp and plan["logprobs"] >= 0):
+                    if text:
                         emit(text)
                 tail = matcher.feed(detok.flush()) + matcher.flush()
                 if matcher.hit:
                     # Stop string completed in the flushed tail (see complete()).
                     finishes[idx] = "stop"
-                if tail or pending_lp:
+                if tail:
                     emit(tail)
                 loop.call_soon_threadsafe(queue.put_nowait, ("end", idx, None))
             except Exception as e:  # normalized below on the consumer side

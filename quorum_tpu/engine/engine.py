@@ -200,6 +200,11 @@ class InferenceEngine:
         self._admitting: list[_Admission] = []
         self._claimed: set[int] = set()  # slots held by in-progress admissions
         self._cond = threading.Condition()
+        # Monotonic counters for /metrics (written on the scheduler/submit
+        # paths; reads are snapshots, exactness across a race is not needed).
+        self.n_requests = 0
+        self.n_tokens = 0
+        self.n_failures = 0
         self._thread = threading.Thread(
             target=self._scheduler, name=f"engine-{id(self):x}", daemon=True
         )
@@ -366,14 +371,17 @@ class InferenceEngine:
         self._admit_cache["register"] = fn
         return fn
 
-    def _decode_fn(self, n_steps: int, want_lp: bool):
+    def _decode_fn(self, n_steps: int, want_lp: bool, history: int):
         """Jitted: ``n_steps`` batched decode+sample steps over all slots.
 
-        Two variants per chunk size: the ``want_lp`` one additionally emits
-        per-step logprobs (log_softmax over [S, V] + top-k) — compiled and
-        paid only when some active request asked for logprobs, keeping the
-        common decode path free of the extra vocab-wide passes."""
-        fn = self._decode_cache.get((n_steps, want_lp))
+        Variants per (chunk size, want_lp, history bucket): the ``want_lp``
+        one additionally emits per-step logprobs (log_softmax over [S, V] +
+        top-k) — compiled and paid only when some active request asked for
+        logprobs; ``history`` (a power-of-two ≥ the longest active sequence
+        after this chunk) bounds each step's attention reads to the live
+        cache prefix instead of the full padded max_seq row (decode is
+        HBM-bound — this is the decode-side bandwidth fix)."""
+        fn = self._decode_cache.get((n_steps, want_lp, history))
         if fn is not None:
             return fn
         spec = self.spec
@@ -393,7 +401,8 @@ class InferenceEngine:
                 # position-0 write.
                 pos = jnp.where(live, lens, 0)
                 logits, ck, cv = decode_step(
-                    params, spec, tok, pos, ck, cv, write_mask=live
+                    params, spec, tok, pos, ck, cv, write_mask=live,
+                    history=history,
                 )
                 # OpenAI sampling knobs, applied per row on the f32 logits:
                 # logit_bias adds; presence/frequency penalties subtract
@@ -439,7 +448,7 @@ class InferenceEngine:
             donate_argnames=("ck", "cv", "token_s", "lengths_s", "keys_s",
                              "counts_s"),
         )
-        self._decode_cache[(n_steps, want_lp)] = fn
+        self._decode_cache[(n_steps, want_lp, history)] = fn
         return fn
 
     # ---- public API -------------------------------------------------------
@@ -577,8 +586,24 @@ class InferenceEngine:
                     f"engine admission queue full ({self.max_pending} waiting)"
                 )
             self._pending.append(req)
+            self.n_requests += 1
             self._cond.notify()
         return req
+
+    def metrics(self) -> dict:
+        """Scheduler/capacity snapshot for the server's /metrics endpoint."""
+        with self._cond:
+            busy = sum(1 for r in self._slots if r is not None)
+            return {
+                "slots": self.n_slots,
+                "busy_slots": busy,
+                "admitting": len(self._admitting),
+                "pending": len(self._pending),
+                "queue_limit": self.max_pending,
+                "requests_total": self.n_requests,
+                "tokens_total": self.n_tokens,
+                "failures_total": self.n_failures,
+            }
 
     def _scheduler(self) -> None:
         while True:
@@ -731,10 +756,14 @@ class InferenceEngine:
         # than surprise XLA compiles inside a serving window.
         n_steps = max(1, min(r.chunk_hint or self.decode_chunk for _, r in active))
         want_lp = any(r.want_lp >= 0 for _, r in active)
+        # History bucket: longest active sequence after this chunk, rounded
+        # to a power of two — every step's attention reads only cache[:hb].
+        max_len = max(len(r.prompt_ids) + r.emitted for _, r in active)
+        history = prefill_bucket(max_len + n_steps, self.spec.max_seq)
         mask = np.zeros((self.n_slots,), np.int32)
         for i, _ in active:
             mask[i] = 1
-        out = self._decode_fn(n_steps, want_lp)(
+        out = self._decode_fn(n_steps, want_lp, history)(
             self.params, mask, self._ck, self._cv, self._token, self._lengths,
             self._keys, self._temp, self._topp, self._topk,
             self._pp, self._fp, self._counts, self._bias,
@@ -765,6 +794,7 @@ class InferenceEngine:
             req.out.put(("end", None))
             return True
         req.emitted += 1
+        self.n_tokens += 1
         req.out.put(("tok", tok))
         if req.eos_id is not None and tok == req.eos_id:
             req.out.put(("end", "stop"))
@@ -787,6 +817,7 @@ class InferenceEngine:
             self._pending = []
         # Wake consumers first — the state rebuild below can itself fail, and
         # doomed requests must never hang on their queues.
+        self.n_failures += len(doomed)
         for r in doomed:
             r.out.put(("err", exc))
         # The failed call may have consumed its donated buffers; rebuild the

@@ -135,6 +135,13 @@ def ring_prefill_attention(
     sp_size = mesh.shape[sp]
     b, h = q.shape[0], q.shape[1]
     n_kv = k.shape[1]
+    if q.shape[2] % sp_size != 0:
+        # Sequence can't shard over sp (e.g. a 16-token admission bucket on
+        # sp=32): fall back to the dense replicated path rather than fail
+        # the request — short sequences don't need the ring anyway.
+        from quorum_tpu.ops.attention import prefill_attention
+
+        return prefill_attention(q, k, v, lengths)
     baxis = _axis_if_divisible(b, AXIS_DP, mesh)
     haxis = _axis_if_divisible(h, AXIS_TP, mesh)
     kaxis = _axis_if_divisible(n_kv, AXIS_TP, mesh)

@@ -29,6 +29,7 @@ import contextlib
 import json
 import logging
 import os
+import time
 import uuid
 from typing import Any, AsyncIterator
 
@@ -134,6 +135,46 @@ def create_app(
     @app.route("GET", "/health", "/v1/health")
     async def health(request: Request) -> Response:
         return JSONResponse({"status": "healthy"})
+
+    started = time.monotonic()
+
+    @app.route("GET", "/metrics", "/v1/metrics")
+    async def metrics(request: Request) -> Response:
+        """Prometheus text exposition of engine/scheduler state — the
+        metrics-export gap the reference leaves open (SURVEY.md §5.5: two
+        log channels, no metrics). One line set per tpu:// backend; HTTP
+        backends have no local state to export."""
+        lines = [
+            "# TYPE quorum_tpu_uptime_seconds gauge",
+            f"quorum_tpu_uptime_seconds {time.monotonic() - started:.3f}",
+        ]
+        gauges = ("slots", "busy_slots", "admitting", "pending", "queue_limit")
+        # One snapshot per distinct engine: backends sharing one cached
+        # engine (get_engine) must not double-count its load. Each family's
+        # TYPE line appears exactly once, with all its samples grouped —
+        # the Prometheus text format rejects repeated TYPE lines.
+        seen: set[int] = set()
+        snapshots: list[tuple[str, dict]] = []
+        for backend in reg.backends:
+            engine = getattr(backend, "engine", None)
+            if engine is None or not hasattr(engine, "metrics"):
+                continue
+            if id(engine) in seen:
+                continue
+            seen.add(id(engine))
+            snapshots.append((backend.name, engine.metrics()))
+        if snapshots:
+            for key in snapshots[0][1]:
+                kind = "gauge" if key in gauges else "counter"
+                lines.append(f"# TYPE quorum_tpu_engine_{key} {kind}")
+                for name, m in snapshots:
+                    lines.append(
+                        f'quorum_tpu_engine_{key}{{backend="{name}"}} {m[key]}'
+                    )
+        return Response(
+            ("\n".join(lines) + "\n").encode(),
+            media_type="text/plain; version=0.0.4",
+        )
 
     @app.route("POST", "/chat/completions", "/v1/chat/completions")
     async def chat_completions(request: Request) -> Response:

@@ -77,7 +77,20 @@ def train_init(
     opt = optimizer or make_optimizer()
     params = shard_pytree(mesh, init_params(spec, seed))
     opt_state = jax.jit(opt.init)(params)
-    return TrainState(params=params, opt_state=opt_state, step=jnp.zeros((), jnp.int32))
+    # jit collapses fully-replicated outputs (adam count, moments of
+    # replicated params) to SingleDeviceSharding; pin those back to a
+    # replicated NamedSharding so the whole state shares one device set —
+    # required for the train step's donation and for sharded checkpoint
+    # restore to round-trip exactly. tp-sharded moments keep the
+    # NamedSharding propagation already gave them.
+    rep = NamedSharding(mesh, P())
+    opt_state = jax.tree.map(
+        lambda x: x if isinstance(x.sharding, NamedSharding)
+        else jax.device_put(x, rep),
+        opt_state,
+    )
+    step = jax.device_put(jnp.zeros((), jnp.int32), rep)
+    return TrainState(params=params, opt_state=opt_state, step=step)
 
 
 def make_train_step(

@@ -104,13 +104,13 @@ def test_engine_survives_failed_device_call():
     real_decode_fn = eng._decode_fn
     calls = {"n": 0}
 
-    def exploding_decode_fn(n_steps, want_lp=False):
+    def exploding_decode_fn(n_steps, want_lp=False, history=0):
         calls["n"] += 1
         if calls["n"] == 1:
             def boom(*a, **k):
                 raise RuntimeError("injected device failure")
             return boom
-        return real_decode_fn(n_steps, want_lp)
+        return real_decode_fn(n_steps, want_lp, history)
 
     eng._decode_fn = exploding_decode_fn
     try:

@@ -1,0 +1,37 @@
+"""/metrics endpoint: Prometheus exposition of engine scheduler state
+(SURVEY §5.5 — the reference exports no metrics at all)."""
+
+from tests.conftest import make_client
+
+
+def _config():
+    return {
+        "settings": {"timeout": 60},
+        "primary_backends": [
+            {"name": "LLM1", "url": "tpu://llama-tiny?seed=9107&slots=2", "model": "t"},
+        ],
+    }
+
+
+async def test_metrics_exposition():
+    async with make_client(_config()) as client:
+        before = (await client.get("/metrics")).text
+        assert "quorum_tpu_uptime_seconds" in before
+        assert 'quorum_tpu_engine_slots{backend="LLM1"} 2' in before
+        assert 'quorum_tpu_engine_requests_total{backend="LLM1"} 0' in before
+
+        resp = await client.post(
+            "/v1/chat/completions",
+            json={"model": "t", "messages": [{"role": "user", "content": "hi"}],
+                  "max_tokens": 5},
+            headers={"Authorization": "Bearer x"},
+        )
+        assert resp.status_code == 200
+
+        after = (await client.get("/v1/metrics")).text
+        assert 'quorum_tpu_engine_requests_total{backend="LLM1"} 1' in after
+        assert 'quorum_tpu_engine_tokens_total{backend="LLM1"} 5' in after
+        assert 'quorum_tpu_engine_busy_slots{backend="LLM1"} 0' in after
+        assert 'quorum_tpu_engine_failures_total{backend="LLM1"} 0' in after
+        # prometheus text format: TYPE comments present
+        assert "# TYPE quorum_tpu_engine_tokens_total counter" in after

@@ -99,6 +99,31 @@ def test_logprobs_rejects_bad_values(backend, bad):
     assert e.value.status_code == 400
 
 
+def test_logprobs_align_with_content_under_stop(backend):
+    """logprobs.content must track EMITTED content: tokens swallowed by the
+    stop matcher (the stop string itself) get no entries (OpenAI 1:1
+    content/logprobs alignment)."""
+    # Find what the model greedily emits, pick its 3rd token's text as stop.
+    probe = run(backend.complete(
+        {**BASE, "max_tokens": 8, "temperature": 0.0, "logprobs": True}, {}, 60))
+    entries = probe.body["choices"][0]["logprobs"]["content"]
+    assert len(entries) == 8
+    stop_tok = entries[3]["token"]
+    if not stop_tok:
+        pytest.skip("3rd token has empty text (detokenizer buffering)")
+
+    res = run(backend.complete(
+        {**BASE, "max_tokens": 8, "temperature": 0.0, "logprobs": True,
+         "stop": [stop_tok]}, {}, 60))
+    choice = res.body["choices"][0]
+    content = choice["message"]["content"]
+    lp = choice["logprobs"]["content"]
+    assert stop_tok not in content  # stop string excluded from content
+    # entries correspond to the emitted prefix only — joining their token
+    # texts reproduces the content exactly
+    assert "".join(e["token"] for e in lp) == content
+
+
 # ---- penalties -------------------------------------------------------------
 
 def test_frequency_penalty_discourages_repeats(backend):

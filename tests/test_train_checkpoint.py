@@ -1,0 +1,102 @@
+"""Training checkpoint/resume on the virtual mesh (SURVEY §5.4).
+
+Save a sharded TrainState mid-training, restore it (same and different mesh
+shape), and verify training continues bit-for-bit; serve from the restored
+params through the engine.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from quorum_tpu.models.model_config import resolve_spec
+from quorum_tpu.parallel import MeshConfig, make_mesh
+from quorum_tpu.training.checkpoint import (
+    restore_checkpoint,
+    restore_params,
+    save_checkpoint,
+)
+from quorum_tpu.training.trainer import make_train_step, train_init
+
+SPEC = resolve_spec("llama-tiny", {"max_seq": "64"})
+
+
+def _tokens(seed, batch=4, seqlen=32):
+    rng = np.random.RandomState(seed)
+    return rng.randint(1, SPEC.vocab_size, size=(batch, seqlen))
+
+
+def _leaves_equal(a, b):
+    fa = jax.tree.leaves(a)
+    fb = jax.tree.leaves(b)
+    assert len(fa) == len(fb)
+    for x, y in zip(fa, fb):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+def test_save_restore_roundtrip_and_resume(tmp_path):
+    mesh = make_mesh(MeshConfig(dp=2, tp=4))
+    step = make_train_step(SPEC, mesh)
+    state = train_init(SPEC, mesh, seed=0)
+    for i in range(3):
+        state, _ = step(state, _tokens(i))
+
+    save_checkpoint(str(tmp_path / "ckpt"), state)
+
+    # Continue the original for two more steps → reference trajectory.
+    ref = state
+    losses_ref = []
+    for i in range(3, 5):
+        ref, loss = step(ref, _tokens(i))
+        losses_ref.append(float(loss))
+
+    # Restore and continue identically.
+    restored = restore_checkpoint(str(tmp_path / "ckpt"), SPEC, mesh)
+    assert int(restored.step) == 3
+    losses_res = []
+    for i in range(3, 5):
+        restored, loss = step(restored, _tokens(i))
+        losses_res.append(float(loss))
+    assert losses_res == losses_ref
+    _leaves_equal(restored.params, ref.params)
+
+
+def test_restore_onto_different_mesh_shape(tmp_path):
+    mesh_a = make_mesh(MeshConfig(dp=2, tp=4))
+    state = train_init(SPEC, mesh_a, seed=1)
+    step_a = make_train_step(SPEC, mesh_a)
+    state, _ = step_a(state, _tokens(0))
+    save_checkpoint(str(tmp_path / "ckpt"), state)
+
+    # Resume on a tp8 mesh: weights re-lay onto the new sharding.
+    mesh_b = make_mesh(MeshConfig(tp=8))
+    restored = restore_checkpoint(str(tmp_path / "ckpt"), SPEC, mesh_b)
+    _leaves_equal(restored.params, state.params)
+    step_b = make_train_step(SPEC, mesh_b)
+    restored, loss = step_b(restored, _tokens(1))
+    assert np.isfinite(float(loss))
+
+
+def test_serve_from_training_checkpoint(tmp_path):
+    from quorum_tpu.engine.engine import InferenceEngine
+    from quorum_tpu.ops.sampling import SamplerConfig
+
+    mesh = make_mesh(MeshConfig(tp=2))
+    state = train_init(SPEC, mesh, seed=2)
+    save_checkpoint(str(tmp_path / "ckpt"), state)
+
+    params = restore_params(str(tmp_path / "ckpt"), SPEC, mesh)
+    eng = InferenceEngine(SPEC, mesh, params=jax.tree.map(np.asarray, params))
+    out = eng.generate([5, 6, 7], max_new_tokens=6,
+                       sampler=SamplerConfig(temperature=0.0))
+    assert len(out.token_ids) == 6
+    # and it really is the trained weights: logits match the state's params
+    from quorum_tpu.models.transformer import forward_logits
+
+    import jax.numpy as jnp
+
+    toks = jnp.asarray([[5, 6, 7]], jnp.int32)
+    a = np.asarray(forward_logits(state.params, SPEC, toks), np.float32)
+    b = np.asarray(forward_logits(eng.params, SPEC, toks), np.float32)
+    np.testing.assert_allclose(a, b, rtol=2e-2, atol=2e-2)
