@@ -391,15 +391,29 @@ class TpuBackend:
         ]
         return entry
 
+    @staticmethod
+    def _take_aligned(pending: list, n_chars: int) -> list:
+        """Pop pending logprob entries covering ``n_chars`` of emitted text.
+
+        The uniform alignment rule for both complete() and stream(): an
+        entry ships exactly when its token's text ships. Entries whose text
+        the stop matcher still buffers (or later swallows) stay pending /
+        are dropped; an entry straddling the emit boundary ships with the
+        chunk that contains its first character. Zero-length token texts
+        ride along with the next emission."""
+        out, used = [], 0
+        while pending and used < n_chars:
+            e = pending.pop(0)
+            out.append(e)
+            used += len(e["token"])
+        return out
+
     def _consume(self, plan: dict[str, Any], req) -> tuple:
         """Drain one submitted choice: returns (result, text, lp_content).
 
-        Logprob entries track *emitted content*: a token whose text the stop
-        matcher swallows (the stop string itself, or buffered text discarded
-        when the match lands) gets no entry — OpenAI's logprobs.content
-        aligns 1:1 with the tokens of the returned content. Entries are held
-        while the matcher is buffering a potential stop prefix and released
-        when that text is emitted."""
+        Logprob entries track *emitted content* (see ``_take_aligned``):
+        tokens the stop matcher swallows get no entry — OpenAI's
+        logprobs.content aligns with the tokens of the returned content."""
         result = GenerationResult()
         detok = self.tokenizer.detokenizer()
         matcher = _StopMatcher(plan["stops"])
@@ -416,8 +430,7 @@ class TpuBackend:
                 pending_lp.append(self._lp_entry(t, req.lp[i], top_n))
             text = matcher.feed(detok.feed(t))
             if text and lp_content is not None:
-                lp_content.extend(pending_lp)
-                pending_lp = []
+                lp_content.extend(self._take_aligned(pending_lp, len(text)))
             pieces.append(text)
             if matcher.hit:
                 # stop string matched: abort decoding now, not at budget
@@ -425,8 +438,8 @@ class TpuBackend:
                 break
         tail = matcher.feed(detok.flush()) + matcher.flush()
         pieces.append(tail)
-        if lp_content is not None and tail and not matcher.hit:
-            lp_content.extend(pending_lp)
+        if lp_content is not None and tail:
+            lp_content.extend(self._take_aligned(pending_lp, len(tail)))
         if matcher.hit:
             # A stop string can complete only in the flushed detokenizer
             # tail; the finish reason must still say "stop", not "length".
@@ -540,7 +553,10 @@ class TpuBackend:
             pending_lp: list = []
 
             def emit(text: str):
-                lp, pending_lp[:] = pending_lp[:], []
+                # Same alignment rule as _consume: entries ship only with
+                # the text that contains their token (stop-swallowed or
+                # still-buffered text keeps its entries pending).
+                lp = self._take_aligned(pending_lp, len(text))
                 loop.call_soon_threadsafe(
                     queue.put_nowait, ("text", idx, (text, lp)))
 

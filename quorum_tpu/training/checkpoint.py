@@ -64,6 +64,49 @@ def _abstract_like(tree: Any) -> Any:
     )
 
 
+def _abstract_params(spec: ModelSpec, mesh: Mesh) -> Any:
+    """Sharded abstract params pytree — no device allocation."""
+    from quorum_tpu.models.init import init_params
+    from quorum_tpu.parallel.sharding import param_shardings
+
+    shapes = jax.eval_shape(lambda: init_params(spec, 0))
+    shardings = param_shardings(mesh, shapes)
+    return jax.tree.map(
+        lambda s, sh: (None if s is None
+                       else jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=sh)),
+        shapes, shardings,
+        is_leaf=lambda x: x is None or hasattr(x, "shape"),
+    )
+
+
+def _abstract_state(
+    spec: ModelSpec, mesh: Mesh, opt: optax.GradientTransformation
+) -> TrainState:
+    """Abstract TrainState with the exact shardings train_init produces —
+    derived via AOT compilation (``lower().compile().output_shardings``),
+    so building the restore target allocates NOTHING on device (restore
+    time is exactly when HBM headroom matters)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    params = _abstract_params(spec, mesh)
+    compiled = jax.jit(opt.init).lower(params).compile()
+    opt_shapes = jax.eval_shape(opt.init, params)
+    rep = NamedSharding(mesh, P())
+    opt_state = jax.tree.map(
+        lambda s, sh: jax.ShapeDtypeStruct(
+            s.shape, s.dtype,
+            # same normalization as train_init: fully-replicated outputs
+            # collapse to SingleDeviceSharding in the AOT answer too
+            sharding=sh if isinstance(sh, NamedSharding) else rep,
+        ),
+        opt_shapes, compiled.output_shardings,
+    )
+    import jax.numpy as jnp
+
+    step = jax.ShapeDtypeStruct((), jnp.int32, sharding=rep)
+    return TrainState(params=params, opt_state=opt_state, step=step)
+
+
 def restore_checkpoint(
     path: str,
     spec: ModelSpec,
@@ -71,17 +114,11 @@ def restore_checkpoint(
     *,
     optimizer: optax.GradientTransformation | None = None,
 ) -> TrainState:
-    """Restore a full TrainState onto ``mesh``, sharded in place.
-
-    The template init provides the target structure + shardings; its device
-    buffers are dropped before orbax allocates the restored arrays, so peak
-    memory stays ~one state."""
+    """Restore a full TrainState onto ``mesh``, sharded in place."""
     import orbax.checkpoint as ocp
 
     opt = optimizer or make_optimizer()
-    template = train_init(spec, mesh, optimizer=opt)
-    abstract = _abstract_like(template)
-    del template
+    abstract = _abstract_state(spec, mesh, opt)
     restored = _checkpointer().restore(
         os.path.abspath(path),
         args=ocp.args.Composite(
@@ -108,17 +145,7 @@ def restore_params(path: str, spec: ModelSpec, mesh: Mesh) -> Any:
     optimizer moments are never read or materialized."""
     import orbax.checkpoint as ocp
 
-    from quorum_tpu.models.init import init_params
-    from quorum_tpu.parallel.sharding import param_shardings
-
-    shapes = jax.eval_shape(lambda: init_params(spec, 0))
-    shardings = param_shardings(mesh, shapes)
-    abstract = jax.tree.map(
-        lambda s, sh: (None if s is None
-                       else jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=sh)),
-        shapes, shardings,
-        is_leaf=lambda x: x is None or hasattr(x, "shape"),
-    )
+    abstract = _abstract_params(spec, mesh)
     ckptr = _checkpointer()
     restored = ckptr.restore(
         os.path.abspath(path),

@@ -71,6 +71,25 @@ def test_mesh_engine_slots_not_divisible_by_dp():
     assert _gen(eng_m, 1, [5, 6, 7]) == _gen(eng_1, 1, [5, 6, 7])
 
 
+def test_moe_engine_on_mesh_matches_single_device():
+    """Grouped sparse-MoE prefill (scatter/gather dispatch) + dense-MoE
+    decode must survive GSPMD on a dp×tp(=ep) mesh inside the full engine
+    path — experts shard over tp, the dispatch indices replicate."""
+    moe = resolve_spec("mixtral-tiny")
+    eng_1 = InferenceEngine(moe, decode_chunk=4, n_slots=2)
+    eng_m = InferenceEngine(moe, make_mesh(MeshConfig(dp=2, tp=4)),
+                            decode_chunk=4, n_slots=2)
+    prompt = [(9 + 5 * i) % 500 for i in range(24)]
+    for sampler, seed in ((SamplerConfig(temperature=0.0), 0),
+                          (SamplerConfig(temperature=0.8, top_p=0.9), 5)):
+        one = eng_1.generate(prompt, max_new_tokens=8, sampler=sampler,
+                             seed=seed).token_ids
+        sharded = eng_m.generate(prompt, max_new_tokens=8, sampler=sampler,
+                                 seed=seed).token_ids
+        assert sharded == one
+        assert len(one) == 8
+
+
 def test_tpu_backend_with_tp_mesh():
     """A ``tpu://…&tp=4`` backend serves complete() and stream() through the
     sharded engine and matches the single-device backend's text."""

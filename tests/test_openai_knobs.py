@@ -124,6 +124,34 @@ def test_logprobs_align_with_content_under_stop(backend):
     assert "".join(e["token"] for e in lp) == content
 
 
+def test_streaming_logprobs_align_with_streamed_content(backend):
+    """Streamed logprob entries ride inside content chunks and, joined,
+    reproduce exactly the streamed content (stop-swallowed text drops its
+    entries)."""
+    probe = run(backend.complete(
+        {**BASE, "max_tokens": 8, "temperature": 0.0, "logprobs": True}, {}, 60))
+    stop_tok = probe.body["choices"][0]["logprobs"]["content"][3]["token"]
+    if not stop_tok:
+        pytest.skip("3rd token has empty text")
+
+    async def go():
+        text, toks = [], []
+        async for ch in backend.stream(
+            {**BASE, "max_tokens": 8, "temperature": 0.0, "logprobs": True,
+             "stop": [stop_tok], "stream": True}, {}, 60):
+            for c in ch.get("choices") or []:
+                delta = c.get("delta") or {}
+                if delta.get("content"):
+                    text.append(delta["content"])
+                for e in ((c.get("logprobs") or {}).get("content") or []):
+                    toks.append(e["token"])
+        return "".join(text), "".join(toks)
+
+    streamed, lp_joined = run(go())
+    assert stop_tok not in streamed
+    assert lp_joined == streamed
+
+
 # ---- penalties -------------------------------------------------------------
 
 def test_frequency_penalty_discourages_repeats(backend):
