@@ -23,6 +23,11 @@ URL grammar:  ``tpu://<model-id>?<spec overrides>&<engine options>``
                    chunks, so a long admission can't stall active streams
   queue=           admission queue bound (default 128); a full queue rejects
                    with 503 instead of growing without limit
+  spec_decode=G    speculative decoding (default 0 = off): when every active
+                   request is greedy with no penalties/bias/logprobs, each
+                   dispatch verifies G prompt-lookup draft tokens in one
+                   multi-token forward — accepted runs advance G+1 tokens
+                   for one dispatch's weight reads (decode is HBM-bound)
   max_tokens=      default completion budget when the request has none
 
 Contract parity with the dispatcher: configured model overrides the request
@@ -199,6 +204,7 @@ class TpuBackend:
             n_slots=n_slots,
             prefill_chunk=int(opts.get("prefill_chunk", DEFAULT_PREFILL_CHUNK)),
             max_pending=int(opts.get("queue", DEFAULT_MAX_PENDING)),
+            spec_decode=int(opts.get("spec_decode", 0)),
         )
         if ckpt:
             # seed= still differentiates ensemble members: it offsets the

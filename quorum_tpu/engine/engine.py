@@ -50,6 +50,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from quorum_tpu.models.init import init_params_sharded
 from quorum_tpu.models.model_config import ModelSpec
 from quorum_tpu.models.transformer import (
+    decode_multi,
     decode_step,
     init_cache,
     prefill,
@@ -99,7 +100,7 @@ class _Request:
     __slots__ = (
         "prompt_ids", "budget", "temperature", "top_p", "top_k", "seed",
         "eos_id", "cancel", "chunk_hint", "out", "emitted",
-        "pp", "fp", "bias_row", "want_lp", "lp",
+        "pp", "fp", "bias_row", "want_lp", "lp", "hist", "ngram",
     )
 
     def __init__(self, prompt_ids, budget, sampler: SamplerConfig, seed, eos_id,
@@ -120,6 +121,22 @@ class _Request:
         self.bias_row = bias_row      # np [V] f32 logit_bias, or None
         self.want_lp = want_lp        # -1 = no logprobs; else #top alternatives
         self.lp: list = []
+        # Prompt-lookup drafting state: the running token history and an
+        # incrementally-maintained 2-gram → position index ("lagged": a pair
+        # is recorded only once a token FOLLOWS it, so the index never
+        # contains the trailing pair and lookups are O(1) per draft).
+        self.hist: list[int] = list(prompt_ids)
+        self.ngram: dict = {
+            (prompt_ids[n - 2], prompt_ids[n - 1]): n - 1
+            for n in range(2, len(prompt_ids))
+        }
+
+    @property
+    def greedy_clean(self) -> bool:
+        """Eligible for speculative verification: greedy, no sampling state
+        that depends on the accepted prefix (penalties/bias), no logprobs."""
+        return (self.temperature <= 0.0 and self.pp == 0.0 and self.fp == 0.0
+                and self.bias_row is None and self.want_lp < 0)
 
 
 class _Admission:
@@ -155,12 +172,17 @@ class InferenceEngine:
         n_slots: int = DEFAULT_SLOTS,
         prefill_chunk: int = DEFAULT_PREFILL_CHUNK,
         max_pending: int = DEFAULT_MAX_PENDING,
+        spec_decode: int = 0,
     ):
         self.spec = spec.validate()
         self.mesh = mesh or single_device_mesh()
         self.decode_chunk = max(1, decode_chunk)
         self.n_slots = max(1, n_slots)
         self.max_pending = max(1, max_pending)
+        # Speculative decoding draft length (0 = off): when every active
+        # request is greedy_clean, each dispatch verifies spec_decode
+        # prompt-lookup draft tokens in one multi-token forward.
+        self.spec_decode = max(0, min(spec_decode, 16))
         # Chunked prefill needs segment offsets that never cross max_seq
         # (dynamic_update_slice clamps out-of-range starts, which would
         # silently corrupt cache history): round the chunk down to a
@@ -449,6 +471,76 @@ class InferenceEngine:
                              "counts_s"),
         )
         self._decode_cache[(n_steps, want_lp, history)] = fn
+        return fn
+
+    def _verify_fn(self, g: int, history: int):
+        """Jitted speculative-verification step: position 0 samples the next
+        token exactly as the normal decode path would; positions 1..g score
+        the drafted continuation, and the longest draft prefix matching the
+        greedy chain is accepted — 1 + n_accept tokens emitted for ONE
+        dispatch's worth of weight reads (decode is bandwidth-bound, so the
+        g extra positions are nearly free).
+
+        Acceptance is sound regardless of where drafts come from: draft i
+        is accepted only if it EQUALS the token the model itself emits at
+        that position, so the output sequence is the model's own greedy
+        continuation. (The multi-token forward may reassociate float ops
+        differently from the single-token program; an exact-tie argmax flip
+        is the same caveat as any program-shape change.)"""
+        fn = self._decode_cache.get(("verify", g, history))
+        if fn is not None:
+            return fn
+        spec = self.spec
+        n_slots = self.n_slots
+
+        def verify(params, active, tokens, ck, cv, token_s, lengths_s, keys_s,
+                   temp_s, topp_s, topk_s, counts_s):
+            live = active > 0
+            pos = jnp.where(live, lengths_s, 0)
+            logits, ck, cv = decode_multi(
+                params, spec, tokens, pos, ck, cv, write_mask=live,
+                history=history,
+            )  # [S, g+1, V]
+            split = jax.vmap(jax.random.split)(keys_s)
+            s0 = sample_token_rows(
+                logits[:, 0].astype(jnp.float32), split[:, 1],
+                temp_s, topp_s, topk_s,
+            )
+            s0 = jnp.where(live, s0, tokens[:, 0])
+            greedy = jnp.argmax(logits[:, 1:], axis=-1).astype(jnp.int32)  # [S,g]
+            # chain: draft i (tokens[:, i]) must equal the model's token at
+            # that position (s0 for i=1, greedy[i-2] for i>=2)
+            prev = jnp.concatenate([s0[:, None], greedy[:, :-1]], axis=1)
+            ok = jnp.cumprod(
+                (tokens[:, 1:] == prev).astype(jnp.int32), axis=1)  # [S,g]
+            ok = ok * live[:, None].astype(jnp.int32)
+            n_extra = jnp.sum(ok, axis=1)                            # [S]
+            emitted = 1 + n_extra
+            last = jnp.where(
+                n_extra > 0,
+                jnp.take_along_axis(
+                    greedy, jnp.maximum(n_extra - 1, 0)[:, None], axis=1)[:, 0],
+                s0,
+            )
+            rows = jnp.arange(n_slots)
+            counts_s = counts_s.at[rows, s0].add(live.astype(jnp.int32))
+            for i in range(g):
+                counts_s = counts_s.at[rows, greedy[:, i]].add(ok[:, i])
+            return (
+                s0, greedy, ok,
+                ck, cv,
+                jnp.where(live, last, token_s),
+                lengths_s + emitted * live.astype(lengths_s.dtype),
+                split[:, 0],
+                counts_s,
+            )
+
+        fn = jax.jit(
+            verify,
+            donate_argnames=("ck", "cv", "token_s", "lengths_s", "keys_s",
+                             "counts_s"),
+        )
+        self._decode_cache[("verify", g, history)] = fn
         return fn
 
     # ---- public API -------------------------------------------------------
@@ -759,6 +851,17 @@ class InferenceEngine:
         # History bucket: longest active sequence after this chunk, rounded
         # to a power of two — every step's attention reads only cache[:hb].
         max_len = max(len(r.prompt_ids) + r.emitted for _, r in active)
+        g = self.spec_decode
+        if (g > 0
+                and all(r.greedy_clean for _, r in active)
+                and max_len + g + 1 <= self.spec.max_seq):
+            drafts = {i: self._draft(r, g) for i, r in active}
+            # Fall through to the chunked path when NO row has a draft —
+            # a draftless verify step would emit 1 token per dispatch and
+            # forfeit decode_chunk amortization for nothing.
+            if any(d is not None for d in drafts.values()):
+                self._run_verify_step(active, g, max_len, drafts)
+                return
         history = prefill_bucket(max_len + n_steps, self.spec.max_seq)
         mask = np.zeros((self.n_slots,), np.int32)
         for i, _ in active:
@@ -788,12 +891,68 @@ class InferenceEngine:
                 with self._cond:
                     self._slots[i] = None
 
+    @staticmethod
+    def _draft(req: _Request, g: int) -> list[int] | None:
+        """Prompt-lookup draft: the most recent earlier occurrence of the
+        trailing 2-gram, continued for g tokens. O(1) via the request's
+        incrementally-maintained n-gram index (the lagged update means the
+        stored position always has ≥ 1 continuation token). Drafts are
+        suggestions only — verification accepts a draft token iff it equals
+        what the model itself emits at that position."""
+        hist = req.hist
+        if len(hist) < 4:
+            return None
+        pos = req.ngram.get((hist[-2], hist[-1]))
+        if pos is None:
+            return None
+        cont = hist[pos + 1 : pos + 1 + g]
+        return cont + [cont[-1]] * (g - len(cont))
+
+    def _run_verify_step(self, active, g: int, max_len: int, drafts) -> None:
+        """One speculative dispatch: verify each row's prompt-lookup draft."""
+        history = prefill_bucket(max_len + g + 1, self.spec.max_seq)
+        mask = np.zeros((self.n_slots,), np.int32)
+        tokens = np.zeros((self.n_slots, g + 1), np.int32)
+        for i, r in active:
+            mask[i] = 1
+            tokens[i, 0] = r.hist[-1]
+            draft = drafts.get(i)
+            if draft is not None:
+                tokens[i, 1:] = draft
+            else:
+                tokens[i, 1:] = -1  # never matches → accepts only s0
+        (s0, greedy, ok, self._ck, self._cv, self._token, self._lengths,
+         self._keys, self._counts) = self._verify_fn(g, history)(
+            self.params, mask, tokens, self._ck, self._cv, self._token,
+            self._lengths, self._keys, self._temp, self._topp, self._topk,
+            self._counts,
+        )
+        s0, greedy, ok = jax.device_get((s0, greedy, ok))
+        for i, req in active:
+            toks = [int(s0[i])]
+            for j in range(g):
+                if not ok[i, j]:
+                    break
+                toks.append(int(greedy[i, j]))
+            finished = False
+            for t in toks:
+                if self._emit(req, t):
+                    finished = True
+                    break
+            if finished:
+                with self._cond:
+                    self._slots[i] = None
+
     def _emit(self, req: _Request, tok: int) -> bool:
         """Deliver one token; returns True when the request just finished."""
         if req.cancel.is_set():
             req.out.put(("end", None))
             return True
         req.emitted += 1
+        hist = req.hist
+        hist.append(tok)
+        if len(hist) >= 3:  # lagged n-gram index update (see _Request)
+            req.ngram[(hist[-3], hist[-2])] = len(hist) - 2
         self.n_tokens += 1
         req.out.put(("tok", tok))
         if req.eos_id is not None and tok == req.eos_id:
@@ -845,13 +1004,15 @@ def get_engine(
     n_slots: int = DEFAULT_SLOTS,
     prefill_chunk: int = DEFAULT_PREFILL_CHUNK,
     max_pending: int = DEFAULT_MAX_PENDING,
+    spec_decode: int = 0,
 ) -> InferenceEngine:
     """Engines are keyed by weight identity (spec, seed, mesh) ONLY — dispatch
     knobs like decode_chunk are per-call, so two backends that differ only in
     chunking share one set of weights on device. ``n_slots``/``prefill_chunk``/
     ``max_pending`` (structural properties of the preallocated cache and the
     scheduler) apply at first construction; later callers share the existing
-    engine as-is."""
+    engine as-is. ``spec_decode`` is NOT structural: a shared engine runs
+    with the maximum draft length any of its backends requested."""
     mesh = mesh or single_device_mesh()
     key = (spec, seed, tuple(sorted(mesh.shape.items())), tuple(map(str, mesh.devices.flat)))
     with _ENGINES_LOCK:
@@ -860,8 +1021,12 @@ def get_engine(
             eng = InferenceEngine(
                 spec, mesh, seed=seed, n_slots=n_slots,
                 prefill_chunk=prefill_chunk, max_pending=max_pending,
+                spec_decode=spec_decode,
             )
             _ENGINES[key] = eng
+        else:
+            eng.spec_decode = max(eng.spec_decode,
+                                  max(0, min(spec_decode, 16)))
         return eng
 
 
@@ -873,6 +1038,7 @@ def get_engine_from_ckpt(
     n_slots: int = DEFAULT_SLOTS,
     prefill_chunk: int = DEFAULT_PREFILL_CHUNK,
     max_pending: int = DEFAULT_MAX_PENDING,
+    spec_decode: int = 0,
 ) -> InferenceEngine:
     """Engine over a local HF checkpoint; keyed by (resolved path, mesh) so N
     backends pointing at one checkpoint share the loaded weights on device."""
@@ -894,6 +1060,10 @@ def get_engine_from_ckpt(
             eng = InferenceEngine(
                 spec, mesh, params=params, n_slots=n_slots,
                 prefill_chunk=prefill_chunk, max_pending=max_pending,
+                spec_decode=spec_decode,
             )
             _ENGINES[key] = eng
+        else:
+            eng.spec_decode = max(eng.spec_decode,
+                                  max(0, min(spec_decode, 16)))
         return eng

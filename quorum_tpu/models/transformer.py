@@ -452,6 +452,80 @@ def decode_step(
     return _unembed(params, spec, x[:, 0, :]), cache_k, cache_v
 
 
+def decode_multi(
+    params: Params,
+    spec: ModelSpec,
+    tokens: jnp.ndarray,   # [B, T] current token + T-1 proposed continuations
+    lengths: jnp.ndarray,  # [B] position of tokens[:, 0] per row
+    cache_k: jnp.ndarray,  # [L, B, K, max_seq, hd]
+    cache_v: jnp.ndarray,
+    write_mask: jnp.ndarray | None = None,  # [B] bool
+    history: int | None = None,
+):
+    """T-token decode: logits for positions lengths..lengths+T-1 of each row
+    in ONE forward. Returns (logits [B,T,V], cache_k, cache_v).
+
+    The speculative-verification step: decode is HBM-bandwidth-bound on the
+    weights, so scoring T candidate tokens costs nearly the same bytes as
+    one — if a draft (e.g. prompt-lookup) guessed the continuation, the
+    accepted prefix advances T tokens for one dispatch's worth of weight
+    reads. Each row's tokens sit at its own offset (``lengths[r] + i``);
+    K/V for all T positions is written into the cache (rejected positions
+    land beyond the advanced length — masked by every later read and
+    overwritten as generation proceeds). ``decode_step`` ≡ T = 1.
+    """
+    b, t = tokens.shape
+    x = params["tok_emb"][tokens].astype(jnp.dtype(spec.dtype))  # [B,T,D]
+    if spec.emb_scale != 1.0:
+        x = x * jnp.asarray(spec.emb_scale, x.dtype)
+    pos = lengths[:, None] + jnp.arange(t)[None, :]              # [B,T]
+    if spec.pos == "learned":
+        x = x + params["pos_emb"][pos].astype(x.dtype)
+    cos, sin = rope_cos_sin(spec.max_seq, spec.head_dim, spec.rope_theta)
+    hist = spec.max_seq if history is None else min(history, spec.max_seq)
+    allow = (jnp.ones((b,), bool) if write_mask is None else write_mask)
+
+    def write_row(cache_row, new_row, idx, w):
+        # cache_row [K, max_seq, hd], new_row [K, T, hd]
+        old = lax.dynamic_slice(cache_row, (0, idx, 0), new_row.shape)
+        return lax.dynamic_update_slice(
+            cache_row, jnp.where(w, new_row, old), (0, idx, 0))
+
+    write = jax.vmap(write_row, in_axes=(0, 0, 0, 0))
+    # per-row causal mask over the cache prefix: key j visible to query i of
+    # row r iff j <= lengths[r] + i
+    ki = jnp.arange(hist)[None, None, :]
+    mask = (ki <= pos[:, :, None])[:, None, None, :, :]  # [B,1,1,T,hist]
+
+    def body(carry_x, per_layer):
+        block, ck, cv = per_layer
+        h = _norm(carry_x, block["attn_norm_w"], block.get("attn_norm_b"), spec)
+        q, k, v = _qkv(h, block, spec)  # q [B,H,T,hd], k/v [B,K,T,hd]
+        if spec.pos == "rope":
+            rope_row = jax.vmap(
+                lambda xr, p: apply_rope(xr[None], cos, sin, p)[0])
+            q = rope_row(q, pos)
+            k = rope_row(k, pos)
+        new_ck = write(ck, k.astype(ck.dtype), lengths, allow)
+        new_cv = write(cv, v.astype(cv.dtype), lengths, allow)
+        read_k = lax.slice_in_dim(new_ck, 0, hist, axis=2)
+        read_v = lax.slice_in_dim(new_cv, 0, hist, axis=2)
+        attn = attention(q, read_k, read_v, mask)
+        carry_x = carry_x + _attn_out(attn, block, carry_x.dtype)
+        h2 = _norm(carry_x, block["mlp_norm_w"], block.get("mlp_norm_b"), spec)
+        # dense MoE (not grouped): verification logits must be numerically
+        # identical to what the T=1 decode path would produce, or a
+        # near-tie argmax could accept a token normal decode wouldn't emit
+        mlp = (_moe_mlp_dense(h2, block, spec)
+               if spec.is_moe else _dense_mlp(h2, block, spec))
+        carry_x = carry_x + mlp
+        return carry_x, (new_ck, new_cv)
+
+    x, (cache_k, cache_v) = lax.scan(body, x, (params["blocks"], cache_k, cache_v))
+    x = _final_norm(params, spec, x)
+    return _unembed(params, spec, x), cache_k, cache_v
+
+
 def _layer_body(carry_x, block, spec: ModelSpec, positions, cos, sin, attn_fn,
                 token_mask=None):
     """One transformer block: norm → qkv(+rope) → attn_fn → norm → mlp.

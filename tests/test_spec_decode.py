@@ -1,0 +1,157 @@
+"""Speculative decoding (prompt-lookup drafts + multi-token verification).
+
+The invariant that makes speculation safe: a draft token is accepted ONLY
+when it equals the token the model itself emits at that position, so the
+output is the model's own greedy continuation — speculation changes speed,
+never content. These tests pin output equality against the non-speculative
+engine, eligibility gating, and the repetitive-text acceptance win.
+"""
+
+import numpy as np
+
+from quorum_tpu.engine.engine import InferenceEngine
+from quorum_tpu.models.model_config import resolve_spec
+from quorum_tpu.ops.sampling import SamplerConfig
+
+TINY = resolve_spec("llama-tiny")
+GREEDY = SamplerConfig(temperature=0.0)
+
+
+def _assert_same_or_tie_flip(prompt, a, b, tol=0.05):
+    """Sequences must match token-for-token; the single allowed exception is
+    an argmax near-tie: the multi-token verification program reassociates
+    float ops differently from the single-token program, so two logits
+    within ~1e-3 (bf16 model) can flip order. On the first divergence,
+    check against a cache-free full forward that BOTH choices sit within
+    ``tol`` of the true max logit — corruption would produce a token far
+    below the max — then stop comparing (the sequences legitimately differ
+    after a flip)."""
+    if a == b:
+        return
+    from quorum_tpu.models.init import init_params
+    from quorum_tpu.models.transformer import forward_logits
+
+    i = next(i for i, (x, y) in enumerate(zip(a, b)) if x != y)
+    params = init_params(TINY, 0)
+    seq = np.asarray([list(prompt) + a[:i]], np.int32)
+    logits = np.asarray(forward_logits(params, TINY, seq)[0, -1], np.float32)
+    top = float(logits.max())
+    assert top - logits[a[i]] < tol and top - logits[b[i]] < tol, (
+        f"divergence at {i} is not a near-tie: max={top:.4f}, "
+        f"plain[{a[i]}]={logits[a[i]]:.4f}, spec[{b[i]}]={logits[b[i]]:.4f}")
+
+
+def test_speculative_matches_plain_greedy():
+    """Greedy output with spec_decode=4 must equal the plain engine's
+    (up to documented argmax near-ties), for prompts with and without
+    self-repetition."""
+    plain = InferenceEngine(TINY, decode_chunk=4, n_slots=2)
+    spec = InferenceEngine(TINY, decode_chunk=4, n_slots=2, spec_decode=4)
+    assert spec.spec_decode == 4
+    prompts = [
+        [5, 6, 7],
+        [9, 8, 9, 8, 9, 8, 9, 8],            # repetitive → drafts accepted
+        [(3 + 7 * i) % 500 for i in range(40)],
+    ]
+    for p in prompts:
+        a = plain.generate(p, max_new_tokens=16, sampler=GREEDY).token_ids
+        b = spec.generate(p, max_new_tokens=16, sampler=GREEDY).token_ids
+        assert len(b) == 16
+        _assert_same_or_tie_flip(p, a, b)
+
+
+def test_draft_lookup_unit():
+    """Prompt-lookup drafting: the trailing 2-gram's earlier occurrence is
+    continued; the lagged index never matches the trailing pair itself."""
+    from quorum_tpu.engine.engine import InferenceEngine, _Request
+
+    req = _Request([1, 2, 3, 9, 1, 2, 3], 8, GREEDY, 0, None, None, None)
+    assert InferenceEngine._draft(req, 4) == [9, 1, 2, 3]  # continue from idx 2
+    assert InferenceEngine._draft(req, 2) == [9, 1]
+    # no earlier occurrence of the trailing pair → no draft
+    req2 = _Request([1, 2, 3, 4, 5, 6], 8, GREEDY, 0, None, None, None)
+    assert InferenceEngine._draft(req2, 4) is None
+    # generated tokens extend the index (lagged): after emitting 9, 1, 2 the
+    # pair (1, 2) from the new text is found and its continuation proposed
+    eng = InferenceEngine.__new__(InferenceEngine)  # only _emit's index path
+    eng.n_tokens = 0
+    for t in (9, 1, 2):
+        req2.emitted += 1
+        req2.hist.append(t)
+        if len(req2.hist) >= 3:
+            req2.ngram[(req2.hist[-3], req2.hist[-2])] = len(req2.hist) - 2
+    assert InferenceEngine._draft(req2, 3) == [3, 4, 5]
+
+
+def test_verification_accepts_correct_drafts():
+    """When drafts ARE the model's continuation (oracle drafting), the
+    engine must accept them: the whole generation completes in far fewer
+    verify dispatches than tokens (each dispatch advances 1 + accepted)."""
+    plain = InferenceEngine(TINY, decode_chunk=1, n_slots=1)
+    ref = plain.generate([5, 6, 7], max_new_tokens=24, sampler=GREEDY).token_ids
+
+    eng = InferenceEngine(TINY, decode_chunk=1, n_slots=1, spec_decode=4)
+    eng._draft = lambda req, g: (ref[req.emitted : req.emitted + g]
+                                 if req.emitted + g <= len(ref) else None)
+    calls = {"n": 0}
+    real = eng._verify_fn
+
+    def counting(g, history):
+        fn = real(g, history)
+
+        def wrapped(*a, **k):
+            calls["n"] += 1
+            return fn(*a, **k)
+        return wrapped
+
+    eng._verify_fn = counting
+    out = eng.generate([5, 6, 7], max_new_tokens=12, sampler=GREEDY).token_ids
+    assert len(out) == 12
+    assert 0 < calls["n"] <= 4, (
+        f"oracle drafts should be accepted (≈3 dispatches for 12 tokens at "
+        f"g=4), got {calls['n']}")
+
+
+def test_sampling_requests_bypass_speculation():
+    """Non-greedy (or penalty/bias/logprobs) requests must take the normal
+    chunked path and produce the same tokens as a spec_decode=0 engine."""
+    plain = InferenceEngine(TINY, decode_chunk=4, n_slots=2)
+    spec = InferenceEngine(TINY, decode_chunk=4, n_slots=2, spec_decode=4)
+    sampler = SamplerConfig(temperature=0.8, top_p=0.9)
+    a = plain.generate([5, 6, 7], max_new_tokens=12, sampler=sampler,
+                       seed=3).token_ids
+    b = spec.generate([5, 6, 7], max_new_tokens=12, sampler=sampler,
+                      seed=3).token_ids
+    assert a == b
+
+
+def test_speculative_near_context_limit_is_safe():
+    """Near max_seq the verify step would write past the cache; the engine
+    must fall back to the normal path and still fill the context exactly."""
+    import dataclasses
+
+    small = dataclasses.replace(TINY, max_seq=32)
+    eng = InferenceEngine(small, decode_chunk=2, n_slots=1, spec_decode=4)
+    prompt = [(5 + i) % 500 for i in range(24)]
+    out = eng.generate(prompt, max_new_tokens=64, sampler=GREEDY).token_ids
+    assert len(out) == 32 - 24  # budget clamped to the window
+    plain = InferenceEngine(small, decode_chunk=2, n_slots=1)
+    ref = plain.generate(prompt, max_new_tokens=64, sampler=GREEDY).token_ids
+    assert len(ref) == len(out)  # both fill the window; tokens may tie-flip
+
+
+def test_mixed_batch_speculates_only_when_all_eligible():
+    """A greedy request co-batched with a sampling request must not flip the
+    sampler's stream: results equal the serial runs."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    eng = InferenceEngine(TINY, decode_chunk=4, n_slots=2, spec_decode=4)
+    jobs = [
+        dict(prompt_ids=[5, 6, 7], max_new_tokens=10, sampler=GREEDY, seed=0),
+        dict(prompt_ids=[8, 9, 10], max_new_tokens=10,
+             sampler=SamplerConfig(temperature=0.9, top_p=0.9), seed=4),
+    ]
+    serial = [eng.generate(**j).token_ids for j in jobs]
+    with ThreadPoolExecutor(max_workers=2) as ex:
+        conc = list(ex.map(lambda j: eng.generate(**j).token_ids, jobs))
+    assert conc == serial
