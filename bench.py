@@ -35,11 +35,19 @@ plus the slot's KV cache through the chip — so alongside MFU (the wrong lens
 for decode) we report **decode HBM-bandwidth utilization**:
     tokens/s × bytes-touched-per-token ÷ 819 GB/s (v5e HBM BW).
 
+Phase 4 (TPU only, ``QUORUM_TPU_BENCH_7B_QUANT``): the NORTH-STAR model —
+llama-3-8b — served with ``quant=int8`` (models/quant.py: native int8 MXU
+matmuls, per-channel weight scales). bf16 llama-3-8b (16.1 GB) does not fit
+one v5e chip at all; int8 (~8.1 GB) does, and halves the weight bytes each
+decoded token must stream. Reported as the ``b7q_*`` metrics.
+
 Prints ONE JSON line:
   {"metric": "p50_ttft_ms", "value": ..., "unit": "ms", "vs_baseline": ...,
    "p50_total_ms": ..., "req_per_s": ..., "tokens_per_s": ..., "mfu_pct": ...,
    "b7_model": ..., "b7_decode_tok_s": ..., "b7_ttft_ms": ...,
-   "b7_hbm_bw_util_pct": ..., "b7_mfu_pct": ...}
+   "b7_hbm_bw_util_pct": ..., "b7_mfu_pct": ...,
+   "b7q_model": ..., "b7q_decode_tok_s": ..., "b7q_ttft_ms": ...,
+   "b7q_hbm_bw_util_pct": ...}
 """
 
 from __future__ import annotations
@@ -81,6 +89,12 @@ B7_MODEL = os.environ.get("QUORUM_TPU_BENCH_7B_MODEL", "mistral-7b")
 # = 0.27 GB.
 B7_URL = f"tpu://{B7_MODEL}?max_seq=1024&slots=2&decode_chunk=16&max_tokens=64"
 B7_MAX_TOKENS = int(os.environ.get("QUORUM_TPU_BENCH_7B_MAX_TOKENS", "64"))
+# Phase 4: the north-star model (llama-3-8b) served int8-quantized — bf16
+# does not fit one v5e (16.1 GB weights); int8 (~8.1 GB) does.
+BENCH_7BQ = os.environ.get("QUORUM_TPU_BENCH_7B_QUANT", BENCH_7B)
+B7Q_MODEL = os.environ.get("QUORUM_TPU_BENCH_7B_QUANT_MODEL", "llama-3-8b")
+B7Q_URL = (f"tpu://{B7Q_MODEL}?max_seq=1024&slots=2&decode_chunk=16"
+           f"&max_tokens=64&quant=int8")
 
 
 def build_app():
@@ -165,26 +179,27 @@ def _on_tpu() -> bool:
     return jax.default_backend() not in ("cpu",)
 
 
-def build_7b_app():
+def build_7b_app(model: str, url: str):
     from quorum_tpu.config import Config
     from quorum_tpu.server.app import create_app
 
     raw = {
         "settings": {"timeout": 600},
         "primary_backends": [
-            {"name": "B7", "url": B7_URL, "model": B7_MODEL},
+            {"name": "B7", "url": url, "model": model},
         ],
     }
     return create_app(Config(raw=raw))
 
 
-def _b7_bytes_per_token() -> tuple[int, int]:
+def _b7_bytes_per_token(model: str, weight_itemsize: int) -> tuple[int, int]:
     """(weight_bytes, kv_bytes) streamed from HBM per decoded token at
-    batch 1: every step reads the full bf16 weights plus the slot's (masked-
-    dense) KV cache — the decode bandwidth floor the chip must sustain."""
+    batch 1: every step reads the full weights (bf16: 2 B/param; int8:
+    1 B/param) plus the slot's (masked-dense) KV cache — the decode
+    bandwidth floor the chip must sustain."""
     from quorum_tpu.models.model_config import resolve_spec
 
-    spec = resolve_spec(B7_MODEL, {"max_seq": "1024"})
+    spec = resolve_spec(model, {"max_seq": "1024"})
     from quorum_tpu.models.init import init_params
 
     import jax
@@ -192,24 +207,24 @@ def _b7_bytes_per_token() -> tuple[int, int]:
     shapes = jax.eval_shape(lambda: init_params(spec, 0))
     n_params = sum(
         x.size for x in jax.tree.leaves(shapes) if hasattr(x, "size"))
-    weight_bytes = n_params * 2  # bf16
+    weight_bytes = n_params * weight_itemsize
     kv_bytes = (spec.n_layers * spec.n_kv_heads * spec.max_seq
                 * spec.head_dim * 2 * 2)  # k+v, bf16, one slot row
     return weight_bytes, kv_bytes
 
 
-async def bench_7b() -> dict:
-    """Serve the 7B-class model through the full socket stack; return the
-    decode-side metrics (VERDICT r2 task 1)."""
+async def bench_7b(model: str, url: str, prefix: str, quant: bool) -> dict:
+    """Serve a 7B-class model through the full socket stack; return the
+    decode-side metrics (VERDICT r2 task 1) under ``{prefix}_*`` keys."""
     import httpx
 
     from quorum_tpu.server.serve import start_server
 
-    app = build_7b_app()
+    app = build_7b_app(model, url)
     server = await start_server(app, "127.0.0.1", 0)
     port = server.sockets[0].getsockname()[1]
     body = {
-        "model": B7_MODEL,
+        "model": model,
         "messages": [{"role": "user", "content": "Benchmark prompt: say something."}],
         "stream": True,
         "max_tokens": B7_MAX_TOKENS,
@@ -258,57 +273,77 @@ async def bench_7b() -> dict:
         await server.wait_closed()
 
     tok_s = statistics.median(rates)
-    weight_bytes, kv_bytes = _b7_bytes_per_token()
-    n_params = weight_bytes // 2
+    weight_bytes, kv_bytes = _b7_bytes_per_token(model, 1 if quant else 2)
+    n_params = weight_bytes // (1 if quant else 2)
     bw_util = tok_s * (weight_bytes + kv_bytes) / V5E_HBM_BW * 100
-    return {
-        "b7_model": B7_MODEL,
-        "b7_decode_tok_s": round(tok_s, 2),
-        "b7_ttft_ms": round(statistics.median(ttfts) * 1000, 2),
-        "b7_hbm_bw_util_pct": round(bw_util, 1),
-        "b7_mfu_pct": round(tok_s * 2 * n_params / V5E_PEAK_FLOPS * 100, 3),
-        "b7_params": n_params,
+    out = {
+        f"{prefix}_model": model + ("+int8" if quant else ""),
+        f"{prefix}_decode_tok_s": round(tok_s, 2),
+        f"{prefix}_ttft_ms": round(statistics.median(ttfts) * 1000, 2),
+        f"{prefix}_hbm_bw_util_pct": round(bw_util, 1),
+        f"{prefix}_params": n_params,
     }
+    if not quant:
+        # MFU is quoted against the bf16 MXU peak; the int8 phase runs its
+        # matmuls at the (2×) int8 rate, so a bf16-denominator MFU would
+        # overstate utilization — bandwidth utilization is its headline.
+        out[f"{prefix}_mfu_pct"] = round(
+            tok_s * 2 * n_params / V5E_PEAK_FLOPS * 100, 3)
+    return out
 
 
 def run_7b_phase() -> dict:
-    """Run the 7B bench in a SUBPROCESS, before this process touches jax.
+    """Run the 7B benches in SUBPROCESSES, before this process touches jax.
 
-    Two reasons it can't run in-process after phases 1/2: the phase-1/2
+    Two reasons they can't run in-process after phases 1/2: the phase-1/2
     engines (3 × 124M weights + slot caches, > 1 GB) stay resident in the
     module-global engine cache — their scheduler threads hold them — while
     the 7B weights alone need ~14.5 GB of the v5e's 16 GB HBM; and only one
-    process can hold the TPU client at a time, so the child must finish
-    before the parent initializes jax."""
+    process can hold the TPU client at a time, so each child must finish
+    before the next starts / the parent initializes jax."""
     import subprocess
 
-    proc = subprocess.run(
-        [sys.executable, os.path.abspath(__file__), "--7b"],
-        capture_output=True, text=True, timeout=3000,
-        cwd=os.path.dirname(os.path.abspath(__file__)),
-    )
-    for line in reversed((proc.stdout or "").splitlines()):
-        line = line.strip()
-        if line.startswith("{"):
-            try:
-                return json.loads(line)
-            except json.JSONDecodeError:
+    out: dict = {}
+    for flag, prefix, gate in (("--7b", "b7", BENCH_7B),
+                               ("--7bq", "b7q", BENCH_7BQ)):
+        if gate == "0":
+            continue
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), flag],
+            capture_output=True, text=True, timeout=3000,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+        )
+        got = None
+        for line in reversed((proc.stdout or "").splitlines()):
+            line = line.strip()
+            if line.startswith("{"):
+                try:
+                    got = json.loads(line)
+                except json.JSONDecodeError:
+                    pass
                 break
-    return {"b7_model": B7_MODEL,
-            "b7_error": f"subprocess rc={proc.returncode}: "
-                        f"{(proc.stderr or '')[-300:]}"}
+        if got is None:
+            got = {f"{prefix}_error":
+                   f"subprocess rc={proc.returncode}: "
+                   f"{(proc.stderr or '')[-300:]}"}
+        out.update(got)
+    return out
 
 
-async def seven_b_main() -> None:
-    """--7b child entry: prints one JSON line with the b7_* metrics."""
-    if not (BENCH_7B == "1" or (BENCH_7B == "auto" and _on_tpu())):
+async def seven_b_main(quant: bool) -> None:
+    """--7b/--7bq child entry: prints one JSON line with the metrics."""
+    gate = BENCH_7BQ if quant else BENCH_7B
+    if not (gate == "1" or (gate == "auto" and _on_tpu())):
         print(json.dumps({}))
         return
+    model, url, prefix = ((B7Q_MODEL, B7Q_URL, "b7q") if quant
+                          else (B7_MODEL, B7_URL, "b7"))
     try:
-        print(json.dumps(await bench_7b()))
+        print(json.dumps(await bench_7b(model, url, prefix, quant)))
     except Exception as e:
         print(json.dumps(
-            {"b7_model": B7_MODEL, "b7_error": f"{type(e).__name__}: {e}"}))
+            {f"{prefix}_model": model,
+             f"{prefix}_error": f"{type(e).__name__}: {e}"}))
 
 
 async def main() -> None:
@@ -316,9 +351,9 @@ async def main() -> None:
 
     from quorum_tpu.server.serve import start_server
 
-    # Phase 3 first (subprocess — see run_7b_phase): skipped entirely when
-    # 7B is disabled so CPU smoke runs don't pay a subprocess spawn.
-    b7: dict = run_7b_phase() if BENCH_7B != "0" else {}
+    # Phases 3+4 first (subprocesses — see run_7b_phase): skipped entirely
+    # when 7B is disabled so CPU smoke runs don't pay a subprocess spawn.
+    b7: dict = run_7b_phase() if (BENCH_7B != "0" or BENCH_7BQ != "0") else {}
 
     app = build_app()
     server = await start_server(app, "127.0.0.1", 0)
@@ -381,6 +416,8 @@ async def main() -> None:
 
 
 if __name__ == "__main__":
+    if "--7bq" in sys.argv:
+        sys.exit(asyncio.run(seven_b_main(quant=True)))
     if "--7b" in sys.argv:
-        sys.exit(asyncio.run(seven_b_main()))
+        sys.exit(asyncio.run(seven_b_main(quant=False)))
     sys.exit(asyncio.run(main()))

@@ -28,6 +28,10 @@ URL grammar:  ``tpu://<model-id>?<spec overrides>&<engine options>``
                    dispatch verifies G prompt-lookup draft tokens in one
                    multi-token forward — accepted runs advance G+1 tokens
                    for one dispatch's weight reads (decode is HBM-bound)
+  quant=int8       weight-only int8 with per-channel scales (models/quant.py):
+                   halves weight HBM bytes/token (decode is bandwidth-bound →
+                   up to 2× decode tokens/s) and weight HBM capacity
+                   (llama-3-8b fits one 16 GB v5e at ~8.1 GB)
   max_tokens=      default completion budget when the request has none
 
 Contract parity with the dispatcher: configured model overrides the request
@@ -205,6 +209,7 @@ class TpuBackend:
             prefill_chunk=int(opts.get("prefill_chunk", DEFAULT_PREFILL_CHUNK)),
             max_pending=int(opts.get("queue", DEFAULT_MAX_PENDING)),
             spec_decode=int(opts.get("spec_decode", 0)),
+            quant=opts.get("quant") or None,
         )
         if ckpt:
             # seed= still differentiates ensemble members: it offsets the

@@ -173,9 +173,13 @@ class InferenceEngine:
         prefill_chunk: int = DEFAULT_PREFILL_CHUNK,
         max_pending: int = DEFAULT_MAX_PENDING,
         spec_decode: int = 0,
+        quant: str | None = None,
     ):
         self.spec = spec.validate()
         self.mesh = mesh or single_device_mesh()
+        if quant not in (None, "", "int8"):
+            raise ValueError(f"unsupported quant mode {quant!r} (int8 or none)")
+        self.quant = quant or None
         self.decode_chunk = max(1, decode_chunk)
         self.n_slots = max(1, n_slots)
         self.max_pending = max(1, max_pending)
@@ -204,6 +208,19 @@ class InferenceEngine:
             self.prefill_chunk = 0
         if params is not None:
             self.params = shard_pytree(self.mesh, params)
+            if self.quant == "int8":
+                # Requantize in place: inputs donated, each bf16 leaf's
+                # buffer dies at its quantize op (models/quant.py).
+                from quorum_tpu.models.quant import quantize_params_sharded
+
+                self.params = quantize_params_sharded(self.params, self.mesh)
+        elif self.quant == "int8":
+            # Init + quantize fused in one program: the bf16 weights are
+            # per-leaf intermediates, so llama-3-8b (16.1 GB bf16 / 8.1 GB
+            # int8) comes up on a single 16 GB chip.
+            from quorum_tpu.models.quant import init_params_quantized_sharded
+
+            self.params = init_params_quantized_sharded(spec, self.mesh, seed)
         else:
             # One compiled program materializes the weights sharded in place —
             # no eager per-leaf dispatch, no replicated copy (critical at 7B:
@@ -1005,23 +1022,26 @@ def get_engine(
     prefill_chunk: int = DEFAULT_PREFILL_CHUNK,
     max_pending: int = DEFAULT_MAX_PENDING,
     spec_decode: int = 0,
+    quant: str | None = None,
 ) -> InferenceEngine:
-    """Engines are keyed by weight identity (spec, seed, mesh) ONLY — dispatch
-    knobs like decode_chunk are per-call, so two backends that differ only in
-    chunking share one set of weights on device. ``n_slots``/``prefill_chunk``/
-    ``max_pending`` (structural properties of the preallocated cache and the
-    scheduler) apply at first construction; later callers share the existing
-    engine as-is. ``spec_decode`` is NOT structural: a shared engine runs
-    with the maximum draft length any of its backends requested."""
+    """Engines are keyed by weight identity (spec, seed, mesh, quant) ONLY —
+    dispatch knobs like decode_chunk are per-call, so two backends that differ
+    only in chunking share one set of weights on device. ``n_slots``/
+    ``prefill_chunk``/``max_pending`` (structural properties of the
+    preallocated cache and the scheduler) apply at first construction; later
+    callers share the existing engine as-is. ``spec_decode`` is NOT
+    structural: a shared engine runs with the maximum draft length any of its
+    backends requested."""
     mesh = mesh or single_device_mesh()
-    key = (spec, seed, tuple(sorted(mesh.shape.items())), tuple(map(str, mesh.devices.flat)))
+    key = (spec, seed, quant or None, tuple(sorted(mesh.shape.items())),
+           tuple(map(str, mesh.devices.flat)))
     with _ENGINES_LOCK:
         eng = _ENGINES.get(key)
         if eng is None:
             eng = InferenceEngine(
                 spec, mesh, seed=seed, n_slots=n_slots,
                 prefill_chunk=prefill_chunk, max_pending=max_pending,
-                spec_decode=spec_decode,
+                spec_decode=spec_decode, quant=quant,
             )
             _ENGINES[key] = eng
         else:
@@ -1039,6 +1059,7 @@ def get_engine_from_ckpt(
     prefill_chunk: int = DEFAULT_PREFILL_CHUNK,
     max_pending: int = DEFAULT_MAX_PENDING,
     spec_decode: int = 0,
+    quant: str | None = None,
 ) -> InferenceEngine:
     """Engine over a local HF checkpoint; keyed by (resolved path, mesh) so N
     backends pointing at one checkpoint share the loaded weights on device."""
@@ -1051,7 +1072,8 @@ def get_engine_from_ckpt(
     # Normalize: dtype=None and an explicit dtype equal to the default must
     # hit the same cache entry (else the checkpoint sits in HBM twice).
     eff_dtype = dtype or ModelSpec().dtype
-    key = ("ckpt", resolved, eff_dtype, tuple(sorted(mesh.shape.items())),
+    key = ("ckpt", resolved, eff_dtype, quant or None,
+           tuple(sorted(mesh.shape.items())),
            tuple(map(str, mesh.devices.flat)))
     with _ENGINES_LOCK:
         eng = _ENGINES.get(key)
@@ -1060,7 +1082,7 @@ def get_engine_from_ckpt(
             eng = InferenceEngine(
                 spec, mesh, params=params, n_slots=n_slots,
                 prefill_chunk=prefill_chunk, max_pending=max_pending,
-                spec_decode=spec_decode,
+                spec_decode=spec_decode, quant=quant,
             )
             _ENGINES[key] = eng
         else:

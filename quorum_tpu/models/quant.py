@@ -1,0 +1,163 @@
+"""Weight-only int8 quantization for serving (``tpu://…&quant=int8``).
+
+Decode is HBM-bandwidth-bound: every generated token streams the full model
+weights from HBM (see PERF.md §1), so the decode tokens/s ceiling is
+
+    HBM_bandwidth / bytes_of_weights_touched_per_token.
+
+Storing matmul weights as int8 with per-output-channel scales halves the
+bytes the memory system must move versus bf16 — an up-to-2× decode speedup
+on the same chip — and halves weight HBM *capacity*, which is what lets the
+llama-3-8b preset (16.1 GB bf16, over one v5e's 16 GB) serve on a single
+chip at ~8.1 GB.
+
+Design (TPU/XLA-first, validated on a real v5e — see PERF.md):
+
+  - A quantized leaf is a plain dict ``{"q8": int8[...same shape...],
+    "qs": f32 scale broadcastable against it}`` — pytree-transparent, so
+    ``lax.scan`` over stacked layers, donation, and ``NamedSharding``
+    placement all work unchanged. ``quorum_tpu.parallel.sharding`` gives
+    ``q8``/``qs`` the parent leaf's partition spec (size-1 reduced dims
+    auto-replicate via ``_fit_spec``).
+  - Matmuls run **natively in int8** (:func:`qeinsum`): activations are
+    dynamically quantized per row over the contraction axis, the einsum is
+    int8×int8→int32 on the MXU (2× the bf16 MXU rate on v5e), and the
+    int32 result is rescaled by the outer product of activation and weight
+    scales. HBM streams the int8 weight bytes directly. The naive
+    alternative — dequantize-then-matmul (``q8.astype(bf16) * qs`` as the
+    dot operand) — measured *slower* than bf16 on the real chip (41.5 vs
+    29.6 ms/decode-step at 7B): XLA materializes the dequantized bf16
+    operand in HBM instead of fusing, so traffic goes up, not down.
+  - Weight scales are per-output-channel (the einsum's non-contracted
+    weight axis): weight quantization error stays relative per channel
+    (≤ 1/254 of the channel's max |w|). Activation scales are per-row
+    (per token). The combination is the standard dynamic-w8a8 serving
+    recipe; ``quant=int8`` is opt-in per backend URL.
+
+What is quantized: every large matmul operand — ``wq wk wv wo w_gate w_up
+w_down moe_w_gate moe_w_up moe_w_down lm_head tok_emb``. What is not:
+norms, biases, MoE router (tiny, routing-accuracy-critical), ``pos_emb``.
+
+The reference has no quantization (or any tensor math) to mirror; this is
+part of the TPU-native performance surface (SURVEY.md §7).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Mapping
+
+import jax
+import jax.numpy as jnp
+
+# Leaf name → axis holding the *rows* reduced into one output channel
+# (the contraction axis of the consuming einsum). Scales keep that axis at
+# size 1 and stay full-size on every other axis.
+QUANT_REDUCE_AXIS: dict[str, int] = {
+    "wq": -2, "wk": -2, "wv": -2, "wo": -2,
+    "w_gate": -2, "w_up": -2, "w_down": -2,
+    "moe_w_gate": -2, "moe_w_up": -2, "moe_w_down": -2,
+    "lm_head": -2,   # [D, V]: contraction over D → per-vocab-column scale
+    "tok_emb": -1,   # [V, D]: per-row scale — exact for the embedding gather
+                     # AND per-output-channel for the tied unembed (x @ emb.T)
+}
+
+
+def is_quantized(leaf: Any) -> bool:
+    return isinstance(leaf, Mapping) and "q8" in leaf
+
+
+def quantize_leaf(w: jnp.ndarray, axis: int) -> dict[str, jnp.ndarray]:
+    """Symmetric per-channel int8: scale = max|w| / 127 over ``axis``."""
+    amax = jnp.max(jnp.abs(w.astype(jnp.float32)), axis=axis, keepdims=True)
+    scale = jnp.maximum(amax, 1e-30) / 127.0
+    q = jnp.clip(jnp.round(w.astype(jnp.float32) / scale), -127, 127)
+    return {"q8": q.astype(jnp.int8), "qs": scale}
+
+
+def dq(leaf: Any, dtype=jnp.bfloat16):
+    """Explicit dequantization: int8 → ``dtype``. Used for small gathered
+    slices (embedding rows) and tests; the matmul hot path uses
+    :func:`qeinsum` instead — materializing a full dequantized operand is
+    exactly what the native-int8 path exists to avoid."""
+    if is_quantized(leaf):
+        return leaf["q8"].astype(dtype) * leaf["qs"].astype(dtype)
+    return leaf
+
+
+def qeinsum(eq: str, x: jnp.ndarray, leaf: Any) -> jnp.ndarray:
+    """``jnp.einsum(eq, x, w)`` where ``w`` may be an int8-quantized leaf.
+
+    Plain leaf: the usual bf16×bf16 MXU einsum accumulating in f32.
+    Quantized leaf (dynamic w8a8): ``x`` is quantized per row over its
+    LAST axis — which is the contraction axis at every transformer call
+    site — the einsum runs int8×int8→int32 natively on the MXU, and the
+    result is rescaled by ``einsum(eq, xs, qs)`` (both scales carry a
+    size-1 contraction dim, so the same equation computes their outer
+    product broadcast to the output shape). Returns f32.
+    """
+    if not is_quantized(leaf):
+        return jnp.einsum(eq, x, leaf, preferred_element_type=jnp.float32)
+    xf = x.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(xf), axis=-1, keepdims=True)
+    xs = jnp.maximum(amax, 1e-30) / 127.0
+    x8 = jnp.clip(jnp.round(xf / xs), -127, 127).astype(jnp.int8)
+    y = jnp.einsum(eq, x8, leaf["q8"], preferred_element_type=jnp.int32)
+    return y.astype(jnp.float32) * jnp.einsum(eq, xs, leaf["qs"])
+
+
+def quantize_params(params: Mapping[str, Any]) -> dict[str, Any]:
+    """Quantize every eligible leaf of a transformer param pytree."""
+
+    def walk(tree: Mapping[str, Any]) -> dict[str, Any]:
+        out: dict[str, Any] = {}
+        for k, v in tree.items():
+            if isinstance(v, Mapping):
+                out[k] = walk(v)
+            elif v is not None and k in QUANT_REDUCE_AXIS:
+                out[k] = quantize_leaf(v, QUANT_REDUCE_AXIS[k])
+            else:
+                out[k] = v
+        return out
+
+    return walk(dict(params))
+
+
+def quantize_params_sharded(params: Mapping[str, Any], mesh) -> dict[str, Any]:
+    """Quantize on-device in ONE compiled program, outputs sharded like the
+    bf16 originals (q8 inherits the parent spec; size-1 scale dims replicate).
+
+    The inputs are donated: each bf16 leaf's buffer dies at its quantize op,
+    so peak HBM stays well under bf16+int8 — required to requantize a 14.5 GB
+    checkpoint in 16 GB of HBM."""
+    from quorum_tpu.parallel.sharding import param_shardings
+
+    shapes = jax.eval_shape(quantize_params, params)
+    shardings = param_shardings(mesh, shapes)
+    return jax.jit(
+        quantize_params, out_shardings=shardings, donate_argnums=0
+    )(params)
+
+
+def init_params_quantized_sharded(spec, mesh, seed: int = 0) -> dict[str, Any]:
+    """Random-init + quantize fused into one compiled program: the bf16
+    weights exist only as per-leaf intermediates (freed after their quantize
+    op), so even models whose bf16 form exceeds HBM come up quantized —
+    llama-3-8b (16.1 GB bf16 / 8.1 GB int8) on one 16 GB v5e."""
+    from quorum_tpu.models.init import init_params
+    from quorum_tpu.parallel.sharding import param_shardings
+
+    shapes = jax.eval_shape(lambda: quantize_params(init_params(spec, seed)))
+    shardings = param_shardings(mesh, shapes)
+    return jax.jit(
+        lambda: quantize_params(init_params(spec, seed)),
+        out_shardings=shardings,
+    )()
+
+
+def quantized_param_bytes(params: Mapping[str, Any]) -> int:
+    """On-device bytes of a (possibly partially) quantized param pytree."""
+    total = 0
+    for leaf in jax.tree.leaves(dict(params)):
+        if hasattr(leaf, "dtype"):
+            total += leaf.size * jnp.dtype(leaf.dtype).itemsize
+    return total

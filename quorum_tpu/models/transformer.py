@@ -35,6 +35,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from quorum_tpu.models.model_config import ModelSpec
+from quorum_tpu.models.quant import is_quantized, qeinsum
 from quorum_tpu.ops.attention import attention, causal_mask, decode_attention
 from quorum_tpu.ops.flash_attention import flash_prefill_attention
 from quorum_tpu.parallel.ring_attention import ring_prefill_attention
@@ -42,6 +43,15 @@ from quorum_tpu.ops.norms import layernorm, rmsnorm
 from quorum_tpu.ops.rotary import apply_rope, rope_cos_sin
 
 Params = dict[str, Any]
+
+
+def _emb_rows(leaf, tokens, dtype):
+    """Embedding-table gather that understands quantized tables: gather the
+    int8 rows and their per-row scales, dequantize the (tiny) gathered slice.
+    HBM traffic for the gather is int8."""
+    if is_quantized(leaf):
+        return leaf["q8"][tokens].astype(dtype) * leaf["qs"][tokens].astype(dtype)
+    return leaf[tokens].astype(dtype)
 
 
 def _norm(x, w, b, spec: ModelSpec):
@@ -61,22 +71,18 @@ def _maybe(block: Params, name: str, layer_slice):
 
 def _dense_mlp(x, block, spec: ModelSpec):
     if spec.gated_mlp:
-        gate = jnp.einsum("btd,df->btf", x, block["w_gate"],
-                          preferred_element_type=jnp.float32)
-        up = jnp.einsum("btd,df->btf", x, block["w_up"],
-                        preferred_element_type=jnp.float32)
+        gate = qeinsum("btd,df->btf", x, block["w_gate"])
+        up = qeinsum("btd,df->btf", x, block["w_up"])
         # swiglu (llama/mistral) gates with SiLU; geglu (gemma) with
         # tanh-approximated GELU (HF act_fn "gelu_pytorch_tanh").
         gated = jax.nn.silu(gate) if spec.act == "swiglu" else jax.nn.gelu(gate, approximate=True)
         h = (gated * up).astype(x.dtype)
     else:
-        up = jnp.einsum("btd,df->btf", x, block["w_up"],
-                        preferred_element_type=jnp.float32)
+        up = qeinsum("btd,df->btf", x, block["w_up"])
         if block.get("b_up") is not None:
             up = up + block["b_up"]
         h = jax.nn.gelu(up, approximate=True).astype(x.dtype)
-    out = jnp.einsum("btf,fd->btd", h, block["w_down"],
-                     preferred_element_type=jnp.float32)
+    out = qeinsum("btf,fd->btd", h, block["w_down"])
     if block.get("b_down") is not None:
         out = out + block["b_down"]
     return out.astype(x.dtype)
@@ -105,13 +111,10 @@ def _moe_mlp_dense(x, block, spec: ModelSpec):
     one_hot = jax.nn.one_hot(top_idx, spec.n_experts, dtype=top_probs.dtype)
     combine = jnp.einsum("btk,btke->bte", top_probs, one_hot)
 
-    gate = jnp.einsum("btd,edf->ebtf", x, block["moe_w_gate"],
-                      preferred_element_type=jnp.float32)
-    up = jnp.einsum("btd,edf->ebtf", x, block["moe_w_up"],
-                    preferred_element_type=jnp.float32)
+    gate = qeinsum("btd,edf->ebtf", x, block["moe_w_gate"])
+    up = qeinsum("btd,edf->ebtf", x, block["moe_w_up"])
     h = (jax.nn.silu(gate) * up).astype(x.dtype)
-    expert_out = jnp.einsum("ebtf,efd->ebtd", h, block["moe_w_down"],
-                            preferred_element_type=jnp.float32)
+    expert_out = qeinsum("ebtf,efd->ebtd", h, block["moe_w_down"])
     out = jnp.einsum("bte,ebtd->btd", combine.astype(expert_out.dtype), expert_out)
     return out.astype(x.dtype)
 
@@ -170,13 +173,10 @@ def _moe_mlp_grouped(x, block, spec: ModelSpec, token_mask=None):
     xf_ext = jnp.concatenate([xf, jnp.zeros((1, d), xf.dtype)])
     expert_in = xf_ext[tok_buf]                    # [E,C,D] gather
 
-    gate = jnp.einsum("ecd,edf->ecf", expert_in, block["moe_w_gate"],
-                      preferred_element_type=jnp.float32)
-    up = jnp.einsum("ecd,edf->ecf", expert_in, block["moe_w_up"],
-                    preferred_element_type=jnp.float32)
+    gate = qeinsum("ecd,edf->ecf", expert_in, block["moe_w_gate"])
+    up = qeinsum("ecd,edf->ecf", expert_in, block["moe_w_up"])
     h = (jax.nn.silu(gate) * up).astype(x.dtype)
-    expert_out = jnp.einsum("ecf,efd->ecd", h, block["moe_w_down"],
-                            preferred_element_type=jnp.float32)  # [E,C,D]
+    expert_out = qeinsum("ecf,efd->ecd", h, block["moe_w_down"])  # [E,C,D]
 
     # combine: gather each pick's output row, weight by its router prob,
     # sum over the k picks per token; dropped/masked picks contribute zero
@@ -199,9 +199,9 @@ def _moe_mlp(x, block, spec: ModelSpec, token_mask=None):
 def _qkv(x, block, spec: ModelSpec):
     """Project to q [B,H,T,hd], k/v [B,K,T,hd]."""
     b, t, _ = x.shape
-    q = jnp.einsum("btd,dh->bth", x, block["wq"], preferred_element_type=jnp.float32)
-    k = jnp.einsum("btd,dh->bth", x, block["wk"], preferred_element_type=jnp.float32)
-    v = jnp.einsum("btd,dh->bth", x, block["wv"], preferred_element_type=jnp.float32)
+    q = qeinsum("btd,dh->bth", x, block["wq"])
+    k = qeinsum("btd,dh->bth", x, block["wk"])
+    v = qeinsum("btd,dh->bth", x, block["wv"])
     if block.get("bq") is not None:
         q, k, v = q + block["bq"], k + block["bk"], v + block["bv"]
     q = q.astype(x.dtype).reshape(b, t, spec.n_heads, spec.head_dim).transpose(0, 2, 1, 3)
@@ -213,15 +213,14 @@ def _qkv(x, block, spec: ModelSpec):
 def _attn_out(attn, block, x_dtype):
     b, h, t, d = attn.shape
     merged = attn.transpose(0, 2, 1, 3).reshape(b, t, h * d)
-    out = jnp.einsum("bth,hd->btd", merged, block["wo"],
-                     preferred_element_type=jnp.float32)
+    out = qeinsum("bth,hd->btd", merged, block["wo"])
     if block.get("bo") is not None:
         out = out + block["bo"]
     return out.astype(x_dtype)
 
 
 def _embed(params, spec: ModelSpec, tokens, positions):
-    x = params["tok_emb"][tokens].astype(jnp.dtype(spec.dtype))
+    x = _emb_rows(params["tok_emb"], tokens, jnp.dtype(spec.dtype))
     if spec.emb_scale != 1.0:  # gemma scales embeddings by sqrt(d_model)
         x = x * jnp.asarray(spec.emb_scale, x.dtype)
     if spec.pos == "learned":
@@ -231,9 +230,11 @@ def _embed(params, spec: ModelSpec, tokens, positions):
 
 def _unembed(params, spec: ModelSpec, x):
     w = params.get("lm_head")
-    if w is None:  # tied
-        w = params["tok_emb"].T
-    return jnp.einsum("...d,dv->...v", x, w, preferred_element_type=jnp.float32)
+    if w is not None:
+        return qeinsum("...d,dv->...v", x, w)
+    # tied head: contract against the embedding table's rows directly — the
+    # quantized table's per-row scales become per-vocab output scales.
+    return qeinsum("...d,vd->...v", x, params["tok_emb"])
 
 
 def _final_norm(params, spec: ModelSpec, x):
@@ -405,7 +406,7 @@ def decode_step(
     the needed bytes for a 512-token conversation. The engine picks a
     power-of-two bucket per chunk, so log-many programs cover every length."""
     b = token.shape[0]
-    x = params["tok_emb"][token][:, None, :].astype(jnp.dtype(spec.dtype))  # [B,1,D]
+    x = _emb_rows(params["tok_emb"], token, jnp.dtype(spec.dtype))[:, None, :]  # [B,1,D]
     if spec.emb_scale != 1.0:  # gemma scales embeddings by sqrt(d_model)
         x = x * jnp.asarray(spec.emb_scale, x.dtype)
     if spec.pos == "learned":
@@ -475,7 +476,7 @@ def decode_multi(
     overwritten as generation proceeds). ``decode_step`` ≡ T = 1.
     """
     b, t = tokens.shape
-    x = params["tok_emb"][tokens].astype(jnp.dtype(spec.dtype))  # [B,T,D]
+    x = _emb_rows(params["tok_emb"], tokens, jnp.dtype(spec.dtype))  # [B,T,D]
     if spec.emb_scale != 1.0:
         x = x * jnp.asarray(spec.emb_scale, x.dtype)
     pos = lengths[:, None] + jnp.arange(t)[None, :]              # [B,T]

@@ -9,6 +9,12 @@ the ring-attention path, and the multi-chip dry run.
 """
 
 from quorum_tpu.parallel.mesh import MeshConfig, best_mesh, make_mesh
+from quorum_tpu.parallel.pipeline import (
+    make_pp_train_step,
+    pipeline_forward_logits,
+    pp_train_init,
+    shard_pytree_pp,
+)
 from quorum_tpu.parallel.sharding import (
     logical_to_sharding,
     param_partition_specs,
@@ -19,6 +25,10 @@ __all__ = [
     "MeshConfig",
     "best_mesh",
     "make_mesh",
+    "make_pp_train_step",
+    "pipeline_forward_logits",
+    "pp_train_init",
+    "shard_pytree_pp",
     "logical_to_sharding",
     "param_partition_specs",
     "shard_pytree",

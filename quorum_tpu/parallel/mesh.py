@@ -3,6 +3,8 @@
 Axis conventions (used consistently across the framework):
 
   ``dp``  data parallel      — batch dimension of activations and KV caches
+  ``pp``  pipeline parallel  — transformer layer *stages*; microbatches flow
+                               stage→stage over ppermute (parallel/pipeline.py)
   ``sp``  sequence parallel  — sequence blocks for ring attention / long context
   ``tp``  tensor parallel    — attention heads, MLP hidden, vocab shards;
                                doubles as ``ep`` (expert parallel) for MoE —
@@ -24,9 +26,10 @@ import numpy as np
 from jax.sharding import Mesh
 
 AXIS_DP = "dp"
+AXIS_PP = "pp"
 AXIS_SP = "sp"
 AXIS_TP = "tp"
-MESH_AXES = (AXIS_DP, AXIS_SP, AXIS_TP)
+MESH_AXES = (AXIS_DP, AXIS_PP, AXIS_SP, AXIS_TP)
 
 
 @dataclass(frozen=True)
@@ -36,18 +39,20 @@ class MeshConfig:
     dp: int = 1
     sp: int = 1
     tp: int = 1
+    pp: int = 1
 
     @property
     def n_devices(self) -> int:
-        return self.dp * self.sp * self.tp
+        return self.dp * self.pp * self.sp * self.tp
 
 
 def make_mesh(cfg: MeshConfig | None = None, devices=None) -> Mesh:
-    """Build a ``(dp, sp, tp)`` mesh over ``devices`` (default: all local).
+    """Build a ``(dp, pp, sp, tp)`` mesh over ``devices`` (default: all local).
 
     The tp axis is placed innermost so tensor-parallel collectives (the
     highest-traffic ones: all-reduce after attention/MLP) map onto
-    nearest-neighbour ICI links.
+    nearest-neighbour ICI links; pp sits next-outermost so stage hand-offs
+    (one activation ppermute per microbatch tick) are also neighbor hops.
     """
     if devices is None:
         devices = jax.devices()
@@ -56,7 +61,8 @@ def make_mesh(cfg: MeshConfig | None = None, devices=None) -> Mesh:
         raise ValueError(
             f"mesh {cfg} needs {cfg.n_devices} devices, have {len(devices)}"
         )
-    arr = np.asarray(devices[: cfg.n_devices]).reshape(cfg.dp, cfg.sp, cfg.tp)
+    arr = np.asarray(devices[: cfg.n_devices]).reshape(
+        cfg.dp, cfg.pp, cfg.sp, cfg.tp)
     return Mesh(arr, MESH_AXES)
 
 

@@ -112,7 +112,14 @@ def param_partition_specs(params: Mapping[str, Any]) -> dict[str, Any]:
         out: dict[str, Any] = {}
         for k, v in tree.items():
             if isinstance(v, Mapping):
-                out[k] = walk(v)
+                if "q8" in v:
+                    # int8-quantized leaf (models/quant.py): q8 has the parent
+                    # leaf's shape → parent spec; the scale keeps the same
+                    # logical axes with reduced dims at size 1, which
+                    # _fit_spec auto-replicates (1 % mesh_size != 0).
+                    out[k] = {"q8": spec_for(k), "qs": spec_for(k)}
+                else:
+                    out[k] = walk(v)
             elif v is None:
                 out[k] = None
             else:

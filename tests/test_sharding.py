@@ -21,7 +21,7 @@ from quorum_tpu.parallel.sharding import (
 
 def test_mesh_shapes():
     mesh = make_mesh(MeshConfig(dp=2, tp=4))
-    assert mesh.shape == {"dp": 2, "sp": 1, "tp": 4}
+    assert mesh.shape == {"dp": 2, "pp": 1, "sp": 1, "tp": 4}
     assert len(mesh.devices.flatten()) == 8
 
 
