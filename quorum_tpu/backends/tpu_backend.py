@@ -32,6 +32,10 @@ URL grammar:  ``tpu://<model-id>?<spec overrides>&<engine options>``
                    halves weight HBM bytes/token (decode is bandwidth-bound →
                    up to 2× decode tokens/s) and weight HBM capacity
                    (llama-3-8b fits one 16 GB v5e at ~8.1 GB)
+  prefix_cache=0   disable automatic prefix caching (default on): a request
+                   whose prompt prefix is already resident in a free slot's
+                   KV cache admits into that slot and prefills only the
+                   suffix — multi-turn histories re-prefill nothing
   max_tokens=      default completion budget when the request has none
 
 Contract parity with the dispatcher: configured model overrides the request
@@ -210,6 +214,8 @@ class TpuBackend:
             max_pending=int(opts.get("queue", DEFAULT_MAX_PENDING)),
             spec_decode=int(opts.get("spec_decode", 0)),
             quant=opts.get("quant") or None,
+            prefix_cache=opts.get("prefix_cache", "1").lower()
+            not in ("0", "false", "no"),
         )
         if ckpt:
             # seed= still differentiates ensemble members: it offsets the

@@ -65,6 +65,10 @@ DEFAULT_SLOTS = 4
 DEFAULT_PREFILL_CHUNK = 512
 DEFAULT_MAX_PENDING = 128
 TOP_LOGPROBS = 20  # top alternatives computed per step (OpenAI's API maximum)
+# Prefix caching: reuse a free slot's resident KV prefix only when the match
+# is at least this long — shorter matches aren't worth routing through the
+# segment path (whose first token costs one extra decode-chunk boundary).
+MIN_PREFIX_REUSE = 16
 
 
 class QueueFullError(Exception):
@@ -141,14 +145,18 @@ class _Request:
 
 class _Admission:
     """An in-progress chunked prefill: one slot, advanced one segment per
-    scheduler iteration so active decodes keep running in between."""
+    scheduler iteration so active decodes keep running in between.
+
+    ``offset`` starts at the reused-prefix length when prefix caching found
+    a match (the slot's cache rows [0, offset) already hold this prompt's
+    K/V from a previous request) — only the suffix is prefilled."""
 
     __slots__ = ("req", "slot", "offset")
 
-    def __init__(self, req: _Request, slot: int):
+    def __init__(self, req: _Request, slot: int, offset: int = 0):
         self.req = req
         self.slot = slot
-        self.offset = 0
+        self.offset = offset
 
 
 class InferenceEngine:
@@ -174,6 +182,7 @@ class InferenceEngine:
         max_pending: int = DEFAULT_MAX_PENDING,
         spec_decode: int = 0,
         quant: str | None = None,
+        prefix_cache: bool = True,
     ):
         self.spec = spec.validate()
         self.mesh = mesh or single_device_mesh()
@@ -206,6 +215,17 @@ class InferenceEngine:
         self._use_sp = dict(self.mesh.shape).get(AXIS_SP, 1) > 1
         if self._use_sp:
             self.prefill_chunk = 0
+        # Automatic prefix caching (zero-copy): each slot remembers the token
+        # sequence whose K/V its cache rows still hold; a new request admits
+        # into the free slot with the longest common prefix and prefills only
+        # the suffix (the admission rides the chunked-prefill machinery with
+        # a nonzero start offset — so it needs prefill_chunk > 0). Multi-turn
+        # conversations re-send their whole history; the repeated prefix
+        # costs nothing on device.
+        self.prefix_cache = bool(prefix_cache) and self.prefill_chunk > 0
+        self._resident: list[list[int]] = [[] for _ in range(self.n_slots)]
+        self.prefix_hits = 0
+        self.prefix_tokens_saved = 0
         if params is not None:
             self.params = shard_pytree(self.mesh, params)
             if self.quant == "int8":
@@ -712,6 +732,8 @@ class InferenceEngine:
                 "requests_total": self.n_requests,
                 "tokens_total": self.n_tokens,
                 "failures_total": self.n_failures,
+                "prefix_hits_total": self.prefix_hits,
+                "prefix_tokens_saved_total": self.prefix_tokens_saved,
             }
 
     def _scheduler(self) -> None:
@@ -733,31 +755,73 @@ class InferenceEngine:
                     # failed or will fail fast on their next admission.
                     pass
 
-    def _free_slot(self) -> int | None:
+    @staticmethod
+    def _lcp(a: list[int], b: list[int]) -> int:
+        n = min(len(a), len(b))
+        i = 0
+        while i < n and a[i] == b[i]:
+            i += 1
+        return i
+
+    def _pick_slot(self, prompt: list[int]) -> tuple[int | None, int]:
+        """(best free slot, reusable prefix length). Prefers the slot whose
+        resident tokens share the longest prefix with ``prompt``; ties go to
+        the lowest index (stable, deterministic)."""
+        best, best_lcp = None, -1
         for i, r in enumerate(self._slots):
-            if r is None and i not in self._claimed:
-                return i
-        return None
+            if r is not None or i in self._claimed:
+                continue
+            lcp = self._lcp(self._resident[i], prompt) if self.prefix_cache else 0
+            if lcp > best_lcp:
+                best, best_lcp = i, lcp
+        return best, max(0, best_lcp)
 
     def _start_admissions(self) -> None:
         """Claim free slots for pending requests. Short prompts prefill in one
         shot (single program, flash attention, immediate first token); long
         prompts become chunked :class:`_Admission`s advanced one segment per
-        scheduler iteration so active decodes interleave."""
+        scheduler iteration so active decodes interleave. A prompt whose
+        prefix is already resident in a free slot (prefix caching) admits
+        into THAT slot and prefills only the suffix — zero K/V copies."""
         while True:
             with self._cond:
-                slot = self._free_slot()
-                if slot is None or not self._pending:
+                if not self._pending:
+                    return
+                slot, lcp = self._pick_slot(self._pending[0].prompt_ids)
+                if slot is None:
                     return
                 req = self._pending.pop(0)
             if req.cancel.is_set():
                 req.out.put(("end", None))
                 continue
-            if self.prefill_chunk and len(req.prompt_ids) > self.prefill_chunk:
+            # Reuse caps at len(prompt)-1 (the final prompt token must run
+            # through a segment so the register path's first decode step has
+            # its position's logits to sample from) and is aligned DOWN to a
+            # prefill_chunk multiple — segment offsets must stay multiples
+            # of prefill_chunk (which divides max_seq) or the final
+            # segment's bucket-padded dynamic_update_slice could cross
+            # max_seq, where the clamped start silently corrupts valid
+            # cache rows (see __init__'s chunk-alignment invariant).
+            reuse = min(lcp, len(req.prompt_ids) - 1)
+            if self.prefill_chunk:
+                reuse -= reuse % self.prefill_chunk
+            if reuse < MIN_PREFIX_REUSE:
+                reuse = 0
+            if reuse or (
+                self.prefill_chunk and len(req.prompt_ids) > self.prefill_chunk
+            ):
+                if reuse:
+                    self.prefix_hits += 1
+                    self.prefix_tokens_saved += reuse
                 with self._cond:
                     self._claimed.add(slot)
-                    self._admitting.append(_Admission(req, slot))
+                    # During the admission the rows beyond the reused prefix
+                    # are in flux; advertise only what is already valid.
+                    self._resident[slot] = req.prompt_ids[:reuse]
+                    self._admitting.append(_Admission(req, slot, offset=reuse))
             else:
+                with self._cond:
+                    self._resident[slot] = []
                 self._admit(req, slot)
 
     def _step_admissions(self) -> None:
@@ -783,6 +847,8 @@ class InferenceEngine:
                 np.int32(adm.slot), self._ck, self._cv,
             )
             adm.offset += len(seg)
+            # keep the prefix-cache view in sync with what the cache rows hold
+            self._resident[adm.slot] = prompt[: adm.offset]
             if adm.offset >= len(prompt):
                 bias = (req.bias_row if req.bias_row is not None
                         else self._zero_bias)
@@ -841,6 +907,8 @@ class InferenceEngine:
         if req.want_lp >= 0:
             req.lp.append((float(s_lp),
                            np.asarray(top_ix), np.asarray(top_lp)))
+        # The one-shot prefill wrote K/V for every prompt position.
+        self._resident[slot] = list(req.prompt_ids)
         done = self._emit(req, int(first))
         if not done:
             with self._cond:
@@ -855,6 +923,7 @@ class InferenceEngine:
                 r.out.put(("end", None))
                 with self._cond:
                     self._slots[i] = None
+                    self._resident[i] = r.hist[:-1]
         with self._cond:
             active = [(i, r) for i, r in enumerate(self._slots) if r is not None]
         if not active:
@@ -907,6 +976,9 @@ class InferenceEngine:
             if finished:
                 with self._cond:
                     self._slots[i] = None
+                    # cache rows hold K/V for everything but the last
+                    # sampled token (never fed back) — reusable prefix
+                    self._resident[i] = req.hist[:-1]
 
     @staticmethod
     def _draft(req: _Request, g: int) -> list[int] | None:
@@ -959,6 +1031,7 @@ class InferenceEngine:
             if finished:
                 with self._cond:
                     self._slots[i] = None
+                    self._resident[i] = req.hist[:-1]
 
     def _emit(self, req: _Request, tok: int) -> bool:
         """Deliver one token; returns True when the request just finished."""
@@ -991,6 +1064,7 @@ class InferenceEngine:
             self._admitting = []
             self._claimed = set()
             self._pending = []
+            self._resident = [[] for _ in range(self.n_slots)]
         # Wake consumers first — the state rebuild below can itself fail, and
         # doomed requests must never hang on their queues.
         self.n_failures += len(doomed)
@@ -1023,15 +1097,18 @@ def get_engine(
     max_pending: int = DEFAULT_MAX_PENDING,
     spec_decode: int = 0,
     quant: str | None = None,
+    prefix_cache: bool = True,
 ) -> InferenceEngine:
     """Engines are keyed by weight identity (spec, seed, mesh, quant) ONLY —
     dispatch knobs like decode_chunk are per-call, so two backends that differ
     only in chunking share one set of weights on device. ``n_slots``/
     ``prefill_chunk``/``max_pending`` (structural properties of the
     preallocated cache and the scheduler) apply at first construction; later
-    callers share the existing engine as-is. ``spec_decode`` is NOT
-    structural: a shared engine runs with the maximum draft length any of its
-    backends requested."""
+    callers share the existing engine as-is. ``spec_decode`` and
+    ``prefix_cache`` are NOT structural: a shared engine runs with the
+    maximum draft length any of its backends requested, and a
+    ``prefix_cache=0`` from ANY backend disables reuse on the shared engine
+    (an explicit opt-out wins over a sharing default)."""
     mesh = mesh or single_device_mesh()
     key = (spec, seed, quant or None, tuple(sorted(mesh.shape.items())),
            tuple(map(str, mesh.devices.flat)))
@@ -1042,11 +1119,13 @@ def get_engine(
                 spec, mesh, seed=seed, n_slots=n_slots,
                 prefill_chunk=prefill_chunk, max_pending=max_pending,
                 spec_decode=spec_decode, quant=quant,
+                prefix_cache=prefix_cache,
             )
             _ENGINES[key] = eng
         else:
             eng.spec_decode = max(eng.spec_decode,
                                   max(0, min(spec_decode, 16)))
+            eng.prefix_cache = eng.prefix_cache and bool(prefix_cache)
         return eng
 
 
@@ -1060,6 +1139,7 @@ def get_engine_from_ckpt(
     max_pending: int = DEFAULT_MAX_PENDING,
     spec_decode: int = 0,
     quant: str | None = None,
+    prefix_cache: bool = True,
 ) -> InferenceEngine:
     """Engine over a local HF checkpoint; keyed by (resolved path, mesh) so N
     backends pointing at one checkpoint share the loaded weights on device."""
@@ -1083,9 +1163,11 @@ def get_engine_from_ckpt(
                 spec, mesh, params=params, n_slots=n_slots,
                 prefill_chunk=prefill_chunk, max_pending=max_pending,
                 spec_decode=spec_decode, quant=quant,
+                prefix_cache=prefix_cache,
             )
             _ENGINES[key] = eng
         else:
             eng.spec_decode = max(eng.spec_decode,
                                   max(0, min(spec_decode, 16)))
+            eng.prefix_cache = eng.prefix_cache and bool(prefix_cache)
         return eng

@@ -1,0 +1,114 @@
+"""Automatic prefix caching (engine/engine.py): a request whose prompt
+prefix is already resident in a free slot's KV cache must admit into that
+slot, prefill only the suffix, and generate EXACTLY what a cache-less engine
+generates — reuse is a scheduling optimization, never a semantic change.
+
+Reuse lengths are aligned DOWN to a prefill_chunk multiple: segment offsets
+must stay chunk-aligned (chunk divides max_seq) or the final segment's
+bucket-padded cache write could cross max_seq and silently corrupt rows.
+"""
+
+import jax
+
+from quorum_tpu.engine.engine import MIN_PREFIX_REUSE, InferenceEngine
+from quorum_tpu.models import resolve_spec
+from quorum_tpu.ops.sampling import SamplerConfig
+
+SPEC = resolve_spec("llama-tiny", {"max_seq": "128"})
+GREEDY = SamplerConfig(temperature=0.0)
+CHUNK = 16  # small alignment unit so short test prompts exercise reuse
+
+
+def _prompt(n, base=3):
+    return [(base + i * 7) % (SPEC.vocab_size - 1) + 1 for i in range(n)]
+
+
+def _engines():
+    eng = InferenceEngine(SPEC, decode_chunk=4, prefill_chunk=CHUNK)
+    ref = InferenceEngine(SPEC, decode_chunk=4, prefill_chunk=CHUNK,
+                          prefix_cache=False)
+    return eng, ref
+
+
+def test_repeat_prompt_reuses_prefix_and_matches():
+    eng, ref = _engines()
+    p = _prompt(24)
+    first = eng.generate(p, max_new_tokens=6, sampler=GREEDY, seed=5).token_ids
+    assert eng.prefix_hits == 0
+    second = eng.generate(p, max_new_tokens=6, sampler=GREEDY, seed=5).token_ids
+    assert eng.prefix_hits == 1
+    # lcp 24 caps at len(p)-1 = 23, aligns down to the chunk multiple 16
+    assert eng.prefix_tokens_saved == 16
+    baseline = ref.generate(p, max_new_tokens=6, sampler=GREEDY, seed=5).token_ids
+    assert first == baseline
+    assert second == baseline, "prefix reuse changed the generation"
+
+
+def test_multi_turn_history_reuses_prefix():
+    eng, ref = _engines()
+    turn1 = _prompt(20)
+    gen1 = eng.generate(turn1, max_new_tokens=5, sampler=GREEDY, seed=1).token_ids
+    # next turn re-sends history + the "assistant reply" + new user tokens
+    turn2 = turn1 + gen1 + _prompt(6, base=100)
+    gen2 = eng.generate(turn2, max_new_tokens=5, sampler=GREEDY, seed=2).token_ids
+    assert eng.prefix_hits == 1
+    assert eng.prefix_tokens_saved >= CHUNK
+    baseline = ref.generate(turn2, max_new_tokens=5, sampler=GREEDY,
+                            seed=2).token_ids
+    assert gen2 == baseline
+
+
+def test_reuse_near_max_seq_is_exact():
+    """End-game regression: a reused prefix plus a suffix that fills the
+    context almost to max_seq — the final segment's bucket write must not
+    cross max_seq (chunk alignment invariant)."""
+    eng = InferenceEngine(SPEC, decode_chunk=2, prefill_chunk=32)
+    ref = InferenceEngine(SPEC, decode_chunk=2, prefill_chunk=32,
+                          prefix_cache=False)
+    first = _prompt(100)
+    gen1 = eng.generate(first, max_new_tokens=4, sampler=GREEDY, seed=3).token_ids
+    long2 = (first + gen1 + _prompt(40, base=77))[:127]
+    got = eng.generate(long2, max_new_tokens=1, sampler=GREEDY, seed=4).token_ids
+    assert eng.prefix_hits == 1
+    assert eng.prefix_tokens_saved == 96  # lcp 103 aligned down to 96
+    baseline = ref.generate(long2, max_new_tokens=1, sampler=GREEDY,
+                            seed=4).token_ids
+    assert got == baseline
+
+
+def test_disjoint_prompt_no_reuse():
+    eng, _ = _engines()
+    eng.generate(_prompt(24), max_new_tokens=4, sampler=GREEDY).token_ids
+    eng.generate(_prompt(24, base=200), max_new_tokens=4,
+                 sampler=GREEDY).token_ids
+    assert eng.prefix_hits == 0
+
+
+def test_short_match_below_threshold_no_reuse():
+    eng, _ = _engines()
+    p = _prompt(MIN_PREFIX_REUSE - 4)
+    eng.generate(p, max_new_tokens=4, sampler=GREEDY).token_ids
+    eng.generate(p, max_new_tokens=4, sampler=GREEDY).token_ids
+    assert eng.prefix_hits == 0
+
+
+def test_prefix_cache_knob_and_metrics():
+    from quorum_tpu.backends.tpu_backend import TpuBackend
+    from quorum_tpu.config import BackendSpec
+
+    off = TpuBackend.from_spec(BackendSpec(
+        name="NC", url="tpu://llama-tiny?prefix_cache=0&max_seq=64&seed=11",
+        model="m"))
+    assert off.engine.prefix_cache is False
+    on = TpuBackend.from_spec(BackendSpec(
+        name="C", url="tpu://llama-tiny?max_seq=64&seed=12", model="m"))
+    assert on.engine.prefix_cache is True
+    m = on.engine.metrics()
+    assert m["prefix_hits_total"] == 0
+    assert m["prefix_tokens_saved_total"] == 0
+    # an explicit opt-out from a later backend sharing the engine wins
+    shared_off = TpuBackend.from_spec(BackendSpec(
+        name="C2", url="tpu://llama-tiny?prefix_cache=0&max_seq=64&seed=12",
+        model="m"))
+    assert shared_off.engine is on.engine
+    assert on.engine.prefix_cache is False
