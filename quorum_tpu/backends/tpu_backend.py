@@ -72,6 +72,16 @@ from quorum_tpu.parallel.mesh import MeshConfig, make_mesh, single_device_mesh
 logger = logging.getLogger(__name__)
 
 
+def _parse_bool_opt(name: str, raw: str) -> bool:
+    """Strict boolean URL option: a typo must not silently mean 'enabled'."""
+    val = str(raw).lower()
+    if val in ("1", "true", "yes"):
+        return True
+    if val in ("0", "false", "no"):
+        return False
+    raise ValueError(f"invalid {name}={raw!r} (use 0/1, true/false, yes/no)")
+
+
 def _request_sampler(body: dict[str, Any]) -> SamplerConfig:
     """Map OpenAI request knobs onto the on-device sampler.
 
@@ -214,8 +224,8 @@ class TpuBackend:
             max_pending=int(opts.get("queue", DEFAULT_MAX_PENDING)),
             spec_decode=int(opts.get("spec_decode", 0)),
             quant=opts.get("quant") or None,
-            prefix_cache=opts.get("prefix_cache", "1").lower()
-            not in ("0", "false", "no"),
+            prefix_cache=_parse_bool_opt(
+                "prefix_cache", opts.get("prefix_cache", "1")),
         )
         if ckpt:
             # seed= still differentiates ensemble members: it offsets the

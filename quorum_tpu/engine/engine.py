@@ -38,6 +38,7 @@ from __future__ import annotations
 
 import queue
 import threading
+import weakref
 from dataclasses import dataclass, field
 from typing import Iterator
 
@@ -264,10 +265,12 @@ class InferenceEngine:
         self.n_requests = 0
         self.n_tokens = 0
         self.n_failures = 0
+        self._stop = False
         self._thread = threading.Thread(
             target=self._scheduler, name=f"engine-{id(self):x}", daemon=True
         )
         self._thread.start()
+        _ALL_ENGINES.add(self)
 
     def _init_device_state(self) -> None:
         """(Re)allocate the slot-batched cache and per-slot state on device.
@@ -710,6 +713,8 @@ class InferenceEngine:
             pp=pp, fp=fp, bias_row=bias_row, want_lp=want_lp,
         )
         with self._cond:
+            if self._stop:
+                raise RuntimeError("engine has been shut down")
             if len(self._pending) >= self.max_pending:
                 raise QueueFullError(
                     f"engine admission queue full ({self.max_pending} waiting)"
@@ -736,11 +741,46 @@ class InferenceEngine:
                 "prefix_tokens_saved_total": self.prefix_tokens_saved,
             }
 
+    def shutdown(self, timeout: float = 30.0) -> None:
+        """Stop the scheduler thread and release device state.
+
+        Pending/active requests are cancelled (their consumers see end within
+        one chunk boundary); the thread is joined, then the weights and slot
+        cache are dropped so a shut-down engine holds no HBM. Used by server
+        teardown and by the test suite's per-module cleanup — dozens of live
+        scheduler threads executing stray device work while the next test
+        compiles is exactly the kind of concurrency XLA's CPU client is not
+        hardened against.
+        """
+        with self._cond:
+            self._stop = True
+            for r in self._slots:
+                if r is not None:
+                    r.cancel.set()
+            for a in self._admitting:
+                a.req.cancel.set()
+            for r in self._pending:
+                r.cancel.set()
+            self._cond.notify_all()
+        self._thread.join(timeout=timeout)
+        if self._thread.is_alive():
+            # A dispatch (e.g. a long XLA compile) is still in flight: do
+            # NOT null the state under it — the thread exits at its next
+            # scheduler-loop boundary and the GC reclaims everything then.
+            return
+        self.params = None
+        self._ck = self._cv = None
+
     def _scheduler(self) -> None:
         while True:
             with self._cond:
-                while not (self._pending or self._admitting or any(self._slots)):
+                while not (self._stop or self._pending or self._admitting
+                           or any(self._slots)):
                     self._cond.wait()
+                if self._stop and not (
+                    self._pending or self._admitting or any(self._slots)
+                ):
+                    return
             try:
                 self._start_admissions()
                 self._step_admissions()
@@ -765,16 +805,19 @@ class InferenceEngine:
 
     def _pick_slot(self, prompt: list[int]) -> tuple[int | None, int]:
         """(best free slot, reusable prefix length). Prefers the slot whose
-        resident tokens share the longest prefix with ``prompt``; ties go to
-        the lowest index (stable, deterministic)."""
-        best, best_lcp = None, -1
+        resident tokens share the longest prefix with ``prompt``; among
+        equal matches (typically lcp 0), the slot with the SHORTEST resident
+        content wins, so a no-match request lands on an empty slot instead
+        of evicting another conversation's long reusable history."""
+        best, best_score = None, None
         for i, r in enumerate(self._slots):
             if r is not None or i in self._claimed:
                 continue
             lcp = self._lcp(self._resident[i], prompt) if self.prefix_cache else 0
-            if lcp > best_lcp:
-                best, best_lcp = i, lcp
-        return best, max(0, best_lcp)
+            score = (lcp, -len(self._resident[i]))
+            if best_score is None or score > best_score:
+                best, best_score = i, score
+        return best, best_score[0] if best_score else 0
 
     def _start_admissions(self) -> None:
         """Claim free slots for pending requests. Short prompts prefill in one
@@ -1071,8 +1114,11 @@ class InferenceEngine:
         for r in doomed:
             r.out.put(("err", exc))
         # The failed call may have consumed its donated buffers; rebuild the
-        # device state so the engine survives for subsequent requests.
-        self._init_device_state()
+        # device state so the engine survives for subsequent requests — but
+        # not mid-shutdown, where a rebuild would reallocate the multi-GB
+        # cache the shutdown exists to release.
+        if not self._stop:
+            self._init_device_state()
 
 
 # ---- engine sharing -------------------------------------------------------
@@ -1085,6 +1131,17 @@ class InferenceEngine:
 
 _ENGINES: dict[tuple, InferenceEngine] = {}
 _ENGINES_LOCK = threading.Lock()
+# Every live engine (cached or directly constructed) for bulk shutdown.
+_ALL_ENGINES: "weakref.WeakSet[InferenceEngine]" = weakref.WeakSet()
+
+
+def shutdown_all_engines(timeout: float = 30.0) -> None:
+    """Shut down every live engine and clear the shared-engine cache —
+    server teardown and test-suite module cleanup."""
+    for eng in list(_ALL_ENGINES):
+        eng.shutdown(timeout=timeout)
+    with _ENGINES_LOCK:
+        _ENGINES.clear()
 
 
 def get_engine(

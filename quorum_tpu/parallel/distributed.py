@@ -1,0 +1,131 @@
+"""Multi-host bootstrap and hybrid ICI/DCN meshes.
+
+The reference's only "communication backend" is per-request HTTP
+(/root/reference/src/quorum/oai_proxy.py:185-192 — no NCCL/MPI of any
+kind, SURVEY.md §5.8). The TPU-native equivalent is jax's distributed
+runtime: every host in a multi-host deployment runs the SAME program,
+``jax.distributed.initialize`` wires the hosts into one JAX process group,
+and XLA collectives ride
+
+  - **ICI** within a slice (the high-bandwidth inter-chip interconnect), and
+  - **DCN** between slices/hosts (the data-center network).
+
+The scaling-book recipe for laying a mesh over that topology: put the
+*highest-traffic* axes (tp all-reduces every layer; sp rings every
+attention; pp hands off every microbatch tick) on ICI, and keep only the
+*lowest-traffic* axis — dp, which communicates once per training step
+(gradient all-reduce) and never during serving forward passes — on DCN.
+:func:`hybrid_mesh` encodes exactly that split via
+``mesh_utils.create_hybrid_device_mesh``.
+
+Single-host processes (tests, the bench chip, CPU meshes) take the same
+code path: ``initialize()`` no-ops and ``hybrid_mesh`` degrades to the
+plain :func:`quorum_tpu.parallel.mesh.make_mesh` layout, so nothing in the
+engine/trainer branches on deployment size.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+from quorum_tpu.parallel.mesh import MESH_AXES, MeshConfig, make_mesh
+
+logger = logging.getLogger(__name__)
+
+
+def initialize(
+    coordinator_address: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+) -> bool:
+    """Join the multi-host JAX process group; returns True if distributed.
+
+    Arguments default from the standard env vars
+    (``JAX_COORDINATOR_ADDRESS`` / ``JAX_NUM_PROCESSES`` /
+    ``JAX_PROCESS_ID``); on TPU pods jax can also infer all three from the
+    TPU metadata, so calling this with no arguments is correct there.
+    Single-process runs (no coordinator configured, one process) skip
+    initialization entirely — the same binary serves a laptop CPU, one
+    bench chip, and a pod.
+    """
+    coordinator_address = coordinator_address or os.environ.get(
+        "JAX_COORDINATOR_ADDRESS")
+    if num_processes is None and "JAX_NUM_PROCESSES" in os.environ:
+        num_processes = int(os.environ["JAX_NUM_PROCESSES"])
+    if process_id is None and "JAX_PROCESS_ID" in os.environ:
+        process_id = int(os.environ["JAX_PROCESS_ID"])
+    if coordinator_address is None and (num_processes or 1) <= 1:
+        return False
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    logger.info(
+        "joined distributed runtime: process %d/%d, %d global devices",
+        jax.process_index(), jax.process_count(), jax.device_count(),
+    )
+    return True
+
+
+def hybrid_mesh(cfg: MeshConfig, *, dcn_dp: int = 1) -> Mesh:
+    """A ``(dp, pp, sp, tp)`` mesh whose dp axis spans slices over DCN.
+
+    ``cfg`` describes the per-slice (ICI) shape; ``dcn_dp`` multiplies the
+    dp axis across slices — the global mesh is
+    ``(dcn_dp · cfg.dp, cfg.pp, cfg.sp, cfg.tp)`` with device placement
+    chosen so every pp/sp/tp neighbor hop stays on ICI and only the
+    once-per-step dp gradient all-reduce crosses DCN.
+
+    With one slice (``dcn_dp == 1``) this is exactly ``make_mesh(cfg)`` —
+    tests and the single-chip bench exercise the same call.
+    """
+    if dcn_dp <= 1:
+        return make_mesh(cfg)
+    from jax.experimental import mesh_utils
+
+    ici_shape = (cfg.dp, cfg.pp, cfg.sp, cfg.tp)
+    dcn_shape = (dcn_dp, 1, 1, 1)
+    devices = mesh_utils.create_hybrid_device_mesh(
+        ici_shape, dcn_shape, devices=jax.devices())
+    return Mesh(devices, MESH_AXES)
+
+
+def local_data_shard(global_batch: int) -> tuple[int, int]:
+    """(start, size) of this host's slice of a dp-sharded global batch —
+    the per-host input feeding convention for multi-host training: each
+    process feeds only the rows its local devices own, and
+    ``jax.make_array_from_process_local_data`` assembles the global array.
+    """
+    n = jax.process_count()
+    i = jax.process_index()
+    if global_batch % n:
+        raise ValueError(f"global batch {global_batch} must divide over "
+                         f"{n} processes")
+    per = global_batch // n
+    return i * per, per
+
+
+def assemble_global_batch(local_tokens: np.ndarray, mesh: Mesh,
+                          global_batch: int):
+    """Build the global [B, T] token array from this host's local rows.
+
+    On one process this is a plain device_put; on many, each host
+    contributes its :func:`local_data_shard` rows and jax assembles the
+    sharded global array without any host ever materializing all of it.
+    """
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from quorum_tpu.parallel.mesh import AXIS_DP
+
+    sharding = NamedSharding(mesh, P(AXIS_DP, None))
+    if jax.process_count() == 1:
+        return jax.device_put(local_tokens, sharding)
+    t = local_tokens.shape[-1]
+    return jax.make_array_from_process_local_data(
+        sharding, local_tokens, (global_batch, t))

@@ -183,7 +183,17 @@ def main() -> None:
     setup_aggregation_log(args.log_dir)
     cfg = load_config(args.config)
     app = create_app(cfg)
-    asyncio.run(serve(app, args.host, args.port))
+    try:
+        asyncio.run(serve(app, args.host, args.port))
+    except KeyboardInterrupt:
+        pass
+    finally:
+        # Graceful teardown: cancel in-flight generations, join scheduler
+        # threads, release HBM — not strictly needed on process exit, but it
+        # makes embedding (and Ctrl-C during local runs) clean.
+        from quorum_tpu.engine.engine import shutdown_all_engines
+
+        shutdown_all_engines()
 
 
 if __name__ == "__main__":

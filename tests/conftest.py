@@ -31,6 +31,19 @@ import inspect  # noqa: E402
 import pytest  # noqa: E402
 
 
+@pytest.fixture(autouse=True, scope="module")
+def _shutdown_engines_between_modules():
+    """Join every engine's scheduler thread and drop its device state after
+    each test module. Without this the suite accumulates dozens of live
+    threads + parameter/cache buffers across ~30 modules, and a straggler
+    thread running device work while the next module compiles can segfault
+    XLA's CPU client (observed on the 1-core CI box)."""
+    yield
+    from quorum_tpu.engine.engine import shutdown_all_engines
+
+    shutdown_all_engines()
+
+
 def make_client(config_raw: dict, **fake_backends):
     """Build the ASGI app over FakeBackends and an httpx client bound to it.
 

@@ -112,3 +112,29 @@ def test_prefix_cache_knob_and_metrics():
         model="m"))
     assert shared_off.engine is on.engine
     assert on.engine.prefix_cache is False
+
+
+def test_no_match_prefers_empty_slot():
+    """A disjoint request must not evict a long resident prefix when an
+    emptier slot is free (tie-break on shortest resident)."""
+    eng = InferenceEngine(SPEC, decode_chunk=4, prefill_chunk=CHUNK, n_slots=2)
+    conv = _prompt(40)
+    eng.generate(conv, max_new_tokens=4, sampler=GREEDY, seed=1)
+    # unrelated request: lcp 0 everywhere → should land on the empty slot
+    eng.generate(_prompt(20, base=300), max_new_tokens=4, sampler=GREEDY)
+    # the conversation's prefix must still be reusable
+    eng.generate(conv + _prompt(4, base=50), max_new_tokens=4,
+                 sampler=GREEDY, seed=2)
+    assert eng.prefix_hits == 1
+    assert eng.prefix_tokens_saved >= 32
+
+
+def test_invalid_prefix_cache_value_rejected():
+    import pytest as _pytest
+
+    from quorum_tpu.backends.tpu_backend import TpuBackend
+    from quorum_tpu.config import BackendSpec
+
+    with _pytest.raises(ValueError, match="prefix_cache"):
+        TpuBackend.from_spec(BackendSpec(
+            name="X", url="tpu://llama-tiny?prefix_cache=off", model="m"))

@@ -133,3 +133,34 @@ async def test_tpu_url_quant_knob():
         TpuBackend.from_spec(BackendSpec(
             name="Q4", url="tpu://llama-tiny?quant=int4", model="m",
         ))
+
+
+def test_ckpt_quant_logits_close_to_transformers(tmp_path):
+    """Real-weights path: a HF checkpoint loaded with quant=int8 still tracks
+    the transformers forward (weight mapping + quantization compose)."""
+    import torch
+    from transformers import LlamaConfig, LlamaForCausalLM
+
+    from quorum_tpu.models.hf_loader import load_hf_checkpoint
+
+    cfg = LlamaConfig(
+        vocab_size=512, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64, rope_theta=10000.0,
+        tie_word_embeddings=False,
+    )
+    model = LlamaForCausalLM(cfg).eval()
+    model.save_pretrained(tmp_path, safe_serialization=True)
+    tokens = np.array([[3, 17, 5, 9, 250, 11, 42, 7]], dtype=np.int32)
+    with torch.no_grad():
+        theirs = model(torch.tensor(tokens, dtype=torch.long)).logits.float().numpy()
+
+    spec, params = load_hf_checkpoint(str(tmp_path), dtype="float32")
+    qlogits = np.asarray(
+        forward_logits(quantize_params(params), spec, jnp.asarray(tokens)),
+        np.float32,
+    )
+    rel = np.linalg.norm(qlogits - theirs) / np.linalg.norm(theirs)
+    assert rel < 0.05, f"relative error vs transformers {rel:.4f}"
+    agree = (qlogits.argmax(-1) == theirs.argmax(-1)).mean()
+    assert agree >= 0.85, f"argmax agreement {agree:.2f}"
