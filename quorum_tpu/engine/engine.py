@@ -995,6 +995,47 @@ class InferenceEngine:
         mask = np.zeros((self.n_slots,), np.int32)
         for i, _ in active:
             mask[i] = 1
+        payload1 = self._dispatch_chunk(mask, n_steps, want_lp, history)
+        # Dispatch overlap: enqueue the NEXT chunk before blocking on this
+        # one's tokens — jax dispatch is async, so the device rolls straight
+        # from chunk N into N+1 while the host reads/emits N's tokens.
+        # Without it the device idles for the whole host turnaround every
+        # chunk (device_get + detok + SSE + scheduling — comparable to the
+        # chunk itself at small-model scale). Only when nothing needs a
+        # decision between the two: no admission waiting (it would be
+        # delayed one chunk), and one more chunk can't run off max_seq.
+        # Rows that finish (EOS/budget) inside chunk N keep decoding
+        # through N+1; their extra tokens are simply discarded.
+        payload2 = None
+        with self._cond:
+            idle = not self._pending and not self._admitting and not self._stop
+        history2 = prefill_bucket(max_len + 2 * n_steps, self.spec.max_seq)
+        if (idle
+                and max_len + 2 * n_steps <= self.spec.max_seq
+                # at least one row can still be decoding in chunk N+1 —
+                # otherwise the whole second chunk is guaranteed discard
+                and any(r.budget - r.emitted > n_steps for _, r in active)
+                # never compile synchronously between the pair: a first-use
+                # history bucket would stall chunk N's already-computed
+                # tokens behind a full XLA compile
+                and (n_steps, want_lp, history2) in self._decode_cache):
+            payload2 = self._dispatch_chunk(mask, n_steps, want_lp, history2)
+        done = self._emit_chunk(active, payload1, set())
+        if payload2 is not None:
+            done |= self._emit_chunk(active, payload2, done)
+        if done:
+            with self._cond:
+                for i, req in active:
+                    if i in done:
+                        self._slots[i] = None
+                        # cache rows hold K/V for everything but the last
+                        # sampled token (never fed back) — reusable prefix
+                        self._resident[i] = req.hist[:-1]
+
+    def _dispatch_chunk(self, mask, n_steps: int, want_lp: bool, history: int):
+        """Enqueue one decode chunk (non-blocking — jax arrays are futures);
+        chains the per-slot device state so a second dispatch can follow
+        before the first is read. Returns the chunk's output arrays."""
         out = self._decode_fn(n_steps, want_lp, history)(
             self.params, mask, self._ck, self._cv, self._token, self._lengths,
             self._keys, self._temp, self._topp, self._topk,
@@ -1003,25 +1044,33 @@ class InferenceEngine:
         if want_lp:
             (toks, s_lp, top_ix, top_lp, self._ck, self._cv, self._token,
              self._lengths, self._keys, self._counts) = out
-            s_lp, top_ix, top_lp = jax.device_get((s_lp, top_ix, top_lp))
+            return (toks, s_lp, top_ix, top_lp)
+        (toks, self._ck, self._cv, self._token, self._lengths,
+         self._keys, self._counts) = out
+        return (toks,)
+
+    def _emit_chunk(self, active, payload, skip: set[int]) -> set[int]:
+        """Block on one dispatched chunk's outputs and deliver its tokens.
+        Rows in ``skip`` already finished in an earlier chunk of the same
+        dispatch pair — their tokens are overrun and discarded. Returns the
+        slots that finished in THIS chunk."""
+        if len(payload) == 4:
+            toks, s_lp, top_ix, top_lp = jax.device_get(payload)
         else:
-            (toks, self._ck, self._cv, self._token, self._lengths,
-             self._keys, self._counts) = out
-        toks_host = jax.device_get(toks)
+            (toks,) = jax.device_get(payload)
+            s_lp = top_ix = top_lp = None
+        done: set[int] = set()
         for i, req in active:
-            finished = False
-            for j, t in enumerate(toks_host[i]):
-                if req.want_lp >= 0:
-                    req.lp.append((float(s_lp[i, j]), top_ix[i, j], top_lp[i, j]))
+            if i in skip:
+                continue
+            for j, t in enumerate(toks[i]):
+                if req.want_lp >= 0 and s_lp is not None:
+                    req.lp.append(
+                        (float(s_lp[i, j]), top_ix[i, j], top_lp[i, j]))
                 if self._emit(req, int(t)):
-                    finished = True
+                    done.add(i)
                     break
-            if finished:
-                with self._cond:
-                    self._slots[i] = None
-                    # cache rows hold K/V for everything but the last
-                    # sampled token (never fed back) — reusable prefix
-                    self._resident[i] = req.hist[:-1]
+        return done
 
     @staticmethod
     def _draft(req: _Request, g: int) -> list[int] | None:

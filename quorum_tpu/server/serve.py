@@ -181,6 +181,13 @@ def main() -> None:
         format="%(levelname)s:%(asctime)s:%(name)s: %(message)s",
     )
     setup_aggregation_log(args.log_dir)
+    # Multi-host deployments: join the jax process group before any backend
+    # initializes a device client (no-op for single-process runs — laptop,
+    # one chip, CPU). Env-driven: JAX_COORDINATOR_ADDRESS / JAX_NUM_PROCESSES
+    # / JAX_PROCESS_ID, or TPU-pod metadata inference.
+    from quorum_tpu.parallel.distributed import initialize
+
+    initialize()
     cfg = load_config(args.config)
     app = create_app(cfg)
     try:
