@@ -965,8 +965,7 @@ class InferenceEngine:
             if r.cancel.is_set():
                 r.out.put(("end", None))
                 with self._cond:
-                    self._slots[i] = None
-                    self._resident[i] = r.hist[:-1]
+                    self._release_slot(i, r)
         with self._cond:
             active = [(i, r) for i, r in enumerate(self._slots) if r is not None]
         if not active:
@@ -1027,10 +1026,14 @@ class InferenceEngine:
             with self._cond:
                 for i, req in active:
                     if i in done:
-                        self._slots[i] = None
-                        # cache rows hold K/V for everything but the last
-                        # sampled token (never fed back) — reusable prefix
-                        self._resident[i] = req.hist[:-1]
+                        self._release_slot(i, req)
+
+    def _release_slot(self, i: int, req: _Request) -> None:
+        """Free a slot whose request finished/cancelled. Caller holds _cond.
+        The cache rows hold K/V for everything but the request's last
+        sampled token (never fed back) — that prefix stays reusable."""
+        self._slots[i] = None
+        self._resident[i] = req.hist[:-1]
 
     def _dispatch_chunk(self, mask, n_steps: int, want_lp: bool, history: int):
         """Enqueue one decode chunk (non-blocking — jax arrays are futures);
@@ -1122,8 +1125,7 @@ class InferenceEngine:
                     break
             if finished:
                 with self._cond:
-                    self._slots[i] = None
-                    self._resident[i] = req.hist[:-1]
+                    self._release_slot(i, req)
 
     def _emit(self, req: _Request, tok: int) -> bool:
         """Deliver one token; returns True when the request just finished."""

@@ -105,3 +105,64 @@ async def test_stream_is_incremental_over_socket(app_factory):
     assert ttft < total * MAX_TTFT_FRACTION, (
         f"first delta at {ttft:.3f}s of {total:.3f}s — stream is buffered"
     )
+
+
+async def test_int8_prefix_cached_serving_over_socket():
+    """Integration of the round-3 features through the FULL stack: an
+    int8-quantized local model behind a real TCP socket, streaming SSE, with
+    the second identical request hitting the prefix cache — and /metrics
+    exporting the hit counters."""
+    raw = {
+        "settings": {"timeout": 60},
+        "primary_backends": [
+            {"name": "Q8",
+             "url": "tpu://llama-tiny?quant=int8&max_seq=128"
+                    "&prefill_chunk=16&seed=3",
+             "model": "llama-tiny"},
+        ],
+    }
+    app = create_app(Config(raw=raw))
+    server = await start_server(app, "127.0.0.1", 0)
+    port = server.sockets[0].getsockname()[1]
+    body = {
+        "model": "llama-tiny",
+        "messages": [{"role": "user",
+                      "content": "please repeat this long shared preamble "
+                                 "once more for the integration test"}],
+        "stream": True,
+        "max_tokens": 4,
+        "temperature": 0,
+    }
+    try:
+        async with httpx.AsyncClient(
+            base_url=f"http://127.0.0.1:{port}", timeout=60
+        ) as client:
+
+            async def one() -> str:
+                text = []
+                async with client.stream(
+                    "POST", "/chat/completions", json=body,
+                    headers={"Authorization": "Bearer t"},
+                ) as resp:
+                    assert resp.status_code == 200
+                    async for line in resp.aiter_lines():
+                        if (not line.startswith("data: ")
+                                or line == "data: [DONE]"):
+                            continue
+                        delta = (json.loads(line[6:]).get("choices")
+                                 or [{}])[0].get("delta") or {}
+                        if delta.get("content"):
+                            text.append(delta["content"])
+                return "".join(text)
+
+            first = await one()
+            second = await one()
+            assert first == second, "greedy repeat diverged"
+            metrics = (await client.get("/metrics")).text
+    finally:
+        server.close()
+        await server.wait_closed()
+    assert 'quorum_tpu_engine_prefix_hits_total{backend="Q8"} 1' in metrics
+    saved = [line for line in metrics.splitlines()
+             if line.startswith("quorum_tpu_engine_prefix_tokens_saved_total")]
+    assert saved and int(saved[0].rsplit(" ", 1)[1]) >= 16
