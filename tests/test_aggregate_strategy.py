@@ -177,3 +177,47 @@ def test_prompt_builder_placeholder_variants():
     params.prompt_template = "no placeholder at all"
     out = build_aggregation_prompt([("A", "body")], params, "")
     assert "body" in out
+
+
+async def test_fully_local_two_hop_aggregation():
+    """The reference's flagship workflow with ZERO network: fan out to two
+    local tpu:// models, then synthesize via a THIRD local tpu:// aggregator
+    (reference: remote HTTP hops only, oai_proxy.py:374-486). The aggregator
+    runs on-device with no credentials; the final content is its generation,
+    not the separator-join fallback."""
+    raw = {
+        "settings": {"timeout": 120},
+        "primary_backends": [
+            {"name": "A", "url": "tpu://llama-tiny?seed=21&max_seq=64",
+             "model": "m"},
+            {"name": "B", "url": "tpu://llama-tiny?seed=22&max_seq=64",
+             "model": "m"},
+            {"name": "AGG", "url": "tpu://llama-tiny?seed=23&max_seq=64",
+             "model": "m"},
+        ],
+        "iterations": {"aggregation": {"strategy": "aggregate"}},
+        "strategy": {
+            "concatenate": {"separator": "\n---\n"},
+            "aggregate": {
+                "source_backends": ["A", "B"],
+                "aggregator_backend": "AGG",
+                "intermediate_separator": "@@SEP@@",
+                "include_source_names": False,
+                "suppress_individual_responses": True,
+            },
+        },
+    }
+    async with make_client(raw) as client:
+        resp = await client.post(
+            "/chat/completions",
+            json={"model": "m", "max_tokens": 6, "temperature": 0,
+                  "messages": [{"role": "user", "content": "hello"}]},
+            headers={"Authorization": "Bearer x"},
+        )
+    assert resp.status_code == 200
+    body = resp.json()
+    content = body["choices"][0]["message"]["content"]
+    # the fallback join would contain the distinctive separator; the real
+    # aggregation hop returns the AGG model's own generation
+    assert "@@SEP@@" not in content
+    assert content  # non-empty synthesis

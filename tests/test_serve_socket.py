@@ -41,6 +41,14 @@ def single_backend_config() -> dict:
     }
 
 
+def _delta_content(line: str) -> str | None:
+    """Extract one SSE line's content delta, or None for non-content lines."""
+    if not line.startswith("data: ") or line == "data: [DONE]":
+        return None
+    delta = (json.loads(line[6:]).get("choices") or [{}])[0].get("delta") or {}
+    return delta.get("content")
+
+
 async def _stream_timing(app, body) -> tuple[float, float]:
     """Drive one streaming request over a real socket; return (ttft, total)."""
     server = await start_server(app, "127.0.0.1", 0)
@@ -57,12 +65,7 @@ async def _stream_timing(app, body) -> tuple[float, float]:
             ) as resp:
                 assert resp.status_code == 200
                 async for line in resp.aiter_lines():
-                    if not line.startswith("data: ") or line == "data: [DONE]":
-                        continue
-                    delta = (json.loads(line[6:]).get("choices") or [{}])[0].get(
-                        "delta"
-                    ) or {}
-                    if ttft is None and delta.get("content"):
+                    if ttft is None and _delta_content(line):
                         ttft = time.perf_counter() - t0
             total = time.perf_counter() - t0
     finally:
@@ -146,13 +149,9 @@ async def test_int8_prefix_cached_serving_over_socket():
                 ) as resp:
                     assert resp.status_code == 200
                     async for line in resp.aiter_lines():
-                        if (not line.startswith("data: ")
-                                or line == "data: [DONE]"):
-                            continue
-                        delta = (json.loads(line[6:]).get("choices")
-                                 or [{}])[0].get("delta") or {}
-                        if delta.get("content"):
-                            text.append(delta["content"])
+                        piece = _delta_content(line)
+                        if piece:
+                            text.append(piece)
                 return "".join(text)
 
             first = await one()
