@@ -32,6 +32,13 @@ URL grammar:  ``tpu://<model-id>?<spec overrides>&<engine options>``
                    halves weight HBM bytes/token (decode is bandwidth-bound →
                    up to 2× decode tokens/s) and weight HBM capacity
                    (llama-3-8b fits one 16 GB v5e at ~8.1 GB)
+  ensemble=M       on-device logit-ensemble decoding (default 1 = off): M
+                   independently-seeded weight sets (seed..seed+M-1) decode
+                   ONE shared stream — every step averages the M members'
+                   next-token logits on device before sampling. A true deep
+                   ensemble (one consensus completion), vs the strategy
+                   layer's text-level concatenation/aggregation of M
+                   separate completions
   prefix_cache=0   disable automatic prefix caching (default on): a request
                    whose prompt prefix is already resident in a free slot's
                    KV cache admits into that slot and prefills only the
@@ -226,6 +233,7 @@ class TpuBackend:
             quant=opts.get("quant") or None,
             prefix_cache=_parse_bool_opt(
                 "prefix_cache", opts.get("prefix_cache", "1")),
+            ensemble=int(opts.get("ensemble", 1)),
         )
         if ckpt:
             # seed= still differentiates ensemble members: it offsets the

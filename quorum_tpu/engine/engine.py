@@ -76,6 +76,22 @@ class QueueFullError(Exception):
     """The engine's admission queue is at capacity (surface as HTTP 503)."""
 
 
+def _member_call(ens: int, fn, params, ck, cv, *, mean: bool = True):
+    """Run a model call member-vmapped when ``ens`` > 1.
+
+    ``fn(params, ck, cv)`` is the single-model call. With an ensemble, every
+    arg carries a leading member axis and the call is vmapped; when ``mean``
+    (the logit-returning calls), the members' logits are averaged in f32 —
+    the consensus distribution every sample draws from."""
+    if ens == 1:
+        return fn(params, ck, cv)
+    out = jax.vmap(fn)(params, ck, cv)
+    if not mean:
+        return out
+    logits, ck, cv = out
+    return jnp.mean(logits.astype(jnp.float32), axis=0), ck, cv
+
+
 def prefill_bucket(n: int, max_seq: int) -> int:
     """Smallest power-of-two ≥ n, clamped to [MIN_BUCKET, max_seq]."""
     b = MIN_BUCKET
@@ -184,12 +200,22 @@ class InferenceEngine:
         spec_decode: int = 0,
         quant: str | None = None,
         prefix_cache: bool = True,
+        ensemble: int = 1,
     ):
         self.spec = spec.validate()
         self.mesh = mesh or single_device_mesh()
         if quant not in (None, "", "int8"):
             raise ValueError(f"unsupported quant mode {quant!r} (int8 or none)")
         self.quant = quant or None
+        # On-device logit-ensemble decoding: M independently-seeded weight
+        # sets decode ONE shared stream — every model call is vmapped over a
+        # leading member axis (params and KV caches are [M, …]) and the M
+        # members' next-token logits are averaged on device before sampling.
+        # A true deep ensemble: one completion whose every token is the
+        # consensus of M models — impossible in the reference architecture,
+        # where members are separate HTTP services whose finished texts can
+        # only be concatenated or re-summarized.
+        self.ensemble = max(1, int(ensemble))
         self.decode_chunk = max(1, decode_chunk)
         self.n_slots = max(1, n_slots)
         self.max_pending = max(1, max_pending)
@@ -216,6 +242,18 @@ class InferenceEngine:
         self._use_sp = dict(self.mesh.shape).get(AXIS_SP, 1) > 1
         if self._use_sp:
             self.prefill_chunk = 0
+        if self.ensemble > 1:
+            if self._use_sp:
+                raise ValueError(
+                    "ensemble decoding does not compose with sp>1 "
+                    "(ring attention inside the member vmap)")
+            if self.quant:
+                raise ValueError(
+                    "ensemble decoding with quant=int8 is not supported yet")
+            if params is not None:
+                raise ValueError(
+                    "ensemble members are seeded random inits; a checkpoint "
+                    "provides only one weight set")
         # Automatic prefix caching (zero-copy): each slot remembers the token
         # sequence whose K/V its cache rows still hold; a new request admits
         # into the free slot with the longest common prefix and prefills only
@@ -227,7 +265,12 @@ class InferenceEngine:
         self._resident: list[list[int]] = [[] for _ in range(self.n_slots)]
         self.prefix_hits = 0
         self.prefix_tokens_saved = 0
-        if params is not None:
+        if self.ensemble > 1:
+            from quorum_tpu.models.init import init_params_ensemble_sharded
+
+            self.params = init_params_ensemble_sharded(
+                spec, self.mesh, [seed + i for i in range(self.ensemble)])
+        elif params is not None:
             self.params = shard_pytree(self.mesh, params)
             if self.quant == "int8":
                 # Requantize in place: inputs donated, each bf16 leaf's
@@ -248,6 +291,11 @@ class InferenceEngine:
             # bf16 weights alone are ~14 GB of a v5e's 16 GB HBM).
             self.params = init_params_sharded(spec, self.mesh, seed)
         self._cache_sh = kv_cache_sharding(self.mesh, spec.n_kv_heads, batch=self.n_slots)
+        if self.ensemble > 1:
+            # member-stacked cache [M, L, S, K, T, hd]: member axis vmapped,
+            # never sharded
+            self._cache_sh = NamedSharding(
+                self.mesh, P(*((None,) + tuple(self._cache_sh.spec))))
         self._rep = NamedSharding(self.mesh, P())
         self._init_device_state()
 
@@ -282,9 +330,17 @@ class InferenceEngine:
         The cache is allocated by a compiled zero-fill — no host-side
         materialization or transfer of the multi-GB buffer.
         """
+        ens = self.ensemble
+
+        def zero_cache():
+            ck, cv = init_cache(self.spec, batch=self.n_slots)
+            if ens > 1:
+                ck = jnp.zeros((ens,) + ck.shape, ck.dtype)
+                cv = jnp.zeros((ens,) + cv.shape, cv.dtype)
+            return ck, cv
+
         self._ck, self._cv = jax.jit(
-            lambda: init_cache(self.spec, batch=self.n_slots),
-            out_shardings=(self._cache_sh, self._cache_sh),
+            zero_cache, out_shardings=(self._cache_sh, self._cache_sh),
         )()
         s = self.n_slots
         rep = self._rep
@@ -318,13 +374,18 @@ class InferenceEngine:
 
         mesh = self.mesh if self._use_sp else None
         n_top = min(TOP_LOGPROBS, spec.vocab_size)
+        ens = self.ensemble
 
         def admit(params, tokens, lengths1, slot, seed, temp1, topp1, topk1,
                   pp1, fp1, bias_row,
                   ck, cv, token_s, lengths_s, keys_s, temp_s, topp_s, topk_s,
                   pp_s, fp_s, counts_s, bias_s):
-            logits, ck, cv = prefill(
-                params, spec, tokens, lengths1, ck, cv, slot=slot, mesh=mesh
+            # mesh is None whenever ens > 1 (sp is rejected with ensembles)
+            logits, ck, cv = _member_call(
+                ens,
+                lambda p, k, v: prefill(
+                    p, spec, tokens, lengths1, k, v, slot=slot, mesh=mesh),
+                params, ck, cv,
             )
             # First sampled token: no generated text yet → penalties are
             # zero; only the logit bias applies.
@@ -376,11 +437,15 @@ class InferenceEngine:
         if fn is not None:
             return fn
         spec = self.spec
+        ens = self.ensemble
 
         def seg(params, tokens, offset, n_valid, slot, ck, cv):
-            return prefill_segment(
-                params, spec, tokens, offset, n_valid, ck, cv, slot,
-                history=history,
+            return _member_call(
+                ens,
+                lambda p, k, v: prefill_segment(
+                    p, spec, tokens, offset, n_valid, k, v, slot,
+                    history=history),
+                params, ck, cv, mean=False,
             )
 
         fn = jax.jit(seg, donate_argnames=("ck", "cv"))
@@ -450,6 +515,7 @@ class InferenceEngine:
 
         n_top = min(TOP_LOGPROBS, spec.vocab_size)
         n_slots = self.n_slots
+        ens = self.ensemble
 
         def chunk(params, active, ck, cv, token_s, lengths_s, keys_s,
                   temp_s, topp_s, topk_s, pp_s, fp_s, counts_s, bias_s):
@@ -462,9 +528,12 @@ class InferenceEngine:
                 # not have its freshly prefilled cache clobbered by the dummy
                 # position-0 write.
                 pos = jnp.where(live, lens, 0)
-                logits, ck, cv = decode_step(
-                    params, spec, tok, pos, ck, cv, write_mask=live,
-                    history=history,
+                logits, ck, cv = _member_call(
+                    ens,
+                    lambda p, k, v: decode_step(
+                        p, spec, tok, pos, k, v, write_mask=live,
+                        history=history),
+                    params, ck, cv,
                 )
                 # OpenAI sampling knobs, applied per row on the f32 logits:
                 # logit_bias adds; presence/frequency penalties subtract
@@ -532,14 +601,18 @@ class InferenceEngine:
             return fn
         spec = self.spec
         n_slots = self.n_slots
+        ens = self.ensemble
 
         def verify(params, active, tokens, ck, cv, token_s, lengths_s, keys_s,
                    temp_s, topp_s, topk_s, counts_s):
             live = active > 0
             pos = jnp.where(live, lengths_s, 0)
-            logits, ck, cv = decode_multi(
-                params, spec, tokens, pos, ck, cv, write_mask=live,
-                history=history,
+            logits, ck, cv = _member_call(
+                ens,
+                lambda p, k, v: decode_multi(
+                    p, spec, tokens, pos, k, v, write_mask=live,
+                    history=history),
+                params, ck, cv,
             )  # [S, g+1, V]
             split = jax.vmap(jax.random.split)(keys_s)
             s0 = sample_token_rows(
@@ -1206,8 +1279,10 @@ def get_engine(
     spec_decode: int = 0,
     quant: str | None = None,
     prefix_cache: bool = True,
+    ensemble: int = 1,
 ) -> InferenceEngine:
-    """Engines are keyed by weight identity (spec, seed, mesh, quant) ONLY —
+    """Engines are keyed by weight identity (spec, seed, mesh, quant,
+    ensemble) ONLY —
     dispatch knobs like decode_chunk are per-call, so two backends that differ
     only in chunking share one set of weights on device. ``n_slots``/
     ``prefill_chunk``/``max_pending`` (structural properties of the
@@ -1218,7 +1293,8 @@ def get_engine(
     ``prefix_cache=0`` from ANY backend disables reuse on the shared engine
     (an explicit opt-out wins over a sharing default)."""
     mesh = mesh or single_device_mesh()
-    key = (spec, seed, quant or None, tuple(sorted(mesh.shape.items())),
+    key = (spec, seed, quant or None, max(1, int(ensemble)),
+           tuple(sorted(mesh.shape.items())),
            tuple(map(str, mesh.devices.flat)))
     with _ENGINES_LOCK:
         eng = _ENGINES.get(key)
@@ -1227,7 +1303,7 @@ def get_engine(
                 spec, mesh, seed=seed, n_slots=n_slots,
                 prefill_chunk=prefill_chunk, max_pending=max_pending,
                 spec_decode=spec_decode, quant=quant,
-                prefix_cache=prefix_cache,
+                prefix_cache=prefix_cache, ensemble=ensemble,
             )
             _ENGINES[key] = eng
         else:
@@ -1248,13 +1324,23 @@ def get_engine_from_ckpt(
     spec_decode: int = 0,
     quant: str | None = None,
     prefix_cache: bool = True,
+    ensemble: int = 1,
 ) -> InferenceEngine:
     """Engine over a local HF checkpoint; keyed by (resolved path, mesh) so N
-    backends pointing at one checkpoint share the loaded weights on device."""
+    backends pointing at one checkpoint share the loaded weights on device.
+    ``ensemble`` > 1 is rejected (members are seeded random inits; a
+    checkpoint provides one weight set)."""
     import os
 
     from quorum_tpu.models.hf_loader import load_hf_checkpoint
 
+    if ensemble > 1:
+        # Reject before touching the multi-GB checkpoint (and before the
+        # cache lookup — a warm single-model engine must not silently serve
+        # a URL that asked for an ensemble).
+        raise ValueError(
+            "ensemble members are seeded random inits; a checkpoint "
+            "provides only one weight set")
     mesh = mesh or single_device_mesh()
     resolved = os.path.realpath(ckpt_path)
     # Normalize: dtype=None and an explicit dtype equal to the default must
@@ -1271,7 +1357,7 @@ def get_engine_from_ckpt(
                 spec, mesh, params=params, n_slots=n_slots,
                 prefill_chunk=prefill_chunk, max_pending=max_pending,
                 spec_decode=spec_decode, quant=quant,
-                prefix_cache=prefix_cache,
+                prefix_cache=prefix_cache, ensemble=ensemble,
             )
             _ENGINES[key] = eng
         else:

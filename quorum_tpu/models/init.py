@@ -17,9 +17,14 @@ from quorum_tpu.models.transformer import Params
 
 
 def init_params(spec: ModelSpec, seed: int = 0) -> Params:
+    return init_params_from_key(spec, jax.random.PRNGKey(seed))
+
+
+def init_params_from_key(spec: ModelSpec, key) -> Params:
+    """Init from a PRNG key (traced-friendly: vmappable over stacked keys —
+    how ensemble members materialize directly into their [M, …] slices)."""
     spec.validate()
     dt = jnp.dtype(spec.dtype)
-    key = jax.random.PRNGKey(seed)
     keys = iter(jax.random.split(key, 32))
 
     def w(k, *shape, fan_in=None):
@@ -91,6 +96,27 @@ def init_params_sharded(spec: ModelSpec, mesh, seed: int = 0) -> Params:
     return jax.jit(
         lambda: init_params(spec, seed), out_shardings=shardings
     )()
+
+
+def init_params_ensemble_sharded(
+    spec: ModelSpec, mesh, seeds: list[int]
+) -> Params:
+    """Member-stacked parameters ``[M, …]`` for on-device logit-ensemble
+    decoding (engine ``ensemble=N``): each member is an independent seeded
+    init, vmapped over stacked PRNG keys so every leaf materializes directly
+    into its ``[M, …]`` slice — no per-member temporaries + stack copy
+    (which would transiently need ~2× the ensemble's weight HBM). The
+    member axis is replicated (vmapped, never communicated)."""
+    from quorum_tpu.parallel.sharding import param_shardings
+
+    keys = jnp.stack([jax.random.PRNGKey(s) for s in seeds])
+
+    def build(ks) -> Params:
+        return jax.vmap(lambda k: init_params_from_key(spec, k))(ks)
+
+    shapes = jax.eval_shape(build, keys)
+    shardings = param_shardings(mesh, shapes, lead_axes=1)
+    return jax.jit(build, out_shardings=shardings)(keys)
 
 
 def param_count(params: Params) -> int:

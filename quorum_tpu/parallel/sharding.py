@@ -99,14 +99,20 @@ ACT_AXES: tuple[str | None, ...] = ("batch", "seq", "model")
 TOKEN_AXES: tuple[str | None, ...] = ("batch", "seq")
 
 
-def param_partition_specs(params: Mapping[str, Any]) -> dict[str, Any]:
-    """PartitionSpec pytree matching a parameter pytree (same nesting)."""
+def param_partition_specs(
+    params: Mapping[str, Any], lead_axes: int = 0
+) -> dict[str, Any]:
+    """PartitionSpec pytree matching a parameter pytree (same nesting).
+
+    ``lead_axes`` prepends that many replicated dims to every leaf's spec —
+    used for member-stacked ensemble params ``[M, …]`` (the member axis is
+    vmapped, never sharded)."""
 
     def spec_for(name: str) -> P:
         axes = PARAM_LOGICAL_AXES.get(name)
         if axes is None:
             return P()  # unknown leaf → replicate
-        return logical_to_spec(axes)
+        return P(*((None,) * lead_axes + tuple(logical_to_spec(axes))))
 
     def walk(tree: Mapping[str, Any]) -> dict[str, Any]:
         out: dict[str, Any] = {}
@@ -142,8 +148,10 @@ def _fit_spec(spec: P, shape: tuple[int, ...], mesh: Mesh) -> P:
     return P(*fitted)
 
 
-def param_shardings(mesh: Mesh, params: Mapping[str, Any]) -> dict[str, Any]:
-    specs = param_partition_specs(params)
+def param_shardings(
+    mesh: Mesh, params: Mapping[str, Any], lead_axes: int = 0
+) -> dict[str, Any]:
+    specs = param_partition_specs(params, lead_axes)
     return jax.tree.map(
         lambda x, s: None if x is None else NamedSharding(mesh, _fit_spec(s, x.shape, mesh)),
         dict(params),
