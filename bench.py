@@ -483,9 +483,47 @@ async def main() -> None:
     }))
 
 
+def _watchdog(prefix: str | None) -> None:
+    """Guarantee ONE JSON line even if the device never comes up.
+
+    The axon TPU tunnel can wedge such that the first jax operation blocks
+    forever (observed twice during round-3 builds); without a watchdog the
+    whole bench would hang and the driver would record nothing. The budget
+    covers a full legitimate run (two 7B subprocesses ≤ 3000 s each + the
+    socket phases); only a true hang trips it. A 7B child (``prefix``) emits
+    its phase-scoped error key — never the parent's top-level schema, which
+    would clobber the parent's real phase-1/2 numbers when merged."""
+    import threading
+
+    try:
+        budget = int(os.environ.get("QUORUM_TPU_BENCH_WATCHDOG", "7200"))
+    except ValueError:
+        budget = 7200  # a malformed env var must not kill the guarantee
+    if budget <= 0:
+        return
+
+    def bark():
+        msg = (f"stalled for {budget}s — device init or a phase hung "
+               "(wedged TPU tunnel?)")
+        if prefix:
+            out = {f"{prefix}_error": msg}
+        else:
+            out = {"metric": "p50_ttft_ms", "value": -1.0, "unit": "ms",
+                   "vs_baseline": 0.0, "error": f"bench {msg}"}
+        print(json.dumps(out), flush=True)
+        os._exit(3)
+
+    t = threading.Timer(budget, bark)
+    t.daemon = True
+    t.start()
+
+
 if __name__ == "__main__":
     if "--7bq" in sys.argv:
+        _watchdog("b7q")
         sys.exit(asyncio.run(seven_b_main(quant=True)))
     if "--7b" in sys.argv:
+        _watchdog("b7")
         sys.exit(asyncio.run(seven_b_main(quant=False)))
+    _watchdog(None)
     sys.exit(asyncio.run(main()))

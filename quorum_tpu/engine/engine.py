@@ -70,6 +70,8 @@ TOP_LOGPROBS = 20  # top alternatives computed per step (OpenAI's API maximum)
 # is at least this long — shorter matches aren't worth routing through the
 # segment path (whose first token costs one extra decode-chunk boundary).
 MIN_PREFIX_REUSE = 16
+_CKPT_ENSEMBLE_ERROR = ("ensemble members are seeded random inits; a "
+                        "checkpoint provides only one weight set")
 
 
 class QueueFullError(Exception):
@@ -251,9 +253,7 @@ class InferenceEngine:
                 raise ValueError(
                     "ensemble decoding with quant=int8 is not supported yet")
             if params is not None:
-                raise ValueError(
-                    "ensemble members are seeded random inits; a checkpoint "
-                    "provides only one weight set")
+                raise ValueError(_CKPT_ENSEMBLE_ERROR)
         # Automatic prefix caching (zero-copy): each slot remembers the token
         # sequence whose K/V its cache rows still hold; a new request admits
         # into the free slot with the longest common prefix and prefills only
@@ -1338,9 +1338,7 @@ def get_engine_from_ckpt(
         # Reject before touching the multi-GB checkpoint (and before the
         # cache lookup — a warm single-model engine must not silently serve
         # a URL that asked for an ensemble).
-        raise ValueError(
-            "ensemble members are seeded random inits; a checkpoint "
-            "provides only one weight set")
+        raise ValueError(_CKPT_ENSEMBLE_ERROR)
     mesh = mesh or single_device_mesh()
     resolved = os.path.realpath(ckpt_path)
     # Normalize: dtype=None and an explicit dtype equal to the default must
