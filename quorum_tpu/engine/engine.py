@@ -249,9 +249,6 @@ class InferenceEngine:
                 raise ValueError(
                     "ensemble decoding does not compose with sp>1 "
                     "(ring attention inside the member vmap)")
-            if self.quant:
-                raise ValueError(
-                    "ensemble decoding with quant=int8 is not supported yet")
             if params is not None:
                 raise ValueError(_CKPT_ENSEMBLE_ERROR)
         # Automatic prefix caching (zero-copy): each slot remembers the token
@@ -268,8 +265,12 @@ class InferenceEngine:
         if self.ensemble > 1:
             from quorum_tpu.models.init import init_params_ensemble_sharded
 
+            # quant composes: the stacked tree quantizes per member inside
+            # the init program (models/init.py) and qeinsum sees each
+            # member's own int8 leaves under the vmap.
             self.params = init_params_ensemble_sharded(
-                spec, self.mesh, [seed + i for i in range(self.ensemble)])
+                spec, self.mesh, [seed + i for i in range(self.ensemble)],
+                quant=self.quant)
         elif params is not None:
             self.params = shard_pytree(self.mesh, params)
             if self.quant == "int8":

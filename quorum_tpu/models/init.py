@@ -99,20 +99,30 @@ def init_params_sharded(spec: ModelSpec, mesh, seed: int = 0) -> Params:
 
 
 def init_params_ensemble_sharded(
-    spec: ModelSpec, mesh, seeds: list[int]
+    spec: ModelSpec, mesh, seeds: list[int], quant: str | None = None
 ) -> Params:
     """Member-stacked parameters ``[M, …]`` for on-device logit-ensemble
     decoding (engine ``ensemble=N``): each member is an independent seeded
     init, vmapped over stacked PRNG keys so every leaf materializes directly
     into its ``[M, …]`` slice — no per-member temporaries + stack copy
     (which would transiently need ~2× the ensemble's weight HBM). The
-    member axis is replicated (vmapped, never communicated)."""
+    member axis is replicated (vmapped, never communicated).
+
+    ``quant="int8"`` fuses per-member quantization into the same program
+    (scales reduce over the contraction axis, so the stacked tree's scales
+    are exactly each member's own) — two int8 7B members fit one 16 GB
+    chip, a consensus ensemble a single device could never hold in bf16."""
     from quorum_tpu.parallel.sharding import param_shardings
 
     keys = jnp.stack([jax.random.PRNGKey(s) for s in seeds])
 
     def build(ks) -> Params:
-        return jax.vmap(lambda k: init_params_from_key(spec, k))(ks)
+        params = jax.vmap(lambda k: init_params_from_key(spec, k))(ks)
+        if quant == "int8":
+            from quorum_tpu.models.quant import quantize_params
+
+            params = quantize_params(params)
+        return params
 
     shapes = jax.eval_shape(build, keys)
     shardings = param_shardings(mesh, shapes, lead_axes=1)

@@ -19,9 +19,12 @@ SPEC = resolve_spec("llama-tiny", {"max_seq": "64"})
 GREEDY = SamplerConfig(temperature=0.0)
 
 
-def _manual_ensemble_rollout(seeds, prompt, n_new):
-    """Reference: full-context forward per member, average logits, argmax."""
+def _manual_ensemble_rollout(seeds, prompt, n_new, transform=None):
+    """Reference: full-context forward per member, average logits, argmax.
+    ``transform`` (e.g. quantize_params) applies to each member's params."""
     members = [init_params(SPEC, s) for s in seeds]
+    if transform is not None:
+        members = [transform(p) for p in members]
     seq = list(prompt)
     out = []
     for _ in range(n_new):
@@ -77,10 +80,23 @@ def test_ensemble_url_knob_and_rejections():
         name="E", url="tpu://llama-tiny?ensemble=2&max_seq=64&seed=5",
         model="m"))
     assert be.engine.ensemble == 2
-    with pytest.raises(ValueError, match="quant"):
-        InferenceEngine(SPEC, ensemble=2, quant="int8")
     with pytest.raises(ValueError, match="one weight set"):
         InferenceEngine(SPEC, ensemble=2, params=init_params(SPEC, 0))
+
+
+def test_ensemble_composes_with_int8():
+    """quant=int8 + ensemble=M: each member quantizes independently inside
+    the stacked init; the consensus equals manually averaging the two
+    QUANTIZED members' logits."""
+    from quorum_tpu.models.quant import quantize_params
+
+    eng = InferenceEngine(SPEC, decode_chunk=4, ensemble=2, seed=0,
+                          quant="int8")
+    prompt = [3, 5, 7, 11]
+    got = eng.generate(prompt, max_new_tokens=5, sampler=GREEDY).token_ids
+    want = _manual_ensemble_rollout([0, 1], prompt, 5,
+                                    transform=quantize_params)
+    assert got == want, (got, want)
 
 
 def test_ckpt_ensemble_rejected_before_load():
