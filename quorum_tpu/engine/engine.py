@@ -314,6 +314,7 @@ class InferenceEngine:
         self.n_requests = 0
         self.n_tokens = 0
         self.n_failures = 0
+        self.n_overlapped = 0  # decode chunks dispatched ahead of the read
         self._stop = False
         self._thread = threading.Thread(
             target=self._scheduler, name=f"engine-{id(self):x}", daemon=True
@@ -813,6 +814,7 @@ class InferenceEngine:
                 "failures_total": self.n_failures,
                 "prefix_hits_total": self.prefix_hits,
                 "prefix_tokens_saved_total": self.prefix_tokens_saved,
+                "overlapped_chunks_total": self.n_overlapped,
             }
 
     def shutdown(self, timeout: float = 30.0) -> None:
@@ -1093,6 +1095,7 @@ class InferenceEngine:
                 # tokens behind a full XLA compile
                 and (n_steps, want_lp, history2) in self._decode_cache):
             payload2 = self._dispatch_chunk(mask, n_steps, want_lp, history2)
+            self.n_overlapped += 1
         done = self._emit_chunk(active, payload1, set())
         if payload2 is not None:
             done |= self._emit_chunk(active, payload2, done)
