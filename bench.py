@@ -111,10 +111,19 @@ def build_app():
     from quorum_tpu.config import Config
     from quorum_tpu.server.app import create_app
 
+    # Stacked fan-out (members=3): the three quorum members share one engine
+    # whose every decode chunk advances all of them in a single dispatch —
+    # same weights/tokens as three separate seed=i engines (pinned by
+    # tests/test_members.py), ~1/3 the host dispatch overhead.
+    # QUORUM_TPU_BENCH_STACKED=0 restores the three-engine shape.
+    stacked = os.environ.get("QUORUM_TPU_BENCH_STACKED", "1") != "0"
+    member = (lambda i: f"members=3&member={i}") if stacked else (
+        lambda i: f"seed={i}")
     raw = {
         "settings": {"timeout": 600},
         "primary_backends": [
-            {"name": f"LLM{i}", "url": f"tpu://{MODEL}?seed={i}&max_tokens={MAX_TOKENS}",
+            {"name": f"LLM{i}",
+             "url": f"tpu://{MODEL}?{member(i)}&max_tokens={MAX_TOKENS}",
              "model": MODEL}
             for i in range(3)
         ],

@@ -39,6 +39,16 @@ URL grammar:  ``tpu://<model-id>?<spec overrides>&<engine options>``
                    ensemble (one consensus completion), vs the strategy
                    layer's text-level concatenation/aggregation of M
                    separate completions
+  members=M        stacked fan-out (default 1 = off): backends whose URLs
+  member=i         agree on ``members=M`` (and the base seed/spec) share ONE
+                   engine holding M independently-seeded weight sets
+                   (seed..seed+M-1) stacked [M, …] on device; ``member=i``
+                   selects which weight set serves THIS backend. Each member
+                   keeps its own slots/sampler state and produces its own
+                   stream (unlike ``ensemble``), but every decode chunk —
+                   and coalesced same-bucket admissions — advance ALL
+                   members in one dispatch: an N-model quorum pays N× the
+                   compute, not N× the per-chunk host turnaround
   prefix_cache=0   disable automatic prefix caching (default on): a request
                    whose prompt prefix is already resident in a free slot's
                    KV cache admits into that slot and prefills only the
@@ -65,6 +75,7 @@ from quorum_tpu.engine.engine import (
     DEFAULT_MAX_PENDING,
     DEFAULT_PREFILL_CHUNK,
     DEFAULT_SLOTS,
+    _CKPT_MEMBERS_ERROR,
     GenerationResult,
     InferenceEngine,
     QueueFullError,
@@ -197,9 +208,13 @@ class TpuBackend:
         decode_chunk: int | None = None,
         tokenizer_path: str | None = None,
         rng_offset: int = 0,
+        member: int = 0,
     ):
         self.name = name
         self.engine = engine
+        # Stacked-members engine: which of the engine's weight sets serves
+        # this backend's requests (0 on ordinary engines).
+        self.member = member
         self.model_id = model_id or "tpu-model"
         self.model = model or self.model_id
         self.default_max_tokens = default_max_tokens
@@ -225,6 +240,11 @@ class TpuBackend:
         tokenizer_path = None
         rng_offset = 0
         n_slots = int(opts.get("slots", DEFAULT_SLOTS))
+        members = int(opts.get("members", 1))
+        member = int(opts.get("member", 0))
+        if not 0 <= member < max(1, members):
+            raise ValueError(
+                f"member={member} out of range for members={members}")
         eng_kw = dict(
             n_slots=n_slots,
             prefill_chunk=int(opts.get("prefill_chunk", DEFAULT_PREFILL_CHUNK)),
@@ -235,6 +255,13 @@ class TpuBackend:
                 "prefix_cache", opts.get("prefix_cache", "1")),
             ensemble=int(opts.get("ensemble", 1)),
         )
+        if ckpt and members > 1:
+            # Checked here (not just in the engine): ckpt engines are keyed
+            # without members, so a stacked URL would otherwise construct a
+            # members=1 engine and fail per-request instead of at config.
+            raise ValueError(
+                f"members=N does not apply to ckpt= backends "
+                f"({_CKPT_MEMBERS_ERROR}; use seed= for sampling diversity)")
         if ckpt:
             # seed= still differentiates ensemble members: it offsets the
             # sampling RNG (weights are shared — one checkpoint on device).
@@ -254,7 +281,8 @@ class TpuBackend:
         else:
             spec = resolve_spec(model_id, opts)
             engine = get_engine(
-                spec, mesh, seed=int(opts.get("seed", 0)), **eng_kw
+                spec, mesh, seed=int(opts.get("seed", 0)), members=members,
+                **eng_kw
             )
         return cls(
             bspec.name,
@@ -265,6 +293,7 @@ class TpuBackend:
             decode_chunk=int(opts["decode_chunk"]) if "decode_chunk" in opts else None,
             tokenizer_path=tokenizer_path,
             rng_offset=rng_offset,
+            member=member,
         )
 
     # ---- request plumbing -------------------------------------------------
@@ -406,6 +435,7 @@ class TpuBackend:
             frequency_penalty=plan["frequency_penalty"],
             logit_bias=plan["logit_bias"],
             logprobs=plan["logprobs"],
+            member=self.member,
         )
 
     def _lp_entry(self, tid: int, record, top_n: int) -> dict[str, Any]:

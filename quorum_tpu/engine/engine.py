@@ -72,6 +72,8 @@ TOP_LOGPROBS = 20  # top alternatives computed per step (OpenAI's API maximum)
 MIN_PREFIX_REUSE = 16
 _CKPT_ENSEMBLE_ERROR = ("ensemble members are seeded random inits; a "
                         "checkpoint provides only one weight set")
+_CKPT_MEMBERS_ERROR = ("stacked members are seeded random inits; a "
+                       "checkpoint provides only one weight set")
 
 
 class QueueFullError(Exception):
@@ -123,11 +125,12 @@ class _Request:
     __slots__ = (
         "prompt_ids", "budget", "temperature", "top_p", "top_k", "seed",
         "eos_id", "cancel", "chunk_hint", "out", "emitted",
-        "pp", "fp", "bias_row", "want_lp", "lp", "hist", "ngram",
+        "pp", "fp", "bias_row", "want_lp", "lp", "hist", "ngram", "member",
     )
 
     def __init__(self, prompt_ids, budget, sampler: SamplerConfig, seed, eos_id,
-                 cancel, chunk_hint, pp=0.0, fp=0.0, bias_row=None, want_lp=-1):
+                 cancel, chunk_hint, pp=0.0, fp=0.0, bias_row=None, want_lp=-1,
+                 member=0):
         self.prompt_ids = prompt_ids
         self.budget = budget
         self.temperature = sampler.temperature
@@ -143,6 +146,7 @@ class _Request:
         self.fp = fp                  # frequency_penalty
         self.bias_row = bias_row      # np [V] f32 logit_bias, or None
         self.want_lp = want_lp        # -1 = no logprobs; else #top alternatives
+        self.member = member          # stacked-members engine: weight set index
         self.lp: list = []
         # Prompt-lookup drafting state: the running token history and an
         # incrementally-maintained 2-gram → position index ("lagged": a pair
@@ -203,6 +207,7 @@ class InferenceEngine:
         quant: str | None = None,
         prefix_cache: bool = True,
         ensemble: int = 1,
+        members: int = 1,
     ):
         self.spec = spec.validate()
         self.mesh = mesh or single_device_mesh()
@@ -218,9 +223,24 @@ class InferenceEngine:
         # where members are separate HTTP services whose finished texts can
         # only be concatenated or re-summarized.
         self.ensemble = max(1, int(ensemble))
+        # Stacked fan-out members: M independently-seeded weight sets serve
+        # M *separate* streams from ONE set of compiled programs — params and
+        # KV caches carry a leading member axis ([M, …], model calls vmapped
+        # over it), and every decode chunk advances all members' active slots
+        # in a single dispatch. This is what makes an N-model quorum on one
+        # chip cost N× the *compute*, not N× the dispatch: three co-located
+        # engines each pay their own host turnaround per chunk, while a
+        # stacked engine pays one. (Distinct from ``ensemble``, which decodes
+        # ONE consensus stream from averaged logits.) The reference cannot
+        # express this at all — its "members" are separate HTTP services
+        # (/root/reference/src/quorum/oai_proxy.py:182-192).
+        self.members = max(1, int(members))
         self.decode_chunk = max(1, decode_chunk)
         self.n_slots = max(1, n_slots)
-        self.max_pending = max(1, max_pending)
+        # Queue capacity scales with members: a stacked engine absorbs the
+        # whole fan-out's admissions in ONE queue, so M members must carry
+        # the aggregate capacity M separate engines would have had.
+        self.max_pending = max(1, max_pending) * max(1, int(members))
         # Speculative decoding draft length (0 = off): when every active
         # request is greedy_clean, each dispatch verifies spec_decode
         # prompt-lookup draft tokens in one multi-token forward.
@@ -251,6 +271,22 @@ class InferenceEngine:
                     "(ring attention inside the member vmap)")
             if params is not None:
                 raise ValueError(_CKPT_ENSEMBLE_ERROR)
+        if self.members > 1:
+            if self.ensemble > 1:
+                raise ValueError(
+                    "members (stacked fan-out streams) and ensemble "
+                    "(consensus decoding) are mutually exclusive")
+            if self._use_sp:
+                raise ValueError(
+                    "members does not compose with sp>1 "
+                    "(ring attention inside the member vmap)")
+            if params is not None:
+                raise ValueError(_CKPT_MEMBERS_ERROR)
+            # v1 restrictions: admission is single-shot (the coalesced
+            # member-vmapped prefill), so chunked prefill / prefix caching /
+            # speculative verification are disabled on stacked engines.
+            self.prefill_chunk = 0
+            self.spec_decode = 0
         # Automatic prefix caching (zero-copy): each slot remembers the token
         # sequence whose K/V its cache rows still hold; a new request admits
         # into the free slot with the longest common prefix and prefills only
@@ -259,10 +295,22 @@ class InferenceEngine:
         # conversations re-send their whole history; the repeated prefix
         # costs nothing on device.
         self.prefix_cache = bool(prefix_cache) and self.prefill_chunk > 0
-        self._resident: list[list[int]] = [[] for _ in range(self.n_slots)]
+        # Host-side slot space is FLAT across members: row m·n_slots + s is
+        # member m's slot s. With members == 1 this is exactly the slot axis.
+        self._rows = self.members * self.n_slots
+        self._resident: list[list[int]] = [[] for _ in range(self._rows)]
         self.prefix_hits = 0
         self.prefix_tokens_saved = 0
-        if self.ensemble > 1:
+        if self.members > 1:
+            from quorum_tpu.models.init import init_params_ensemble_sharded
+
+            # Same stacked-init program as ensembles ([M, …] leaves, one
+            # seed per member, quant applied per member inside the init);
+            # only the *decode semantics* differ (separate streams, no mean).
+            self.params = init_params_ensemble_sharded(
+                spec, self.mesh, [seed + i for i in range(self.members)],
+                quant=self.quant)
+        elif self.ensemble > 1:
             from quorum_tpu.models.init import init_params_ensemble_sharded
 
             # quant composes: the stacked tree quantizes per member inside
@@ -292,7 +340,7 @@ class InferenceEngine:
             # bf16 weights alone are ~14 GB of a v5e's 16 GB HBM).
             self.params = init_params_sharded(spec, self.mesh, seed)
         self._cache_sh = kv_cache_sharding(self.mesh, spec.n_kv_heads, batch=self.n_slots)
-        if self.ensemble > 1:
+        if self.ensemble > 1 or self.members > 1:
             # member-stacked cache [M, L, S, K, T, hd]: member axis vmapped,
             # never sharded
             self._cache_sh = NamedSharding(
@@ -305,7 +353,7 @@ class InferenceEngine:
 
         # Scheduler state, guarded by _cond's lock.
         self._pending: list[_Request] = []
-        self._slots: list[_Request | None] = [None] * self.n_slots
+        self._slots: list[_Request | None] = [None] * self._rows
         self._admitting: list[_Admission] = []
         self._claimed: set[int] = set()  # slots held by in-progress admissions
         self._cond = threading.Condition()
@@ -332,19 +380,19 @@ class InferenceEngine:
         The cache is allocated by a compiled zero-fill — no host-side
         materialization or transfer of the multi-GB buffer.
         """
-        ens = self.ensemble
+        stacked = max(self.ensemble, self.members)
 
         def zero_cache():
             ck, cv = init_cache(self.spec, batch=self.n_slots)
-            if ens > 1:
-                ck = jnp.zeros((ens,) + ck.shape, ck.dtype)
-                cv = jnp.zeros((ens,) + cv.shape, cv.dtype)
+            if stacked > 1:
+                ck = jnp.zeros((stacked,) + ck.shape, ck.dtype)
+                cv = jnp.zeros((stacked,) + cv.shape, cv.dtype)
             return ck, cv
 
         self._ck, self._cv = jax.jit(
             zero_cache, out_shardings=(self._cache_sh, self._cache_sh),
         )()
-        s = self.n_slots
+        s = self._rows
         rep = self._rep
         self._token = jax.device_put(np.zeros((s,), np.int32), rep)
         self._lengths = jax.device_put(np.zeros((s,), np.int32), rep)
@@ -364,6 +412,11 @@ class InferenceEngine:
             out_shardings=(self._rep, self._rep),
         )()
         self._zero_bias = np.zeros((v,), np.float32)
+        if self.members > 1:
+            # Shared zero logit-bias template for coalesced member
+            # admissions — copied only when a request actually sets
+            # logit_bias (the _zero_bias copy-on-write convention).
+            self._zero_bias_mem = np.zeros((self.members, v), np.float32)
 
     # ---- compiled programs ------------------------------------------------
 
@@ -428,6 +481,81 @@ class InferenceEngine:
             ),
         )
         self._admit_cache[bucket] = fn
+        return fn
+
+    def _admit_fn_members(self, bucket: int):
+        """Jitted coalesced admission for a stacked-members engine: up to one
+        prompt PER member prefills into one shared slot row in a single
+        member-vmapped program. The quorum fan-out pattern submits the same
+        request to every member within microseconds, so admissions naturally
+        arrive in member-complete groups and the M prefills share one
+        dispatch. ``enables[m]`` gates member m's cache write (see
+        transformer.prefill's ``write_gate``) and state update, so a
+        partially-filled group (or a lone admission) runs the same compiled
+        program without touching absent members' rows."""
+        fn = self._admit_cache.get(("members", bucket))
+        if fn is not None:
+            return fn
+        spec = self.spec
+        n_top = min(TOP_LOGPROBS, spec.vocab_size)
+        n_s = self.n_slots
+        mem = self.members
+
+        def admit(params, tokens, lengths, slot, enables, seeds,
+                  temps, topps, topks, pps, fps, bias_rows,
+                  ck, cv, token_s, lengths_s, keys_s, temp_s, topp_s, topk_s,
+                  pp_s, fp_s, counts_s, bias_s):
+            # tokens [M, 1, bucket]; lengths [M, 1]; slot scalar int32;
+            # enables [M] bool; sampler knobs [M]; bias_rows [M, V].
+            def one(p, tok, lens, k, v, gate):
+                return prefill(p, spec, tok, lens, k, v, slot=slot,
+                               write_gate=gate)
+
+            logits, ck, cv = jax.vmap(one)(
+                params, tokens, lengths, ck, cv, enables)
+            adj = logits[:, 0].astype(jnp.float32) + bias_rows  # [M, V]
+            # Same PRNG stream as the single-model admit: sample the first
+            # token with split row 1, carry row 0 — a member's stream is
+            # token-for-token the stream a members=1 engine with that
+            # member's seed would produce.
+            keys = jax.vmap(jax.random.PRNGKey)(seeds)          # [M, 2]
+            split = jax.vmap(jax.random.split)(keys)            # [M, 2, 2]
+            firsts = sample_token_rows(adj, split[:, 1], temps, topps, topks)
+            lp_all = jax.nn.log_softmax(adj)
+            top_lp, top_ix = lax.top_k(lp_all, n_top)
+            s_lp = jnp.take_along_axis(lp_all, firsts[:, None], 1)[:, 0]
+            rows = slot + n_s * jnp.arange(mem)  # flat state row per member
+
+            def upd(arr, vals):
+                en = enables.reshape((mem,) + (1,) * (vals.ndim - 1))
+                return arr.at[rows].set(jnp.where(en, vals, arr[rows]))
+
+            counts_rows = jnp.zeros(
+                (mem, spec.vocab_size), jnp.int32
+            ).at[jnp.arange(mem), firsts].set(1)
+            return (
+                firsts, s_lp, top_ix, top_lp, ck, cv,
+                upd(token_s, firsts),
+                upd(lengths_s, lengths[:, 0]),
+                upd(keys_s, split[:, 0]),
+                upd(temp_s, temps),
+                upd(topp_s, topps),
+                upd(topk_s, topks),
+                upd(pp_s, pps),
+                upd(fp_s, fps),
+                upd(counts_s, counts_rows),
+                upd(bias_s, bias_rows),
+            )
+
+        fn = jax.jit(
+            admit,
+            donate_argnames=(
+                "ck", "cv", "token_s", "lengths_s", "keys_s",
+                "temp_s", "topp_s", "topk_s",
+                "pp_s", "fp_s", "counts_s", "bias_s",
+            ),
+        )
+        self._admit_cache[("members", bucket)] = fn
         return fn
 
     def _seg_fn(self, bucket: int, history: int):
@@ -516,8 +644,10 @@ class InferenceEngine:
         spec = self.spec
 
         n_top = min(TOP_LOGPROBS, spec.vocab_size)
-        n_slots = self.n_slots
+        n_rows = self._rows
+        n_s = self.n_slots
         ens = self.ensemble
+        mem = self.members
 
         def chunk(params, active, ck, cv, token_s, lengths_s, keys_s,
                   temp_s, topp_s, topk_s, pp_s, fp_s, counts_s, bias_s):
@@ -530,13 +660,26 @@ class InferenceEngine:
                 # not have its freshly prefilled cache clobbered by the dummy
                 # position-0 write.
                 pos = jnp.where(live, lens, 0)
-                logits, ck, cv = _member_call(
-                    ens,
-                    lambda p, k, v: decode_step(
-                        p, spec, tok, pos, k, v, write_mask=live,
-                        history=history),
-                    params, ck, cv,
-                )
+                if mem > 1:
+                    # Stacked members: one dispatch advances every member's
+                    # slots. Flat state rows [M·S] fold to [M, S] for the
+                    # member-vmapped model call; sampling stays flat.
+                    def one(p, t, ps, k, v, wm):
+                        return decode_step(p, spec, t, ps, k, v,
+                                           write_mask=wm, history=history)
+
+                    logits, ck, cv = jax.vmap(one)(
+                        params, tok.reshape(mem, n_s), pos.reshape(mem, n_s),
+                        ck, cv, live.reshape(mem, n_s))
+                    logits = logits.reshape(n_rows, -1)
+                else:
+                    logits, ck, cv = _member_call(
+                        ens,
+                        lambda p, k, v: decode_step(
+                            p, spec, tok, pos, k, v, write_mask=live,
+                            history=history),
+                        params, ck, cv,
+                    )
                 # OpenAI sampling knobs, applied per row on the f32 logits:
                 # logit_bias adds; presence/frequency penalties subtract
                 # based on the slot's generated-token counts.
@@ -548,7 +691,7 @@ class InferenceEngine:
                     adj, split[:, 1], temp_s, topp_s, topk_s
                 )
                 nxt = jnp.where(live, nxt, tok)
-                counts = counts.at[jnp.arange(n_slots), nxt].add(
+                counts = counts.at[jnp.arange(n_rows), nxt].add(
                     live.astype(jnp.int32))
                 lens = lens + live.astype(lens.dtype)
                 if want_lp:
@@ -602,7 +745,7 @@ class InferenceEngine:
         if fn is not None:
             return fn
         spec = self.spec
-        n_slots = self.n_slots
+        n_slots = self._rows  # == n_slots: members>1 disables spec_decode
         ens = self.ensemble
 
         def verify(params, active, tokens, ck, cv, token_s, lengths_s, keys_s,
@@ -670,6 +813,7 @@ class InferenceEngine:
         eos_id: int | None = None,
         cancel: threading.Event | None = None,
         decode_chunk: int | None = None,
+        member: int = 0,
     ) -> Iterator[int]:
         """Yield generated token ids as the scheduler produces them (the EOS
         token, when hit, is the last id yielded). Stops at EOS,
@@ -686,6 +830,7 @@ class InferenceEngine:
             eos_id=eos_id,
             cancel=cancel,
             decode_chunk=decode_chunk,
+            member=member,
         )
         yield from self.stream_results(req)
 
@@ -703,6 +848,7 @@ class InferenceEngine:
         frequency_penalty: float = 0.0,
         logit_bias: "np.ndarray | None" = None,  # [vocab] f32 additive bias
         logprobs: int = -1,  # ≥ 0 → record per-token logprobs + that many tops
+        member: int = 0,  # stacked-members engine: which weight set serves this
     ) -> _Request | None:
         """Enqueue a generation and return its handle (``None`` when there is
         nothing to generate). Raises :class:`QueueFullError` *synchronously*
@@ -725,6 +871,7 @@ class InferenceEngine:
             fp=frequency_penalty,
             bias_row=logit_bias,
             want_lp=logprobs,
+            member=member,
         )
 
     def stream_results(self, req: _Request | None) -> Iterator[int]:
@@ -752,6 +899,7 @@ class InferenceEngine:
         sampler: SamplerConfig | None = None,
         seed: int = 0,
         eos_id: int | None = None,
+        member: int = 0,
     ) -> GenerationResult:
         out = GenerationResult()
         for t in self.generate_stream(
@@ -760,6 +908,7 @@ class InferenceEngine:
             sampler=sampler,
             seed=seed,
             eos_id=eos_id,
+            member=member,
         ):
             out.token_ids.append(t)
         if eos_id is not None and out.token_ids and out.token_ids[-1] == eos_id:
@@ -771,8 +920,12 @@ class InferenceEngine:
 
     def _submit(self, prompt_ids, *, max_new_tokens, sampler, seed, eos_id,
                 cancel, decode_chunk, pp=0.0, fp=0.0, bias_row=None,
-                want_lp=-1) -> _Request | None:
+                want_lp=-1, member=0) -> _Request | None:
         spec = self.spec
+        if not 0 <= member < self.members:
+            raise ValueError(
+                f"member {member} out of range for a {self.members}-member "
+                "engine")
         # Keep the most recent context if the prompt exceeds the window,
         # reserving at least one position to generate into.
         prompt = list(prompt_ids)[-(spec.max_seq - 1):]
@@ -785,7 +938,7 @@ class InferenceEngine:
             prompt, budget, sampler, seed, eos_id,
             cancel if cancel is not None else threading.Event(),
             decode_chunk,
-            pp=pp, fp=fp, bias_row=bias_row, want_lp=want_lp,
+            pp=pp, fp=fp, bias_row=bias_row, want_lp=want_lp, member=member,
         )
         with self._cond:
             if self._stop:
@@ -804,7 +957,8 @@ class InferenceEngine:
         with self._cond:
             busy = sum(1 for r in self._slots if r is not None)
             return {
-                "slots": self.n_slots,
+                "slots": self._rows,
+                "members": self.members,
                 "busy_slots": busy,
                 "admitting": len(self._admitting),
                 "pending": len(self._pending),
@@ -884,7 +1038,9 @@ class InferenceEngine:
         resident tokens share the longest prefix with ``prompt``; among
         equal matches (typically lcp 0), the slot with the SHORTEST resident
         content wins, so a no-match request lands on an empty slot instead
-        of evicting another conversation's long reusable history."""
+        of evicting another conversation's long reusable history. (Only the
+        members=1 admission path calls this; stacked engines pick rows with
+        ``_common_free_row``.)"""
         best, best_score = None, None
         for i, r in enumerate(self._slots):
             if r is not None or i in self._claimed:
@@ -902,6 +1058,9 @@ class InferenceEngine:
         scheduler iteration so active decodes interleave. A prompt whose
         prefix is already resident in a free slot (prefix caching) admits
         into THAT slot and prefills only the suffix — zero K/V copies."""
+        if self.members > 1:
+            self._start_admissions_members()
+            return
         while True:
             with self._cond:
                 if not self._pending:
@@ -942,6 +1101,127 @@ class InferenceEngine:
                 with self._cond:
                     self._resident[slot] = []
                 self._admit(req, slot)
+
+    def _common_free_row(self, members) -> int | None:
+        """A slot row index that is free for EVERY given member. Caller holds
+        ``_cond``."""
+        for s in range(self.n_slots):
+            if all(
+                self._slots[m * self.n_slots + s] is None
+                and (m * self.n_slots + s) not in self._claimed
+                for m in members
+            ):
+                return s
+        return None
+
+    def _start_admissions_members(self) -> None:
+        """Admission for stacked-members engines: gather up to one pending
+        request per member into a group sharing one prompt bucket and one
+        free slot row, then admit the whole group in a single member-vmapped
+        prefill (``_admit_fn_members``). The quorum fan-out submits the same
+        prompt to every member at once, so the common case is a full group —
+        M admissions for one dispatch."""
+        while True:
+            with self._cond:
+                if not self._pending:
+                    return
+                # Per-member FIFO: only each member's OLDEST pending request
+                # (its queue head) is ever a candidate — requests must start
+                # in submission order per backend.
+                heads: list[_Request] = []
+                seen: set[int] = set()
+                for r in self._pending:
+                    if r.member not in seen:
+                        seen.add(r.member)
+                        heads.append(r)
+                # Anchor on each head in global FIFO order: coalesce every
+                # head sharing the anchor's bucket when a slot row is free
+                # for all of them, else admit the anchor alone. Trying every
+                # anchor (not just pending[0]) keeps one busy member's full
+                # slots from starving idle members' queues (cross-member
+                # head-of-line blocking).
+                group: dict[int, _Request] = {}
+                row = None
+                for anchor in heads:
+                    bucket = prefill_bucket(
+                        len(anchor.prompt_ids), self.spec.max_seq)
+                    group = {
+                        h.member: h for h in heads
+                        if prefill_bucket(
+                            len(h.prompt_ids), self.spec.max_seq) == bucket
+                    }
+                    row = self._common_free_row(group)
+                    if row is None and len(group) > 1:
+                        group = {anchor.member: anchor}
+                        row = self._common_free_row(group)
+                    if row is not None:
+                        break
+                if row is None:
+                    return  # no member has both a queue head and a free row
+                for r in group.values():
+                    self._pending.remove(r)
+            self._admit_members(group, row, bucket)
+
+    def _admit_members(self, group: dict[int, _Request], row: int,
+                       bucket: int) -> None:
+        """Run one coalesced member-vmapped admission (see
+        ``_start_admissions_members``)."""
+        mem, n_s = self.members, self.n_slots
+        spec = self.spec
+        tokens = np.zeros((mem, 1, bucket), np.int32)
+        lengths = np.ones((mem, 1), np.int32)  # ≥1 keeps the last-token gather valid
+        enables = np.zeros((mem,), bool)
+        seeds = np.zeros((mem,), np.int32)
+        temps = np.ones((mem,), np.float32)
+        topps = np.ones((mem,), np.float32)
+        topks = np.zeros((mem,), np.int32)
+        pps = np.zeros((mem,), np.float32)
+        fps = np.zeros((mem,), np.float32)
+        bias_rows = self._zero_bias_mem  # copy-on-write below
+        live: dict[int, _Request] = {}
+        for m, req in group.items():
+            if req.cancel.is_set():
+                req.out.put(("end", None))
+                continue
+            n = len(req.prompt_ids)
+            tokens[m, 0, :n] = req.prompt_ids
+            lengths[m, 0] = n
+            enables[m] = True
+            seeds[m] = req.seed
+            temps[m] = req.temperature
+            topps[m] = req.top_p
+            topks[m] = req.top_k
+            pps[m] = req.pp
+            fps[m] = req.fp
+            if req.bias_row is not None:
+                if bias_rows is self._zero_bias_mem:
+                    bias_rows = bias_rows.copy()
+                bias_rows[m] = req.bias_row
+            live[m] = req
+        if not live:
+            return
+        (firsts, s_lp, top_ix, top_lp,
+         self._ck, self._cv, self._token, self._lengths, self._keys,
+         self._temp, self._topp, self._topk,
+         self._pp, self._fp, self._counts, self._bias,
+         ) = self._admit_fn_members(bucket)(
+            self.params, tokens, lengths, np.int32(row), enables, seeds,
+            temps, topps, topks, pps, fps, bias_rows,
+            self._ck, self._cv, self._token, self._lengths, self._keys,
+            self._temp, self._topp, self._topk,
+            self._pp, self._fp, self._counts, self._bias,
+        )
+        firsts, s_lp, top_ix, top_lp = jax.device_get(
+            (firsts, s_lp, top_ix, top_lp))
+        for m, req in live.items():
+            flat = m * n_s + row
+            self._resident[flat] = list(req.prompt_ids)
+            if req.want_lp >= 0:
+                req.lp.append((float(s_lp[m]),
+                               np.asarray(top_ix[m]), np.asarray(top_lp[m])))
+            if not self._emit(req, int(firsts[m])):
+                with self._cond:
+                    self._slots[flat] = req
 
     def _step_admissions(self) -> None:
         """Advance every in-progress chunked admission by ONE prompt segment.
@@ -1067,7 +1347,7 @@ class InferenceEngine:
                 self._run_verify_step(active, g, max_len, drafts)
                 return
         history = prefill_bucket(max_len + n_steps, self.spec.max_seq)
-        mask = np.zeros((self.n_slots,), np.int32)
+        mask = np.zeros((self._rows,), np.int32)
         for i, _ in active:
             mask[i] = 1
         payload1 = self._dispatch_chunk(mask, n_steps, want_lp, history)
@@ -1172,8 +1452,8 @@ class InferenceEngine:
     def _run_verify_step(self, active, g: int, max_len: int, drafts) -> None:
         """One speculative dispatch: verify each row's prompt-lookup draft."""
         history = prefill_bucket(max_len + g + 1, self.spec.max_seq)
-        mask = np.zeros((self.n_slots,), np.int32)
-        tokens = np.zeros((self.n_slots, g + 1), np.int32)
+        mask = np.zeros((self._rows,), np.int32)
+        tokens = np.zeros((self._rows, g + 1), np.int32)
         for i, r in active:
             mask[i] = 1
             tokens[i, 0] = r.hist[-1]
@@ -1231,11 +1511,11 @@ class InferenceEngine:
                 + [a.req for a in self._admitting]
                 + self._pending
             )
-            self._slots = [None] * self.n_slots
+            self._slots = [None] * self._rows
             self._admitting = []
             self._claimed = set()
             self._pending = []
-            self._resident = [[] for _ in range(self.n_slots)]
+            self._resident = [[] for _ in range(self._rows)]
         # Wake consumers first — the state rebuild below can itself fail, and
         # doomed requests must never hang on their queues.
         self.n_failures += len(doomed)
@@ -1284,9 +1564,10 @@ def get_engine(
     quant: str | None = None,
     prefix_cache: bool = True,
     ensemble: int = 1,
+    members: int = 1,
 ) -> InferenceEngine:
     """Engines are keyed by weight identity (spec, seed, mesh, quant,
-    ensemble) ONLY —
+    ensemble, members) ONLY —
     dispatch knobs like decode_chunk are per-call, so two backends that differ
     only in chunking share one set of weights on device. ``n_slots``/
     ``prefill_chunk``/``max_pending`` (structural properties of the
@@ -1298,6 +1579,7 @@ def get_engine(
     (an explicit opt-out wins over a sharing default)."""
     mesh = mesh or single_device_mesh()
     key = (spec, seed, quant or None, max(1, int(ensemble)),
+           max(1, int(members)),
            tuple(sorted(mesh.shape.items())),
            tuple(map(str, mesh.devices.flat)))
     with _ENGINES_LOCK:
@@ -1308,11 +1590,16 @@ def get_engine(
                 prefill_chunk=prefill_chunk, max_pending=max_pending,
                 spec_decode=spec_decode, quant=quant,
                 prefix_cache=prefix_cache, ensemble=ensemble,
+                members=members,
             )
             _ENGINES[key] = eng
         else:
-            eng.spec_decode = max(eng.spec_decode,
-                                  max(0, min(spec_decode, 16)))
+            if eng.members == 1:
+                # Stacked engines force spec_decode=0 at construction (the
+                # verify program is not member-vmapped); a later backend's
+                # URL must not re-enable it on the shared engine.
+                eng.spec_decode = max(eng.spec_decode,
+                                      max(0, min(spec_decode, 16)))
             eng.prefix_cache = eng.prefix_cache and bool(prefix_cache)
         return eng
 

@@ -251,6 +251,7 @@ def prefill(
     remat: bool = False,
     slot: jnp.ndarray | None = None,
     mesh=None,
+    write_gate: jnp.ndarray | None = None,  # scalar bool: False → cache unchanged
 ):
     """Process the full prompt; returns (last-token logits [B,V], cache_k, cache_v).
 
@@ -260,6 +261,13 @@ def prefill(
     cache transfer; the compiled program fills the preallocated slot in place
     (the engine donates the cache args). One program per prompt bucket serves
     every slot. ``tokens`` must then be batch-1.
+
+    ``write_gate`` (a traced bool scalar) gates the cache write without
+    branching the program: when False, the touched region is written back
+    with its existing contents (one extra region-sized read, no full-cache
+    copy). The stacked-members engine admits under a member vmap with one
+    gate per member, so a prompt admitted for member m never clobbers the
+    co-located members' cache rows at the same slot index.
 
     With ``mesh`` (and its ``sp`` axis > 1), prompt attention runs as ring
     attention with the sequence sharded over ``sp`` — the serving engine's
@@ -294,8 +302,14 @@ def prefill(
         mlp = (_moe_mlp(h2, block, spec, token_mask=moe_mask)
                if spec.is_moe else _dense_mlp(h2, block, spec))
         carry_x = carry_x + mlp
-        new_ck = lax.dynamic_update_slice(ck, k.astype(ck.dtype), (cache_row, 0, 0, 0))
-        new_cv = lax.dynamic_update_slice(cv, v.astype(cv.dtype), (cache_row, 0, 0, 0))
+        wk, wv = k.astype(ck.dtype), v.astype(cv.dtype)
+        if write_gate is not None:
+            old_k = lax.dynamic_slice(ck, (cache_row, 0, 0, 0), wk.shape)
+            old_v = lax.dynamic_slice(cv, (cache_row, 0, 0, 0), wv.shape)
+            wk = jnp.where(write_gate, wk, old_k)
+            wv = jnp.where(write_gate, wv, old_v)
+        new_ck = lax.dynamic_update_slice(ck, wk, (cache_row, 0, 0, 0))
+        new_cv = lax.dynamic_update_slice(cv, wv, (cache_row, 0, 0, 0))
         return carry_x, (new_ck, new_cv)
 
     if remat:

@@ -148,7 +148,8 @@ def create_app(
             "# TYPE quorum_tpu_uptime_seconds gauge",
             f"quorum_tpu_uptime_seconds {time.monotonic() - started:.3f}",
         ]
-        gauges = ("slots", "busy_slots", "admitting", "pending", "queue_limit")
+        gauges = ("slots", "members", "busy_slots", "admitting", "pending",
+                  "queue_limit")
         # One snapshot per distinct engine: backends sharing one cached
         # engine (get_engine) must not double-count its load. Each family's
         # TYPE line appears exactly once, with all its samples grouped —

@@ -90,6 +90,26 @@ def test_moe_engine_on_mesh_matches_single_device():
         assert len(one) == 8
 
 
+def test_stacked_members_on_mesh_match_single_device():
+    """Stacked fan-out members on a tp mesh: the member axis vmaps OVER the
+    sharded model call (params [M, …] with each member's leaves sharded),
+    and every member's stream must still equal the unsharded members=1
+    engine with that member's seed."""
+    eng_m = InferenceEngine(TINY, make_mesh(MeshConfig(dp=2, tp=4)),
+                            seed=0, members=2, decode_chunk=4, n_slots=2)
+    singles = [InferenceEngine(TINY, seed=i, decode_chunk=4, n_slots=2)
+               for i in range(2)]
+    prompt = [3, 4, 5]
+    want = [_gen(singles[i], 7, prompt) for i in range(2)]
+    got = [
+        eng_m.generate(prompt, max_new_tokens=8,
+                       sampler=SamplerConfig(temperature=0.8, top_p=0.9),
+                       seed=7, member=i).token_ids
+        for i in range(2)
+    ]
+    assert got == want
+
+
 def test_tpu_backend_with_tp_mesh():
     """A ``tpu://…&tp=4`` backend serves complete() and stream() through the
     sharded engine and matches the single-device backend's text."""

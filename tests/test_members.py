@@ -1,0 +1,175 @@
+"""Stacked fan-out members: M weight sets, M separate streams, one dispatch.
+
+Contract (quorum_tpu/engine/engine.py ``members=M``): member i of a stacked
+engine produces token-for-token the stream a ``members=1`` engine with seed
+``base+i`` produces. Slot co-location, coalesced admission, and the member
+vmap must never change *content* — only how many host dispatches the quorum
+costs. (The reference cannot co-locate models at all: its "members" are
+separate HTTP services, /root/reference/src/quorum/oai_proxy.py:182-192.)
+"""
+
+from concurrent.futures import ThreadPoolExecutor
+
+import pytest
+
+from quorum_tpu.backends.tpu_backend import TpuBackend
+from quorum_tpu.config import BackendSpec
+from quorum_tpu.engine.engine import InferenceEngine, get_engine
+from quorum_tpu.models.model_config import MODEL_PRESETS, resolve_spec
+from quorum_tpu.ops.sampling import SamplerConfig
+
+TINY = MODEL_PRESETS["llama-tiny"]
+M = 3
+
+
+def _gen(eng, member, seed, prompt, n=8, temp=0.8):
+    return eng.generate(
+        prompt, max_new_tokens=n,
+        sampler=SamplerConfig(temperature=temp, top_p=0.9),
+        seed=seed, member=member,
+    ).token_ids
+
+
+def test_members_match_single_engines():
+    """Each member's stream equals the members=1 engine with that seed."""
+    stacked = InferenceEngine(TINY, seed=0, members=M, decode_chunk=4, n_slots=2)
+    singles = [
+        InferenceEngine(TINY, seed=i, decode_chunk=4, n_slots=2)
+        for i in range(M)
+    ]
+    prompt = [3, 4, 5]
+    want = [_gen(singles[i], 0, 7, prompt) for i in range(M)]
+    got = [_gen(stacked, i, 7, prompt) for i in range(M)]
+    assert got == want
+    assert len({tuple(w) for w in want}) > 1, (
+        "distinct member weights should usually diverge — if not, the "
+        "equivalence above proved nothing")
+
+
+def test_members_concurrent_matches_serial():
+    """Fan-out shape: one request per member at once, co-batched in one
+    program, must match the serial member-by-member runs."""
+    eng = InferenceEngine(TINY, seed=0, members=M, decode_chunk=4, n_slots=2)
+    jobs = [(m, 11 + m, [5, 6, 7 + m]) for m in range(M)]
+    serial = [_gen(eng, *j) for j in jobs]
+    with ThreadPoolExecutor(max_workers=M) as ex:
+        concurrent = list(ex.map(lambda j: _gen(eng, *j), jobs))
+    assert concurrent == serial
+
+
+def test_member_isolation_mid_generation():
+    """Admitting one member while another is mid-generation must not
+    disturb the in-flight member's stream (write_gate correctness)."""
+    eng = InferenceEngine(TINY, seed=0, members=2, decode_chunk=2, n_slots=2)
+    solo = _gen(eng, 0, 3, [9, 8, 7], n=12)
+
+    it = eng.generate_stream(
+        [9, 8, 7], max_new_tokens=12,
+        sampler=SamplerConfig(temperature=0.8, top_p=0.9), seed=3, member=0,
+    )
+    head = [next(it) for _ in range(2)]
+    # admit member 1 into the same slot row while member 0 is active
+    other = _gen(eng, 1, 4, [1, 2], n=6)
+    tail = list(it)
+    assert head + tail == solo
+    assert len(other) == 6
+
+
+def test_more_requests_than_slots_per_member():
+    eng = InferenceEngine(TINY, seed=0, members=2, decode_chunk=4, n_slots=1)
+    with ThreadPoolExecutor(max_workers=4) as ex:
+        results = list(ex.map(
+            lambda i: _gen(eng, i % 2, i, [5, 6], n=5), range(4)))
+    assert all(len(r) == 5 for r in results)
+
+
+def test_members_logprobs_and_choices():
+    """logprobs and n>1 choices ride the members path unchanged."""
+    eng = InferenceEngine(TINY, seed=0, members=2, decode_chunk=4, n_slots=2)
+    req = eng.submit([4, 5, 6], max_new_tokens=4, seed=9,
+                     sampler=SamplerConfig(temperature=0.0),
+                     logprobs=3, member=1)
+    toks = list(eng.stream_results(req))
+    assert len(toks) == 4
+    assert len(req.lp) >= len(toks)
+    lp, top_ids, top_lps = req.lp[0]
+    assert lp <= 0.0 and len(top_ids) >= 3
+
+
+def test_member_out_of_range_and_exclusions():
+    eng = InferenceEngine(TINY, seed=0, members=2, n_slots=1)
+    with pytest.raises(ValueError, match="member 5 out of range"):
+        eng.submit([1, 2], max_new_tokens=2, member=5)
+    with pytest.raises(ValueError, match="mutually exclusive"):
+        InferenceEngine(TINY, members=2, ensemble=2)
+
+
+def test_shared_stacked_engine_never_reenables_spec_decode():
+    """A later backend's spec_decode= URL knob must not re-enable
+    speculative verification on a cached stacked engine — the verify
+    program is not member-vmapped (get_engine merge guard)."""
+    spec = resolve_spec("llama-tiny", {"max_seq": "64"})
+    first = get_engine(spec, seed=400, members=2, n_slots=1)
+    assert first.spec_decode == 0
+    again = get_engine(spec, seed=400, members=2, n_slots=1, spec_decode=4)
+    assert again is first and first.spec_decode == 0
+
+
+def test_backend_urls_share_one_engine():
+    """members=M&member=i backends resolve to ONE engine; distinct member
+    indices; rejected for ckpt backends and out-of-range members."""
+    def mk(i):
+        return TpuBackend.from_spec(BackendSpec(
+            name=f"LLM{i}",
+            url=f"tpu://llama-tiny?members={M}&member={i}&slots=2",
+            model="llama-tiny",
+        ))
+
+    backends = [mk(i) for i in range(M)]
+    assert len({id(b.engine) for b in backends}) == 1
+    assert [b.member for b in backends] == list(range(M))
+    assert backends[0].engine.members == M
+
+    with pytest.raises(ValueError, match="out of range"):
+        TpuBackend.from_spec(BackendSpec(
+            name="bad", url=f"tpu://llama-tiny?members={M}&member={M}",
+            model="x"))
+    with pytest.raises(ValueError, match="does not apply to ckpt"):
+        TpuBackend.from_spec(BackendSpec(
+            name="bad", url="tpu://llama-tiny?members=2&ckpt=/tmp/nope",
+            model="x"))
+
+
+def test_stacked_engine_matches_separate_seeded_engines_via_backend():
+    """End-to-end: the stacked backends' completions equal the old
+    three-separate-engines completions (seed i ↔ member i)."""
+    import asyncio
+
+    def complete(backend, body):
+        return asyncio.run(backend.complete(dict(body), {}, timeout=60))
+
+    body = {
+        "model": "m",
+        "messages": [{"role": "user", "content": "hello quorum"}],
+        "max_tokens": 6,
+        "temperature": 0.8,
+        "seed": 2,
+    }
+    stacked = [
+        TpuBackend.from_spec(BackendSpec(
+            name=f"S{i}",
+            url=f"tpu://llama-tiny?members={M}&member={i}&slots=2",
+            model="m"))
+        for i in range(M)
+    ]
+    singles = [
+        TpuBackend.from_spec(BackendSpec(
+            name=f"P{i}", url=f"tpu://llama-tiny?seed={i}&slots=2",
+            model="m"))
+        for i in range(M)
+    ]
+    got = [complete(b, body).body["choices"][0]["message"]["content"]
+           for b in stacked]
+    want = [complete(b, body).body["choices"][0]["message"]["content"]
+            for b in singles]
+    assert got == want
