@@ -32,6 +32,12 @@ URL grammar:  ``tpu://<model-id>?<spec overrides>&<engine options>``
                    halves weight HBM bytes/token (decode is bandwidth-bound →
                    up to 2× decode tokens/s) and weight HBM capacity
                    (llama-3-8b fits one 16 GB v5e at ~8.1 GB)
+  kv_quant=int8    int8 KV cache (per-token scales, native int8 q·K / p·V
+                   decode contractions): halves cache HBM capacity (at 8B,
+                   an 8k window drops 1.07 → 0.54 GB per slot) AND the
+                   cache bytes each long-context decode step streams.
+                   Orthogonal to quant= (compose both for the smallest
+                   footprint)
   ensemble=M       on-device logit-ensemble decoding (default 1 = off): M
                    independently-seeded weight sets (seed..seed+M-1) decode
                    ONE shared stream — every step averages the M members'
@@ -251,6 +257,7 @@ class TpuBackend:
             max_pending=int(opts.get("queue", DEFAULT_MAX_PENDING)),
             spec_decode=int(opts.get("spec_decode", 0)),
             quant=opts.get("quant") or None,
+            kv_quant=opts.get("kv_quant") or None,
             prefix_cache=_parse_bool_opt(
                 "prefix_cache", opts.get("prefix_cache", "1")),
             ensemble=int(opts.get("ensemble", 1)),

@@ -208,12 +208,22 @@ class InferenceEngine:
         prefix_cache: bool = True,
         ensemble: int = 1,
         members: int = 1,
+        kv_quant: str | None = None,
     ):
         self.spec = spec.validate()
         self.mesh = mesh or single_device_mesh()
         if quant not in (None, "", "int8"):
             raise ValueError(f"unsupported quant mode {quant!r} (int8 or none)")
         self.quant = quant or None
+        if kv_quant not in (None, "", "int8"):
+            raise ValueError(
+                f"unsupported kv_quant mode {kv_quant!r} (int8 or none)")
+        # int8 KV cache: each side stored (int8 values, f32 per-token
+        # scales) — half the cache HBM capacity AND half the bytes every
+        # decode step streams from its history window; decode attention
+        # contracts natively in int8 (transformer.py / ops.attention).
+        # Orthogonal to weight quant= (compose freely).
+        self.kv_quant = kv_quant or None
         # On-device logit-ensemble decoding: M independently-seeded weight
         # sets decode ONE shared stream — every model call is vmapped over a
         # leading member axis (params and KV caches are [M, …]) and the M
@@ -340,11 +350,20 @@ class InferenceEngine:
             # bf16 weights alone are ~14 GB of a v5e's 16 GB HBM).
             self.params = init_params_sharded(spec, self.mesh, seed)
         self._cache_sh = kv_cache_sharding(self.mesh, spec.n_kv_heads, batch=self.n_slots)
+        if self.kv_quant:
+            # (values, scales): the scale array drops the head_dim axis.
+            self._cache_sh = (
+                self._cache_sh,
+                NamedSharding(self.mesh, P(*tuple(self._cache_sh.spec)[:4])),
+            )
         if self.ensemble > 1 or self.members > 1:
             # member-stacked cache [M, L, S, K, T, hd]: member axis vmapped,
             # never sharded
-            self._cache_sh = NamedSharding(
-                self.mesh, P(*((None,) + tuple(self._cache_sh.spec))))
+            self._cache_sh = jax.tree.map(
+                lambda sh: NamedSharding(
+                    self.mesh, P(*((None,) + tuple(sh.spec)))),
+                self._cache_sh,
+                is_leaf=lambda x: isinstance(x, NamedSharding))
         self._rep = NamedSharding(self.mesh, P())
         self._init_device_state()
 
@@ -383,10 +402,13 @@ class InferenceEngine:
         stacked = max(self.ensemble, self.members)
 
         def zero_cache():
-            ck, cv = init_cache(self.spec, batch=self.n_slots)
+            ck, cv = init_cache(self.spec, batch=self.n_slots,
+                                kv_quant=self.kv_quant)
             if stacked > 1:
-                ck = jnp.zeros((stacked,) + ck.shape, ck.dtype)
-                cv = jnp.zeros((stacked,) + cv.shape, cv.dtype)
+                stack = lambda x: jnp.zeros(  # noqa: E731
+                    (stacked,) + x.shape, x.dtype)
+                ck = jax.tree.map(stack, ck)
+                cv = jax.tree.map(stack, cv)
             return ck, cv
 
         self._ck, self._cv = jax.jit(
@@ -1565,9 +1587,10 @@ def get_engine(
     prefix_cache: bool = True,
     ensemble: int = 1,
     members: int = 1,
+    kv_quant: str | None = None,
 ) -> InferenceEngine:
     """Engines are keyed by weight identity (spec, seed, mesh, quant,
-    ensemble, members) ONLY —
+    ensemble, members) plus the cache representation (kv_quant) —
     dispatch knobs like decode_chunk are per-call, so two backends that differ
     only in chunking share one set of weights on device. ``n_slots``/
     ``prefill_chunk``/``max_pending`` (structural properties of the
@@ -1579,7 +1602,7 @@ def get_engine(
     (an explicit opt-out wins over a sharing default)."""
     mesh = mesh or single_device_mesh()
     key = (spec, seed, quant or None, max(1, int(ensemble)),
-           max(1, int(members)),
+           max(1, int(members)), kv_quant or None,
            tuple(sorted(mesh.shape.items())),
            tuple(map(str, mesh.devices.flat)))
     with _ENGINES_LOCK:
@@ -1590,7 +1613,7 @@ def get_engine(
                 prefill_chunk=prefill_chunk, max_pending=max_pending,
                 spec_decode=spec_decode, quant=quant,
                 prefix_cache=prefix_cache, ensemble=ensemble,
-                members=members,
+                members=members, kv_quant=kv_quant,
             )
             _ENGINES[key] = eng
         else:
@@ -1616,6 +1639,7 @@ def get_engine_from_ckpt(
     quant: str | None = None,
     prefix_cache: bool = True,
     ensemble: int = 1,
+    kv_quant: str | None = None,
 ) -> InferenceEngine:
     """Engine over a local HF checkpoint; keyed by (resolved path, mesh) so N
     backends pointing at one checkpoint share the loaded weights on device.
@@ -1635,7 +1659,7 @@ def get_engine_from_ckpt(
     # Normalize: dtype=None and an explicit dtype equal to the default must
     # hit the same cache entry (else the checkpoint sits in HBM twice).
     eff_dtype = dtype or ModelSpec().dtype
-    key = ("ckpt", resolved, eff_dtype, quant or None,
+    key = ("ckpt", resolved, eff_dtype, quant or None, kv_quant or None,
            tuple(sorted(mesh.shape.items())),
            tuple(map(str, mesh.devices.flat)))
     with _ENGINES_LOCK:
@@ -1647,6 +1671,7 @@ def get_engine_from_ckpt(
                 prefill_chunk=prefill_chunk, max_pending=max_pending,
                 spec_decode=spec_decode, quant=quant,
                 prefix_cache=prefix_cache, ensemble=ensemble,
+                kv_quant=kv_quant,
             )
             _ENGINES[key] = eng
         else:

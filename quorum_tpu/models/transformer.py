@@ -36,13 +36,47 @@ from jax import lax
 
 from quorum_tpu.models.model_config import ModelSpec
 from quorum_tpu.models.quant import is_quantized, qeinsum
-from quorum_tpu.ops.attention import attention, causal_mask, decode_attention
+from quorum_tpu.ops.attention import (
+    attention,
+    causal_mask,
+    decode_attention,
+    decode_attention_q8,
+    quantize_rows,
+)
 from quorum_tpu.ops.flash_attention import flash_prefill_attention
 from quorum_tpu.parallel.ring_attention import ring_prefill_attention
 from quorum_tpu.ops.norms import layernorm, rmsnorm
 from quorum_tpu.ops.rotary import apply_rope, rope_cos_sin
 
 Params = dict[str, Any]
+
+# ---- int8 KV cache representation -----------------------------------------
+#
+# A cache side (k or v) is EITHER a bf16 array [L, B, K, max_seq, hd] (the
+# default) OR, with ``kv_quant="int8"``, a tuple ``(q8, scale)`` of
+# [L, B, K, max_seq, hd] int8 and [L, B, K, max_seq] f32 with
+# ``value ≈ q8 * scale[..., None]`` (per-token-per-head symmetric amax/127,
+# the same formulation as the int8 weight quantizer in models/quant.py).
+# Every cache op below dispatches on the representation; jax pytree
+# machinery (lax.scan carries, jit donation, vmap) handles the tuple leaves
+# transparently. Decode — the bandwidth-bound path — contracts NATIVELY in
+# int8 (ops.attention.decode_attention_q8); the cold prefill-segment /
+# verify paths dequantize their bounded history window instead.
+
+
+def kv_is_q8(cache) -> bool:
+    """True when a cache side uses the int8 (q8, scale) representation."""
+    return isinstance(cache, tuple)
+
+
+def _kv_quantize(x: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """[..., T, hd] bf16 → (int8 [..., T, hd], scale [..., T])."""
+    q8, s = quantize_rows(x, axis=-1)
+    return q8, s[..., 0]
+
+
+def _kv_dequant(q8: jnp.ndarray, scale: jnp.ndarray, dtype) -> jnp.ndarray:
+    return (q8.astype(jnp.float32) * scale[..., None]).astype(dtype)
 
 
 def _emb_rows(leaf, tokens, dtype):
@@ -241,6 +275,26 @@ def _final_norm(params, spec: ModelSpec, x):
     return _norm(x, params["final_norm_w"], params.get("final_norm_b"), spec)
 
 
+def _prefill_write(cache, value, cache_row, write_gate):
+    """Write a prompt block's K or V into one cache row, handling both
+    representations. ``value`` [B, K, T, hd] (B = 1 in slot mode) lands at
+    position ``(cache_row, 0, 0, 0)``; ``write_gate`` (scalar bool) writes
+    the touched region back unchanged when False (one extra region read —
+    never a full-cache select)."""
+    def gated(arr, new, idx):
+        if write_gate is not None:
+            old = lax.dynamic_slice(arr, idx, new.shape)
+            new = jnp.where(write_gate, new, old)
+        return lax.dynamic_update_slice(arr, new, idx)
+
+    if kv_is_q8(cache):
+        c8, cs = cache
+        q8, s = _kv_quantize(value)
+        return (gated(c8, q8, (cache_row, 0, 0, 0)),
+                gated(cs, s.astype(cs.dtype), (cache_row, 0, 0)))
+    return gated(cache, value.astype(cache.dtype), (cache_row, 0, 0, 0))
+
+
 def prefill(
     params: Params,
     spec: ModelSpec,
@@ -302,14 +356,8 @@ def prefill(
         mlp = (_moe_mlp(h2, block, spec, token_mask=moe_mask)
                if spec.is_moe else _dense_mlp(h2, block, spec))
         carry_x = carry_x + mlp
-        wk, wv = k.astype(ck.dtype), v.astype(cv.dtype)
-        if write_gate is not None:
-            old_k = lax.dynamic_slice(ck, (cache_row, 0, 0, 0), wk.shape)
-            old_v = lax.dynamic_slice(cv, (cache_row, 0, 0, 0), wv.shape)
-            wk = jnp.where(write_gate, wk, old_k)
-            wv = jnp.where(write_gate, wv, old_v)
-        new_ck = lax.dynamic_update_slice(ck, wk, (cache_row, 0, 0, 0))
-        new_cv = lax.dynamic_update_slice(cv, wv, (cache_row, 0, 0, 0))
+        new_ck = _prefill_write(ck, k, cache_row, write_gate)
+        new_cv = _prefill_write(cv, v, cache_row, write_gate)
         return carry_x, (new_ck, new_cv)
 
     if remat:
@@ -368,21 +416,40 @@ def prefill_segment(
     mask = (ki <= qi)[None, None, None, :, :]  # [1,1,1,T,hist]
     moe_mask = (jnp.arange(t) < n_valid)[None, :]  # [1,T]
 
+    def seg_write(cache, value):
+        # value [1, K, t, hd] at absolute position offset of row `slot`
+        if kv_is_q8(cache):
+            c8, cs = cache
+            q8, s = _kv_quantize(value)
+            return (lax.dynamic_update_slice(c8, q8, (slot, 0, offset, 0)),
+                    lax.dynamic_update_slice(
+                        cs, s.astype(cs.dtype), (slot, 0, offset)))
+        return lax.dynamic_update_slice(
+            cache, value.astype(cache.dtype), (slot, 0, offset, 0))
+
+    def seg_read(cache, dtype):
+        # the slot's history window [1, K, hist, hd]; int8 caches dequantize
+        # the bounded window (cold path — decode uses the native-int8 dot)
+        if kv_is_q8(cache):
+            c8, cs = cache
+            row8 = lax.dynamic_slice(
+                c8, (slot, 0, 0, 0), (1, spec.n_kv_heads, hist, spec.head_dim))
+            rs = lax.dynamic_slice(cs, (slot, 0, 0), (1, spec.n_kv_heads, hist))
+            return _kv_dequant(row8, rs, dtype)
+        return lax.dynamic_slice(
+            cache, (slot, 0, 0, 0), (1, spec.n_kv_heads, hist, spec.head_dim))
+
     def body(carry_x, per_layer):
-        block, ck, cv = per_layer  # ck/cv: [S, K, max_seq, hd]
+        block, ck, cv = per_layer  # ck/cv: [S, K, max_seq, hd] (or (q8, scale))
         h = _norm(carry_x, block["attn_norm_w"], block.get("attn_norm_b"), spec)
         q, k, v = _qkv(h, block, spec)
         if spec.pos == "rope":
             q = apply_rope(q, cos, sin, positions)
             k = apply_rope(k, cos, sin, positions)
-        new_ck = lax.dynamic_update_slice(ck, k.astype(ck.dtype), (slot, 0, offset, 0))
-        new_cv = lax.dynamic_update_slice(cv, v.astype(cv.dtype), (slot, 0, offset, 0))
-        row_k = lax.dynamic_slice(
-            new_ck, (slot, 0, 0, 0),
-            (1, spec.n_kv_heads, hist, spec.head_dim))
-        row_v = lax.dynamic_slice(
-            new_cv, (slot, 0, 0, 0),
-            (1, spec.n_kv_heads, hist, spec.head_dim))
+        new_ck = seg_write(ck, k)
+        new_cv = seg_write(cv, v)
+        row_k = seg_read(new_ck, q.dtype)
+        row_v = seg_read(new_cv, q.dtype)
         attn = attention(q, row_k, row_v, mask)
         carry_x = carry_x + _attn_out(attn, block, carry_x.dtype)
         h2 = _norm(carry_x, block["mlp_norm_w"], block.get("mlp_norm_b"), spec)
@@ -428,13 +495,34 @@ def decode_step(
     cos, sin = rope_cos_sin(spec.max_seq, spec.head_dim, spec.rope_theta)
 
     def write_row(cache_row, new_row, idx, allow):
-        # cache_row [K, max_seq, hd], new_row [K, 1, hd]
-        old = lax.dynamic_slice(cache_row, (0, idx, 0), new_row.shape)
+        # cache_row [K, max_seq, hd] (or [K, max_seq] scale), new_row likewise
+        start = (0, idx, 0)[: cache_row.ndim]
+        old = lax.dynamic_slice(cache_row, start, new_row.shape)
         return lax.dynamic_update_slice(
-            cache_row, jnp.where(allow, new_row, old), (0, idx, 0))
+            cache_row, jnp.where(allow, new_row, old), start)
 
     allow = (jnp.ones((b,), bool) if write_mask is None else write_mask)
     write = jax.vmap(write_row, in_axes=(0, 0, 0, 0))  # over batch
+
+    def step_write(cache, value):
+        # value [B, K, 1, hd] at each row's own position
+        if kv_is_q8(cache):
+            c8, cs = cache
+            q8, s = _kv_quantize(value)
+            return (write(c8, q8, lengths, allow),
+                    write(cs, s.astype(cs.dtype), lengths, allow))
+        return write(cache, value.astype(cache.dtype), lengths, allow)
+
+    def step_read(cache):
+        if history is not None and history < spec.max_seq:
+            # Read only the prefix that can hold valid entries (the write
+            # above landed at lengths < history). The mask ki < lengths+1
+            # already excludes the tail; the slice stops it being READ.
+            if kv_is_q8(cache):
+                return (lax.slice_in_dim(cache[0], 0, history, axis=2),
+                        lax.slice_in_dim(cache[1], 0, history, axis=2))
+            return lax.slice_in_dim(cache, 0, history, axis=2)
+        return cache
 
     def body(carry_x, per_layer):
         block, ck, cv = per_layer
@@ -445,17 +533,17 @@ def decode_step(
             rope_row = jax.vmap(lambda xr, p: apply_rope(xr[None], cos, sin, p[None])[0])
             q = rope_row(q, lengths)
             k = rope_row(k, lengths)
-        new_ck = write(ck, k.astype(ck.dtype), lengths, allow)
-        new_cv = write(cv, v.astype(cv.dtype), lengths, allow)
-        if history is not None and history < spec.max_seq:
-            # Read only the prefix that can hold valid entries (the write
-            # above landed at lengths < history). The mask ki < lengths+1
-            # already excludes the tail; the slice stops it being READ.
-            read_k = lax.slice_in_dim(new_ck, 0, history, axis=2)
-            read_v = lax.slice_in_dim(new_cv, 0, history, axis=2)
+        new_ck = step_write(ck, k)
+        new_cv = step_write(cv, v)
+        read_k = step_read(new_ck)
+        read_v = step_read(new_cv)
+        if kv_is_q8(new_ck):
+            # Native int8 q·K / p·V over the quantized cache: HALF the
+            # cache bytes per step, no dequantized HBM copy.
+            attn = decode_attention_q8(
+                q, read_k[0], read_k[1], read_v[0], read_v[1], lengths + 1)
         else:
-            read_k, read_v = new_ck, new_cv
-        attn = decode_attention(q, read_k, read_v, lengths + 1)
+            attn = decode_attention(q, read_k, read_v, lengths + 1)
         carry_x = carry_x + _attn_out(attn, block, carry_x.dtype)
         h2 = _norm(carry_x, block["mlp_norm_w"], block.get("mlp_norm_b"), spec)
         mlp = _moe_mlp(h2, block, spec) if spec.is_moe else _dense_mlp(h2, block, spec)
@@ -501,12 +589,29 @@ def decode_multi(
     allow = (jnp.ones((b,), bool) if write_mask is None else write_mask)
 
     def write_row(cache_row, new_row, idx, w):
-        # cache_row [K, max_seq, hd], new_row [K, T, hd]
-        old = lax.dynamic_slice(cache_row, (0, idx, 0), new_row.shape)
+        # cache_row [K, max_seq, hd] (or [K, max_seq] scale), new_row likewise
+        start = (0, idx, 0)[: cache_row.ndim]
+        old = lax.dynamic_slice(cache_row, start, new_row.shape)
         return lax.dynamic_update_slice(
-            cache_row, jnp.where(w, new_row, old), (0, idx, 0))
+            cache_row, jnp.where(w, new_row, old), start)
 
     write = jax.vmap(write_row, in_axes=(0, 0, 0, 0))
+
+    def multi_write(cache, value):
+        if kv_is_q8(cache):
+            c8, cs = cache
+            q8, s = _kv_quantize(value)
+            return (write(c8, q8, lengths, allow),
+                    write(cs, s.astype(cs.dtype), lengths, allow))
+        return write(cache, value.astype(cache.dtype), lengths, allow)
+
+    def multi_read(cache, dtype):
+        if kv_is_q8(cache):
+            return _kv_dequant(
+                lax.slice_in_dim(cache[0], 0, hist, axis=2),
+                lax.slice_in_dim(cache[1], 0, hist, axis=2), dtype)
+        return lax.slice_in_dim(cache, 0, hist, axis=2)
+
     # per-row causal mask over the cache prefix: key j visible to query i of
     # row r iff j <= lengths[r] + i
     ki = jnp.arange(hist)[None, None, :]
@@ -521,10 +626,10 @@ def decode_multi(
                 lambda xr, p: apply_rope(xr[None], cos, sin, p)[0])
             q = rope_row(q, pos)
             k = rope_row(k, pos)
-        new_ck = write(ck, k.astype(ck.dtype), lengths, allow)
-        new_cv = write(cv, v.astype(cv.dtype), lengths, allow)
-        read_k = lax.slice_in_dim(new_ck, 0, hist, axis=2)
-        read_v = lax.slice_in_dim(new_cv, 0, hist, axis=2)
+        new_ck = multi_write(ck, k)
+        new_cv = multi_write(cv, v)
+        read_k = multi_read(new_ck, q.dtype)
+        read_v = multi_read(new_cv, q.dtype)
         attn = attention(q, read_k, read_v, mask)
         carry_x = carry_x + _attn_out(attn, block, carry_x.dtype)
         h2 = _norm(carry_x, block["mlp_norm_w"], block.get("mlp_norm_b"), spec)
@@ -621,8 +726,18 @@ def forward_logits_sp(
     return _scan_layers(params, spec, tokens, ring_attn, remat, lengths=lengths)
 
 
-def init_cache(spec: ModelSpec, batch: int, dtype=None):
-    """Preallocated KV cache: [L, B, K, max_seq, hd] × 2."""
+def init_cache(spec: ModelSpec, batch: int, dtype=None, kv_quant: str | None = None):
+    """Preallocated KV cache: [L, B, K, max_seq, hd] × 2.
+
+    ``kv_quant="int8"`` stores each side as ``(int8 values, f32 per-token
+    scales)`` — HALF the cache HBM capacity and half the bytes every decode
+    step streams from the history window (decode attention contracts
+    natively in int8, ops.attention.decode_attention_q8). At llama-3-8b /
+    8k window the bf16 cache is 1.07 GB per slot; int8 is 0.54 GB."""
     dt = jnp.dtype(dtype or spec.dtype)
     shape = (spec.n_layers, batch, spec.n_kv_heads, spec.max_seq, spec.head_dim)
+    if kv_quant == "int8":
+        side = lambda: (jnp.zeros(shape, jnp.int8),  # noqa: E731
+                        jnp.zeros(shape[:-1], jnp.float32))
+        return side(), side()
     return jnp.zeros(shape, dt), jnp.zeros(shape, dt)

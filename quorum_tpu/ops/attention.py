@@ -79,3 +79,61 @@ def decode_attention(
     valid = jnp.arange(k_cache.shape[2])[None, :] < length[:, None]  # [B, max_seq]
     mask = valid[:, None, None, None, :]
     return attention(q, k_cache, v_cache, mask)
+
+
+def quantize_rows(x: jnp.ndarray, axis: int = -1) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Symmetric int8 quantization along ``axis``: returns ``(q8, scale)``
+    with ``x ≈ q8 * scale`` (scale keeps the reduced dim, size 1).
+    Deliberately the same amax/127 formulation — including the 1e-30
+    all-zero-row floor — as models/quant.py's weight/activation quantizers
+    (kept separate only because ops/ must not import models/); a change to
+    the formulation belongs in both places. Used by the int8 KV cache's
+    write path and its dynamic query/probability quantization."""
+    amax = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=axis, keepdims=True)
+    s = jnp.maximum(amax, 1e-30) / 127.0
+    q8 = jnp.clip(jnp.round(x.astype(jnp.float32) / s), -127, 127).astype(jnp.int8)
+    return q8, s
+
+
+def decode_attention_q8(
+    q: jnp.ndarray,        # [B, H, 1, hd] bf16/f32
+    k8: jnp.ndarray,       # [B, K, T, hd] int8 cache
+    k_scale: jnp.ndarray,  # [B, K, T] f32: k ≈ k8 * k_scale[..., None]
+    v8: jnp.ndarray,       # [B, K, T, hd] int8 cache
+    v_scale: jnp.ndarray,  # [B, K, T] f32
+    length: jnp.ndarray,   # [B] or scalar
+) -> jnp.ndarray:
+    """One decode step against an int8-quantized KV cache, with the
+    contractions run NATIVELY in int8 (int8×int8→int32 on the MXU) — never
+    dequantize-into-dot, which materializes a bf16 copy in HBM and made
+    int8 *slower* than bf16 for weights (PERF.md §2, the measured-first
+    rule this module inherits).
+
+    The per-token scales factor cleanly out of both dots:
+      q·kᵀ: k's scale indexes the OUTPUT position t → logits · ks[t].
+      p·v:  v's scale indexes the CONTRACTION position t → fold vs[t] into
+            the probabilities BEFORE quantizing them over t.
+    q (one row per head) and p (one row per query) are dynamically
+    quantized amax/127, like activations in models/quant.qeinsum."""
+    b, h, s, d = q.shape
+    n_kv = k8.shape[1]
+    qg = _group_heads(q, n_kv)                        # [B, K, G, 1, hd]
+    q8, qs = quantize_rows(qg, axis=-1)               # qs [B, K, G, 1, 1]
+    logits_i = jnp.einsum(
+        "bkgsd,bktd->bkgst", q8, k8, preferred_element_type=jnp.int32)
+    scale = d ** -0.5
+    logits = (logits_i.astype(jnp.float32) * qs
+              * k_scale[:, :, None, None, :]) * scale  # [B, K, G, 1, T]
+    length = jnp.asarray(length)
+    if length.ndim == 0:
+        length = length[None]
+    valid = jnp.arange(k8.shape[2])[None, :] < length[:, None]  # [B, T]
+    logits = jnp.where(valid[:, None, None, None, :], logits, NEG_INF)
+    probs = jnp.exp(logits - jnp.max(logits, axis=-1, keepdims=True))
+    probs = probs / jnp.sum(probs, axis=-1, keepdims=True)
+    pv = probs * v_scale[:, :, None, None, :]          # fold v's scale in
+    p8, ps = quantize_rows(pv, axis=-1)                # ps [B, K, G, 1, 1]
+    out_i = jnp.einsum(
+        "bkgst,bktd->bkgsd", p8, v8, preferred_element_type=jnp.int32)
+    out = out_i.astype(jnp.float32) * ps               # [B, K, G, 1, hd]
+    return out.reshape(b, h, s, d).astype(q.dtype)
