@@ -271,14 +271,16 @@ async def bench_7b(model: str, url: str, prefix: str, quant: bool,
             base_url=f"http://127.0.0.1:{port}", timeout=3600
         ) as client:
 
-            async def one() -> tuple[float, float, int, float]:
-                """(ttft_s, decode_s, n_tokens, total_s): decode_s spans
-                first→last content delta — pure decode, no prefill/HTTP."""
+            async def one(req_body=body):
+                """(ttft_s, decode_s, n_tokens, first_abs, last_abs):
+                decode_s spans first→last content delta — pure decode, no
+                prefill/HTTP; the absolute delta timestamps let concurrent
+                callers compute their true overlap window."""
                 t0 = time.perf_counter()
                 first = last = None
                 n = 0
                 async with client.stream(
-                    "POST", "/chat/completions", json=body,
+                    "POST", "/chat/completions", json=req_body,
                     headers={"Authorization": "Bearer bench"},
                 ) as resp:
                     assert resp.status_code == 200, f"HTTP {resp.status_code}"
@@ -293,14 +295,13 @@ async def bench_7b(model: str, url: str, prefix: str, quant: bool,
                                 first = now
                             last = now
                             n += 1
-                total = time.perf_counter() - t0
                 assert first is not None and n > 1, "no content deltas"
-                return first - t0, last - first, n, total
+                return first - t0, last - first, n, first, last
 
             await one()  # warmup: compile prefill bucket + decode chunk
             ttfts, rates = [], []
             for _ in range(3):
-                ttft, decode_s, n, _total = await one()
+                ttft, decode_s, n, _f, _l = await one()
                 ttfts.append(ttft)
                 # deltas arrive per decode_chunk dispatch; (n-1) inter-delta
                 # tokens over decode_s seconds
@@ -308,12 +309,13 @@ async def bench_7b(model: str, url: str, prefix: str, quant: bool,
 
             # Co-batched throughput: both slots decode concurrently in ONE
             # program — decode is weight-bandwidth-bound, so the aggregate
-            # should approach 2× the single-stream rate. Same convention as
-            # the single-stream metric ((n−1) inter-delta tokens over the
-            # decode window, no prefill/TTFT in the denominator), summed
-            # over the co-batched streams, so the two numbers compare.
+            # should approach 2× the single-stream rate. Aggregate decode
+            # tokens over the UNION first→last-delta window (no prefill in
+            # the denominator, same convention as the single-stream rate) —
+            # a serialized engine would show ~1×, perfect co-batching ~2×.
             pair = await asyncio.gather(one(), one())
-            c2_tok_s = sum((n - 1) / d for _, d, n, _ in pair)
+            c2_window = max(p[4] for p in pair) - min(p[3] for p in pair)
+            c2_tok_s = sum(p[2] - 1 for p in pair) / max(c2_window, 1e-9)
 
             # Prefix caching at 7B scale, where prefill dominates TTFT: a
             # long shared system preamble (the quorum workload — every
@@ -378,33 +380,8 @@ async def bench_7b(model: str, url: str, prefix: str, quant: bool,
                     "max_tokens": 32,
                 }
 
-                async def one_longctx():
-                    t0 = time.perf_counter()
-                    first = last = None
-                    n = 0
-                    async with client.stream(
-                        "POST", "/chat/completions", json=lbody,
-                        headers={"Authorization": "Bearer bench"},
-                    ) as resp:
-                        assert resp.status_code == 200, f"HTTP {resp.status_code}"
-                        async for line in resp.aiter_lines():
-                            if (not line.startswith("data: ")
-                                    or line == "data: [DONE]"):
-                                continue
-                            chunk = json.loads(line[len("data: "):])
-                            delta = (chunk.get("choices") or [{}])[0].get(
-                                "delta") or {}
-                            if delta.get("content"):
-                                now = time.perf_counter()
-                                if first is None:
-                                    first = now
-                                last = now
-                                n += 1
-                    assert first is not None and n > 1, "no long-ctx deltas"
-                    return first - t0, last - first, n
-
-                await one_longctx()  # compile segment/history buckets
-                lttft, ldecode_s, ln = await one_longctx()
+                await one(lbody)  # compile segment/history buckets
+                lttft, ldecode_s, ln, _f, _l = await one(lbody)
                 long_metrics = {
                     f"{prefix}_long_prompt_tokens": 5000,
                     f"{prefix}_long_ttft_ms": round(lttft * 1000, 2),
