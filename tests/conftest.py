@@ -91,6 +91,37 @@ def two_backend_parallel_config(strategy: str = "concatenate", **strategy_overri
     }
 
 
+class ParallelStreamCollector:
+    """Buckets a parallel quorum's SSE stream by chunk id: per-member
+    ``chatcmpl-parallel-{i}`` content deltas into ``texts[i]`` and the
+    ``chatcmpl-parallel-final`` combined text into ``final`` — the
+    streaming wire contract several endpoint tests assert against."""
+
+    def __init__(self):
+        self.texts: dict[int, list[str]] = {}
+        self.final: list[str] = []
+
+    def feed_line(self, line: str) -> None:
+        import json
+
+        if not line.startswith("data: ") or line == "data: [DONE]":
+            return
+        chunk = json.loads(line[len("data: "):])
+        cid = chunk.get("id", "")
+        for ch in chunk.get("choices") or []:
+            delta = (ch.get("delta") or {}).get("content")
+            if not delta:
+                continue
+            if cid == "chatcmpl-parallel-final":
+                self.final.append(delta)
+            elif cid.startswith("chatcmpl-parallel-"):
+                self.texts.setdefault(
+                    int(cid.rsplit("-", 1)[1]), []).append(delta)
+
+    def stream(self, i: int) -> str:
+        return "".join(self.texts[i])
+
+
 # Minimal built-in async-test support (pytest-asyncio is not in this image):
 # run ``async def`` tests via asyncio.run.
 @pytest.hookimpl(tryfirst=True)

@@ -140,6 +140,59 @@ def test_backend_urls_share_one_engine():
             model="x"))
 
 
+async def test_stacked_quorum_through_real_socket():
+    """The shipped stacked shape end-to-end: a members=3 quorum served by
+    the bundled h11 server over TCP streams per-member `chatcmpl-parallel-i`
+    deltas and a final combined chunk whose sections are the three members'
+    streams (the /verify scenario, pinned)."""
+    import httpx
+
+    from quorum_tpu.config import Config
+    from quorum_tpu.server.app import create_app
+    from quorum_tpu.server.serve import start_server
+    from tests.conftest import ParallelStreamCollector
+
+    config = Config(raw={
+        "settings": {"timeout": 120},
+        "primary_backends": [
+            {"name": f"LLM{i}",
+             "url": f"tpu://llama-tiny?members=3&member={i}&slots=2",
+             "model": "tiny"}
+            for i in range(3)
+        ],
+        "iterations": {"aggregation": {"strategy": "concatenate"}},
+        "strategy": {"concatenate": {
+            "separator": "\n---\n",
+            "hide_intermediate_think": False,
+            "hide_final_think": False,
+            "thinking_tags": ["think"],
+        }},
+    })
+    server = await start_server(create_app(config), "127.0.0.1", 0)
+    port = server.sockets[0].getsockname()[1]
+    col = ParallelStreamCollector()
+    try:
+        async with httpx.AsyncClient(
+            base_url=f"http://127.0.0.1:{port}", timeout=120
+        ) as client:
+            async with client.stream(
+                "POST", "/chat/completions",
+                json={"model": "tiny", "stream": True, "max_tokens": 5,
+                      "temperature": 0.8, "seed": 6,
+                      "messages": [{"role": "user", "content": "hi"}]},
+                headers={"Authorization": "Bearer t"},
+            ) as resp:
+                assert resp.status_code == 200
+                async for line in resp.aiter_lines():
+                    col.feed_line(line)
+    finally:
+        server.close()
+        await server.wait_closed()
+    assert sorted(col.texts) == [0, 1, 2], "all three members streamed"
+    streams = [col.stream(i) for i in range(3)]
+    assert "".join(col.final) == "\n---\n".join(streams)
+
+
 def test_stacked_engine_matches_separate_seeded_engines_via_backend():
     """End-to-end: the stacked backends' completions equal the old
     three-separate-engines completions (seed i ↔ member i)."""

@@ -8,8 +8,6 @@ app — three engines with different compiled programs co-resident on one
 device, fanned out and concatenated — in both non-streaming and SSE modes.
 """
 
-import json
-
 import httpx
 
 from quorum_tpu.config import Config
@@ -71,23 +69,16 @@ async def test_mixed_family_quorum_non_streaming():
 
 
 async def test_mixed_family_quorum_streaming():
-    texts: dict[int, list[str]] = {}
+    from tests.conftest import ParallelStreamCollector
+
+    col = ParallelStreamCollector()
     async with mixed_client() as client:
         async with client.stream(
             "POST", "/chat/completions", json=BODY | {"stream": True}
         ) as resp:
             assert resp.status_code == 200
             async for line in resp.aiter_lines():
-                if not line.startswith("data: ") or line == "data: [DONE]":
-                    continue
-                chunk = json.loads(line[6:])
-                if chunk["id"].startswith("chatcmpl-parallel-") and \
-                        chunk["id"] != "chatcmpl-parallel-final":
-                    idx = int(chunk["id"].rsplit("-", 1)[1])
-                    for ch in chunk.get("choices") or []:
-                        d = (ch.get("delta") or {}).get("content")
-                        if d:
-                            texts.setdefault(idx, []).append(d)
-    assert sorted(texts) == [0, 1, 2], "all three families streamed"
-    streams = ["".join(v) for _, v in sorted(texts.items())]
+                col.feed_line(line)
+    assert sorted(col.texts) == [0, 1, 2], "all three families streamed"
+    streams = [col.stream(i) for i in range(3)]
     assert len(set(streams)) == 3
