@@ -101,6 +101,25 @@ def test_kv_quant_chunked_prefill_and_prefix_reuse_exact():
     assert warm.prefix_hits >= 1
 
 
+def test_kv_quant_engine_on_mesh():
+    """The (int8, scale) cache under GSPMD: values shard like the bf16 cache
+    and the scale array drops the head_dim axis — the full engine path on a
+    dp×tp mesh must still generate, and its first token (sampled from the
+    bf16 prefill logits) must match the single-device kv_quant engine."""
+    from quorum_tpu.parallel import MeshConfig, make_mesh
+
+    spec = resolve_spec("llama-tiny", {"n_kv_heads": "4"})
+    eng_1 = InferenceEngine(spec, seed=3, decode_chunk=4, n_slots=2,
+                            kv_quant="int8")
+    eng_m = InferenceEngine(spec, make_mesh(MeshConfig(dp=2, tp=4)), seed=3,
+                            decode_chunk=4, n_slots=2, kv_quant="int8")
+    kw = dict(max_new_tokens=8, sampler=SamplerConfig(temperature=0.0))
+    one = eng_1.generate([7, 8, 9], **kw).token_ids
+    sharded = eng_m.generate([7, 8, 9], **kw).token_ids
+    assert len(sharded) == 8
+    assert sharded[0] == one[0]
+
+
 def test_kv_quant_url_and_engine_identity():
     def mk(url):
         return TpuBackend.from_spec(BackendSpec(name="b", url=url, model="t"))
