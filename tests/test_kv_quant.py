@@ -117,7 +117,10 @@ def test_kv_quant_engine_on_mesh():
     one = eng_1.generate([7, 8, 9], **kw).token_ids
     sharded = eng_m.generate([7, 8, 9], **kw).token_ids
     assert len(sharded) == 8
-    assert sharded[0] == one[0]
+    # full token-for-token equality (same bar as the bf16 sibling test,
+    # test_engine_mesh.py): int8 rounding happens before the cache write,
+    # so sharded and single-device decode read identical stored bytes
+    assert sharded == one
 
 
 def test_kv_quant_url_and_engine_identity():
