@@ -117,17 +117,16 @@ B7Q_URL = (f"tpu://{B7Q_MODEL}?max_seq=8192&slots=2&decode_chunk=16"
            f"&max_tokens=64&quant=int8&prefill_chunk=512")
 
 
-def build_app(stacked: bool | None = None):
+def build_app(stacked: bool):
     from quorum_tpu.config import Config
     from quorum_tpu.server.app import create_app
 
     # Stacked fan-out (members=3): the three quorum members share one engine
     # whose every decode chunk advances all of them in a single dispatch —
     # same weights/tokens as three separate seed=i engines (pinned by
-    # tests/test_members.py), ~1/3 the host dispatch overhead.
-    # QUORUM_TPU_BENCH_STACKED=0 restores the three-engine shape.
-    if stacked is None:
-        stacked = os.environ.get("QUORUM_TPU_BENCH_STACKED", "1") != "0"
+    # tests/test_members.py), ~1/3 the host dispatch overhead. main() reads
+    # QUORUM_TPU_BENCH_STACKED (=0 restores the three-engine shape) — the
+    # env knob has exactly one reader.
     member = (lambda i: f"members=3&member={i}") if stacked else (
         lambda i: f"seed={i}")
     raw = {

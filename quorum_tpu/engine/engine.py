@@ -292,10 +292,8 @@ class InferenceEngine:
                     "(ring attention inside the member vmap)")
             if params is not None:
                 raise ValueError(_CKPT_MEMBERS_ERROR)
-            # v1 restrictions: admission is single-shot (the coalesced
-            # member-vmapped prefill), so chunked prefill / prefix caching /
-            # speculative verification are disabled on stacked engines.
-            self.prefill_chunk = 0
+            # Speculative verification is not member-vmapped; everything
+            # else (chunked prefill, prefix caching) runs member-coalesced.
             self.spec_decode = 0
         # Automatic prefix caching (zero-copy): each slot remembers the token
         # sequence whose K/V its cache rows still hold; a new request admits
@@ -1055,16 +1053,19 @@ class InferenceEngine:
             i += 1
         return i
 
-    def _pick_slot(self, prompt: list[int]) -> tuple[int | None, int]:
+    def _pick_slot(self, prompt: list[int], member: int = 0) -> tuple[int | None, int]:
         """(best free slot, reusable prefix length). Prefers the slot whose
         resident tokens share the longest prefix with ``prompt``; among
         equal matches (typically lcp 0), the slot with the SHORTEST resident
         content wins, so a no-match request lands on an empty slot instead
-        of evicting another conversation's long reusable history. (Only the
-        members=1 admission path calls this; stacked engines pick rows with
-        ``_common_free_row``.)"""
+        of evicting another conversation's long reusable history. On a
+        stacked engine only ``member``'s own rows are candidates (the
+        chunked/reused admission route; coalesced single-shot admission
+        uses ``_common_free_row`` instead)."""
         best, best_score = None, None
-        for i, r in enumerate(self._slots):
+        lo = member * self.n_slots
+        for i in range(lo, lo + self.n_slots):
+            r = self._slots[i]
             if r is not None or i in self._claimed:
                 continue
             lcp = self._lcp(self._resident[i], prompt) if self.prefix_cache else 0
@@ -1102,11 +1103,7 @@ class InferenceEngine:
             # segment's bucket-padded dynamic_update_slice could cross
             # max_seq, where the clamped start silently corrupts valid
             # cache rows (see __init__'s chunk-alignment invariant).
-            reuse = min(lcp, len(req.prompt_ids) - 1)
-            if self.prefill_chunk:
-                reuse -= reuse % self.prefill_chunk
-            if reuse < MIN_PREFIX_REUSE:
-                reuse = 0
+            reuse = self._reuse_len(lcp, len(req.prompt_ids))
             if reuse or (
                 self.prefill_chunk and len(req.prompt_ids) > self.prefill_chunk
             ):
@@ -1125,64 +1122,102 @@ class InferenceEngine:
                 self._admit(req, slot)
 
     def _common_free_row(self, members) -> int | None:
-        """A slot row index that is free for EVERY given member. Caller holds
+        """The slot row that is free for EVERY given member, preferring the
+        row with the LEAST resident content across them — same tie-break as
+        ``_pick_slot``: a fresh admission should land on an empty row, not
+        evict another conversation's reusable prefix history. Caller holds
         ``_cond``."""
+        best, best_load = None, None
         for s in range(self.n_slots):
-            if all(
+            if not all(
                 self._slots[m * self.n_slots + s] is None
                 and (m * self.n_slots + s) not in self._claimed
                 for m in members
             ):
-                return s
-        return None
+                continue
+            load = sum(len(self._resident[m * self.n_slots + s])
+                       for m in members)
+            if best_load is None or load < best_load:
+                best, best_load = s, load
+        return best
+
+    def _reuse_len(self, lcp: int, n_prompt: int) -> int:
+        """Usable prefix-reuse length: capped at n_prompt−1, aligned DOWN to
+        a prefill_chunk multiple, zero below MIN_PREFIX_REUSE (the same
+        invariants as the single-engine admission route — see
+        ``_start_admissions``)."""
+        reuse = min(lcp, n_prompt - 1)
+        if self.prefill_chunk:
+            reuse -= reuse % self.prefill_chunk
+        return reuse if reuse >= MIN_PREFIX_REUSE else 0
 
     def _start_admissions_members(self) -> None:
-        """Admission for stacked-members engines: gather up to one pending
-        request per member into a group sharing one prompt bucket and one
-        free slot row, then admit the whole group in a single member-vmapped
-        prefill (``_admit_fn_members``). The quorum fan-out submits the same
-        prompt to every member at once, so the common case is a full group —
-        M admissions for one dispatch."""
+        """Admission for stacked-members engines. Two routes, decided per
+        member queue head (FIFO per member — only heads are candidates):
+
+        - **Chunked / prefix-reuse**: a head that is longer than
+          prefill_chunk, or whose prefix is resident in one of its member's
+          free rows, becomes an :class:`_Admission` on that member's own
+          best row; in-flight admissions advance member-coalesced — one
+          vmapped segment program per (bucket, history) group per iteration
+          (``_step_admissions_members``).
+        - **Single-shot**: remaining short heads coalesce into one
+          member-vmapped prefill sharing a common free slot row
+          (``_admit_fn_members``); anchoring on every head in FIFO order
+          keeps one busy member's full slots from starving idle members."""
         while True:
+            admit_chunked: _Admission | None = None
+            group: dict[int, _Request] = {}
+            row = None
             with self._cond:
                 if not self._pending:
                     return
-                # Per-member FIFO: only each member's OLDEST pending request
-                # (its queue head) is ever a candidate — requests must start
-                # in submission order per backend.
                 heads: list[_Request] = []
                 seen: set[int] = set()
                 for r in self._pending:
                     if r.member not in seen:
                         seen.add(r.member)
                         heads.append(r)
-                # Anchor on each head in global FIFO order: coalesce every
-                # head sharing the anchor's bucket when a slot row is free
-                # for all of them, else admit the anchor alone. Trying every
-                # anchor (not just pending[0]) keeps one busy member's full
-                # slots from starving idle members' queues (cross-member
-                # head-of-line blocking).
-                group: dict[int, _Request] = {}
-                row = None
-                for anchor in heads:
-                    bucket = prefill_bucket(
-                        len(anchor.prompt_ids), self.spec.max_seq)
-                    group = {
-                        h.member: h for h in heads
-                        if prefill_bucket(
-                            len(h.prompt_ids), self.spec.max_seq) == bucket
-                    }
-                    row = self._common_free_row(group)
-                    if row is None and len(group) > 1:
-                        group = {anchor.member: anchor}
-                        row = self._common_free_row(group)
-                    if row is not None:
+                for r in heads:
+                    slot, lcp = self._pick_slot(r.prompt_ids, r.member)
+                    if slot is None:
+                        continue
+                    reuse = self._reuse_len(lcp, len(r.prompt_ids))
+                    if reuse or (self.prefill_chunk
+                                 and len(r.prompt_ids) > self.prefill_chunk):
+                        if reuse:
+                            self.prefix_hits += 1
+                            self.prefix_tokens_saved += reuse
+                        self._pending.remove(r)
+                        self._claimed.add(slot)
+                        self._resident[slot] = r.prompt_ids[:reuse]
+                        admit_chunked = _Admission(r, slot, offset=reuse)
+                        self._admitting.append(admit_chunked)
                         break
-                if row is None:
-                    return  # no member has both a queue head and a free row
-                for r in group.values():
-                    self._pending.remove(r)
-            self._admit_members(group, row, bucket)
+                if admit_chunked is None:
+                    for anchor in heads:
+                        bucket = prefill_bucket(
+                            len(anchor.prompt_ids), self.spec.max_seq)
+                        group = {
+                            h.member: h for h in heads
+                            if prefill_bucket(
+                                len(h.prompt_ids), self.spec.max_seq
+                            ) == bucket
+                        }
+                        row = self._common_free_row(group)
+                        if row is None and len(group) > 1:
+                            group = {anchor.member: anchor}
+                            row = self._common_free_row(group)
+                        if row is not None:
+                            break
+                    if row is None:
+                        return  # no head has a usable row this iteration
+                    for r in group.values():
+                        self._pending.remove(r)
+            if admit_chunked is None:
+                self._admit_members(group, row, bucket)
+            # chunked admissions advance in _step_admissions_members; loop
+            # to route any further heads
 
     def _admit_members(self, group: dict[int, _Request], row: int,
                        bucket: int) -> None:
@@ -1245,12 +1280,121 @@ class InferenceEngine:
                 with self._cond:
                     self._slots[flat] = req
 
+    def _seg_fn_members(self, bucket: int, history: int):
+        """Jitted member-coalesced prompt segment: each member advances its
+        own in-flight admission (own tokens/offset/slot row) in one vmapped
+        program; ``enables[m]`` gates absent members' cache writes."""
+        fn = self._admit_cache.get(("mseg", bucket, history))
+        if fn is not None:
+            return fn
+        spec = self.spec
+
+        def seg(params, tokens, offsets, n_valids, slots, enables, ck, cv):
+            # tokens [M, 1, bucket]; offsets/n_valids/slots [M] int32;
+            # enables [M] bool
+            def one(p, tok, off, nv, slot, en, k, v):
+                return prefill_segment(p, spec, tok, off, nv, k, v, slot,
+                                       history=history, write_gate=en)
+
+            return jax.vmap(one)(
+                params, tokens, offsets, n_valids, slots, enables, ck, cv)
+
+        fn = jax.jit(seg, donate_argnames=("ck", "cv"))
+        self._admit_cache[("mseg", bucket, history)] = fn
+        return fn
+
+    def _step_admissions_members(self) -> None:
+        """Advance in-flight chunked admissions on a stacked engine:
+        admissions sharing a (segment bucket, history bucket) — the lockstep
+        fan-out case — coalesce into ONE vmapped segment program, at most
+        one admission per member per call."""
+        groups: dict[tuple[int, int], list[_Admission]] = {}
+        for adm in list(self._admitting):
+            req = adm.req
+            if req.cancel.is_set():
+                req.out.put(("end", None))
+                self._release_admission(adm)
+                continue
+            seg = req.prompt_ids[adm.offset: adm.offset + self.prefill_chunk]
+            bucket = prefill_bucket(len(seg), self.prefill_chunk)
+            history = prefill_bucket(adm.offset + len(seg), self.spec.max_seq)
+            groups.setdefault((bucket, history), []).append(adm)
+        for (bucket, history), adms in groups.items():
+            while adms:
+                batch: dict[int, _Admission] = {}
+                rest: list[_Admission] = []
+                for adm in adms:
+                    m = adm.slot // self.n_slots
+                    if m in batch:
+                        rest.append(adm)
+                    else:
+                        batch[m] = adm
+                adms = rest
+                self._run_member_segments(batch, bucket, history)
+
+    def _run_member_segments(
+        self, batch: dict[int, _Admission], bucket: int, history: int
+    ) -> None:
+        mem, n_s = self.members, self.n_slots
+        tokens = np.zeros((mem, 1, bucket), np.int32)
+        offsets = np.zeros((mem,), np.int32)
+        n_valids = np.zeros((mem,), np.int32)
+        slots = np.zeros((mem,), np.int32)
+        enables = np.zeros((mem,), bool)
+        for m, adm in batch.items():
+            req = adm.req
+            seg = req.prompt_ids[adm.offset: adm.offset + self.prefill_chunk]
+            tokens[m, 0, : len(seg)] = seg
+            offsets[m] = adm.offset
+            n_valids[m] = len(seg)
+            slots[m] = adm.slot % n_s
+            enables[m] = True
+        self._ck, self._cv = self._seg_fn_members(bucket, history)(
+            self.params, tokens, offsets, n_valids, slots, enables,
+            self._ck, self._cv,
+        )
+        for m, adm in batch.items():
+            adm.offset += int(n_valids[m])
+            self._resident[adm.slot] = adm.req.prompt_ids[: adm.offset]
+            if adm.offset >= len(adm.req.prompt_ids):
+                self._finish_admission(adm)
+
+    def _finish_admission(self, adm: _Admission) -> None:
+        """Install a finished chunked admission's per-slot state (flat row —
+        identical for plain and stacked engines) and activate the slot."""
+        req = adm.req
+        prompt = req.prompt_ids
+        bias = req.bias_row if req.bias_row is not None else self._zero_bias
+        (self._token, self._lengths, self._keys, self._temp,
+         self._topp, self._topk, self._pp, self._fp,
+         self._counts, self._bias) = self._register_fn()(
+            np.int32(adm.slot),
+            np.int32(prompt[-1]),
+            np.int32(len(prompt) - 1),
+            np.int32(req.seed),
+            np.float32(req.temperature),
+            np.float32(req.top_p),
+            np.int32(req.top_k),
+            np.float32(req.pp),
+            np.float32(req.fp),
+            bias,
+            self._token, self._lengths, self._keys,
+            self._temp, self._topp, self._topk,
+            self._pp, self._fp, self._counts, self._bias,
+        )
+        with self._cond:
+            self._slots[adm.slot] = req
+        self._release_admission(adm)
+
     def _step_admissions(self) -> None:
         """Advance every in-progress chunked admission by ONE prompt segment.
         Interleaving unit of the scheduler: between any two segments (and
         before the next one), `_run_chunk` keeps active requests decoding —
         a long admission can no longer stall in-flight streams
         (VERDICT r2 weakness 6)."""
+        if self.members > 1:
+            self._step_admissions_members()
+            return
         for adm in list(self._admitting):
             req = adm.req
             if req.cancel.is_set():
@@ -1271,28 +1415,7 @@ class InferenceEngine:
             # keep the prefix-cache view in sync with what the cache rows hold
             self._resident[adm.slot] = prompt[: adm.offset]
             if adm.offset >= len(prompt):
-                bias = (req.bias_row if req.bias_row is not None
-                        else self._zero_bias)
-                (self._token, self._lengths, self._keys, self._temp,
-                 self._topp, self._topk, self._pp, self._fp,
-                 self._counts, self._bias) = self._register_fn()(
-                    np.int32(adm.slot),
-                    np.int32(prompt[-1]),
-                    np.int32(len(prompt) - 1),
-                    np.int32(req.seed),
-                    np.float32(req.temperature),
-                    np.float32(req.top_p),
-                    np.int32(req.top_k),
-                    np.float32(req.pp),
-                    np.float32(req.fp),
-                    bias,
-                    self._token, self._lengths, self._keys,
-                    self._temp, self._topp, self._topk,
-                    self._pp, self._fp, self._counts, self._bias,
-                )
-                with self._cond:
-                    self._slots[adm.slot] = req
-                self._release_admission(adm)
+                self._finish_admission(adm)
 
     def _release_admission(self, adm: _Admission) -> None:
         with self._cond:

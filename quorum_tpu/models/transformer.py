@@ -379,6 +379,7 @@ def prefill_segment(
     cache_v: jnp.ndarray,
     slot: jnp.ndarray,     # scalar int32
     history: int | None = None,  # static: attend over cache[:history] only
+    write_gate: jnp.ndarray | None = None,  # scalar bool: False → cache unchanged
 ):
     """Chunked prefill: process prompt positions [offset, offset+T) of one slot.
 
@@ -417,15 +418,21 @@ def prefill_segment(
     moe_mask = (jnp.arange(t) < n_valid)[None, :]  # [1,T]
 
     def seg_write(cache, value):
-        # value [1, K, t, hd] at absolute position offset of row `slot`
+        # value [1, K, t, hd] at absolute position offset of row `slot`;
+        # write_gate (stacked-members segment coalescing) writes the touched
+        # region back unchanged when False — region-sized extra read only.
+        def gated(arr, new, idx):
+            if write_gate is not None:
+                old = lax.dynamic_slice(arr, idx, new.shape)
+                new = jnp.where(write_gate, new, old)
+            return lax.dynamic_update_slice(arr, new, idx)
+
         if kv_is_q8(cache):
             c8, cs = cache
             q8, s = _kv_quantize(value)
-            return (lax.dynamic_update_slice(c8, q8, (slot, 0, offset, 0)),
-                    lax.dynamic_update_slice(
-                        cs, s.astype(cs.dtype), (slot, 0, offset)))
-        return lax.dynamic_update_slice(
-            cache, value.astype(cache.dtype), (slot, 0, offset, 0))
+            return (gated(c8, q8, (slot, 0, offset, 0)),
+                    gated(cs, s.astype(cs.dtype), (slot, 0, offset)))
+        return gated(cache, value.astype(cache.dtype), (slot, 0, offset, 0))
 
     def seg_read(cache, dtype):
         # the slot's history window [1, K, hist, hd]; int8 caches dequantize

@@ -83,6 +83,50 @@ def test_more_requests_than_slots_per_member():
     assert all(len(r) == 5 for r in results)
 
 
+def test_members_chunked_prefill_matches_single_engines():
+    """Long prompts on a stacked engine ride member-coalesced chunked
+    prefill (one vmapped segment program per scheduler turn) and must still
+    match the per-seed engines token-for-token — including when both
+    members admit the same long prompt concurrently (the fan-out shape)."""
+    spec = resolve_spec("llama-tiny", {"max_seq": "128"})
+    stacked = InferenceEngine(spec, seed=0, members=2, decode_chunk=4,
+                              n_slots=2, prefill_chunk=16)
+    singles = [InferenceEngine(spec, seed=i, decode_chunk=4, n_slots=2,
+                               prefill_chunk=16) for i in range(2)]
+    prompt = [(3 + 7 * i) % 500 for i in range(50)]  # > prefill_chunk
+    kw = dict(max_new_tokens=6, sampler=SamplerConfig(temperature=0.7),
+              seed=5)
+    want = [singles[i].generate(prompt, **kw).token_ids for i in range(2)]
+    with ThreadPoolExecutor(max_workers=2) as ex:
+        got = list(ex.map(
+            lambda m: stacked.generate(prompt, member=m, **kw).token_ids,
+            range(2)))
+    assert got == want
+
+
+def test_members_prefix_reuse_exact_and_counted():
+    """Warm turns on a stacked engine reuse each member's own resident
+    rows: output matches a reuse-disabled stacked engine exactly and the
+    hit counter advances once per member."""
+    spec = resolve_spec("llama-tiny", {"max_seq": "128"})
+    eng = InferenceEngine(spec, seed=0, members=2, decode_chunk=4,
+                          n_slots=1, prefill_chunk=16)
+    cold = InferenceEngine(spec, seed=0, members=2, decode_chunk=4,
+                           n_slots=1, prefill_chunk=16, prefix_cache=False)
+    prompt = [(9 + 3 * i) % 500 for i in range(40)]
+    follow = prompt + [7, 8, 9]
+    kw = dict(max_new_tokens=5, sampler=SamplerConfig(temperature=0.6),
+              seed=2)
+    for m in range(2):
+        assert eng.generate(prompt, member=m, **kw).token_ids == \
+            cold.generate(prompt, member=m, **kw).token_ids
+    hits0 = eng.prefix_hits
+    for m in range(2):
+        assert eng.generate(follow, member=m, **kw).token_ids == \
+            cold.generate(follow, member=m, **kw).token_ids
+    assert eng.prefix_hits >= hits0 + 2
+
+
 def test_members_logprobs_and_choices():
     """logprobs and n>1 choices ride the members path unchanged."""
     eng = InferenceEngine(TINY, seed=0, members=2, decode_chunk=4, n_slots=2)

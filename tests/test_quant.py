@@ -149,6 +149,10 @@ def test_ckpt_quant_logits_close_to_transformers(tmp_path):
         max_position_embeddings=64, rope_theta=10000.0,
         tie_word_embeddings=False,
     )
+    # Deterministic weights: the int8 error/argmax bounds below are tight
+    # enough that an unlucky UNSEEDED draw can cross them (observed once in
+    # a full-suite run) — that flake tells us nothing about the quantizer.
+    torch.manual_seed(0)
     model = LlamaForCausalLM(cfg).eval()
     model.save_pretrained(tmp_path, safe_serialization=True)
     tokens = np.array([[3, 17, 5, 9, 250, 11, 42, 7]], dtype=np.int32)
