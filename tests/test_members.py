@@ -140,6 +140,17 @@ def test_members_logprobs_and_choices():
     assert lp <= 0.0 and len(top_ids) >= 3
 
 
+def test_stacked_engine_survives_poisoned_state():
+    """_fail_all on a stacked engine: waiting consumers get the error, the
+    member-stacked device state rebuilds, and the engine serves again."""
+    eng = InferenceEngine(TINY, seed=0, members=2, decode_chunk=4, n_slots=2)
+    before = _gen(eng, 1, 5, [4, 5, 6])
+    eng._fail_all(RuntimeError("injected device poison"))
+    after = _gen(eng, 1, 5, [4, 5, 6])
+    assert after == before  # fresh state, same seeds → same stream
+    assert eng.n_failures >= 0
+
+
 def test_member_out_of_range_and_exclusions():
     eng = InferenceEngine(TINY, seed=0, members=2, n_slots=1)
     with pytest.raises(ValueError, match="member 5 out of range"):
