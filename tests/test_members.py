@@ -140,6 +140,47 @@ def test_members_logprobs_and_choices():
     assert lp <= 0.0 and len(top_ids) >= 3
 
 
+async def test_stacked_two_hop_aggregation():
+    """The reference's flagship workflow on ONE stacked engine: fan out to
+    two members, then synthesize via a THIRD member as the aggregator —
+    three weight sets, two hops, zero network, one engine's programs."""
+    from tests.conftest import make_client
+
+    url = "tpu://llama-tiny?members=3&member={}&slots=2&max_seq=64"
+    raw = {
+        "settings": {"timeout": 120},
+        "primary_backends": [
+            {"name": "A", "url": url.format(0), "model": "m"},
+            {"name": "B", "url": url.format(1), "model": "m"},
+            {"name": "AGG", "url": url.format(2), "model": "m"},
+        ],
+        "iterations": {"aggregation": {"strategy": "aggregate"}},
+        "strategy": {
+            "concatenate": {"separator": "\n---\n"},
+            "aggregate": {
+                "source_backends": ["A", "B"],
+                "aggregator_backend": "AGG",
+                "intermediate_separator": "@@SEP@@",
+                "include_source_names": False,
+                "suppress_individual_responses": True,
+            },
+        },
+    }
+    async with make_client(raw) as client:
+        resp = await client.post(
+            "/chat/completions",
+            json={"model": "m", "max_tokens": 6, "temperature": 0,
+                  "messages": [{"role": "user", "content": "hello"}]},
+            headers={"Authorization": "Bearer x"},
+        )
+    assert resp.status_code == 200
+    content = resp.json()["choices"][0]["message"]["content"]
+    # a separator in the output would mean the join fallback ran instead of
+    # the member-2 aggregation hop
+    assert "@@SEP@@" not in content
+    assert content
+
+
 def test_stacked_engine_survives_poisoned_state():
     """_fail_all on a stacked engine: waiting consumers get the error, the
     member-stacked device state rebuilds, and the engine serves again."""
