@@ -365,11 +365,20 @@ async def bench_7b(model: str, url: str, prefix: str, quant: bool,
             lp_warm = statistics.median(
                 [await one_long(f"w{i}") for i in range(3)])
 
+            core = _core_7b_metrics(
+                model, prefix, quant, rates, c2_tok_s, ttfts,
+                lp_cold, lp_warm)
+
             # Long-context serving: a ~5k-token prompt admitted via chunked
             # prefill (512-token segments interleaved with decode chunks)
             # and decoded against the long-history cache bucket.
             long_metrics: dict = {}
             if long_ctx:
+                # Checkpoint the core metrics first: the parent parses the
+                # LAST JSON line of this child's stdout, so if the long
+                # phase dies (compile timeout, wedged tunnel) the north-star
+                # numbers above still record.
+                print(json.dumps(core), flush=True)
                 sent = ("The quick brown fox jumps over the lazy dog; "
                         "pack my box with five dozen liquor jugs. ")
                 long_text = (sent * 64)[:5000]  # ~5k byte-tokens
@@ -380,18 +389,30 @@ async def bench_7b(model: str, url: str, prefix: str, quant: bool,
                     "max_tokens": 32,
                 }
 
-                await one(lbody)  # compile segment/history buckets
-                lttft, ldecode_s, ln, _f, _l = await one(lbody)
-                long_metrics = {
-                    f"{prefix}_long_prompt_tokens": 5000,
-                    f"{prefix}_long_ttft_ms": round(lttft * 1000, 2),
-                    f"{prefix}_long_decode_tok_s": round(
-                        (ln - 1) / ldecode_s, 2),
-                }
+                try:
+                    await one(lbody)  # compile segment/history buckets
+                    lttft, ldecode_s, ln, _f, _l = await one(lbody)
+                    long_metrics = {
+                        f"{prefix}_long_prompt_tokens": 5000,
+                        f"{prefix}_long_ttft_ms": round(lttft * 1000, 2),
+                        f"{prefix}_long_decode_tok_s": round(
+                            (ln - 1) / ldecode_s, 2),
+                    }
+                except Exception as e:
+                    # A failing long phase must not discard the core
+                    # metrics (seven_b_main would otherwise print an
+                    # error-only dict as the last JSON line).
+                    long_metrics = {
+                        f"{prefix}_long_error": f"{type(e).__name__}: {e}"}
     finally:
         server.close()
         await server.wait_closed()
 
+    return {**core, **long_metrics}
+
+
+def _core_7b_metrics(model, prefix, quant, rates, c2_tok_s, ttfts,
+                     lp_cold, lp_warm) -> dict:
     tok_s = statistics.median(rates)
     weight_bytes, kv_bytes = _b7_bytes_per_token(model, 1 if quant else 2)
     n_params = weight_bytes // (1 if quant else 2)
@@ -407,7 +428,6 @@ async def bench_7b(model: str, url: str, prefix: str, quant: bool,
         f"{prefix}_prefix_warm_ttft_ms": round(lp_warm * 1000, 2),
         f"{prefix}_prefix_speedup": (
             round(lp_cold / lp_warm, 2) if lp_warm > 0 else 0.0),
-        **long_metrics,
     }
     if not quant:
         # MFU is quoted against the bf16 MXU peak; the int8 phase runs its
@@ -430,36 +450,53 @@ def run_7b_phase() -> dict:
     import subprocess
 
     out: dict = {}
-    for flag, prefix, gate in (("--7b", "b7", BENCH_7B),
-                               ("--7bq", "b7q", BENCH_7BQ)):
+    # The int8 north-star child does much more one-time XLA compilation than
+    # the bf16 one (fused init+quantize of 8B params, the 8192-window cache,
+    # segment programs for 5 history buckets) — give it the larger share of
+    # the parent watchdog's 7200 s budget.
+    for flag, prefix, gate, budget in (("--7b", "b7", BENCH_7B, 2000),
+                                       ("--7bq", "b7q", BENCH_7BQ, 4500)):
         if gate == "0":
             continue
         try:
             proc = subprocess.run(
                 [sys.executable, os.path.abspath(__file__), flag],
-                capture_output=True, text=True, timeout=3000,
+                capture_output=True, text=True, timeout=budget,
                 cwd=os.path.dirname(os.path.abspath(__file__)),
             )
-        except subprocess.TimeoutExpired:
+        except subprocess.TimeoutExpired as e:
             # A hung child (e.g. a wedged TPU tunnel) must not take down the
-            # whole bench — report the phase as errored and move on.
-            out[f"{prefix}_error"] = "subprocess timeout after 3000s"
+            # whole bench — salvage any checkpointed metrics line the child
+            # printed before stalling (the long-ctx phase checkpoints its
+            # core metrics first), then report the timeout and move on.
+            stdout = e.stdout
+            if isinstance(stdout, bytes):
+                stdout = stdout.decode(errors="replace")
+            got = _last_json_line(stdout)
+            out.update(got or {})
+            out[f"{prefix}_error"] = f"subprocess timeout after {budget}s"
             continue
-        got = None
-        for line in reversed((proc.stdout or "").splitlines()):
-            line = line.strip()
-            if line.startswith("{"):
-                try:
-                    got = json.loads(line)
-                except json.JSONDecodeError:
-                    pass
-                break
+        got = _last_json_line(proc.stdout)
         if got is None:
             got = {f"{prefix}_error":
                    f"subprocess rc={proc.returncode}: "
                    f"{(proc.stderr or '')[-300:]}"}
         out.update(got)
     return out
+
+
+def _last_json_line(stdout: "str | None") -> "dict | None":
+    """Latest parseable JSON object line. Malformed brace-prefixed lines are
+    skipped, not fatal: a timed-out child's captured stdout can end mid-line,
+    and the intact checkpoint line above it must still be salvaged."""
+    for line in reversed((stdout or "").splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                return json.loads(line)
+            except json.JSONDecodeError:
+                continue
+    return None
 
 
 async def seven_b_main(quant: bool) -> None:
@@ -586,7 +623,7 @@ def _watchdog(prefix: str | None) -> None:
     The axon TPU tunnel can wedge such that the first jax operation blocks
     forever (observed twice during round-3 builds); without a watchdog the
     whole bench would hang and the driver would record nothing. The budget
-    covers a full legitimate run (two 7B subprocesses ≤ 3000 s each + the
+    covers a full legitimate run (7B subprocesses ≤ 2000 s + 4500 s + the
     socket phases); only a true hang trips it. A 7B child (``prefix``) emits
     its phase-scoped error key — never the parent's top-level schema, which
     would clobber the parent's real phase-1/2 numbers when merged."""
