@@ -135,6 +135,30 @@ def test_kv_quant_url_and_engine_identity():
     assert b1.engine.kv_quant == "int8" and b3.engine.kv_quant is None
 
 
+def test_kv_quant_composes_with_members_and_ensemble():
+    """The (int8, scale) cache under the member axis: both stacked fan-out
+    (members=M, separate streams) and consensus decoding (ensemble=M, one
+    averaged stream) vmap over tuple-leaf caches. Member streams must still
+    match the members=1 kv_quant engine with that member's seed."""
+    stacked = InferenceEngine(TINY, seed=0, members=2, decode_chunk=4,
+                              n_slots=2, kv_quant="int8")
+    singles = [InferenceEngine(TINY, seed=i, decode_chunk=4, n_slots=2,
+                               kv_quant="int8") for i in range(2)]
+    kw = dict(max_new_tokens=6,
+              sampler=SamplerConfig(temperature=0.8, top_p=0.9), seed=4)
+    got = [stacked.generate([3, 4, 5], member=m, **kw).token_ids
+           for m in range(2)]
+    want = [singles[i].generate([3, 4, 5], **kw).token_ids for i in range(2)]
+    assert got == want
+
+    consensus = InferenceEngine(TINY, seed=0, ensemble=2, decode_chunk=4,
+                                n_slots=1, kv_quant="int8")
+    out = consensus.generate([5, 6], max_new_tokens=6,
+                             sampler=SamplerConfig(temperature=0.0)).token_ids
+    assert len(out) == 6
+    assert all(0 <= t < TINY.vocab_size for t in out)
+
+
 def test_kv_quant_composes_with_weight_quant():
     """quant=int8 (weights) + kv_quant=int8 (cache) together: the smallest
     serving footprint — generation still completes and emits valid ids."""
