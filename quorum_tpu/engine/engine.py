@@ -96,6 +96,19 @@ def _member_call(ens: int, fn, params, ck, cv, *, mean: bool = True):
     return jnp.mean(logits.astype(jnp.float32), axis=0), ck, cv
 
 
+def _stacked_rows_call(mem: int, n_s: int, fn, params, ck, cv, *rows):
+    """Member-vmapped model call over flat member-major row arrays.
+
+    Each array in ``rows`` ([M·S, …]) folds to [M, S, …] for the vmap;
+    ``fn(params_m, ck_m, cv_m, *rows_m)`` returns (logits, ck, cv) for one
+    member; the stacked logits unfold back to flat rows. The one home for
+    the fold/unfold convention shared by the stacked decode chunk and the
+    stacked speculative-verify step."""
+    folded = tuple(r.reshape((mem, n_s) + r.shape[1:]) for r in rows)
+    logits, ck, cv = jax.vmap(fn)(params, ck, cv, *folded)
+    return logits.reshape((mem * n_s,) + logits.shape[2:]), ck, cv
+
+
 def prefill_bucket(n: int, max_seq: int) -> int:
     """Smallest power-of-two ≥ n, clamped to [MIN_BUCKET, max_seq]."""
     b = MIN_BUCKET
@@ -292,9 +305,6 @@ class InferenceEngine:
                     "(ring attention inside the member vmap)")
             if params is not None:
                 raise ValueError(_CKPT_MEMBERS_ERROR)
-            # Speculative verification is not member-vmapped; everything
-            # else (chunked prefill, prefix caching) runs member-coalesced.
-            self.spec_decode = 0
         # Automatic prefix caching (zero-copy): each slot remembers the token
         # sequence whose K/V its cache rows still hold; a new request admits
         # into the free slot with the longest common prefix and prefills only
@@ -682,16 +692,14 @@ class InferenceEngine:
                 pos = jnp.where(live, lens, 0)
                 if mem > 1:
                     # Stacked members: one dispatch advances every member's
-                    # slots. Flat state rows [M·S] fold to [M, S] for the
-                    # member-vmapped model call; sampling stays flat.
-                    def one(p, t, ps, k, v, wm):
-                        return decode_step(p, spec, t, ps, k, v,
-                                           write_mask=wm, history=history)
-
-                    logits, ck, cv = jax.vmap(one)(
-                        params, tok.reshape(mem, n_s), pos.reshape(mem, n_s),
-                        ck, cv, live.reshape(mem, n_s))
-                    logits = logits.reshape(n_rows, -1)
+                    # slots (fold/unfold via _stacked_rows_call; sampling
+                    # stays flat).
+                    logits, ck, cv = _stacked_rows_call(
+                        mem, n_s,
+                        lambda p, k, v, t, ps, wm: decode_step(
+                            p, spec, t, ps, k, v, write_mask=wm,
+                            history=history),
+                        params, ck, cv, tok, pos, live)
                 else:
                     logits, ck, cv = _member_call(
                         ens,
@@ -765,20 +773,33 @@ class InferenceEngine:
         if fn is not None:
             return fn
         spec = self.spec
-        n_slots = self._rows  # == n_slots: members>1 disables spec_decode
+        n_slots = self._rows  # flat rows (member-major on stacked engines)
+        n_s = self.n_slots
         ens = self.ensemble
+        mem = self.members
 
         def verify(params, active, tokens, ck, cv, token_s, lengths_s, keys_s,
                    temp_s, topp_s, topk_s, counts_s):
             live = active > 0
             pos = jnp.where(live, lengths_s, 0)
-            logits, ck, cv = _member_call(
-                ens,
-                lambda p, k, v: decode_multi(
-                    p, spec, tokens, pos, k, v, write_mask=live,
-                    history=history),
-                params, ck, cv,
-            )  # [S, g+1, V]
+            if mem > 1:
+                # Stacked members: verify all members' drafts in one
+                # member-vmapped multi-token forward (same fold/unfold as
+                # the decode chunk — _stacked_rows_call).
+                logits, ck, cv = _stacked_rows_call(
+                    mem, n_s,
+                    lambda p, k, v, t, ps, wm: decode_multi(
+                        p, spec, t, ps, k, v, write_mask=wm,
+                        history=history),
+                    params, ck, cv, tokens, pos, live)
+            else:
+                logits, ck, cv = _member_call(
+                    ens,
+                    lambda p, k, v: decode_multi(
+                        p, spec, tokens, pos, k, v, write_mask=live,
+                        history=history),
+                    params, ck, cv,
+                )  # [S, g+1, V]
             split = jax.vmap(jax.random.split)(keys_s)
             s0 = sample_token_rows(
                 logits[:, 0].astype(jnp.float32), split[:, 1],
@@ -1740,12 +1761,8 @@ def get_engine(
             )
             _ENGINES[key] = eng
         else:
-            if eng.members == 1:
-                # Stacked engines force spec_decode=0 at construction (the
-                # verify program is not member-vmapped); a later backend's
-                # URL must not re-enable it on the shared engine.
-                eng.spec_decode = max(eng.spec_decode,
-                                      max(0, min(spec_decode, 16)))
+            eng.spec_decode = max(eng.spec_decode,
+                                  max(0, min(spec_decode, 16)))
             eng.prefix_cache = eng.prefix_cache and bool(prefix_cache)
         return eng
 

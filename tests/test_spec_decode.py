@@ -17,7 +17,7 @@ TINY = resolve_spec("llama-tiny")
 GREEDY = SamplerConfig(temperature=0.0)
 
 
-def _assert_same_or_tie_flip(prompt, a, b, tol=0.05):
+def _assert_same_or_tie_flip(prompt, a, b, tol=0.05, member_seed=0):
     """Sequences must match token-for-token; the single allowed exception is
     an argmax near-tie: the multi-token verification program reassociates
     float ops differently from the single-token program, so two logits
@@ -25,14 +25,15 @@ def _assert_same_or_tie_flip(prompt, a, b, tol=0.05):
     check against a cache-free full forward that BOTH choices sit within
     ``tol`` of the true max logit — corruption would produce a token far
     below the max — then stop comparing (the sequences legitimately differ
-    after a flip)."""
+    after a flip). ``member_seed`` selects the weight seed to audit against
+    (stacked-members callers pass the member's seed)."""
     if a == b:
         return
     from quorum_tpu.models.init import init_params
     from quorum_tpu.models.transformer import forward_logits
 
     i = next(i for i, (x, y) in enumerate(zip(a, b)) if x != y)
-    params = init_params(TINY, 0)
+    params = init_params(TINY, member_seed)
     seq = np.asarray([list(prompt) + a[:i]], np.int32)
     logits = np.asarray(forward_logits(params, TINY, seq)[0, -1], np.float32)
     top = float(logits.max())
