@@ -28,6 +28,16 @@ concurrent requests):
     from quorum_tpu.parallel.sharding; the same code runs on a 1-device CPU
     mesh (tests), a single TPU chip (bench), or a tp×dp slice (GSPMD inserts
     the collectives).
+  - **Stacked fan-out members** (``members=M``): the N-model quorum's weight
+    sets live ``[M, …]`` on ONE engine; every decode chunk, coalesced
+    admission (single-shot or chunked segment), and speculative-verify step
+    advances ALL members in a single member-vmapped program — N models'
+    streams for one host turnaround per dispatch. Distinct from
+    ``ensemble=M`` (one consensus stream from averaged logits).
+  - **Quantized representations**: ``quant=int8`` stores weights int8 with
+    per-channel scales (native int8 MXU matmuls); ``kv_quant=int8`` stores
+    the KV cache as (int8, per-token scale) pairs with native int8 decode
+    attention. Both halve their side's HBM bytes; they compose.
 
 The reference has no analog — its "backends" are HTTP calls
 (/root/reference/src/quorum/oai_proxy.py:182-192). This module is what makes a
