@@ -192,6 +192,32 @@ def test_stacked_engine_survives_poisoned_state():
     assert eng.n_failures >= 0
 
 
+def test_member_sampler_state_isolation():
+    """Per-member sampler state must not leak across the coalesced
+    admission: a logit_bias that forces member 0 onto one token leaves
+    member 1's stream exactly as it would be without any sibling."""
+    import numpy as np
+
+    eng = InferenceEngine(TINY, seed=0, members=2, decode_chunk=4, n_slots=1)
+    kw = dict(max_new_tokens=5,
+              sampler=SamplerConfig(temperature=0.8, top_p=0.9))
+    baseline = list(eng.stream_results(
+        eng.submit([4, 5, 6], seed=3, member=1, **kw)))
+
+    forced = 7
+    bias = np.zeros((TINY.vocab_size,), np.float32)
+    bias[forced] = 100.0
+    from concurrent.futures import ThreadPoolExecutor as _TPE
+    with _TPE(max_workers=2) as ex:
+        f0 = ex.submit(lambda: list(eng.stream_results(eng.submit(
+            [4, 5, 6], seed=3, member=0, logit_bias=bias, **kw))))
+        f1 = ex.submit(lambda: list(eng.stream_results(eng.submit(
+            [4, 5, 6], seed=3, member=1, **kw))))
+        biased0, plain1 = f0.result(), f1.result()
+    assert all(t == forced for t in biased0), "bias must dominate member 0"
+    assert plain1 == baseline, "sibling's bias leaked into member 1"
+
+
 def test_member_out_of_range_and_exclusions():
     eng = InferenceEngine(TINY, seed=0, members=2, n_slots=1)
     with pytest.raises(ValueError, match="member 5 out of range"):
