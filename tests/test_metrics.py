@@ -19,6 +19,10 @@ async def test_metrics_exposition():
         assert "quorum_tpu_uptime_seconds" in before
         assert 'quorum_tpu_engine_slots{backend="LLM1"} 2' in before
         assert 'quorum_tpu_engine_requests_total{backend="LLM1"} 0' in before
+        # members is exported as a gauge (1 on ordinary engines; M on
+        # stacked engines, whose "slots" reads M x n_slots flat rows)
+        assert 'quorum_tpu_engine_members{backend="LLM1"} 1' in before
+        assert "# TYPE quorum_tpu_engine_members gauge" in before
 
         resp = await client.post(
             "/v1/chat/completions",
