@@ -438,51 +438,81 @@ def _core_7b_metrics(model, prefix, quant, rates, c2_tok_s, ttfts,
     return out
 
 
-def run_7b_phase() -> dict:
-    """Run the 7B benches in SUBPROCESSES, before this process touches jax.
+def _probe_device(budget: int = 120) -> bool:
+    """True iff a fresh process can run one tiny op on the accelerator.
 
-    Two reasons they can't run in-process after phases 1/2: the phase-1/2
-    engines (3 × 124M weights + slot caches, > 1 GB) stay resident in the
-    module-global engine cache — their scheduler threads hold them — while
-    the 7B weights alone need ~14.5 GB of the v5e's 16 GB HBM; and only one
-    process can hold the TPU client at a time, so each child must finish
-    before the next starts / the parent initializes jax."""
+    The axon TPU tunnel wedges such that jax init (or the first dispatch)
+    blocks forever — observed repeatedly during round-3 builds, including
+    mid-bench: the tunnel was alive for phase 1 and dead by the 7B phase.
+    Each heavy subprocess is therefore gated on this cheap probe so a dead
+    tunnel costs ~2 min of skipping, not the phase's whole multi-thousand-
+    second budget. Runs in a SUBPROCESS (jax init is per-process and a
+    wedged init can't be cancelled in-process)."""
     import subprocess
 
-    out: dict = {}
-    # The int8 north-star child does much more one-time XLA compilation than
-    # the bf16 one (fused init+quantize of 8B params, the 8192-window cache,
-    # segment programs for 5 history buckets) — give it the larger share of
-    # the parent watchdog's 7200 s budget.
-    for flag, prefix, gate, budget in (("--7b", "b7", BENCH_7B, 2000),
-                                       ("--7bq", "b7q", BENCH_7BQ, 4500)):
-        if gate == "0":
-            continue
-        try:
-            proc = subprocess.run(
-                [sys.executable, os.path.abspath(__file__), flag],
-                capture_output=True, text=True, timeout=budget,
-                cwd=os.path.dirname(os.path.abspath(__file__)),
-            )
-        except subprocess.TimeoutExpired as e:
-            # A hung child (e.g. a wedged TPU tunnel) must not take down the
-            # whole bench — salvage any checkpointed metrics line the child
-            # printed before stalling (the long-ctx phase checkpoints its
-            # core metrics first), then report the timeout and move on.
-            stdout = e.stdout
-            if isinstance(stdout, bytes):
-                stdout = stdout.decode(errors="replace")
-            got = _last_json_line(stdout)
-            out.update(got or {})
-            out[f"{prefix}_error"] = f"subprocess timeout after {budget}s"
-            continue
-        got = _last_json_line(proc.stdout)
-        if got is None:
-            got = {f"{prefix}_error":
-                   f"subprocess rc={proc.returncode}: "
-                   f"{(proc.stderr or '')[-300:]}"}
-        out.update(got)
-    return out
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c",
+             "import jax, jax.numpy as jnp;"
+             "x = jnp.ones((256, 256), jnp.bfloat16);"
+             "(x @ x).block_until_ready();"
+             "print('PROBE_OK', jax.default_backend())"],
+            capture_output=True, text=True, timeout=budget,
+        )
+    except subprocess.TimeoutExpired:
+        return False
+    # A fast tunnel failure makes jax fall back to the CPU backend and the
+    # probe "succeed" — which would record CPU numbers as the TPU headline.
+    # The accelerator is live only if the op actually ran somewhere real.
+    marker = (proc.stdout or "").strip().splitlines()
+    return (proc.returncode == 0 and bool(marker)
+            and marker[-1].startswith("PROBE_OK")
+            and not marker[-1].endswith(" cpu"))
+
+
+def _probe_with_retry(wait_s: int = 60) -> bool:
+    """One probe, and on failure one more after ``wait_s`` — the tunnel's
+    remote end is supervised and sometimes comes back within a minute."""
+    if _probe_device():
+        return True
+    print(f"device probe failed; retrying in {wait_s}s", file=sys.stderr)
+    time.sleep(wait_s)
+    return _probe_device()
+
+
+def run_child_phase(flag: str, prefix: str, budget: int) -> dict:
+    """Run one bench phase in a SUBPROCESS and return its JSON metrics.
+
+    Subprocesses for two reasons: the phase-1/2 engines (3 × 124M weights +
+    slot caches, > 1 GB) stay resident in the module-global engine cache —
+    their scheduler threads hold them — while the 7B weights alone need
+    ~14.5 GB of the v5e's 16 GB HBM; and only one process can hold the TPU
+    client at a time, so each child must finish before the next starts."""
+    import subprocess
+
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), flag],
+            capture_output=True, text=True, timeout=budget,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+        )
+    except subprocess.TimeoutExpired as e:
+        # A hung child (e.g. a wedged TPU tunnel) must not take down the
+        # whole bench — salvage any checkpointed metrics line the child
+        # printed before stalling (the long-ctx phase checkpoints its
+        # core metrics first), then report the timeout and move on.
+        stdout = e.stdout
+        if isinstance(stdout, bytes):
+            stdout = stdout.decode(errors="replace")
+        got = _last_json_line(stdout) or {}
+        got[f"{prefix}_error"] = f"subprocess timeout after {budget}s"
+        return got
+    got = _last_json_line(proc.stdout)
+    if got is None:
+        got = {f"{prefix}_error":
+               f"subprocess rc={proc.returncode}: "
+               f"{(proc.stderr or '')[-300:]}"}
+    return got
 
 
 def _last_json_line(stdout: "str | None") -> "dict | None":
@@ -566,11 +596,10 @@ async def _serve_and_run(stacked: bool) -> tuple[list, list, list, float]:
         await server.wait_closed()
 
 
-async def main() -> None:
-    # Phases 3+4 first (subprocesses — see run_7b_phase): skipped entirely
-    # when 7B is disabled so CPU smoke runs don't pay a subprocess spawn.
-    b7: dict = run_7b_phase() if (BENCH_7B != "0" or BENCH_7BQ != "0") else {}
-
+async def phase12_main(extra: "dict | None" = None) -> None:
+    """Phases 1+2 (the headline stacked-quorum latency/throughput numbers)
+    against a live socket; prints the one top-level JSON line, merged with
+    ``extra`` (the 7B phases' keys, when the parent orchestrator ran them)."""
     stacked = os.environ.get("QUORUM_TPU_BENCH_STACKED", "1") != "0"
     stacked_fallback = False
     try:
@@ -618,8 +647,83 @@ async def main() -> None:
         **({"stacked_fallback": True} if stacked_fallback else {}),
         "max_tokens": MAX_TOKENS,
         "params_per_model": n_params,
-        **b7,
+        **(extra or {}),
     }))
+
+
+# The 7B phases, shared by the TPU orchestrator and the CPU-smoke helper:
+# (child flag, metric prefix, gate env value, TPU budget s, CPU budget s).
+# The int8 north-star child does much more one-time XLA compilation than the
+# bf16 one (fused init+quantize of 8B params, the 8192-window cache, segment
+# programs for 5 history buckets) — it gets the larger share.
+_7B_PHASES = (("--7b", "b7", BENCH_7B, 1800, 2000),
+              ("--7bq", "b7q", BENCH_7BQ, 3300, 4500))
+
+# Metrics banked so far by main(); the watchdog's bark salvages these, so a
+# budget overrun reports every phase that DID complete, not an empty error.
+_BANKED: dict = {}
+
+
+async def main() -> None:
+    """Orchestrator. On CPU (smoke runs, tests): phases 1/2 in-process, no
+    probes. On a potential TPU: every phase is a probe-gated subprocess,
+    SMALLEST FIRST, so the headline numbers are banked before the heavy 7B
+    phases get a chance to hit a wedged tunnel (observed failure mode: the
+    tunnel was alive at bench start and dead by the 7B child's weight init —
+    with 7B-first ordering that run recorded nothing at all)."""
+    plat = os.environ.get("JAX_PLATFORMS", "")
+    maybe_tpu = bool(os.environ.get("PALLAS_AXON_POOL_IPS")) or any(
+        p in plat for p in ("tpu", "axon"))
+    if plat.startswith("cpu") or not maybe_tpu:
+        # CPU smoke path (explicit JAX_PLATFORMS=cpu, or no accelerator
+        # configured at all): subprocess isolation buys nothing (no tunnel,
+        # no HBM budget) and the 7B gates resolve to skip in the children.
+        b7: dict = run_7b_phase() if (BENCH_7B != "0" or BENCH_7BQ != "0") else {}
+        await phase12_main(b7)
+        return
+
+    out = _BANKED
+    alive = _probe_with_retry()
+    if not alive:
+        out["phase12_error"] = "skipped: device probe failed (tunnel dead)"
+    else:
+        # Headline first. The child prints the full top-level schema; the
+        # parent re-emits it merged with the later phases' keys.
+        out.update(run_child_phase("--phase12", "phase12", budget=1200))
+    for flag, prefix, gate, budget, _ in _7B_PHASES:
+        if gate == "0":
+            continue
+        alive = alive and _probe_with_retry()
+        if not alive:
+            out[f"{prefix}_error"] = "skipped: device probe failed (tunnel dead)"
+            continue
+        out.update(run_child_phase(flag, prefix, budget))
+    if "value" not in out:
+        # No headline numbers. Keep whatever the other phases banked, name
+        # the actual phase-1/2 failure, and signal total failure (exit 3)
+        # only when NOTHING was measured.
+        out.update({"metric": "p50_ttft_ms", "value": -1.0, "unit": "ms",
+                    "vs_baseline": 0.0,
+                    "error": out.get("phase12_error", "phases 1/2 failed")})
+        print(json.dumps(out))
+        # "Measured" means a numeric metric — not the *_model / *_error
+        # context keys seven_b_main emits beside a failure.
+        measured = any(
+            k.startswith(("b7_", "b7q_")) and isinstance(v, (int, float))
+            for k, v in out.items())
+        sys.exit(0 if measured else 3)
+    print(json.dumps(out))
+
+
+def run_7b_phase() -> dict:
+    """CPU-smoke helper: both 7B children, no probes (kept for the CPU path
+    where the gates resolve to skip inside each child)."""
+    out: dict = {}
+    for flag, prefix, gate, _, budget in _7B_PHASES:
+        if gate == "0":
+            continue
+        out.update(run_child_phase(flag, prefix, budget))
+    return out
 
 
 def _watchdog(prefix: str | None) -> None:
@@ -628,8 +732,11 @@ def _watchdog(prefix: str | None) -> None:
     The axon TPU tunnel can wedge such that the first jax operation blocks
     forever (observed twice during round-3 builds); without a watchdog the
     whole bench would hang and the driver would record nothing. The budget
-    covers a full legitimate run (7B subprocesses ≤ 2000 s + 4500 s + the
-    socket phases); only a true hang trips it. A 7B child (``prefix``) emits
+    covers a full legitimate run (probe-gated subprocesses ≤ 1200 s
+    phase12 + 1800 s 7B + 3300 s int8, plus ≤ 900 s of probes) — and if it
+    does trip at the margin, the parent's bark salvages every metric the
+    completed phases already banked (``_BANKED``) instead of discarding
+    them. A 7B child (``prefix``) emits
     its phase-scoped error key — never the parent's top-level schema, which
     would clobber the parent's real phase-1/2 numbers when merged."""
     import threading
@@ -647,8 +754,10 @@ def _watchdog(prefix: str | None) -> None:
         if prefix:
             out = {f"{prefix}_error": msg}
         else:
+            # Salvage the completed phases' metrics: the orchestrator banks
+            # each child's keys into _BANKED as it goes.
             out = {"metric": "p50_ttft_ms", "value": -1.0, "unit": "ms",
-                   "vs_baseline": 0.0, "error": f"bench {msg}"}
+                   "vs_baseline": 0.0, **_BANKED, "error": f"bench {msg}"}
         print(json.dumps(out), flush=True)
         os._exit(3)
 
@@ -664,5 +773,8 @@ if __name__ == "__main__":
     if "--7b" in sys.argv:
         _watchdog("b7")
         sys.exit(asyncio.run(seven_b_main(quant=False)))
+    if "--phase12" in sys.argv:
+        _watchdog("phase12")
+        sys.exit(asyncio.run(phase12_main()))
     _watchdog(None)
     sys.exit(asyncio.run(main()))
