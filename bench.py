@@ -671,10 +671,11 @@ async def main() -> None:
     phases get a chance to hit a wedged tunnel (observed failure mode: the
     tunnel was alive at bench start and dead by the 7B child's weight init —
     with 7B-first ordering that run recorded nothing at all)."""
-    plat = os.environ.get("JAX_PLATFORMS", "")
-    maybe_tpu = bool(os.environ.get("PALLAS_AXON_POOL_IPS")) or any(
-        p in plat for p in ("tpu", "axon"))
-    if plat.startswith("cpu") or not maybe_tpu:
+    from quorum_tpu.compile_cache import tpu_host_configured
+
+    # (An explicit JAX_PLATFORMS=cpu run already popped the axon pool var
+    # at module import, so the helper correctly reports no TPU for it.)
+    if not tpu_host_configured():
         # CPU smoke path (explicit JAX_PLATFORMS=cpu, or no accelerator
         # configured at all): subprocess isolation buys nothing (no tunnel,
         # no HBM budget) and the 7B gates resolve to skip in the children.

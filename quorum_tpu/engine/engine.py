@@ -58,6 +58,7 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from quorum_tpu.compile_cache import enable_persistent_compile_cache
 from quorum_tpu.models.init import init_params_sharded
 from quorum_tpu.models.model_config import ModelSpec
 from quorum_tpu.models.transformer import (
@@ -70,6 +71,8 @@ from quorum_tpu.models.transformer import (
 from quorum_tpu.ops.sampling import SamplerConfig, sample_token_rows
 from quorum_tpu.parallel.mesh import single_device_mesh
 from quorum_tpu.parallel.sharding import kv_cache_sharding, shard_pytree
+
+enable_persistent_compile_cache()  # restart compiles become disk reads
 
 MIN_BUCKET = 16
 DEFAULT_SLOTS = 4

@@ -30,11 +30,14 @@ import jax.numpy as jnp
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from quorum_tpu.compile_cache import enable_persistent_compile_cache
 from quorum_tpu.models.init import init_params
 from quorum_tpu.models.model_config import ModelSpec
 from quorum_tpu.models.transformer import forward_logits
 from quorum_tpu.parallel.mesh import AXIS_DP, AXIS_SP
 from quorum_tpu.parallel.sharding import shard_pytree
+
+enable_persistent_compile_cache()  # restart compiles become disk reads
 
 
 class TrainState(NamedTuple):

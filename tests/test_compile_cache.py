@@ -1,0 +1,70 @@
+"""Persistent XLA compilation cache (quorum_tpu/compile_cache.py).
+
+Restart compiles become disk reads: a fresh process serving the same model
+reloads its executables from ``QUORUM_TPU_COMPILE_CACHE`` instead of
+recompiling. The reference proxy has no equivalent (it compiles nothing);
+this is TPU-runtime surface, validated here on CPU via the explicit opt-in
+(default-on applies only to TPU-configured hosts — XLA:CPU AOT entries are
+host-feature-sensitive).
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_CHILD = """
+import json, os, sys, time
+t0 = time.time()
+from quorum_tpu.models.model_config import resolve_spec
+from quorum_tpu.engine.engine import InferenceEngine
+from quorum_tpu.ops.sampling import SamplerConfig
+spec = resolve_spec("gpt2-tiny", {"max_seq": "128"})
+eng = InferenceEngine(spec, decode_chunk=4, n_slots=2)
+toks = eng.generate([3, 4, 5], max_new_tokens=8,
+                    sampler=SamplerConfig(temperature=0.8, top_p=0.9),
+                    seed=1).token_ids
+import jax
+print(json.dumps({"tokens": toks, "wall": time.time() - t0,
+                  "cache_dir": jax.config.jax_compilation_cache_dir}))
+"""
+
+
+def _run_child(cache_env: str) -> dict:
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    if cache_env:
+        env["QUORUM_TPU_COMPILE_CACHE"] = cache_env
+    else:
+        env.pop("QUORUM_TPU_COMPILE_CACHE", None)
+    proc = subprocess.run([sys.executable, "-c", _CHILD], env=env, cwd=REPO,
+                          capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    return json.loads(proc.stdout.strip().splitlines()[-1])
+
+
+def test_opt_in_cache_populates_and_reloads(tmp_path):
+    cache = str(tmp_path / "xla")
+    cold = _run_child(cache)
+    assert cold["cache_dir"] == cache
+    entries = os.listdir(cache)
+    assert entries, "cold run wrote no cache entries"
+    warm = _run_child(cache)
+    # Same executables → byte-identical sampling; no new entries compiled.
+    assert warm["tokens"] == cold["tokens"]
+    assert sorted(os.listdir(cache)) == sorted(entries)
+
+
+def test_cpu_host_defaults_off(tmp_path):
+    # Without the explicit opt-in, a CPU-configured host must not set up a
+    # cache (XLA:CPU AOT reloads are host-feature-sensitive).
+    got = _run_child("")
+    assert not got["cache_dir"]
+
+
+def test_disable_knob_wins(tmp_path):
+    got = _run_child("0")
+    assert not got["cache_dir"]
