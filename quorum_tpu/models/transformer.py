@@ -44,6 +44,11 @@ from quorum_tpu.ops.attention import (
     quantize_rows,
 )
 from quorum_tpu.ops.flash_attention import flash_prefill_attention
+from quorum_tpu.ops.flash_decode import (
+    flash_decode_attention,
+    flash_decode_mode,
+    flash_decode_supported,
+)
 from quorum_tpu.parallel.ring_attention import ring_prefill_attention
 from quorum_tpu.ops.norms import layernorm, rmsnorm
 from quorum_tpu.ops.rotary import apply_rope, rope_cos_sin
@@ -549,6 +554,15 @@ def decode_step(
             # cache bytes per step, no dequantized HBM copy.
             attn = decode_attention_q8(
                 q, read_k[0], read_k[1], read_v[0], read_v[1], lengths + 1)
+        elif flash_decode_mode():
+            # Opt-in Pallas kernel (QUORUM_TPU_FLASH_DECODE=1): per-ROW
+            # exact cache reads — a short row co-batched with a long one
+            # stops streaming K/V near its own length, not at the shared
+            # history bucket. The wrapper re-checks shape support and falls
+            # back to decode_attention itself (ops/flash_decode.py).
+            attn = flash_decode_attention(
+                q, read_k, read_v, lengths + 1,
+                interpret=flash_decode_mode() == "interpret")
         else:
             attn = decode_attention(q, read_k, read_v, lengths + 1)
         carry_x = carry_x + _attn_out(attn, block, carry_x.dtype)
