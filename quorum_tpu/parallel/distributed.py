@@ -91,8 +91,17 @@ def hybrid_mesh(cfg: MeshConfig, *, dcn_dp: int = 1) -> Mesh:
 
     ici_shape = (cfg.dp, cfg.pp, cfg.sp, cfg.tp)
     dcn_shape = (dcn_dp, 1, 1, 1)
+    devices = jax.devices()
+    # Multi-slice TPU deployments granulate DCN by slice; runs whose
+    # devices all share one slice id (CPU multi-process runs —
+    # tests/distributed_worker.py — and single-slice multi-host pods)
+    # granulate by process instead, the only boundary DCN traffic crosses
+    # there.
+    slice_ids = {getattr(d, "slice_index", None) for d in devices}
+    by_process = len(slice_ids) <= 1
     devices = mesh_utils.create_hybrid_device_mesh(
-        ici_shape, dcn_shape, devices=jax.devices())
+        ici_shape, dcn_shape, devices=devices,
+        process_is_granule=by_process)
     return Mesh(devices, MESH_AXES)
 
 
