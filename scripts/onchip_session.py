@@ -1,0 +1,224 @@
+"""On-chip measurement runbook: PERF.md §5's "first moves", one command.
+
+The round-3 TPU tunnel has repeatedly wedged minutes into a session, so
+every measurement this script takes is banked to ``ONCHIP.json`` the moment
+it lands — run it as soon as the chip answers and let it execute the whole
+list; whatever the tunnel survives is kept:
+
+  1. ``python bench.py`` end to end (itself probe-gated per phase since
+     round 3) — the BENCH headline + 7B + int8 north-star numbers.
+  2. Stacked A/B: phases 1/2 rerun with ``QUORUM_TPU_BENCH_STACKED=0`` —
+     the stacked-vs-three-engines TTFT/tokens-per-second delta for PERF §4.
+  3. ``kv_quant=int8`` on real silicon: one request against
+     llama-3-8b ``quant=int8&kv_quant=int8&max_seq=8192`` (the native int8
+     q·K / p·V decode einsums have only ever run on CPU).
+  4. Pallas decode-kernel A/B (``QUORUM_TPU_FLASH_DECODE=1``) on a skewed
+     co-batch at 7B — separate processes per arm (the flag is read at
+     trace time).
+  5. One ``QUORUM_TPU_PROFILE_DIR`` trace of steady-state 7B decode, to
+     attribute the ~38% HBM-roofline gap (PERF §4).
+
+Usage: ``python scripts/onchip_session.py [--skip bench,ab,kvq,flash,profile]``
+Each step is a subprocess with its own budget; a wedged step is recorded
+and skipped, never fatal. Results: ``ONCHIP.json`` (merged dict, one key
+prefix per step) + profile trace under ``profiles/``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+OUT = os.path.join(REPO, "ONCHIP.json")
+
+KVQ_URL = ("tpu://llama-3-8b?max_seq=8192&slots=2&decode_chunk=16"
+           "&max_tokens=32&quant=int8&kv_quant=int8&prefill_chunk=512")
+B7_URL = ("tpu://mistral-7b?max_seq=4096&slots=2&decode_chunk=16"
+          "&max_tokens=48&prefill_chunk=256")
+
+
+def bank(update: dict) -> None:
+    got = {}
+    if os.path.exists(OUT):
+        try:
+            with open(OUT) as f:
+                got = json.load(f)
+        except (json.JSONDecodeError, OSError):
+            # A mid-write kill (the scenario this script exists for) must
+            # not poison every later session; start fresh.
+            got = {}
+    got.update(update)
+    tmp = OUT + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(got, f, indent=1)
+    os.replace(tmp, OUT)  # atomic: never a truncated ONCHIP.json
+    print(f"[onchip] banked: {sorted(update)}", flush=True)
+
+
+def probe(budget: int = 120) -> bool:
+    try:
+        p = subprocess.run(
+            [sys.executable, "-c",
+             "import jax, jax.numpy as jnp;"
+             "x = jnp.ones((256,256), jnp.bfloat16);"
+             "(x @ x).block_until_ready();"
+             "print('PROBE_OK', jax.default_backend())"],
+            capture_output=True, text=True, timeout=budget)
+    except subprocess.TimeoutExpired:
+        return False
+    out = (p.stdout or "").strip().splitlines()
+    return (p.returncode == 0 and bool(out)
+            and out[-1].startswith("PROBE_OK") and not out[-1].endswith(" cpu"))
+
+
+def run_step(name: str, argv: list[str], budget: int,
+             env_extra: dict | None = None) -> dict:
+    """Run one measurement subprocess; parse its last JSON line."""
+    if not probe():
+        return {f"{name}_error": "skipped: device probe failed"}
+    env = dict(os.environ)
+    env.update(env_extra or {})
+    t0 = time.time()
+    try:
+        p = subprocess.run(argv, capture_output=True, text=True,
+                           timeout=budget, cwd=REPO, env=env)
+    except subprocess.TimeoutExpired as e:
+        stdout = e.stdout.decode(errors="replace") if isinstance(
+            e.stdout, bytes) else (e.stdout or "")
+        got = _last_json(stdout)
+        got[f"{name}_error"] = f"timeout after {budget}s"
+        return got
+    got = _last_json(p.stdout)
+    if not got:
+        got = {f"{name}_error": f"rc={p.returncode}: {(p.stderr or '')[-300:]}"}
+    got[f"{name}_wall_s"] = round(time.time() - t0, 1)
+    return got
+
+
+def _last_json(stdout: str) -> dict:
+    for line in reversed((stdout or "").splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                return json.loads(line)
+            except json.JSONDecodeError:
+                continue
+    return {}
+
+
+# Serving measurement used by the kvq/flash/profile steps: drive requests
+# through the real engine+backend (no HTTP — the socket stack is bench.py's
+# job). Modes: "seq" (N sequential requests, report the warm one; wrapped
+# in maybe_profile when QUORUM_TPU_PROFILE_DIR is set) and "skew" (after a
+# sequential warmup, co-batch one LONG and one SHORT stream concurrently —
+# the decode-kernel A/B case: the short row is the per-row-exact-read
+# beneficiary).
+_SERVE_ONE = r"""
+import asyncio, json, os, sys, time
+url, n_requests, prefix, n_words = (
+    sys.argv[1], int(sys.argv[2]), sys.argv[3], int(sys.argv[4]))
+mode = sys.argv[5] if len(sys.argv) > 5 else "seq"
+from quorum_tpu.config import BackendSpec
+from quorum_tpu.backends.tpu_backend import TpuBackend
+from quorum_tpu.observability import maybe_profile
+
+be = TpuBackend.from_spec(BackendSpec(name="M", url=url, model="m"))
+
+async def one(seed, words):
+    body = {"model": "m", "stream": True, "max_tokens": 32,
+            "temperature": 0.0, "seed": seed,
+            "messages": [{"role": "user", "content": "x " * words}]}
+    t0 = time.time()
+    first = None
+    toks = 0
+    async for chunk in be.stream(body, {}, 3600.0):
+        if chunk.get("choices", [{}])[0].get("delta", {}).get("content"):
+            first = first or time.time()
+            toks += 1
+    if first is None:   # error chunk / zero-token stream: record, not crash
+        return {"ttft_s": -1.0, "toks": 0, "decode_s": 0.0}
+    return {"ttft_s": first - t0, "toks": toks,
+            "decode_s": time.time() - first}
+
+def tok_s(r):
+    return round((r["toks"] - 1) / max(r["decode_s"], 1e-9), 1)
+
+if mode == "skew":
+    asyncio.run(one(0, n_words))   # compile both admission buckets
+    asyncio.run(one(1, 20))
+    async def pair():
+        return await asyncio.gather(one(2, n_words), one(3, 20))
+    long_r, short_r = asyncio.run(pair())
+    print(json.dumps({
+        f"{prefix}_short_decode_tok_s": tok_s(short_r),
+        f"{prefix}_long_decode_tok_s": tok_s(long_r),
+        f"{prefix}_agg_decode_tok_s": round(
+            tok_s(short_r) + tok_s(long_r), 1),
+    }))
+else:
+    outs = [asyncio.run(one(i, n_words)) for i in range(n_requests - 1)]
+    with maybe_profile("onchip"):   # no-op unless QUORUM_TPU_PROFILE_DIR
+        outs.append(asyncio.run(one(n_requests - 1, n_words)))
+    warm = outs[-1]
+    print(json.dumps({
+        f"{prefix}_ttft_ms": round(warm["ttft_s"] * 1e3, 1),
+        f"{prefix}_decode_tok_s": tok_s(warm),
+        f"{prefix}_n_tokens": warm["toks"],
+    }))
+"""
+
+
+def main() -> None:
+    skip = set()
+    args = sys.argv[1:]
+    for i, a in enumerate(args):
+        if a.startswith("--skip="):
+            skip |= set(a.split("=", 1)[1].split(","))
+        elif a == "--skip" and i + 1 < len(args):
+            skip |= set(args[i + 1].split(","))
+
+    if not probe():
+        print("[onchip] device probe failed — tunnel dead; retry later")
+        bank({"onchip_error": "tunnel dead at session start",
+              "ts": time.time()})
+        sys.exit(3)
+    print("[onchip] device alive — starting the list", flush=True)
+    bank({"onchip_started_ts": time.time(), "onchip_error": None})
+
+    if "bench" not in skip:
+        bank(run_step("bench", [sys.executable, "bench.py"], budget=7300))
+    if "ab" not in skip:
+        bank({(k if k.startswith("ab_") else f"ab_{k}"): v
+              for k, v in run_step(
+            "ab", [sys.executable, "bench.py", "--phase12"], budget=1200,
+            env_extra={"QUORUM_TPU_BENCH_STACKED": "0"}).items()})
+    if "kvq" not in skip:
+        bank(run_step(
+            "kvq", [sys.executable, "-c", _SERVE_ONE, KVQ_URL, "2", "kvq",
+                    "600"], budget=1800))
+    if "flash" not in skip:
+        # ~1000 words ≈ 3000 byte-tokens: long row near the 4096 window,
+        # short row at ~60 — the skew the kernel exists for.
+        for arm, env in (("flash_off", {"QUORUM_TPU_FLASH_DECODE": "0"}),
+                         ("flash_on", {"QUORUM_TPU_FLASH_DECODE": "1"})):
+            bank(run_step(
+                arm, [sys.executable, "-c", _SERVE_ONE, B7_URL, "2", arm,
+                      "1000", "skew"], budget=1500, env_extra=env))
+    if "profile" not in skip:
+        prof_dir = os.path.join(REPO, "profiles")
+        bank(run_step(
+            "profile", [sys.executable, "-c", _SERVE_ONE, B7_URL, "2",
+                        "profile", "600"], budget=1500,
+            env_extra={"QUORUM_TPU_PROFILE_DIR": prof_dir}))
+        if os.path.isdir(prof_dir):
+            bank({"profile_artifacts": sum(
+                len(fs) for _, _, fs in os.walk(prof_dir))})
+    print(f"[onchip] done — see {OUT}")
+
+
+if __name__ == "__main__":
+    main()
