@@ -402,6 +402,7 @@ class InferenceEngine:
         self.n_requests = 0
         self.n_tokens = 0
         self.n_failures = 0
+        self.n_cancelled = 0   # requests retired because cancel was set
         self.n_overlapped = 0  # decode chunks dispatched ahead of the read
         self._stop = False
         self._thread = threading.Thread(
@@ -1020,6 +1021,7 @@ class InferenceEngine:
                 "requests_total": self.n_requests,
                 "tokens_total": self.n_tokens,
                 "failures_total": self.n_failures,
+                "cancellations_total": self.n_cancelled,
                 "prefix_hits_total": self.prefix_hits,
                 "prefix_tokens_saved_total": self.prefix_tokens_saved,
                 "overlapped_chunks_total": self.n_overlapped,
@@ -1127,6 +1129,7 @@ class InferenceEngine:
                     return
                 req = self._pending.pop(0)
             if req.cancel.is_set():
+                self.n_cancelled += 1
                 req.out.put(("end", None))
                 continue
             # Reuse caps at len(prompt)-1 (the final prompt token must run
@@ -1272,6 +1275,7 @@ class InferenceEngine:
         live: dict[int, _Request] = {}
         for m, req in group.items():
             if req.cancel.is_set():
+                self.n_cancelled += 1
                 req.out.put(("end", None))
                 continue
             n = len(req.prompt_ids)
@@ -1346,6 +1350,7 @@ class InferenceEngine:
         for adm in list(self._admitting):
             req = adm.req
             if req.cancel.is_set():
+                self.n_cancelled += 1
                 req.out.put(("end", None))
                 self._release_admission(adm)
                 continue
@@ -1432,6 +1437,7 @@ class InferenceEngine:
         for adm in list(self._admitting):
             req = adm.req
             if req.cancel.is_set():
+                self.n_cancelled += 1
                 req.out.put(("end", None))
                 self._release_admission(adm)
                 continue
@@ -1498,6 +1504,7 @@ class InferenceEngine:
         # Drop cancelled requests before spending device time on them.
         for i, r in active:
             if r.cancel.is_set():
+                self.n_cancelled += 1
                 r.out.put(("end", None))
                 with self._cond:
                     self._release_slot(i, r)
@@ -1666,6 +1673,7 @@ class InferenceEngine:
     def _emit(self, req: _Request, tok: int) -> bool:
         """Deliver one token; returns True when the request just finished."""
         if req.cancel.is_set():
+            self.n_cancelled += 1
             req.out.put(("end", None))
             return True
         req.emitted += 1

@@ -10,6 +10,7 @@ stream is still being produced.
 
 from __future__ import annotations
 
+import asyncio
 import json
 import time
 
@@ -165,3 +166,72 @@ async def test_int8_prefix_cached_serving_over_socket():
     saved = [line for line in metrics.splitlines()
              if line.startswith("quorum_tpu_engine_prefix_tokens_saved_total")]
     assert saved and int(saved[0].rsplit(" ", 1)[1]) >= 16
+
+
+@pytest.mark.asyncio
+async def test_client_disconnect_frees_slot_and_counts_cancellation():
+    """A client that drops its SSE connection mid-stream must not pin the
+    engine slot for the rest of its max_tokens budget: the engine retires
+    the request within a chunk boundary (slot freed for the next request)
+    and /metrics counts the cancellation. slots=1 makes reclamation
+    observable — a follow-up request can only be served from the freed
+    slot."""
+    raw = {
+        "settings": {"timeout": 60},
+        "primary_backends": [
+            {"name": "T",
+             "url": "tpu://gpt2-tiny?max_seq=2048&slots=1&decode_chunk=4"
+                    "&max_tokens=1500",
+             "model": "gpt2-tiny"},
+        ],
+    }
+    app = create_app(Config(raw=raw))
+    server = await start_server(app, "127.0.0.1", 0)
+    port = server.sockets[0].getsockname()[1]
+    long_body = {
+        "model": "gpt2-tiny",
+        "messages": [{"role": "user", "content": "stream a very long answer"}],
+        "stream": True, "max_tokens": 1500, "temperature": 0.8,
+    }
+    try:
+        async with httpx.AsyncClient(
+            base_url=f"http://127.0.0.1:{port}", timeout=60
+        ) as client:
+            # Start the long stream and abandon it after the first delta.
+            async with client.stream(
+                "POST", "/chat/completions", json=long_body,
+                headers={"Authorization": "Bearer t"},
+            ) as resp:
+                assert resp.status_code == 200
+                async for line in resp.aiter_lines():
+                    if _delta_content(line):
+                        break  # exit the context = drop the connection
+
+            # The freed slot must serve a fresh request to completion, and
+            # the cancellation must be counted. Poll briefly: teardown of
+            # the dropped request propagates asynchronously (client close →
+            # ASGI task cancel → engine cancel event → chunk boundary).
+            deadline = time.time() + 30
+            counted = False
+            while time.time() < deadline and not counted:
+                metrics = (await client.get("/metrics")).text
+                counted = "quorum_tpu_engine_cancellations_total" in metrics and any(
+                    line.split()[-1] not in ("0", "0.0")
+                    for line in metrics.splitlines()
+                    if line.startswith("quorum_tpu_engine_cancellations_total"))
+                if not counted:
+                    await asyncio.sleep(0.3)
+            assert counted, "cancellation never counted after client drop"
+
+            short = dict(long_body, max_tokens=4, stream=False)
+            t0 = time.time()
+            r = await client.post("/chat/completions", json=short,
+                                  headers={"Authorization": "Bearer t"})
+            assert r.status_code == 200
+            assert r.json()["usage"]["completion_tokens"] >= 1
+            # Well under the dropped request's 1500-token budget worth of
+            # decode time: the slot was reclaimed, not waited out.
+            assert time.time() - t0 < 25
+    finally:
+        server.close()
+        await server.wait_closed()
