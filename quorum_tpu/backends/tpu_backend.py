@@ -25,9 +25,16 @@ URL grammar:  ``tpu://<model-id>?<spec overrides>&<engine options>``
                    with 503 instead of growing without limit
   spec_decode=G    speculative decoding (default 0 = off): when every active
                    request is greedy with no penalties/bias/logprobs, each
-                   dispatch verifies G prompt-lookup draft tokens in one
-                   multi-token forward — accepted runs advance G+1 tokens
-                   for one dispatch's weight reads (decode is HBM-bound)
+                   dispatch verifies G draft tokens in one multi-token
+                   forward — accepted runs advance G+1 tokens for one
+                   dispatch's weight reads (decode is HBM-bound)
+  spec_model=<id>  draft-MODEL speculation: the named preset (random init,
+                   seeded by spec_seed=, target's vocab/window) proposes
+                   the G-token drafts instead of prompt lookup; its own
+                   slot KV cache tracks each request. Speed-only knob —
+                   acceptance still requires equality with the target's
+                   greedy token. Implies spec_decode=4 when unset;
+                   random-init engines only (rejected with ckpt=)
   quant=int8       weight-only int8 with per-channel scales (models/quant.py):
                    halves weight HBM bytes/token (decode is bandwidth-bound →
                    up to 2× decode tokens/s) and weight HBM capacity
@@ -255,13 +262,23 @@ class TpuBackend:
             n_slots=n_slots,
             prefill_chunk=int(opts.get("prefill_chunk", DEFAULT_PREFILL_CHUNK)),
             max_pending=int(opts.get("queue", DEFAULT_MAX_PENDING)),
-            spec_decode=int(opts.get("spec_decode", 0)),
+            # spec_model implies speculation: default g=4 when the knob
+            # is absent. An EXPLICIT spec_decode=0 beside spec_model= is a
+            # contradiction the engine rejects (never silently rewritten).
+            spec_decode=int(opts.get(
+                "spec_decode", "4" if opts.get("spec_model") else "0")),
             quant=opts.get("quant") or None,
             kv_quant=opts.get("kv_quant") or None,
             prefix_cache=_parse_bool_opt(
                 "prefix_cache", opts.get("prefix_cache", "1")),
             ensemble=int(opts.get("ensemble", 1)),
         )
+        spec_model = opts.get("spec_model", "")
+        if spec_model and ckpt:
+            raise ValueError(
+                "spec_model= draft decoding is not yet supported for ckpt= "
+                "backends (the draft would be a random init drafting for "
+                "real weights — 0 acceptance, pure overhead)")
         if ckpt and members > 1:
             # Checked here (not just in the engine): ckpt engines are keyed
             # without members, so a stacked URL would otherwise construct a
@@ -287,6 +304,15 @@ class TpuBackend:
                 tokenizer_path = ckpt
         else:
             spec = resolve_spec(model_id, opts)
+            if spec_model:
+                # The draft runs the TARGET's vocab and window: drafted ids
+                # must be comparable (and embeddable) in the target, and the
+                # draft cache must reach every target position.
+                eng_kw["draft_spec"] = resolve_spec(spec_model, {
+                    "max_seq": str(spec.max_seq),
+                    "vocab_size": str(spec.vocab_size),
+                })
+                eng_kw["draft_seed"] = int(opts.get("spec_seed", 0))
             engine = get_engine(
                 spec, mesh, seed=int(opts.get("seed", 0)), members=members,
                 **eng_kw

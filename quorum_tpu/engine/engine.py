@@ -59,7 +59,7 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from quorum_tpu.compile_cache import enable_persistent_compile_cache
-from quorum_tpu.models.init import init_params_sharded
+from quorum_tpu.models.init import init_params, init_params_sharded
 from quorum_tpu.models.model_config import ModelSpec
 from quorum_tpu.models.transformer import (
     decode_multi,
@@ -208,6 +208,160 @@ class _Admission:
         self.offset = offset
 
 
+class _DraftRuntime:
+    """Draft-model state for speculative decoding (``spec_model=…``).
+
+    A small model proposes each verify turn's g-token draft instead of the
+    prompt-lookup 2-gram heuristic — a few milliseconds of draft-model
+    dispatches buy model-quality guesses, so acceptance (and therefore
+    tokens per target dispatch) is high wherever the draft model predicts
+    the target well. Correctness NEVER depends on the draft: verification
+    accepts a token iff it equals the target model's own greedy token
+    (``InferenceEngine._verify_fn``), so any draft state — stale, random,
+    or mid-resync — affects only speed. All calls happen on the engine's
+    scheduler thread (no locking).
+
+    State: the draft model's own slot KV cache plus, per target slot, how
+    many of the request's tokens have been fed (``synced``). Each turn the
+    unsynced history advances through ``decode_multi`` in ≤``BITE``-token
+    bites (rows that finish early are padded by repeating their last token;
+    the pad writes land beyond their true length and are overwritten later
+    — the same property the target's verify path relies on), then g−1
+    greedy ``decode_step`` calls extend the draft. Drafted positions sit
+    beyond ``synced``, so the next turn's advance overwrites them — no
+    rollback is ever needed.
+    """
+
+    BITE = 16  # max tokens per advance program (T buckets: powers of two ≤ 16)
+
+    def __init__(self, spec: ModelSpec, target_spec: ModelSpec, rows: int,
+                 seed: int = 0, params=None):
+        if spec.vocab_size != target_spec.vocab_size:
+            raise ValueError(
+                f"draft model vocab {spec.vocab_size} != target vocab "
+                f"{target_spec.vocab_size}: drafted ids would be meaningless "
+                "(and can index out of the target embedding)")
+        if spec.max_seq < target_spec.max_seq:
+            raise ValueError(
+                f"draft model max_seq {spec.max_seq} < target max_seq "
+                f"{target_spec.max_seq}: the draft cache must hold every "
+                "position the target can reach")
+        self.spec = spec.validate()
+        self.params = params if params is not None else init_params(spec, seed)
+        self.rows = rows
+        self._ck, self._cv = init_cache(spec, rows)
+        self.synced = [0] * rows
+        self.reqs: list = [None] * rows
+        self._advance_cache: dict = {}
+        self._step_cache: dict = {}
+
+    def _advance_fn(self, t: int, history: int):
+        fn = self._advance_cache.get((t, history))
+        if fn is None:
+            def run(params, tokens, lengths, wmask, ck, cv):
+                logits, ck, cv = decode_multi(
+                    params, self.spec, tokens, lengths, ck, cv,
+                    write_mask=wmask, history=history)
+                return jnp.argmax(logits, axis=-1).astype(jnp.int32), ck, cv
+
+            fn = jax.jit(run, donate_argnums=(4, 5))
+            self._advance_cache[(t, history)] = fn
+        return fn
+
+    def _extend_fn(self, n: int, history: int):
+        """One dispatch drafting ``n`` greedy tokens: a lax.scan carries
+        the token on device (no per-step host round trip — the engine's
+        scheduler path avoids host turnarounds everywhere else too)."""
+        fn = self._step_cache.get((n, history))
+        if fn is None:
+            def run(params, token, lengths, wmask, ck, cv):
+                def body(carry, _):
+                    tok, lens, ck, cv = carry
+                    logits, ck, cv = decode_step(
+                        params, self.spec, tok, lens, ck, cv,
+                        write_mask=wmask, history=history)
+                    nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                    return (nxt, lens + 1, ck, cv), nxt
+
+                (_, _, ck, cv), toks = lax.scan(
+                    body, (token, lengths, ck, cv), None, length=n)
+                return toks, ck, cv  # toks [n, rows]
+
+            fn = jax.jit(run, donate_argnums=(4, 5))
+            self._step_cache[(n, history)] = fn
+        return fn
+
+    def draft_all(self, active, g: int) -> dict:
+        """g-token draft per active slot: sync the unsynced history, then
+        extend greedily. Returns {slot: [t0..t_{g-1}]}."""
+        for i, r in active:
+            if self.reqs[i] is not r:   # slot reassigned → full resync
+                self.reqs[i] = r
+                self.synced[i] = 0
+        max_hist = max(len(r.hist) for _, r in active)
+        history = prefill_bucket(
+            min(max_hist + g + 1, self.spec.max_seq), self.spec.max_seq)
+        # Feed hist[pos..] (≥1 token: refeed hist[-1] when already synced —
+        # an identical rewrite, done only to recover its next-token logits).
+        rem = {i: max(1, len(r.hist) - self.synced[i]) for i, r in active}
+        pos = {i: len(r.hist) - rem[i] for i, r in active}
+        first: dict[int, int] = {}
+        while any(v > 0 for v in rem.values()):
+            t_bite = min(self.BITE, max(rem.values()))
+            # Pad writes land at pos..pos+t_bite-1 for EVERY masked row;
+            # near the window cap that span must not run past max_seq
+            # (dynamic_update_slice would clamp the start BACKWARDS and
+            # silently corrupt already-synced positions). len(hist) ≤
+            # max_seq always, so the clamp keeps t_bite ≥ 1.
+            t_bite = min(t_bite, self.spec.max_seq
+                         - max(pos[i] for i, _ in active if rem[i] > 0))
+            t_bite = 1 << (t_bite - 1).bit_length()  # pow-2 program reuse
+            if t_bite > self.spec.max_seq - max(
+                    pos[i] for i, _ in active if rem[i] > 0):
+                t_bite >>= 1  # pow-2 rounding may not exceed the cap
+            tokens = np.zeros((self.rows, t_bite), np.int32)
+            lengths = np.zeros((self.rows,), np.int32)
+            wmask = np.zeros((self.rows,), bool)
+            for i, r in active:
+                if rem[i] <= 0:
+                    continue
+                k = min(rem[i], t_bite)
+                seg = r.hist[pos[i]: pos[i] + k]
+                tokens[i, :k] = seg
+                tokens[i, k:] = seg[-1]
+                lengths[i] = pos[i]
+                wmask[i] = True
+            toks, self._ck, self._cv = self._advance_fn(t_bite, history)(
+                self.params, jnp.asarray(tokens), jnp.asarray(lengths),
+                jnp.asarray(wmask), self._ck, self._cv)
+            toks = np.asarray(jax.device_get(toks))
+            for i, r in active:
+                if rem[i] <= 0:
+                    continue
+                k = min(rem[i], t_bite)
+                pos[i] += k
+                rem[i] -= k
+                if rem[i] == 0:
+                    first[i] = int(toks[i, k - 1])
+                    self.synced[i] = len(r.hist)
+        drafts = {i: [first[i]] for i, _ in active}
+        if g > 1:
+            token = np.zeros((self.rows,), np.int32)
+            lengths = np.zeros((self.rows,), np.int32)
+            wmask = np.zeros((self.rows,), bool)
+            for i, r in active:
+                token[i] = first[i]
+                lengths[i] = len(r.hist)
+                wmask[i] = True
+            toks, self._ck, self._cv = self._extend_fn(g - 1, history)(
+                self.params, jnp.asarray(token), jnp.asarray(lengths),
+                jnp.asarray(wmask), self._ck, self._cv)
+            toks = np.asarray(jax.device_get(toks))  # [g-1, rows]
+            for i, _ in active:
+                drafts[i].extend(int(t) for t in toks[:, i])
+        return drafts
+
+
 class InferenceEngine:
     """One loaded model on one mesh, serving many requests concurrently.
 
@@ -235,6 +389,8 @@ class InferenceEngine:
         ensemble: int = 1,
         members: int = 1,
         kv_quant: str | None = None,
+        draft_spec: ModelSpec | None = None,
+        draft_seed: int = 0,
     ):
         self.spec = spec.validate()
         self.mesh = mesh or single_device_mesh()
@@ -404,6 +560,28 @@ class InferenceEngine:
         self.n_failures = 0
         self.n_cancelled = 0   # requests retired because cancel was set
         self.n_overlapped = 0  # decode chunks dispatched ahead of the read
+        self.n_spec_turns = 0      # speculative verify dispatches
+        self.n_spec_accepted = 0   # draft tokens accepted across them
+        # Draft-MODEL speculative decoding (spec_model=…): a second, small
+        # model proposes each verify turn's draft instead of prompt lookup.
+        # Greedy-only like all speculation (greedy_clean gating); excluded
+        # for stacked/ensemble engines — the draft runtime is not
+        # member-vmapped.
+        if draft_spec is not None:
+            if self.members > 1 or self.ensemble > 1:
+                raise ValueError(
+                    "spec_model draft decoding does not compose with "
+                    "members/ensemble engines")
+            if self.spec_decode <= 0:
+                raise ValueError(
+                    "draft_spec requires spec_decode > 0 (the backend "
+                    "defaults spec_decode=4 when spec_model= is set and "
+                    "spec_decode= is absent; an explicit 0 means off — "
+                    "drop spec_model= instead)")
+            self._draft_rt = _DraftRuntime(
+                draft_spec, self.spec, self._rows, seed=draft_seed)
+        else:
+            self._draft_rt = None
         self._stop = False
         self._thread = threading.Thread(
             target=self._scheduler, name=f"engine-{id(self):x}", daemon=True
@@ -1022,6 +1200,8 @@ class InferenceEngine:
                 "tokens_total": self.n_tokens,
                 "failures_total": self.n_failures,
                 "cancellations_total": self.n_cancelled,
+                "spec_turns_total": self.n_spec_turns,
+                "spec_accepted_total": self.n_spec_accepted,
                 "prefix_hits_total": self.prefix_hits,
                 "prefix_tokens_saved_total": self.prefix_tokens_saved,
                 "overlapped_chunks_total": self.n_overlapped,
@@ -1525,10 +1705,14 @@ class InferenceEngine:
         if (g > 0
                 and all(r.greedy_clean for _, r in active)
                 and max_len + g + 1 <= self.spec.max_seq):
-            drafts = {i: self._draft(r, g) for i, r in active}
+            if self._draft_rt is not None:
+                drafts = self._draft_rt.draft_all(active, g)
+            else:
+                drafts = {i: self._draft(r, g) for i, r in active}
             # Fall through to the chunked path when NO row has a draft —
             # a draftless verify step would emit 1 token per dispatch and
-            # forfeit decode_chunk amortization for nothing.
+            # forfeit decode_chunk amortization for nothing. (A draft MODEL
+            # always drafts.)
             if any(d is not None for d in drafts.values()):
                 self._run_verify_step(active, g, max_len, drafts)
                 return
@@ -1655,12 +1839,14 @@ class InferenceEngine:
             self._counts,
         )
         s0, greedy, ok = jax.device_get((s0, greedy, ok))
+        self.n_spec_turns += 1
         for i, req in active:
             toks = [int(s0[i])]
             for j in range(g):
                 if not ok[i, j]:
                     break
                 toks.append(int(greedy[i, j]))
+            self.n_spec_accepted += len(toks) - 1
             finished = False
             for t in toks:
                 if self._emit(req, t):
@@ -1753,9 +1939,11 @@ def get_engine(
     ensemble: int = 1,
     members: int = 1,
     kv_quant: str | None = None,
+    draft_spec: ModelSpec | None = None,
+    draft_seed: int = 0,
 ) -> InferenceEngine:
     """Engines are keyed by weight identity (spec, seed, mesh, quant,
-    ensemble, members) plus the cache representation (kv_quant) —
+    ensemble, members, draft model) plus the cache representation (kv_quant) —
     dispatch knobs like decode_chunk are per-call, so two backends that differ
     only in chunking share one set of weights on device. ``n_slots``/
     ``prefill_chunk``/``max_pending`` (structural properties of the
@@ -1768,6 +1956,7 @@ def get_engine(
     mesh = mesh or single_device_mesh()
     key = (spec, seed, quant or None, max(1, int(ensemble)),
            max(1, int(members)), kv_quant or None,
+           draft_spec, draft_seed,
            tuple(sorted(mesh.shape.items())),
            tuple(map(str, mesh.devices.flat)))
     with _ENGINES_LOCK:
@@ -1779,6 +1968,7 @@ def get_engine(
                 spec_decode=spec_decode, quant=quant,
                 prefix_cache=prefix_cache, ensemble=ensemble,
                 members=members, kv_quant=kv_quant,
+                draft_spec=draft_spec, draft_seed=draft_seed,
             )
             _ENGINES[key] = eng
         else:
