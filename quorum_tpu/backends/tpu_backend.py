@@ -35,6 +35,10 @@ URL grammar:  ``tpu://<model-id>?<spec overrides>&<engine options>``
                    acceptance still requires equality with the target's
                    greedy token. Implies spec_decode=4 when unset;
                    random-init engines only (rejected with ckpt=)
+  spec_ckpt=<dir>  draft-MODEL speculation from a REAL small checkpoint
+                   (same tokenizer/vocab as the target; window raised to
+                   the target's). Works for both ckpt= and random-init
+                   targets; implies spec_decode=4 when unset
   quant=int8       weight-only int8 with per-channel scales (models/quant.py):
                    halves weight HBM bytes/token (decode is bandwidth-bound →
                    up to 2× decode tokens/s) and weight HBM capacity
@@ -266,7 +270,8 @@ class TpuBackend:
             # is absent. An EXPLICIT spec_decode=0 beside spec_model= is a
             # contradiction the engine rejects (never silently rewritten).
             spec_decode=int(opts.get(
-                "spec_decode", "4" if opts.get("spec_model") else "0")),
+                "spec_decode", "4" if (opts.get("spec_model")
+                                       or opts.get("spec_ckpt")) else "0")),
             quant=opts.get("quant") or None,
             kv_quant=opts.get("kv_quant") or None,
             prefix_cache=_parse_bool_opt(
@@ -274,11 +279,26 @@ class TpuBackend:
             ensemble=int(opts.get("ensemble", 1)),
         )
         spec_model = opts.get("spec_model", "")
+        spec_ckpt = opts.get("spec_ckpt", "")
         if spec_model and ckpt:
             raise ValueError(
-                "spec_model= draft decoding is not yet supported for ckpt= "
-                "backends (the draft would be a random init drafting for "
-                "real weights — 0 acceptance, pure overhead)")
+                "spec_model= (a random-init draft) would draft for real "
+                "ckpt= weights with ~0 acceptance — pure overhead; point "
+                "spec_ckpt= at a small same-tokenizer checkpoint instead")
+        if spec_model and spec_ckpt:
+            raise ValueError("spec_model= and spec_ckpt= are mutually "
+                             "exclusive draft sources")
+        if spec_ckpt:
+            # Config-time validation (the members= check below follows the
+            # same pattern): a typo must fail fast, not after the multi-GB
+            # target checkpoint has already loaded into HBM.
+            import os as _os
+
+            if not _os.path.exists(_os.path.join(spec_ckpt, "config.json")):
+                raise ValueError(
+                    f"spec_ckpt={spec_ckpt!r} is not a checkpoint dir "
+                    "(no config.json)")
+            eng_kw["draft_ckpt"] = spec_ckpt
         if ckpt and members > 1:
             # Checked here (not just in the engine): ckpt engines are keyed
             # without members, so a stacked URL would otherwise construct a

@@ -391,6 +391,7 @@ class InferenceEngine:
         kv_quant: str | None = None,
         draft_spec: ModelSpec | None = None,
         draft_seed: int = 0,
+        draft_params=None,
     ):
         self.spec = spec.validate()
         self.mesh = mesh or single_device_mesh()
@@ -570,16 +571,17 @@ class InferenceEngine:
         if draft_spec is not None:
             if self.members > 1 or self.ensemble > 1:
                 raise ValueError(
-                    "spec_model draft decoding does not compose with "
-                    "members/ensemble engines")
+                    "draft-model decoding (spec_model=/spec_ckpt=) does "
+                    "not compose with members/ensemble engines")
             if self.spec_decode <= 0:
                 raise ValueError(
-                    "draft_spec requires spec_decode > 0 (the backend "
-                    "defaults spec_decode=4 when spec_model= is set and "
-                    "spec_decode= is absent; an explicit 0 means off — "
-                    "drop spec_model= instead)")
+                    "a draft model requires spec_decode > 0 (the backend "
+                    "defaults spec_decode=4 when spec_model=/spec_ckpt= is "
+                    "set and spec_decode= is absent; an explicit 0 means "
+                    "off — drop the draft knob instead)")
             self._draft_rt = _DraftRuntime(
-                draft_spec, self.spec, self._rows, seed=draft_seed)
+                draft_spec, self.spec, self._rows, seed=draft_seed,
+                params=draft_params)
         else:
             self._draft_rt = None
         self._stop = False
@@ -1925,6 +1927,25 @@ def shutdown_all_engines(timeout: float = 30.0) -> None:
         _ENGINES.clear()
 
 
+def _load_draft_ckpt(draft_ckpt: str, target_max_seq: int,
+                     dtype: str | None = None):
+    """(spec, params) for a draft checkpoint, window-matched to the target.
+
+    The draft cache must hold every position the target can reach, so the
+    draft spec's ``max_seq`` is raised to the target's (RoPE tables extend;
+    positions beyond the draft's trained range can only lower acceptance —
+    drafts are speed-only). Vocab equality is enforced downstream by
+    ``_DraftRuntime``."""
+    import dataclasses
+
+    from quorum_tpu.models.hf_loader import load_hf_checkpoint
+
+    dspec, dparams = load_hf_checkpoint(draft_ckpt, dtype=dtype)
+    if dspec.max_seq < target_max_seq:
+        dspec = dataclasses.replace(dspec, max_seq=target_max_seq)
+    return dspec, dparams
+
+
 def get_engine(
     spec: ModelSpec,
     mesh: Mesh | None = None,
@@ -1941,6 +1962,7 @@ def get_engine(
     kv_quant: str | None = None,
     draft_spec: ModelSpec | None = None,
     draft_seed: int = 0,
+    draft_ckpt: str | None = None,
 ) -> InferenceEngine:
     """Engines are keyed by weight identity (spec, seed, mesh, quant,
     ensemble, members, draft model) plus the cache representation (kv_quant) —
@@ -1953,15 +1975,24 @@ def get_engine(
     maximum draft length any of its backends requested, and a
     ``prefix_cache=0`` from ANY backend disables reuse on the shared engine
     (an explicit opt-out wins over a sharing default)."""
+    import os
+
+    if draft_ckpt and draft_spec is not None:
+        raise ValueError("draft_spec and draft_ckpt are mutually exclusive")
+    draft_ckpt = os.path.realpath(draft_ckpt) if draft_ckpt else None
     mesh = mesh or single_device_mesh()
     key = (spec, seed, quant or None, max(1, int(ensemble)),
            max(1, int(members)), kv_quant or None,
-           draft_spec, draft_seed,
+           draft_spec, draft_seed, draft_ckpt,
            tuple(sorted(mesh.shape.items())),
            tuple(map(str, mesh.devices.flat)))
     with _ENGINES_LOCK:
         eng = _ENGINES.get(key)
         if eng is None:
+            draft_params = None
+            if draft_ckpt:
+                draft_spec, draft_params = _load_draft_ckpt(
+                    draft_ckpt, spec.max_seq)
             eng = InferenceEngine(
                 spec, mesh, seed=seed, n_slots=n_slots,
                 prefill_chunk=prefill_chunk, max_pending=max_pending,
@@ -1969,6 +2000,7 @@ def get_engine(
                 prefix_cache=prefix_cache, ensemble=ensemble,
                 members=members, kv_quant=kv_quant,
                 draft_spec=draft_spec, draft_seed=draft_seed,
+                draft_params=draft_params,
             )
             _ENGINES[key] = eng
         else:
@@ -1991,9 +2023,13 @@ def get_engine_from_ckpt(
     prefix_cache: bool = True,
     ensemble: int = 1,
     kv_quant: str | None = None,
+    draft_ckpt: str | None = None,
 ) -> InferenceEngine:
-    """Engine over a local HF checkpoint; keyed by (resolved path, mesh) so N
-    backends pointing at one checkpoint share the loaded weights on device.
+    """Engine over a local HF checkpoint; keyed by (resolved path, mesh,
+    draft checkpoint) so N backends pointing at one checkpoint with the
+    same draft configuration share the loaded weights on device (a backend
+    that adds spec_ckpt= constructs its own engine — and re-loads the
+    target).
     ``ensemble`` > 1 is rejected (members are seeded random inits; a
     checkpoint provides one weight set)."""
     import os
@@ -2010,19 +2046,29 @@ def get_engine_from_ckpt(
     # Normalize: dtype=None and an explicit dtype equal to the default must
     # hit the same cache entry (else the checkpoint sits in HBM twice).
     eff_dtype = dtype or ModelSpec().dtype
+    draft_resolved = os.path.realpath(draft_ckpt) if draft_ckpt else None
     key = ("ckpt", resolved, eff_dtype, quant or None, kv_quant or None,
+           draft_resolved,
            tuple(sorted(mesh.shape.items())),
            tuple(map(str, mesh.devices.flat)))
     with _ENGINES_LOCK:
         eng = _ENGINES.get(key)
         if eng is None:
             spec, params = load_hf_checkpoint(resolved, dtype=dtype)
+            draft_spec = draft_params = None
+            if draft_resolved:
+                # The draft follows the target's dtype= override: a mixed
+                # f32/bf16 pair would round differently and lower
+                # acceptance for no reason.
+                draft_spec, draft_params = _load_draft_ckpt(
+                    draft_resolved, spec.max_seq, dtype=dtype)
             eng = InferenceEngine(
                 spec, mesh, params=params, n_slots=n_slots,
                 prefill_chunk=prefill_chunk, max_pending=max_pending,
                 spec_decode=spec_decode, quant=quant,
                 prefix_cache=prefix_cache, ensemble=ensemble,
                 kv_quant=kv_quant,
+                draft_spec=draft_spec, draft_params=draft_params,
             )
             _ENGINES[key] = eng
         else:

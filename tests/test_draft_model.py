@@ -156,3 +156,70 @@ def test_explicit_spec_decode_zero_with_draft_rejected():
     spec = resolve_spec("llama-tiny", SPEC)
     with pytest.raises(ValueError, match="spec_decode"):
         InferenceEngine(spec, spec_decode=0, draft_spec=spec)
+
+
+def _tiny_llama_ckpt(dirpath, seed):
+    import torch
+    from transformers import LlamaConfig, LlamaForCausalLM
+
+    torch.manual_seed(seed)
+    cfg = LlamaConfig(
+        vocab_size=512, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=256, rope_theta=10000.0,
+        tie_word_embeddings=False,
+    )
+    LlamaForCausalLM(cfg).eval().save_pretrained(
+        dirpath, safe_serialization=True)
+    return str(dirpath)
+
+
+def test_spec_ckpt_oracle_and_other_weights(tmp_path):
+    """Real-checkpoint draft pairs (spec_ckpt=): the deployment story —
+    a small checkpoint drafts for a checkpoint target. Oracle case (draft
+    dir == target dir → identical weights) must reproduce the no-draft
+    output with high acceptance; a different-weights draft must also
+    reproduce it (speed-only, like every draft source)."""
+    import asyncio
+
+    from quorum_tpu.backends.tpu_backend import TpuBackend
+    from quorum_tpu.config import BackendSpec
+
+    target = _tiny_llama_ckpt(tmp_path / "target", seed=0)
+    other = _tiny_llama_ckpt(tmp_path / "other", seed=1)
+    body = {"model": "m", "temperature": 0.0, "max_tokens": 12,
+            "messages": [{"role": "user", "content": "draft me a reply"}]}
+
+    def text(url):
+        be = TpuBackend.from_spec(BackendSpec(name="C", url=url, model="m"))
+        result = asyncio.run(be.complete(body, {}, 120.0))
+        assert result.ok, result.body
+        return result.content, be.engine
+
+    plain, _ = text(f"tpu://x?ckpt={target}&slots=2&max_tokens=12")
+    oracle, eng = text(f"tpu://x?ckpt={target}&slots=2&max_tokens=12"
+                       f"&spec_ckpt={target}")
+    assert oracle == plain, "spec_ckpt oracle changed ckpt greedy content"
+    m = eng.metrics()
+    assert m["spec_turns_total"] > 0
+    assert m["spec_accepted_total"] >= 2 * m["spec_turns_total"]
+
+    different, _ = text(f"tpu://x?ckpt={target}&slots=2&max_tokens=12"
+                        f"&spec_ckpt={other}")
+    assert different == plain, "different-weights draft changed content"
+
+
+def test_draft_source_knob_validation(tmp_path):
+    from quorum_tpu.backends.tpu_backend import TpuBackend
+    from quorum_tpu.config import BackendSpec
+
+    with pytest.raises(ValueError, match="mutually"):
+        TpuBackend.from_spec(BackendSpec(
+            name="X",
+            url="tpu://llama-tiny?spec_model=llama-tiny&spec_ckpt=/x",
+            model="m"))
+    with pytest.raises(ValueError, match="config.json"):
+        TpuBackend.from_spec(BackendSpec(
+            name="X",
+            url=f"tpu://llama-tiny?spec_ckpt={tmp_path}/typo",
+            model="m"))
