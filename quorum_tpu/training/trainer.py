@@ -60,8 +60,62 @@ def loss_fn(params, spec: ModelSpec, tokens: jnp.ndarray, remat: bool = True):
     return jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
 
 
-def make_optimizer(lr: float = 3e-4, weight_decay: float = 0.1) -> optax.GradientTransformation:
-    return optax.adamw(lr, b1=0.9, b2=0.95, weight_decay=weight_decay)
+def make_optimizer(
+    lr: float = 3e-4,
+    weight_decay: float = 0.1,
+    *,
+    warmup_steps: int = 0,
+    total_steps: int | None = None,
+    grad_clip: float | None = None,
+    accum_steps: int = 1,
+) -> optax.GradientTransformation:
+    """The standard LLM training stack, composed from optax:
+
+      - AdamW (b1 0.9, b2 0.95) at ``lr`` — constant by default; with
+        ``warmup_steps``/``total_steps`` a linear-warmup + cosine-decay
+        schedule (the near-universal LLM recipe);
+      - optional global-norm gradient clipping (``grad_clip``);
+      - optional gradient accumulation (``accum_steps`` micro-batches per
+        optimizer update, via ``optax.MultiSteps``) — the TPU-relevant
+        lever: global batch beyond what fits HBM costs steps, not memory.
+        Micro-gradients are cast to f32 before the running mean (bf16
+        accumulation would round away late micro-batches as the window
+        grows). For micro-batches with EQUAL real-token counts the
+        accumulated update equals one big-batch step (pinned by
+        tests/test_train_checkpoint); unequal counts weight each
+        micro-batch's tokens by 1/its own count — loss_fn normalizes per
+        micro-batch — so keep bucketed batches out of one window.
+
+    Any bespoke ``optax.GradientTransformation`` can still be passed to
+    ``train_init``/``make_train_step`` directly; this is the shipped recipe.
+    """
+    if total_steps is not None:
+        if warmup_steps >= total_steps:
+            raise ValueError(
+                f"warmup_steps={warmup_steps} must be < total_steps="
+                f"{total_steps} (no decay budget left)")
+        sched = optax.warmup_cosine_decay_schedule(
+            init_value=0.0, peak_value=lr,
+            warmup_steps=max(0, warmup_steps),
+            decay_steps=max(1, total_steps))
+    elif warmup_steps > 0:
+        sched = optax.linear_schedule(0.0, lr, warmup_steps)
+    else:
+        sched = lr
+    tx = optax.adamw(sched, b1=0.9, b2=0.95, weight_decay=weight_decay)
+    if grad_clip is not None:
+        tx = optax.chain(optax.clip_by_global_norm(grad_clip), tx)
+    if accum_steps > 1:
+        # f32 accumulator: MultiSteps keeps its running mean in the
+        # incoming gradient dtype, and a bf16 mean over a long window
+        # rounds away the late micro-batches' 1/k-scaled contributions.
+        cast_f32 = optax.GradientTransformation(
+            lambda params: optax.EmptyState(),
+            lambda updates, state, params=None: (
+                jax.tree.map(lambda g: g.astype(jnp.float32), updates),
+                state))
+        tx = optax.chain(cast_f32, optax.MultiSteps(tx, every_k_schedule=accum_steps))
+    return tx
 
 
 def train_init(

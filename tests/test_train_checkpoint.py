@@ -100,3 +100,61 @@ def test_serve_from_training_checkpoint(tmp_path):
     a = np.asarray(forward_logits(state.params, SPEC, toks), np.float32)
     b = np.asarray(forward_logits(eng.params, SPEC, toks), np.float32)
     np.testing.assert_allclose(a, b, rtol=2e-2, atol=2e-2)
+
+
+def test_optimizer_recipe_schedule_clip_accumulation():
+    """The shipped optimizer recipe (make_optimizer): warmup-cosine LR,
+    global-norm clipping, and gradient accumulation. Accumulation is the
+    TPU-relevant lever — accum_steps micro-batches must equal ONE step on
+    the concatenated batch (optax.MultiSteps averages the window), so
+    global batch scales in steps instead of HBM."""
+    from quorum_tpu.training.trainer import make_optimizer
+
+    spec = resolve_spec("llama-tiny", {"max_seq": "32"})
+    mesh = make_mesh(MeshConfig())
+    tokens = (np.arange(4 * 16, dtype=np.int32) % 97 + 3).reshape(4, 16)
+
+    # One big-batch step…
+    big = train_init(spec, mesh, seed=0,
+                     optimizer=make_optimizer(grad_clip=1.0))
+    big_step = make_train_step(spec, mesh,
+                               optimizer=make_optimizer(grad_clip=1.0))
+    big, _ = big_step(big, tokens)
+
+    # …equals two accumulated half-batch micro-steps.
+    acc_opt = make_optimizer(grad_clip=1.0, accum_steps=2)
+    acc = train_init(spec, mesh, seed=0, optimizer=acc_opt)
+    acc_step = make_train_step(spec, mesh, optimizer=acc_opt)
+    acc, _ = acc_step(acc, tokens[:2])
+    # materialize before the next (donating) step deletes the buffers
+    mid = [np.asarray(x) for x in jax.tree.leaves(acc.params)]
+    # the running mean must accumulate in f32 (bf16 would round away late
+    # micro-batches as the window grows)
+    acc_grads = [x for x in jax.tree.leaves(acc.opt_state)
+                 if hasattr(x, "dtype") and x.ndim > 0]
+    assert any(x.dtype == np.float32 for x in acc_grads)
+    acc, _ = acc_step(acc, tokens[2:])
+
+    base = [np.asarray(x, np.float32)
+            for x in jax.tree.leaves(train_init(spec, mesh, seed=0).params)]
+
+    def max_delta(params, ref):
+        return max(float(np.abs(np.asarray(a, np.float32) - b).max())
+                   for a, b in zip(jax.tree.leaves(params), ref))
+
+    assert max_delta(mid, base) == 0.0  # first micro-step: no update applied
+    for a, b in zip(jax.tree.leaves(acc.params), jax.tree.leaves(big.params)):
+        np.testing.assert_allclose(np.asarray(a, np.float32),
+                                   np.asarray(b, np.float32),
+                                   rtol=5e-2, atol=1e-3)  # bf16 params: ±1 ulp
+
+    # Warmup schedule: step-0 LR is ~0, so params barely move.
+    warm_opt = make_optimizer(warmup_steps=10, total_steps=100)
+    warm = train_init(spec, mesh, seed=0, optimizer=warm_opt)
+    warm_step = make_train_step(spec, mesh, optimizer=warm_opt)
+    warm, _ = warm_step(warm, tokens)
+    assert max_delta(warm.params, base) < max_delta(big.params, base) / 10
+
+    import pytest as _pytest
+    with _pytest.raises(ValueError, match="warmup_steps"):
+        make_optimizer(warmup_steps=100, total_steps=50)
