@@ -457,6 +457,11 @@ class InferenceEngine:
         self._use_sp = dict(self.mesh.shape).get(AXIS_SP, 1) > 1
         if self._use_sp:
             self.prefill_chunk = 0
+            if self.spec.sliding_window > 0:
+                raise ValueError(
+                    "sliding_window specs (mistral) do not compose with "
+                    "sp>1: ring attention computes full causal attention "
+                    "and would silently widen the receptive field")
         if self.ensemble > 1:
             if self._use_sp:
                 raise ValueError(

@@ -135,6 +135,14 @@ def spec_from_hf_config(cfg: dict[str, Any]) -> ModelSpec:
             act="swiglu",
             use_bias=bool(cfg.get("attention_bias", mt == "qwen2")),
             tied_lm_head=bool(cfg.get("tie_word_embeddings", False)),
+            # mistral v0.1-style sliding-window attention; null/absent =
+            # full causal (llama, mistral v0.2+). qwen2 is deliberately
+            # NOT windowed: HF applies qwen2 SWA per-layer (only layers >=
+            # max_window_layers — no layer at all in stock configs), and
+            # this runtime has one global window; a partial match would be
+            # silently wrong, full-causal matches stock HF behavior.
+            sliding_window=(int(cfg.get("sliding_window") or 0)
+                            if mt == "mistral" else 0),
         ).validate()
     if mt == "gemma":
         d = cfg["hidden_size"]

@@ -28,6 +28,7 @@ class ModelSpec:
     head_dim: int = 128
     d_ff: int = 14336
     max_seq: int = 4096
+    sliding_window: int = 0        # >0: attend only the last W positions (mistral)
     norm: str = "rmsnorm"          # "rmsnorm" | "layernorm"
     norm_eps: float = 1e-5
     norm_offset: float = 0.0       # weight used as (offset + w); gemma: 1.0
@@ -84,6 +85,7 @@ MODEL_PRESETS: dict[str, ModelSpec] = {
     "mistral-7b": ModelSpec(
         family="llama", vocab_size=32000, d_model=4096, n_layers=32, n_heads=32,
         n_kv_heads=8, head_dim=128, d_ff=14336, max_seq=8192, rope_theta=1000000.0,
+        sliding_window=4096,
         tied_lm_head=False,
     ),
     # Gemma-7B: GeGLU MLP, (1 + w) RMSNorm, sqrt(d_model)-scaled embeddings,

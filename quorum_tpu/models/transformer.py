@@ -337,6 +337,11 @@ def prefill(
     """
     b, t = tokens.shape
     cache_row = slot if slot is not None else 0
+    if mesh is not None and spec.sliding_window > 0:
+        raise ValueError(
+            "sliding_window specs cannot use ring-attention admission "
+            "(sp>1): the ring computes full causal attention and would "
+            "silently widen the receptive field")
     positions = jnp.arange(t)
     x = _embed(params, spec, tokens, positions)
     cos, sin = rope_cos_sin(spec.max_seq, spec.head_dim, spec.rope_theta)
@@ -351,11 +356,13 @@ def prefill(
             k = apply_rope(k, cos, sin, positions)
         if mesh is not None:
             # Sequence-parallel admission: ring attention over the sp axis.
+            # (Windowed specs were rejected above — the ring is full-causal.)
             attn = ring_prefill_attention(q, k, v, lengths, mesh)
         else:
             # Flash kernel on TPU (causal + length mask fused, O(S) VMEM);
             # XLA-native reference path elsewhere.
-            attn = flash_prefill_attention(q, k, v, lengths)
+            attn = flash_prefill_attention(q, k, v, lengths,
+                                           window=spec.sliding_window)
         carry_x = carry_x + _attn_out(attn, block, carry_x.dtype)
         h2 = _norm(carry_x, block["mlp_norm_w"], block.get("mlp_norm_b"), spec)
         mlp = (_moe_mlp(h2, block, spec, token_mask=moe_mask)
@@ -419,7 +426,10 @@ def prefill_segment(
     # causal over absolute positions: key j visible to query i iff j <= i
     qi = positions[:, None]
     ki = jnp.arange(hist)[None, :]
-    mask = (ki <= qi)[None, None, None, :, :]  # [1,1,1,T,hist]
+    keep = ki <= qi
+    if spec.sliding_window > 0:
+        keep = keep & (ki > qi - spec.sliding_window)
+    mask = keep[None, None, None, :, :]  # [1,1,1,T,hist]
     moe_mask = (jnp.arange(t) < n_valid)[None, :]  # [1,T]
 
     def seg_write(cache, value):
@@ -553,7 +563,8 @@ def decode_step(
             # Native int8 q·K / p·V over the quantized cache: HALF the
             # cache bytes per step, no dequantized HBM copy.
             attn = decode_attention_q8(
-                q, read_k[0], read_k[1], read_v[0], read_v[1], lengths + 1)
+                q, read_k[0], read_k[1], read_v[0], read_v[1], lengths + 1,
+                window=spec.sliding_window)
         elif flash_decode_mode():
             # Opt-in Pallas kernel (QUORUM_TPU_FLASH_DECODE=1): per-ROW
             # exact cache reads — a short row co-batched with a long one
@@ -562,9 +573,11 @@ def decode_step(
             # back to decode_attention itself (ops/flash_decode.py).
             attn = flash_decode_attention(
                 q, read_k, read_v, lengths + 1,
-                interpret=flash_decode_mode() == "interpret")
+                interpret=flash_decode_mode() == "interpret",
+                window=spec.sliding_window)
         else:
-            attn = decode_attention(q, read_k, read_v, lengths + 1)
+            attn = decode_attention(q, read_k, read_v, lengths + 1,
+                                    window=spec.sliding_window)
         carry_x = carry_x + _attn_out(attn, block, carry_x.dtype)
         h2 = _norm(carry_x, block["mlp_norm_w"], block.get("mlp_norm_b"), spec)
         mlp = _moe_mlp(h2, block, spec) if spec.is_moe else _dense_mlp(h2, block, spec)
@@ -636,7 +649,10 @@ def decode_multi(
     # per-row causal mask over the cache prefix: key j visible to query i of
     # row r iff j <= lengths[r] + i
     ki = jnp.arange(hist)[None, None, :]
-    mask = (ki <= pos[:, :, None])[:, None, None, :, :]  # [B,1,1,T,hist]
+    keep = ki <= pos[:, :, None]
+    if spec.sliding_window > 0:
+        keep = keep & (ki > pos[:, :, None] - spec.sliding_window)
+    mask = keep[:, None, None, :, :]  # [B,1,1,T,hist]
 
     def body(carry_x, per_layer):
         block, ck, cv = per_layer
@@ -718,7 +734,8 @@ def forward_logits(
     """Full-sequence logits [B, T, V] — the training-step / eval forward
     (no KV cache; used by the multi-chip dry run's loss+grad and by tests
     that check prefill/decode consistency against a cache-free ground truth)."""
-    mask = causal_mask(tokens.shape[1], tokens.shape[1])
+    mask = causal_mask(tokens.shape[1], tokens.shape[1],
+                       window=spec.sliding_window)
     return _scan_layers(
         params, spec, tokens, lambda q, k, v: attention(q, k, v, mask), remat
     )
@@ -737,8 +754,18 @@ def forward_logits_sp(
     Long-context path (SURVEY.md §5.7): attention runs under shard_map with
     the sequence sharded over the mesh's ``sp`` axis — per-device K/V memory
     is O(T/sp) inside the ring; everything else is left to GSPMD (dp/tp).
+
+    Sliding-window specs are rejected: the ring computes full causal
+    attention, and silently widening a windowed model's receptive field
+    would change its output (window support inside the ring — where ≥
+    W-distant hops could skip entirely — is future work).
     GQA is grouped inside the ring — the blocks riding the ICI ring stay at
     KV-head width (no repeat_kv broadcast)."""
+    if spec.sliding_window > 0:
+        raise ValueError(
+            "sliding_window specs cannot use ring attention (sp>1): the "
+            "ring computes full causal attention and would silently widen "
+            "the model's receptive field")
     from quorum_tpu.parallel.ring_attention import ring_prefill_attention
 
     def ring_attn(q, k, v):

@@ -45,21 +45,28 @@ def attention(
     return out.reshape(b, k_ * g, s, d)
 
 
-def causal_mask(s_q: int, s_kv: int, q_offset: jnp.ndarray | int = 0) -> jnp.ndarray:
+def causal_mask(s_q: int, s_kv: int, q_offset: jnp.ndarray | int = 0,
+                window: int = 0) -> jnp.ndarray:
     """[1, 1, 1, s_q, s_kv] boolean causal mask; query i sits at absolute
-    position q_offset + i."""
+    position q_offset + i. ``window`` > 0 adds sliding-window attention
+    (mistral): key j visible to query at position p iff p-window < j <= p."""
     qi = jnp.arange(s_q)[:, None] + q_offset
     ki = jnp.arange(s_kv)[None, :]
-    return (ki <= qi)[None, None, None, :, :]
+    keep = ki <= qi
+    if window and window > 0:
+        keep = keep & (ki > qi - window)
+    return keep[None, None, None, :, :]
 
 
-def prefill_attention(q, k, v, lengths: jnp.ndarray | None = None) -> jnp.ndarray:
+def prefill_attention(q, k, v, lengths: jnp.ndarray | None = None,
+                      window: int = 0) -> jnp.ndarray:
     """Causal self-attention over a [B, ·, S, hd] prompt block.
 
     ``lengths`` ([B]) masks out right-padding so batched prompts of unequal
-    length share one compiled program (static shapes — SURVEY.md §7).
+    length share one compiled program (static shapes — SURVEY.md §7);
+    ``window`` > 0 adds the sliding-window constraint.
     """
-    mask = causal_mask(q.shape[2], k.shape[2])
+    mask = causal_mask(q.shape[2], k.shape[2], window=window)
     if lengths is not None:
         valid = (jnp.arange(k.shape[2])[None, :] < lengths[:, None])  # [B, S_kv]
         mask = mask & valid[:, None, None, None, :]
@@ -71,12 +78,17 @@ def decode_attention(
     k_cache: jnp.ndarray,  # [B, K, max_seq, hd]
     v_cache: jnp.ndarray,
     length: jnp.ndarray,  # [B] or scalar: #valid cache entries (incl. current token)
+    window: int = 0,
 ) -> jnp.ndarray:
-    """One decode step against the KV cache (static max_seq, masked by length)."""
+    """One decode step against the KV cache (static max_seq, masked by
+    length; ``window`` > 0 restricts to the last ``window`` positions)."""
     length = jnp.asarray(length)
     if length.ndim == 0:
         length = length[None]
-    valid = jnp.arange(k_cache.shape[2])[None, :] < length[:, None]  # [B, max_seq]
+    ki = jnp.arange(k_cache.shape[2])[None, :]
+    valid = ki < length[:, None]  # [B, max_seq]
+    if window and window > 0:
+        valid = valid & (ki >= length[:, None] - window)
     mask = valid[:, None, None, None, :]
     return attention(q, k_cache, v_cache, mask)
 
@@ -102,6 +114,7 @@ def decode_attention_q8(
     v8: jnp.ndarray,       # [B, K, T, hd] int8 cache
     v_scale: jnp.ndarray,  # [B, K, T] f32
     length: jnp.ndarray,   # [B] or scalar
+    window: int = 0,
 ) -> jnp.ndarray:
     """One decode step against an int8-quantized KV cache, with the
     contractions run NATIVELY in int8 (int8×int8→int32 on the MXU) — never
@@ -127,7 +140,10 @@ def decode_attention_q8(
     length = jnp.asarray(length)
     if length.ndim == 0:
         length = length[None]
-    valid = jnp.arange(k8.shape[2])[None, :] < length[:, None]  # [B, T]
+    ki = jnp.arange(k8.shape[2])[None, :]
+    valid = ki < length[:, None]  # [B, T]
+    if window and window > 0:
+        valid = valid & (ki >= length[:, None] - window)
     logits = jnp.where(valid[:, None, None, None, :], logits, NEG_INF)
     probs = jnp.exp(logits - jnp.max(logits, axis=-1, keepdims=True))
     probs = probs / jnp.sum(probs, axis=-1, keepdims=True)

@@ -61,6 +61,7 @@ def _flash_kernel(
     *,
     scale: float,
     block_k: int,
+    window: int,
 ):
     iq, ik = pl.program_id(2), pl.program_id(3)
     n_k = pl.num_programs(3)
@@ -75,7 +76,15 @@ def _flash_kernel(
         l_scr[:, :] = jnp.zeros_like(l_scr[:, :])
         acc_scr[:, :] = jnp.zeros_like(acc_scr[:, :])
 
-    @pl.when(k_start <= q_start + bq - 1)  # tile intersects the causal region
+    live = k_start <= q_start + bq - 1  # tile intersects the causal region
+    if window > 0:
+        # …and is not entirely left of every query's sliding window —
+        # recovers SWA's O(S·W) compute (the DMA still streams; masked
+        # tiles skip the matmuls/softmax, the dominant cost at these tile
+        # sizes).
+        live = live & (k_start + block_k > q_start - window + 1)
+
+    @pl.when(live)
     def _update():
         q = q_ref[0, 0, :, :].astype(jnp.float32) * scale
         k_blk = k_ref[0, 0, :, :].astype(jnp.float32)
@@ -87,6 +96,8 @@ def _flash_kernel(
         row_ids = q_start + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
         col_ids = k_start + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 1)
         keep = (col_ids <= row_ids) & (col_ids < length)
+        if window > 0:  # sliding-window attention (static; mistral)
+            keep = keep & (col_ids > row_ids - window)
         logits = jnp.where(keep, logits, NEG_INF)
 
         m_prev = m_scr[:, :]
@@ -109,10 +120,11 @@ def _flash_kernel(
 
 
 @functools.partial(
-    jax.jit, static_argnames=("block_q", "block_k", "interpret")
+    jax.jit, static_argnames=("block_q", "block_k", "interpret", "window")
 )
 def _flash_call(
-    q, k, v, lengths, *, block_q: int, block_k: int, interpret: bool
+    q, k, v, lengths, *, block_q: int, block_k: int, interpret: bool,
+    window: int = 0,
 ):
     b, h, s_q, hd = q.shape
     n_kv = k.shape[1]
@@ -120,7 +132,8 @@ def _flash_call(
     group = h // n_kv
     grid = (b, h, s_q // block_q, s_kv // block_k)
 
-    kernel = functools.partial(_flash_kernel, scale=hd**-0.5, block_k=block_k)
+    kernel = functools.partial(_flash_kernel, scale=hd**-0.5, block_k=block_k,
+                               window=window)
     return pl.pallas_call(
         kernel,
         grid=grid,
@@ -179,9 +192,11 @@ def flash_prefill_attention(
     block_q: int = DEFAULT_BLOCK_Q,
     block_k: int = DEFAULT_BLOCK_K,
     interpret: bool = False,
+    window: int = 0,
 ) -> jnp.ndarray:
-    """Causal, length-masked prefill attention; flash kernel when supported,
-    XLA-native reference otherwise. Returns [B, H, S, hd]."""
+    """Causal, length-masked prefill attention (``window`` > 0 adds the
+    sliding-window constraint); flash kernel when supported, XLA-native
+    reference otherwise. Returns [B, H, S, hd]."""
     # Clamp tiles to the sequence (buckets are powers of two, so they divide).
     block_q = min(block_q, q.shape[2])
     block_k = min(block_k, k.shape[2])
@@ -191,7 +206,8 @@ def flash_prefill_attention(
         return _flash_call(
             q, k, v, jnp.asarray(lengths, jnp.int32),
             block_q=block_q, block_k=block_k, interpret=interpret,
+            window=window,
         )
     from quorum_tpu.ops.attention import prefill_attention
 
-    return prefill_attention(q, k, v, lengths)
+    return prefill_attention(q, k, v, lengths, window=window)

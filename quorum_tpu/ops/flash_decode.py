@@ -62,6 +62,7 @@ def _decode_kernel(
     *,
     scale: float,
     block_k: int,
+    window: int,
 ):
     ib, it = pl.program_id(0), pl.program_id(2)
     n_t = pl.num_programs(2)
@@ -74,7 +75,13 @@ def _decode_kernel(
         l_scr[:, :] = jnp.zeros_like(l_scr[:, :])
         acc_scr[:, :] = jnp.zeros_like(acc_scr[:, :])
 
-    @pl.when(k_start < length)  # tile holds live cache entries for THIS row
+    live = k_start < length  # tile holds live cache entries for THIS row
+    if window > 0:
+        # …within this row's sliding window (queries sit at length-1; keys
+        # ≥ length-window are visible).
+        live = live & (k_start + block_k > length - window)
+
+    @pl.when(live)
     def _update():
         q = q_ref[0, 0, :, :].astype(jnp.float32) * scale      # [G, hd]
         k_blk = k_ref[0, 0, :, :].astype(jnp.float32)          # [BK, hd]
@@ -86,7 +93,10 @@ def _decode_kernel(
         g = q.shape[0]
         col_ids = k_start + jax.lax.broadcasted_iota(
             jnp.int32, (g, block_k), 1)
-        logits = jnp.where(col_ids < length, logits, NEG_INF)
+        keep = col_ids < length
+        if window > 0:  # sliding-window attention (static; mistral)
+            keep = keep & (col_ids >= length - window)
+        logits = jnp.where(keep, logits, NEG_INF)
 
         m_prev = m_scr[:, :]
         m_new = jnp.maximum(m_prev, jnp.max(logits, axis=-1, keepdims=True))
@@ -106,8 +116,9 @@ def _decode_kernel(
         o_ref[0, 0, :, :] = out.astype(o_ref.dtype)
 
 
-@functools.partial(jax.jit, static_argnames=("block_k", "interpret"))
-def _decode_call(q, k_cache, v_cache, lengths, *, block_k: int, interpret: bool):
+@functools.partial(jax.jit, static_argnames=("block_k", "interpret", "window"))
+def _decode_call(q, k_cache, v_cache, lengths, *, block_k: int,
+                 interpret: bool, window: int = 0):
     b, h, _, hd = q.shape
     n_kv, t = k_cache.shape[1], k_cache.shape[2]
     group = h // n_kv
@@ -118,23 +129,30 @@ def _decode_call(q, k_cache, v_cache, lengths, *, block_k: int, interpret: bool)
         # Last tile holding live entries for row ib; lengths ≥ 1 always.
         return (lens[ib] - 1) // block_k
 
+    def first_live_tile(ib, lens):
+        # With a sliding window, tiles entirely below length-window hold
+        # nothing visible — clamp from below too, so their DMAs are also
+        # skipped (repeated index → no copy).
+        if window <= 0:
+            return 0
+        return jnp.maximum(lens[ib] - window, 0) // block_k
+
+    def kv_index(ib, ik, it, lens):
+        return (ib, ik,
+                jnp.clip(it, first_live_tile(ib, lens),
+                         last_live_tile(ib, lens)), 0)
+
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
         grid=(b, n_kv, n_tiles),
         in_specs=[
             pl.BlockSpec((1, 1, group, hd),
                          lambda ib, ik, it, lens: (ib, ik, 0, 0)),
-            # Clamp the tile index to the row's last live tile: repeated
-            # indices on later grid steps skip the HBM→VMEM copy entirely
+            # Clamp the tile index into the row's live range: repeated
+            # indices on clamped grid steps skip the HBM→VMEM copy entirely
             # (compute for them is skipped by pl.when in the kernel).
-            pl.BlockSpec((1, 1, block_k, hd),
-                         lambda ib, ik, it, lens: (
-                             ib, ik,
-                             jnp.minimum(it, last_live_tile(ib, lens)), 0)),
-            pl.BlockSpec((1, 1, block_k, hd),
-                         lambda ib, ik, it, lens: (
-                             ib, ik,
-                             jnp.minimum(it, last_live_tile(ib, lens)), 0)),
+            pl.BlockSpec((1, 1, block_k, hd), kv_index),
+            pl.BlockSpec((1, 1, block_k, hd), kv_index),
         ],
         out_specs=pl.BlockSpec((1, 1, group, hd),
                                lambda ib, ik, it, lens: (ib, ik, 0, 0)),
@@ -145,7 +163,8 @@ def _decode_call(q, k_cache, v_cache, lengths, *, block_k: int, interpret: bool)
         ],
     )
     out = pl.pallas_call(
-        functools.partial(_decode_kernel, scale=hd**-0.5, block_k=block_k),
+        functools.partial(_decode_kernel, scale=hd**-0.5, block_k=block_k,
+                          window=window),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct(qg.shape, q.dtype),
         interpret=interpret,
@@ -196,6 +215,7 @@ def flash_decode_attention(
     *,
     block_k: int = DEFAULT_BLOCK_K,
     interpret: bool = False,
+    window: int = 0,
 ) -> jnp.ndarray:
     """Per-row-exact decode attention; Pallas kernel when supported, the
     masked-dense reference (ops.attention.decode_attention) otherwise."""
@@ -207,7 +227,8 @@ def flash_decode_attention(
         q.shape, k_cache.shape, block_k
     ):
         return _decode_call(q, k_cache, v_cache, lengths,
-                            block_k=block_k, interpret=interpret)
+                            block_k=block_k, interpret=interpret,
+                            window=window)
     from quorum_tpu.ops.attention import decode_attention
 
-    return decode_attention(q, k_cache, v_cache, lengths)
+    return decode_attention(q, k_cache, v_cache, lengths, window=window)

@@ -106,7 +106,7 @@ def _pipeline_blocks(blocks, xs, spec: ModelSpec, mesh: Mesh, remat: bool):
     n_micro, mb, t_len, _ = xs.shape
     baxis = AXIS_DP if mb % mesh.shape[AXIS_DP] == 0 else None
     positions = jnp.arange(t_len)
-    mask = causal_mask(t_len, t_len)
+    mask = causal_mask(t_len, t_len, window=spec.sliding_window)
 
     from quorum_tpu.models.transformer import _layer_body
 
