@@ -11,6 +11,11 @@ the device per decode-chunk), fixing the reference's pseudo-streaming
 URL grammar:  ``tpu://<model-id>?<spec overrides>&<engine options>``
   spec overrides   any ModelSpec field (n_layers=2, d_model=64, ...)
   tp=, dp=, sp=    mesh shape (default: single device); sp>1 runs admission
+  sp_impl=         sp>1 attention strategy: "ring" (default — O(S/sp)
+                   memory, KV blocks ppermute the ICI ring) or "ulysses"
+                   (head<->sequence all-to-alls, full-seq local attention;
+                   supports sliding-window specs, needs head counts
+                   divisible by sp)
                    prefill as ring attention with the prompt sequence
                    sharded over the sp axis (long-context serving)
   seed=            weight-init seed (distinct seeds ≈ distinct ensemble members)
@@ -277,6 +282,7 @@ class TpuBackend:
             prefix_cache=_parse_bool_opt(
                 "prefix_cache", opts.get("prefix_cache", "1")),
             ensemble=int(opts.get("ensemble", 1)),
+            sp_impl=opts.get("sp_impl", "ring"),
         )
         spec_model = opts.get("spec_model", "")
         spec_ckpt = opts.get("spec_ckpt", "")

@@ -392,6 +392,7 @@ class InferenceEngine:
         draft_spec: ModelSpec | None = None,
         draft_seed: int = 0,
         draft_params=None,
+        sp_impl: str = "ring",
     ):
         self.spec = spec.validate()
         self.mesh = mesh or single_device_mesh()
@@ -455,13 +456,32 @@ class InferenceEngine:
         from quorum_tpu.parallel.mesh import AXIS_SP
 
         self._use_sp = dict(self.mesh.shape).get(AXIS_SP, 1) > 1
+        if sp_impl not in ("ring", "ulysses"):
+            raise ValueError(
+                f"unknown sp_impl {sp_impl!r} (ring or ulysses)")
+        self.sp_impl = sp_impl
         if self._use_sp:
             self.prefill_chunk = 0
-            if self.spec.sliding_window > 0:
+            if sp_impl == "ulysses":
+                from quorum_tpu.parallel.ulysses import ulysses_supported
+
+                if not ulysses_supported(self.spec.n_heads,
+                                         self.spec.n_kv_heads, self.mesh):
+                    raise ValueError(
+                        f"sp_impl=ulysses needs the per-device head counts "
+                        f"to split over sp "
+                        f"(heads={self.spec.n_heads}, "
+                        f"kv_heads={self.spec.n_kv_heads}, mesh "
+                        f"{dict(self.mesh.shape)}) — a silent dense "
+                        "fallback would replicate full attention at "
+                        "exactly the lengths sp exists for")
+            if self.spec.sliding_window > 0 and sp_impl == "ring":
                 raise ValueError(
                     "sliding_window specs (mistral) do not compose with "
-                    "sp>1: ring attention computes full causal attention "
-                    "and would silently widen the receptive field")
+                    "ring-attention sp>1 (full causal attention would "
+                    "silently widen the receptive field); use "
+                    "sp_impl=ulysses, whose full-sequence local attention "
+                    "applies windows unchanged")
         if self.ensemble > 1:
             if self._use_sp:
                 raise ValueError(
@@ -668,7 +688,8 @@ class InferenceEngine:
             logits, ck, cv = _member_call(
                 ens,
                 lambda p, k, v: prefill(
-                    p, spec, tokens, lengths1, k, v, slot=slot, mesh=mesh),
+                    p, spec, tokens, lengths1, k, v, slot=slot, mesh=mesh,
+                    sp_impl=self.sp_impl),
                 params, ck, cv,
             )
             # First sampled token: no generated text yet → penalties are
@@ -1968,6 +1989,7 @@ def get_engine(
     draft_spec: ModelSpec | None = None,
     draft_seed: int = 0,
     draft_ckpt: str | None = None,
+    sp_impl: str = "ring",
 ) -> InferenceEngine:
     """Engines are keyed by weight identity (spec, seed, mesh, quant,
     ensemble, members, draft model) plus the cache representation (kv_quant) —
@@ -1986,9 +2008,14 @@ def get_engine(
         raise ValueError("draft_spec and draft_ckpt are mutually exclusive")
     draft_ckpt = os.path.realpath(draft_ckpt) if draft_ckpt else None
     mesh = mesh or single_device_mesh()
+    from quorum_tpu.parallel.mesh import AXIS_SP as _SP
+
+    # sp_impl is inert without an sp axis — normalize it out of the key so
+    # equivalent configs share one engine (and one set of weights).
+    sp_key = sp_impl if dict(mesh.shape).get(_SP, 1) > 1 else None
     key = (spec, seed, quant or None, max(1, int(ensemble)),
            max(1, int(members)), kv_quant or None,
-           draft_spec, draft_seed, draft_ckpt,
+           draft_spec, draft_seed, draft_ckpt, sp_key,
            tuple(sorted(mesh.shape.items())),
            tuple(map(str, mesh.devices.flat)))
     with _ENGINES_LOCK:
@@ -2005,7 +2032,7 @@ def get_engine(
                 prefix_cache=prefix_cache, ensemble=ensemble,
                 members=members, kv_quant=kv_quant,
                 draft_spec=draft_spec, draft_seed=draft_seed,
-                draft_params=draft_params,
+                draft_params=draft_params, sp_impl=sp_impl,
             )
             _ENGINES[key] = eng
         else:
@@ -2029,6 +2056,7 @@ def get_engine_from_ckpt(
     ensemble: int = 1,
     kv_quant: str | None = None,
     draft_ckpt: str | None = None,
+    sp_impl: str = "ring",
 ) -> InferenceEngine:
     """Engine over a local HF checkpoint; keyed by (resolved path, mesh,
     draft checkpoint) so N backends pointing at one checkpoint with the
@@ -2052,8 +2080,11 @@ def get_engine_from_ckpt(
     # hit the same cache entry (else the checkpoint sits in HBM twice).
     eff_dtype = dtype or ModelSpec().dtype
     draft_resolved = os.path.realpath(draft_ckpt) if draft_ckpt else None
+    from quorum_tpu.parallel.mesh import AXIS_SP as _SP
+
+    sp_key = sp_impl if dict(mesh.shape).get(_SP, 1) > 1 else None
     key = ("ckpt", resolved, eff_dtype, quant or None, kv_quant or None,
-           draft_resolved,
+           draft_resolved, sp_key,
            tuple(sorted(mesh.shape.items())),
            tuple(map(str, mesh.devices.flat)))
     with _ENGINES_LOCK:
@@ -2074,6 +2105,7 @@ def get_engine_from_ckpt(
                 prefix_cache=prefix_cache, ensemble=ensemble,
                 kv_quant=kv_quant,
                 draft_spec=draft_spec, draft_params=draft_params,
+                sp_impl=sp_impl,
             )
             _ENGINES[key] = eng
         else:

@@ -50,6 +50,7 @@ from quorum_tpu.ops.flash_decode import (
     flash_decode_supported,
 )
 from quorum_tpu.parallel.ring_attention import ring_prefill_attention
+from quorum_tpu.parallel.ulysses import ulysses_prefill_attention
 from quorum_tpu.ops.norms import layernorm, rmsnorm
 from quorum_tpu.ops.rotary import apply_rope, rope_cos_sin
 
@@ -311,6 +312,7 @@ def prefill(
     slot: jnp.ndarray | None = None,
     mesh=None,
     write_gate: jnp.ndarray | None = None,  # scalar bool: False → cache unchanged
+    sp_impl: str = "ring",  # "ring" | "ulysses" — SP attention strategy
 ):
     """Process the full prompt; returns (last-token logits [B,V], cache_k, cache_v).
 
@@ -337,11 +339,12 @@ def prefill(
     """
     b, t = tokens.shape
     cache_row = slot if slot is not None else 0
-    if mesh is not None and spec.sliding_window > 0:
+    if mesh is not None and spec.sliding_window > 0 and sp_impl == "ring":
         raise ValueError(
             "sliding_window specs cannot use ring-attention admission "
             "(sp>1): the ring computes full causal attention and would "
-            "silently widen the receptive field")
+            "silently widen the receptive field (use sp_impl=ulysses — "
+            "each device sees the full sequence, windows apply unchanged)")
     positions = jnp.arange(t)
     x = _embed(params, spec, tokens, positions)
     cos, sin = rope_cos_sin(spec.max_seq, spec.head_dim, spec.rope_theta)
@@ -354,7 +357,12 @@ def prefill(
         if spec.pos == "rope":
             q = apply_rope(q, cos, sin, positions)
             k = apply_rope(k, cos, sin, positions)
-        if mesh is not None:
+        if mesh is not None and sp_impl == "ulysses":
+            # Sequence-parallel admission via head↔sequence all-to-alls:
+            # full-sequence local attention, so windows apply unchanged.
+            attn = ulysses_prefill_attention(
+                q, k, v, lengths, mesh, window=spec.sliding_window)
+        elif mesh is not None:
             # Sequence-parallel admission: ring attention over the sp axis.
             # (Windowed specs were rejected above — the ring is full-causal.)
             attn = ring_prefill_attention(q, k, v, lengths, mesh)
@@ -748,6 +756,7 @@ def forward_logits_sp(
     lengths: jnp.ndarray,  # [B]
     mesh,
     remat: bool = False,
+    sp_impl: str = "ring",
 ) -> jnp.ndarray:
     """Sequence-parallel full-sequence logits via ring attention.
 
@@ -761,17 +770,20 @@ def forward_logits_sp(
     W-distant hops could skip entirely — is future work).
     GQA is grouped inside the ring — the blocks riding the ICI ring stay at
     KV-head width (no repeat_kv broadcast)."""
-    if spec.sliding_window > 0:
+    if spec.sliding_window > 0 and sp_impl != "ulysses":
         raise ValueError(
             "sliding_window specs cannot use ring attention (sp>1): the "
             "ring computes full causal attention and would silently widen "
-            "the model's receptive field")
-    from quorum_tpu.parallel.ring_attention import ring_prefill_attention
+            "the model's receptive field (sp_impl=ulysses supports windows)")
+    if sp_impl == "ulysses":
+        def sp_attn(q, k, v):
+            return ulysses_prefill_attention(
+                q, k, v, lengths, mesh, window=spec.sliding_window)
+    else:
+        def sp_attn(q, k, v):
+            return ring_prefill_attention(q, k, v, lengths, mesh)
 
-    def ring_attn(q, k, v):
-        return ring_prefill_attention(q, k, v, lengths, mesh)
-
-    return _scan_layers(params, spec, tokens, ring_attn, remat, lengths=lengths)
+    return _scan_layers(params, spec, tokens, sp_attn, remat, lengths=lengths)
 
 
 def init_cache(spec: ModelSpec, batch: int, dtype=None, kv_quant: str | None = None):

@@ -113,6 +113,21 @@ def _axis_if_divisible(dim: int, axis: str, mesh: Mesh) -> str | None:
     return axis if dim % mesh.shape[axis] == 0 else None
 
 
+def gqa_axis_selection(b: int, h: int, n_kv: int, mesh: Mesh):
+    """(baxis, haxis, kaxis) for sequence-parallel attention wrappers —
+    shared by the ring and Ulysses strategies so the sharding-selection
+    rules can never diverge. Batch rides dp and heads ride tp when they
+    divide; when H would shard over tp but K would not, q's heads are
+    replicated alongside the replicated KV heads so the per-device GQA
+    grouping stays consistent."""
+    baxis = _axis_if_divisible(b, AXIS_DP, mesh)
+    haxis = _axis_if_divisible(h, AXIS_TP, mesh)
+    kaxis = _axis_if_divisible(n_kv, AXIS_TP, mesh)
+    if haxis != kaxis:
+        haxis = kaxis
+    return baxis, haxis, kaxis
+
+
 def ring_prefill_attention(
     q: jnp.ndarray,        # [B, H, S, hd] (global view)
     k: jnp.ndarray,        # [B, K, S, hd] — KV heads; grouped inside the ring
@@ -142,11 +157,7 @@ def ring_prefill_attention(
         from quorum_tpu.ops.attention import prefill_attention
 
         return prefill_attention(q, k, v, lengths)
-    baxis = _axis_if_divisible(b, AXIS_DP, mesh)
-    haxis = _axis_if_divisible(h, AXIS_TP, mesh)
-    kaxis = _axis_if_divisible(n_kv, AXIS_TP, mesh)
-    if haxis != kaxis:
-        haxis = kaxis  # replicate q heads alongside replicated KV heads
+    baxis, haxis, kaxis = gqa_axis_selection(b, h, n_kv, mesh)
     qs = P(baxis, haxis, sp, None)
     ks = P(baxis, kaxis, sp, None)
     # The online-softmax carries vary only over the axes the inputs are

@@ -1,0 +1,101 @@
+"""Ulysses sequence parallelism: all-to-all attention over the ``sp`` axis.
+
+The second standard SP strategy beside ring attention
+(parallel/ring_attention.py). Where the ring keeps the sequence sharded and
+circulates K/V blocks device-to-device (sp ppermutes per layer), Ulysses
+re-shards ONCE per attention: all-to-alls convert sequence-sharded
+activations into head-sharded ones (each device holds the FULL sequence for
+H/sp of the heads), attention runs entirely locally, and one all-to-all
+converts back — four collective launches per layer (q, k, v in; out back;
+packing q/k/v into one transfer is possible but needs a per-sp-group head
+reordering), total bytes O(B·S·(D + 2·K·hd)/sp) in two resharding phases
+rather than sp dependent ring hops.
+
+Trade-offs vs the ring (why both exist):
+
+  - Ulysses holds full-length K/V for its head slice — per-device attention
+    memory is O(S·K/sp · hd), not O(S/sp). Fine for prefill at serving
+    context lengths; the ring remains the answer when even one head's
+    full-length K/V cannot fit.
+  - Ulysses needs the HEAD counts divisible by sp (H/tp-shard and K must
+    split over sp); GQA models with few KV heads cap sp at K. The ring has
+    no head constraint.
+  - Because each device sees the whole sequence, windowed (mistral) specs
+    work unchanged — the ring rejects them (it would widen the receptive
+    field).
+
+The reference proxy has no sequence handling at all
+(/root/reference/src/quorum/oai_proxy.py:185-192); north-star
+functionality, not behavioral parity.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+try:
+    from jax import shard_map  # jax ≥ 0.8
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map
+
+from quorum_tpu.ops.attention import prefill_attention
+from quorum_tpu.parallel.mesh import AXIS_DP, AXIS_SP, AXIS_TP
+from quorum_tpu.parallel.ring_attention import gqa_axis_selection
+
+
+def _ulysses_local(q, k, v, lengths, *, axis: str, window: int):
+    """Per-device body: seq-sharded in → all-to-all → full-seq attention on
+    a head slice → all-to-all back to seq-sharded out."""
+    # [B, h_loc, s_loc, hd] → [B, h_loc/sp, S, hd]: split heads, gather seq.
+    qh = lax.all_to_all(q, axis, split_axis=1, concat_axis=2, tiled=True)
+    kh = lax.all_to_all(k, axis, split_axis=1, concat_axis=2, tiled=True)
+    vh = lax.all_to_all(v, axis, split_axis=1, concat_axis=2, tiled=True)
+    out = prefill_attention(qh, kh, vh, lengths, window=window)
+    # [B, h_loc/sp, S, hd] → [B, h_loc, s_loc, hd]: split seq, gather heads.
+    return lax.all_to_all(out, axis, split_axis=2, concat_axis=1, tiled=True)
+
+
+def ulysses_supported(h: int, n_kv: int, mesh: Mesh, sp: str = AXIS_SP) -> bool:
+    """Statically checkable Ulysses requirement: the per-device head counts
+    must split over sp. The engine uses this to FAIL FAST at construction —
+    a silent dense fallback at serving time would materialize full
+    replicated attention at exactly the context lengths sp exists for.
+    (Sequence-length divisibility stays a per-request dynamic fallback.)"""
+    _, haxis, kaxis = gqa_axis_selection(1, h, n_kv, mesh)
+    tp_div = mesh.shape[AXIS_TP] if haxis else 1
+    sp_size = mesh.shape[sp]
+    return (h // tp_div) % sp_size == 0 and (n_kv // tp_div) % sp_size == 0
+
+
+def ulysses_prefill_attention(
+    q: jnp.ndarray,        # [B, H, S, hd] (global view)
+    k: jnp.ndarray,        # [B, K, S, hd]
+    v: jnp.ndarray,
+    lengths: jnp.ndarray,  # [B]
+    mesh: Mesh,
+    *,
+    sp: str = AXIS_SP,
+    window: int = 0,
+) -> jnp.ndarray:
+    """Causal, length-masked GQA attention, sequence sharded over ``sp``
+    via head↔sequence all-to-alls. Falls back to the dense replicated path
+    when the shapes don't divide (short admission buckets, few heads)."""
+    sp_size = mesh.shape[sp]
+    b, h, s, _ = q.shape
+    n_kv = k.shape[1]
+    baxis, haxis, kaxis = gqa_axis_selection(b, h, n_kv, mesh)
+    if (sp_size == 1 or s % sp_size != 0
+            or not ulysses_supported(h, n_kv, mesh, sp)):
+        return prefill_attention(q, k, v, lengths, window=window)
+    qs = P(baxis, haxis, sp, None)
+    ks = P(baxis, kaxis, sp, None)
+    fn = shard_map(
+        partial(_ulysses_local, axis=sp, window=window),
+        mesh=mesh,
+        in_specs=(qs, ks, ks, P(baxis)),
+        out_specs=qs,
+    )
+    return fn(q, k, v, lengths)

@@ -138,6 +138,24 @@ def create_app(
 
     started = time.monotonic()
 
+    @app.route("GET", "/models", "/v1/models")
+    async def models(request: Request) -> Response:
+        """OpenAI model-discovery surface: one entry per distinct configured
+        model id (SDKs and UIs probe this before chatting). The reference
+        exposes no discovery endpoint — clients had to know the model name
+        out of band; a local serving framework can simply list what it
+        loaded. ``owned_by`` carries the backend name(s) serving the id."""
+        owners: dict[str, list[str]] = {}
+        for backend in reg.backends:
+            mid = getattr(backend, "model", "") or getattr(
+                backend, "model_id", "")
+            if mid:
+                owners.setdefault(mid, []).append(backend.name)
+        data = [{"id": mid, "object": "model", "created": 0,
+                 "owned_by": ",".join(names)}
+                for mid, names in sorted(owners.items())]
+        return JSONResponse({"object": "list", "data": data})
+
     @app.route("GET", "/metrics", "/v1/metrics")
     async def metrics(request: Request) -> Response:
         """Prometheus text exposition of engine/scheduler state — the

@@ -14,3 +14,32 @@ async def test_health_v1_alias():
     async with make_client({"primary_backends": [], "settings": {}}) as client:
         r = await client.get("/v1/health")
         assert r.status_code == 200
+
+
+async def test_models_lists_configured_ids():
+    """GET /models and /v1/models: OpenAI discovery — one entry per distinct
+    configured model id, owned_by naming the serving backend(s)."""
+    import httpx
+
+    from quorum_tpu.config import Config
+    from quorum_tpu.server.app import create_app
+
+    raw = {
+        "settings": {"timeout": 10},
+        "primary_backends": [
+            {"name": "A", "url": "http://one.test/v1", "model": "gpt-4o-mini"},
+            {"name": "B", "url": "http://two.test/v1", "model": "gpt-4o-mini"},
+            {"name": "T", "url": "tpu://gpt2-tiny?max_seq=64", "model": ""},
+        ],
+    }
+    app = create_app(Config(raw=raw))
+    async with httpx.AsyncClient(
+        transport=httpx.ASGITransport(app=app), base_url="http://t"
+    ) as client:
+        for path in ("/models", "/v1/models"):
+            body = (await client.get(path)).json()
+            assert body["object"] == "list"
+            ids = {m["id"]: m for m in body["data"]}
+            assert ids["gpt-4o-mini"]["owned_by"] == "A,B"
+            assert "gpt2-tiny" in ids
+            assert all(m["object"] == "model" for m in body["data"])
