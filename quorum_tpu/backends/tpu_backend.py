@@ -29,16 +29,19 @@ URL grammar:  ``tpu://<model-id>?<spec overrides>&<engine options>``
   queue=           admission queue bound (default 128); a full queue rejects
                    with 503 instead of growing without limit
   spec_decode=G    speculative decoding (default 0 = off): when every active
-                   request is greedy with no penalties/bias/logprobs, each
-                   dispatch verifies G draft tokens in one multi-token
-                   forward — accepted runs advance G+1 tokens for one
-                   dispatch's weight reads (decode is HBM-bound)
+                   request is free of penalties/bias/logprobs (greedy OR
+                   sampled — verification samples each position with the
+                   row's own RNG chain, so tokens match the plain path bit
+                   for bit), each dispatch verifies G draft tokens in one
+                   multi-token forward — accepted runs advance G+1 tokens
+                   for one dispatch's weight reads (decode is HBM-bound)
   spec_model=<id>  draft-MODEL speculation: the named preset (random init,
                    seeded by spec_seed=, target's vocab/window) proposes
                    the G-token drafts instead of prompt lookup; its own
                    slot KV cache tracks each request. Speed-only knob —
-                   acceptance still requires equality with the target's
-                   greedy token. Implies spec_decode=4 when unset;
+                   acceptance still requires equality with the token the
+                   target itself emits (sampled with the request's RNG
+                   chain; greedy = argmax). Implies spec_decode=4 when unset;
                    random-init engines only (rejected with ckpt=)
   spec_ckpt=<dir>  draft-MODEL speculation from a REAL small checkpoint
                    (same tokenizer/vocab as the target; window raised to

@@ -185,10 +185,15 @@ class _Request:
         }
 
     @property
-    def greedy_clean(self) -> bool:
-        """Eligible for speculative verification: greedy, no sampling state
-        that depends on the accepted prefix (penalties/bias), no logprobs."""
-        return (self.temperature <= 0.0 and self.pp == 0.0 and self.fp == 0.0
+    def spec_clean(self) -> bool:
+        """Eligible for speculative verification: no sampling state that
+        depends on the accepted prefix (penalties/bias), no logprobs.
+        SAMPLED requests qualify too — verification samples every position
+        with the row's own RNG chain (one key split per emitted token,
+        exactly the decode path's discipline), so the emitted tokens equal
+        the non-speculative path's bit for bit; a draft token is accepted
+        iff it equals the token the model itself SAMPLES there."""
+        return (self.pp == 0.0 and self.fp == 0.0
                 and self.bias_row is None and self.want_lp < 0)
 
 
@@ -216,9 +221,10 @@ class _DraftRuntime:
     dispatches buy model-quality guesses, so acceptance (and therefore
     tokens per target dispatch) is high wherever the draft model predicts
     the target well. Correctness NEVER depends on the draft: verification
-    accepts a token iff it equals the target model's own greedy token
-    (``InferenceEngine._verify_fn``), so any draft state — stale, random,
-    or mid-resync — affects only speed. All calls happen on the engine's
+    accepts a token iff it equals the token the target model itself emits
+    there — sampled with the request's own RNG chain, argmax for greedy
+    rows (``InferenceEngine._verify_fn``) — so any draft state — stale,
+    random, or mid-resync — affects only speed. All calls happen on the engine's
     scheduler thread (no locking).
 
     State: the draft model's own slot KV cache plus, per target slot, how
@@ -436,7 +442,7 @@ class InferenceEngine:
         # the aggregate capacity M separate engines would have had.
         self.max_pending = max(1, max_pending) * max(1, int(members))
         # Speculative decoding draft length (0 = off): when every active
-        # request is greedy_clean, each dispatch verifies spec_decode
+        # request is spec_clean, each dispatch verifies spec_decode
         # prompt-lookup draft tokens in one multi-token forward.
         self.spec_decode = max(0, min(spec_decode, 16))
         # Chunked prefill needs segment offsets that never cross max_seq
@@ -590,7 +596,7 @@ class InferenceEngine:
         self.n_spec_accepted = 0   # draft tokens accepted across them
         # Draft-MODEL speculative decoding (spec_model=…): a second, small
         # model proposes each verify turn's draft instead of prompt lookup.
-        # Greedy-only like all speculation (greedy_clean gating); excluded
+        # Subject to spec_clean gating like all speculation; excluded
         # for stacked/ensemble engines — the draft runtime is not
         # member-vmapped.
         if draft_spec is not None:
@@ -976,19 +982,21 @@ class InferenceEngine:
         return fn
 
     def _verify_fn(self, g: int, history: int):
-        """Jitted speculative-verification step: position 0 samples the next
-        token exactly as the normal decode path would; positions 1..g score
-        the drafted continuation, and the longest draft prefix matching the
-        greedy chain is accepted — 1 + n_accept tokens emitted for ONE
+        """Jitted speculative-verification step: every position 0..g is
+        SAMPLED with the row's own RNG chain exactly as the normal decode
+        path would sample it (one key split per position; greedy rows
+        reduce to argmax), and the longest draft prefix matching that
+        sampled chain is accepted — 1 + n_accept tokens emitted for ONE
         dispatch's worth of weight reads (decode is bandwidth-bound, so the
         g extra positions are nearly free).
 
         Acceptance is sound regardless of where drafts come from: draft i
-        is accepted only if it EQUALS the token the model itself emits at
-        that position, so the output sequence is the model's own greedy
-        continuation. (The multi-token forward may reassociate float ops
-        differently from the single-token program; an exact-tie argmax flip
-        is the same caveat as any program-shape change.)"""
+        is accepted only if it EQUALS the token the model itself samples at
+        that position, so the output sequence — and the carried RNG state —
+        is identical to the non-speculative path's. (The multi-token
+        forward may reassociate float ops differently from the single-token
+        program; a near-tie flip under a sampling threshold is the same
+        caveat as any program-shape change.)"""
         fn = self._decode_cache.get(("verify", g, history))
         if fn is not None:
             return fn
@@ -1020,16 +1028,26 @@ class InferenceEngine:
                         history=history),
                     params, ck, cv,
                 )  # [S, g+1, V]
-            split = jax.vmap(jax.random.split)(keys_s)
-            s0 = sample_token_rows(
-                logits[:, 0].astype(jnp.float32), split[:, 1],
-                temp_s, topp_s, topk_s,
-            )
-            s0 = jnp.where(live, s0, tokens[:, 0])
-            greedy = jnp.argmax(logits[:, 1:], axis=-1).astype(jnp.int32)  # [S,g]
+            # The model's own token chain over positions 0..g, SAMPLED with
+            # each row's key stream — one split per position, exactly the
+            # decode path's per-token discipline, so emitted tokens (and the
+            # carried key after `emitted` splits) match the non-speculative
+            # path bit for bit. Greedy rows reduce to argmax (key-free).
+            def samp_step(keys, logit_i):
+                split = jax.vmap(jax.random.split)(keys)       # [S, 2, 2]
+                tok_i = sample_token_rows(
+                    logit_i.astype(jnp.float32), split[:, 1],
+                    temp_s, topp_s, topk_s)
+                return split[:, 0], (tok_i, split[:, 0])
+
+            _, (sampled, key_chain) = lax.scan(
+                samp_step, keys_s, jnp.moveaxis(logits, 1, 0))  # over g+1
+            sampled = jnp.swapaxes(sampled, 0, 1)               # [S, g+1]
+            s0 = jnp.where(live, sampled[:, 0], tokens[:, 0])
+            model_rest = sampled[:, 1:]                          # [S, g]
             # chain: draft i (tokens[:, i]) must equal the model's token at
-            # that position (s0 for i=1, greedy[i-2] for i>=2)
-            prev = jnp.concatenate([s0[:, None], greedy[:, :-1]], axis=1)
+            # that position (s0 for i=1, model_rest[i-2] for i>=2)
+            prev = jnp.concatenate([s0[:, None], model_rest[:, :-1]], axis=1)
             ok = jnp.cumprod(
                 (tokens[:, 1:] == prev).astype(jnp.int32), axis=1)  # [S,g]
             ok = ok * live[:, None].astype(jnp.int32)
@@ -1038,19 +1056,25 @@ class InferenceEngine:
             last = jnp.where(
                 n_extra > 0,
                 jnp.take_along_axis(
-                    greedy, jnp.maximum(n_extra - 1, 0)[:, None], axis=1)[:, 0],
+                    model_rest, jnp.maximum(n_extra - 1, 0)[:, None],
+                    axis=1)[:, 0],
                 s0,
             )
             rows = jnp.arange(n_slots)
             counts_s = counts_s.at[rows, s0].add(live.astype(jnp.int32))
             for i in range(g):
-                counts_s = counts_s.at[rows, greedy[:, i]].add(ok[:, i])
+                counts_s = counts_s.at[rows, model_rest[:, i]].add(ok[:, i])
+            # Key after `emitted` splits per row (dead rows keep theirs).
+            key_sel = jnp.take_along_axis(
+                jnp.moveaxis(key_chain, 0, 1),                   # [S,g+1,2]
+                (emitted - 1)[:, None, None], axis=1)[:, 0]
+            new_keys = jnp.where(live[:, None], key_sel, keys_s)
             return (
-                s0, greedy, ok,
+                s0, model_rest, ok,
                 ck, cv,
                 jnp.where(live, last, token_s),
                 lengths_s + emitted * live.astype(lengths_s.dtype),
-                split[:, 0],
+                new_keys,
                 counts_s,
             )
 
@@ -1731,7 +1755,7 @@ class InferenceEngine:
         max_len = max(len(r.prompt_ids) + r.emitted for _, r in active)
         g = self.spec_decode
         if (g > 0
-                and all(r.greedy_clean for _, r in active)
+                and all(r.spec_clean for _, r in active)
                 and max_len + g + 1 <= self.spec.max_seq):
             if self._draft_rt is not None:
                 drafts = self._draft_rt.draft_all(active, g)
@@ -1848,7 +1872,8 @@ class InferenceEngine:
         return cont + [cont[-1]] * (g - len(cont))
 
     def _run_verify_step(self, active, g: int, max_len: int, drafts) -> None:
-        """One speculative dispatch: verify each row's prompt-lookup draft."""
+        """One speculative dispatch: verify each row's draft against the
+        model's own sampled chain (greedy rows: argmax)."""
         history = prefill_bucket(max_len + g + 1, self.spec.max_seq)
         mask = np.zeros((self._rows,), np.int32)
         tokens = np.zeros((self._rows, g + 1), np.int32)
@@ -1860,20 +1885,20 @@ class InferenceEngine:
                 tokens[i, 1:] = draft
             else:
                 tokens[i, 1:] = -1  # never matches → accepts only s0
-        (s0, greedy, ok, self._ck, self._cv, self._token, self._lengths,
+        (s0, model_toks, ok, self._ck, self._cv, self._token, self._lengths,
          self._keys, self._counts) = self._verify_fn(g, history)(
             self.params, mask, tokens, self._ck, self._cv, self._token,
             self._lengths, self._keys, self._temp, self._topp, self._topk,
             self._counts,
         )
-        s0, greedy, ok = jax.device_get((s0, greedy, ok))
+        s0, model_toks, ok = jax.device_get((s0, model_toks, ok))
         self.n_spec_turns += 1
         for i, req in active:
             toks = [int(s0[i])]
             for j in range(g):
                 if not ok[i, j]:
                     break
-                toks.append(int(greedy[i, j]))
+                toks.append(int(model_toks[i, j]))
             self.n_spec_accepted += len(toks) - 1
             finished = False
             for t in toks:
