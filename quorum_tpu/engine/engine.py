@@ -1033,15 +1033,20 @@ class InferenceEngine:
             # decode path's per-token discipline, so emitted tokens (and the
             # carried key after `emitted` splits) match the non-speculative
             # path bit for bit. Greedy rows reduce to argmax (key-free).
-            def samp_step(keys, logit_i):
+            # Keys first (a trivial scan over splits), then all g+1
+            # positions sample in PARALLEL — each position's sample depends
+            # only on its key, and serializing g+1 top-p sorts would add
+            # latency comparable to the forward itself.
+            def key_step(keys, _):
                 split = jax.vmap(jax.random.split)(keys)       # [S, 2, 2]
-                tok_i = sample_token_rows(
-                    logit_i.astype(jnp.float32), split[:, 1],
-                    temp_s, topp_s, topk_s)
-                return split[:, 0], (tok_i, split[:, 0])
+                return split[:, 0], (split[:, 0], split[:, 1])
 
-            _, (sampled, key_chain) = lax.scan(
-                samp_step, keys_s, jnp.moveaxis(logits, 1, 0))  # over g+1
+            _, (key_chain, samp_keys) = lax.scan(
+                key_step, keys_s, None, length=g + 1)
+            sampled = jax.vmap(
+                lambda lg, kk: sample_token_rows(
+                    lg.astype(jnp.float32), kk, temp_s, topp_s, topk_s)
+            )(jnp.moveaxis(logits, 1, 0), samp_keys)            # [g+1, S]
             sampled = jnp.swapaxes(sampled, 0, 1)               # [S, g+1]
             s0 = jnp.where(live, sampled[:, 0], tokens[:, 0])
             model_rest = sampled[:, 1:]                          # [S, g]

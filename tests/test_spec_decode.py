@@ -1,9 +1,10 @@
 """Speculative decoding (prompt-lookup drafts + multi-token verification).
 
 The invariant that makes speculation safe: a draft token is accepted ONLY
-when it equals the token the model itself emits at that position, so the
-output is the model's own greedy continuation — speculation changes speed,
-never content. These tests pin output equality against the non-speculative
+when it equals the token the model itself emits at that position — sampled
+with the request's own RNG chain (greedy = argmax) — so the output is
+bit-identical to the non-speculative path's, at any temperature;
+speculation changes speed, never content. These tests pin output equality against the non-speculative
 engine, eligibility gating, and the repetitive-text acceptance win.
 """
 
@@ -113,9 +114,11 @@ def test_verification_accepts_correct_drafts():
         f"g=4), got {calls['n']}")
 
 
-def test_sampling_requests_bypass_speculation():
-    """Non-greedy (or penalty/bias/logprobs) requests must take the normal
-    chunked path and produce the same tokens as a spec_decode=0 engine."""
+def test_sampling_requests_match_plain_engine():
+    """Sampled requests SPECULATE too (round-3 extension) — and must still
+    produce exactly a spec_decode=0 engine's tokens. (Requests with
+    penalties/bias/logprobs are the ones that bypass to the chunked path —
+    pinned by the eligibility test below.)"""
     plain = InferenceEngine(TINY, decode_chunk=4, n_slots=2)
     spec = InferenceEngine(TINY, decode_chunk=4, n_slots=2, spec_decode=4)
     sampler = SamplerConfig(temperature=0.8, top_p=0.9)
