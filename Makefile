@@ -17,6 +17,8 @@ test:
 	python -m pytest tests/ -x -q
 
 coverage:
+	@python -c "import pytest_cov" 2>/dev/null \
+	  || (echo "pytest-cov is not installed (pip install pytest-cov)"; exit 1)
 	python -m pytest tests/ --cov=quorum_tpu --cov-report=term-missing
 
 bench:
