@@ -1293,6 +1293,10 @@ class InferenceEngine:
             return
         self.params = None
         self._ck = self._cv = None
+        if self._draft_rt is not None:  # draft weights + cache go with them
+            self._draft_rt.params = None
+            self._draft_rt._ck = self._draft_rt._cv = None
+            self._draft_rt = None
 
     def _scheduler(self) -> None:
         while True:
