@@ -239,3 +239,23 @@ def test_sampled_draft_model_composition():
     eng.shutdown()
     assert got == ref
     assert m["spec_turns_total"] > 0, "speculation never engaged for sampling"
+
+
+def test_penalty_requests_bypass_speculation():
+    """Requests with penalties/bias/logprobs are NOT spec_clean: the verify
+    program omits those logit adjustments, so such requests must take the
+    chunked path — pinned by exact equality with a spec_decode=0 engine
+    (the verify path, which samples unadjusted logits, would diverge)."""
+    plain = InferenceEngine(TINY, decode_chunk=4, n_slots=2)
+    spec = InferenceEngine(TINY, decode_chunk=4, n_slots=2, spec_decode=4)
+    sampler = SamplerConfig(temperature=0.8, top_p=0.9)
+    def run(eng):
+        req = eng.submit([5, 6, 7, 5, 6, 7], max_new_tokens=12,
+                         sampler=sampler, seed=3, frequency_penalty=1.5)
+        return list(eng.stream_results(req))
+
+    a = run(plain)
+    b = run(spec)
+    plain.shutdown()
+    spec.shutdown()
+    assert a == b
