@@ -145,6 +145,7 @@ async def test_nonstream_fixture(name):
 
 @pytest.mark.parametrize("name,config", [
     ("stream_single.json", single_backend_config()),
+    ("stream_include_usage.json", single_backend_config()),
     ("stream_parallel_concatenate.json", parallel_config()),
 ])
 async def test_stream_fixture(name, config):
