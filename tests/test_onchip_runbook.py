@@ -1,0 +1,47 @@
+"""Crash-safety helpers of the on-chip runbook (scripts/onchip_session.py).
+
+The runbook exists because the TPU tunnel dies mid-session; its banking
+must therefore survive exactly that: partial writes, corrupt files from a
+mid-write kill, and children whose stdout ends mid-line.
+"""
+
+import importlib.util
+import json
+import os
+
+
+def _load():
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "scripts", "onchip_session.py")
+    spec = importlib.util.spec_from_file_location("onchip_session", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_bank_merges_and_survives_corruption(tmp_path, monkeypatch):
+    mod = _load()
+    out = tmp_path / "ONCHIP.json"
+    monkeypatch.setattr(mod, "OUT", str(out))
+
+    mod.bank({"a": 1})
+    mod.bank({"b": 2.5})
+    assert json.loads(out.read_text()) == {"a": 1, "b": 2.5}
+
+    # A mid-write kill leaves a truncated file; the next bank must recover
+    # (start fresh) instead of crashing every later session.
+    out.write_text('{"a": 1, "b"')
+    mod.bank({"c": 3})
+    assert json.loads(out.read_text()) == {"c": 3}
+    # no stray temp file left behind
+    assert not (tmp_path / "ONCHIP.json.tmp").exists()
+
+
+def test_last_json_salvages_checkpoint_line():
+    mod = _load()
+    # A timed-out child's stdout can end mid-line; the intact checkpoint
+    # line above it must be salvaged.
+    stdout = 'noise\n{"good": 1}\n{"partial": '
+    assert mod._last_json(stdout) == {"good": 1}
+    assert mod._last_json("") == {}
+    assert mod._last_json(None) == {}
