@@ -3,13 +3,12 @@
 The second standard SP strategy beside ring attention
 (parallel/ring_attention.py). Where the ring keeps the sequence sharded and
 circulates K/V blocks device-to-device (sp ppermutes per layer), Ulysses
-re-shards ONCE per attention: all-to-alls convert sequence-sharded
-activations into head-sharded ones (each device holds the FULL sequence for
-H/sp of the heads), attention runs entirely locally, and one all-to-all
-converts back — four collective launches per layer (q, k, v in; out back;
-packing q/k/v into one transfer is possible but needs a per-sp-group head
-reordering), total bytes O(B·S·(D + 2·K·hd)/sp) in two resharding phases
-rather than sp dependent ring hops.
+re-shards ONCE per attention: a single packed all-to-all (q/k/v
+interleaved per sp-group along the head axis) converts sequence-sharded
+activations into head-sharded ones — each device holds the FULL sequence
+for its H/sp head slice — attention runs entirely locally, and one
+all-to-all converts back. TWO collective launches per layer, total bytes
+O(B·S·(D + 2·K·hd)/sp), rather than sp dependent ring hops.
 
 Trade-offs vs the ring (why both exist):
 
@@ -46,15 +45,34 @@ from quorum_tpu.parallel.mesh import AXIS_DP, AXIS_SP, AXIS_TP
 from quorum_tpu.parallel.ring_attention import gqa_axis_selection
 
 
-def _ulysses_local(q, k, v, lengths, *, axis: str, window: int):
-    """Per-device body: seq-sharded in → all-to-all → full-seq attention on
-    a head slice → all-to-all back to seq-sharded out."""
-    # [B, h_loc, s_loc, hd] → [B, h_loc/sp, S, hd]: split heads, gather seq.
-    qh = lax.all_to_all(q, axis, split_axis=1, concat_axis=2, tiled=True)
-    kh = lax.all_to_all(k, axis, split_axis=1, concat_axis=2, tiled=True)
-    vh = lax.all_to_all(v, axis, split_axis=1, concat_axis=2, tiled=True)
+def _ulysses_local(q, k, v, lengths, *, axis: str, sp_size: int, window: int):
+    """Per-device body: seq-sharded in → ONE packed all-to-all → full-seq
+    attention on a head slice → one all-to-all back to seq-sharded out.
+
+    q/k/v share one inbound transfer: ``all_to_all(split_axis=1)`` hands
+    destination device d the d-th sp-slice of the packed head axis, so the
+    packing interleaves PER-GROUP — group d carries (q-heads d·hq/sp…,
+    k-heads d·hk/sp…, v-heads …) contiguously and every split boundary
+    stays pure. The head-divisibility preconditions are enforced by
+    ``ulysses_supported`` before shard_map dispatches here."""
+    b, hq, s_loc, hd = q.shape
+    hk = k.shape[1]
+    gq, gk = hq // sp_size, hk // sp_size
+
+    def grouped(x, g):
+        # [B, sp·g, s, hd] → [B, sp, g, s, hd]
+        return x.reshape(b, sp_size, g, s_loc, hd)
+
+    packed = jnp.concatenate(
+        [grouped(q, gq), grouped(k, gk), grouped(v, gk)], axis=2
+    ).reshape(b, hq + 2 * hk, s_loc, hd)
+    ph = lax.all_to_all(packed, axis, split_axis=1, concat_axis=2, tiled=True)
+    # ph [B, gq+2·gk, S, hd]: this device's q/k/v head slices, full sequence.
+    qh = ph[:, :gq]
+    kh = ph[:, gq:gq + gk]
+    vh = ph[:, gq + gk:]
     out = prefill_attention(qh, kh, vh, lengths, window=window)
-    # [B, h_loc/sp, S, hd] → [B, h_loc, s_loc, hd]: split seq, gather heads.
+    # [B, hq/sp, S, hd] → [B, hq, s_loc, hd]: split seq, gather heads.
     return lax.all_to_all(out, axis, split_axis=2, concat_axis=1, tiled=True)
 
 
@@ -93,7 +111,7 @@ def ulysses_prefill_attention(
     qs = P(baxis, haxis, sp, None)
     ks = P(baxis, kaxis, sp, None)
     fn = shard_map(
-        partial(_ulysses_local, axis=sp, window=window),
+        partial(_ulysses_local, axis=sp, sp_size=sp_size, window=window),
         mesh=mesh,
         in_specs=(qs, ks, ks, P(baxis)),
         out_specs=qs,
