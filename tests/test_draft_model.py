@@ -223,3 +223,22 @@ def test_draft_source_knob_validation(tmp_path):
             name="X",
             url=f"tpu://llama-tiny?spec_ckpt={tmp_path}/typo",
             model="m"))
+
+
+def test_draft_over_int8_target_is_exact():
+    """quant=int8 target + draft model: the draft (bf16 init) is no longer
+    a perfect oracle for the quantized target, so acceptance drops — but
+    content must still equal the draft-less int8 engine token for token
+    (speed-only, like every draft configuration)."""
+    spec = resolve_spec("llama-tiny", SPEC)
+    plain = InferenceEngine(spec, decode_chunk=4, n_slots=2, quant="int8")
+    ref = _serve(plain, n=12)
+    plain.shutdown()
+
+    drafted = InferenceEngine(spec, decode_chunk=4, n_slots=2, quant="int8",
+                              spec_decode=4, draft_spec=spec, draft_seed=0)
+    got = _serve(drafted, n=12)
+    m = drafted.metrics()
+    drafted.shutdown()
+    assert got == ref
+    assert m["spec_turns_total"] > 0
