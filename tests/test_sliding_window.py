@@ -166,3 +166,24 @@ def test_sp_mesh_rejects_windowed_spec():
     mesh = make_mesh(MeshConfig(sp=2))
     with pytest.raises(ValueError, match="sliding_window"):
         InferenceEngine(spec, mesh)
+
+
+def test_stacked_members_respect_window():
+    """members=M stacks windowed engines member-vmapped; each member's
+    stream must equal its own per-seed single engine."""
+    spec = resolve_spec("llama-tiny", WSPEC)
+    prompt = [(i % 79) + 3 for i in range(40)]
+    stacked = InferenceEngine(spec, members=2, decode_chunk=4, n_slots=2)
+    singles = [InferenceEngine(spec, seed=i, decode_chunk=4, n_slots=2)
+               for i in range(2)]
+    try:
+        for m in range(2):
+            a = stacked.generate(prompt, max_new_tokens=8, sampler=GREEDY,
+                                 seed=9, member=m).token_ids
+            b = singles[m].generate(prompt, max_new_tokens=8, sampler=GREEDY,
+                                    seed=9).token_ids
+            assert a == b, f"member {m} diverged under the window"
+    finally:
+        stacked.shutdown()
+        for s in singles:
+            s.shutdown()
