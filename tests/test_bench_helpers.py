@@ -1,0 +1,57 @@
+"""Driver-critical pure helpers of bench.py's probe-gated orchestration.
+
+The driver records whatever bench.py prints; these helpers decide what
+survives a wedged-tunnel run, so they get direct pins: JSON-line salvage
+from truncated child output, and the probe's rejection of a CPU-fallback
+jax (which would otherwise record CPU numbers as the TPU headline).
+"""
+
+import importlib.util
+import os
+import sys
+
+
+def _load_bench():
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    # bench respects JAX_PLATFORMS=cpu at import (the conftest env).
+    spec = importlib.util.spec_from_file_location(
+        "bench_under_test", os.path.join(repo, "bench.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_last_json_line_salvage():
+    bench = _load_bench()
+    stdout = 'log noise\n{"checkpoint": 1}\n{"trunca'
+    assert bench._last_json_line(stdout) == {"checkpoint": 1}
+    assert bench._last_json_line("no json at all") is None
+    assert bench._last_json_line(None) is None
+    # latest intact line wins
+    assert bench._last_json_line('{"a":1}\n{"b":2}') == {"b": 2}
+
+
+def test_probe_rejects_cpu_fallback(monkeypatch):
+    bench = _load_bench()
+
+    class FakeProc:
+        def __init__(self, stdout, rc=0):
+            self.stdout = stdout
+            self.returncode = rc
+
+    outcomes = {
+        "PROBE_OK tpu": True,
+        "warning noise\nPROBE_OK axon": True,
+        "PROBE_OK cpu": False,   # fast tunnel failure → cpu fallback
+        "": False,
+    }
+    import subprocess as sp
+    for stdout, want in outcomes.items():
+        monkeypatch.setattr(sp, "run", lambda *a, _s=stdout, **k: FakeProc(_s))
+        assert bench._probe_device(budget=1) is want, stdout
+
+    def timeout_run(*a, **k):
+        raise sp.TimeoutExpired(cmd="probe", timeout=1)
+
+    monkeypatch.setattr(sp, "run", timeout_run)
+    assert bench._probe_device(budget=1) is False
