@@ -42,7 +42,11 @@ def test_probe_rejects_cpu_fallback(monkeypatch):
     outcomes = {
         "PROBE_OK tpu": True,
         "warning noise\nPROBE_OK axon": True,
+        # Teardown noise AFTER the marker must not read as a dead tunnel
+        # (ADVICE r3: the old check required the marker on the LAST line).
+        "PROBE_OK tpu\nruntime shutdown notice": True,
         "PROBE_OK cpu": False,   # fast tunnel failure → cpu fallback
+        "PROBE_OK cpu\nnoise": False,
         "": False,
     }
     import subprocess as sp
@@ -55,3 +59,43 @@ def test_probe_rejects_cpu_fallback(monkeypatch):
 
     monkeypatch.setattr(sp, "run", timeout_run)
     assert bench._probe_device(budget=1) is False
+
+
+def test_probe_until_retries_across_window(monkeypatch):
+    """r03 regression: one failed probe must not end the retry horizon —
+    _probe_until keeps asking (with backoff) until success or deadline."""
+    import time as _time
+
+    bench = _load_bench()
+    calls = {"n": 0}
+
+    def flaky_probe(budget=120):
+        calls["n"] += 1
+        return calls["n"] >= 3  # dead twice, then the tunnel recovers
+
+    slept = []
+    monkeypatch.setattr(bench, "_probe_device", flaky_probe)
+    monkeypatch.setattr(bench.time, "sleep", lambda s: slept.append(s))
+    assert bench._probe_until(_time.time() + 3600) is True
+    assert calls["n"] == 3
+    assert len(slept) == 2 and slept[1] > slept[0]  # backoff grows
+
+    # Past-deadline: gives up after the first failed probe, returns False.
+    calls["n"] = -10**9
+    assert bench._probe_until(_time.time() - 1) is False
+
+
+def test_watchdog_budget_derived_and_overridable(monkeypatch):
+    """ADVICE r3: the watchdog budget must exceed the phase-budget sum (a
+    slow-but-healthy run must not be shot by its own watchdog); an env
+    override still wins, and a malformed one falls back to derived."""
+    bench = _load_bench()
+    monkeypatch.delenv("QUORUM_TPU_BENCH_WATCHDOG", raising=False)
+    phase_sum = bench._PHASE12_BUDGET + sum(
+        b for _, _, gate, b, _ in bench._7B_PHASES if gate != "0")
+    assert bench._derived_watchdog_budget() >= phase_sum + 600
+
+    monkeypatch.setenv("QUORUM_TPU_BENCH_WATCHDOG", "123")
+    assert bench._derived_watchdog_budget() == 123
+    monkeypatch.setenv("QUORUM_TPU_BENCH_WATCHDOG", "not-a-number")
+    assert bench._derived_watchdog_budget() >= phase_sum + 600
