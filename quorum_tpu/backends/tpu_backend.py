@@ -335,11 +335,15 @@ class TpuBackend:
             spec = resolve_spec(model_id, opts)
             if spec_model:
                 # The draft runs the TARGET's vocab and window: drafted ids
-                # must be comparable (and embeddable) in the target, and the
-                # draft cache must reach every target position.
+                # must be comparable (and embeddable) in the target, the
+                # draft cache must reach every target position, and the
+                # draft's attention span must match the target's sliding
+                # window (ADVICE r3: a preset window on the draft diverged
+                # from the documented contract and lowered acceptance).
                 eng_kw["draft_spec"] = resolve_spec(spec_model, {
                     "max_seq": str(spec.max_seq),
                     "vocab_size": str(spec.vocab_size),
+                    "sliding_window": str(spec.sliding_window),
                 })
                 eng_kw["draft_seed"] = int(opts.get("spec_seed", 0))
             engine = get_engine(

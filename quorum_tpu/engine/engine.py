@@ -1908,12 +1908,17 @@ class InferenceEngine:
                 if not ok[i, j]:
                     break
                 toks.append(int(model_toks[i, j]))
-            self.n_spec_accepted += len(toks) - 1
             finished = False
+            emitted_before = req.emitted
             for t in toks:
                 if self._emit(req, t):
                     finished = True
                     break
+            # Count accepted drafts by what actually reached the stream:
+            # req.emitted only advances for delivered tokens, so drafts past
+            # an EOS/budget/cancel finish never inflate the metric. The
+            # chain's first token (s0) is the model's own step, not a draft.
+            self.n_spec_accepted += max(0, req.emitted - emitted_before - 1)
             if finished:
                 with self._cond:
                     self._release_slot(i, req)

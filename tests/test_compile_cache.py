@@ -68,3 +68,34 @@ def test_cpu_host_defaults_off(tmp_path):
 def test_disable_knob_wins(tmp_path):
     got = _run_child("0")
     assert not got["cache_dir"]
+
+
+def test_tpu_host_detection(monkeypatch):
+    """ADVICE r3: a stock TPU VM (libtpu installed, neither env var set)
+    must count as a TPU host; an explicit JAX_PLATFORMS=cpu still opts out."""
+    import importlib.util
+
+    from quorum_tpu import compile_cache
+
+    monkeypatch.delenv("PALLAS_AXON_POOL_IPS", raising=False)
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    assert compile_cache.tpu_host_configured() is False
+
+    monkeypatch.setenv("JAX_PLATFORMS", "tpu,cpu")
+    assert compile_cache.tpu_host_configured() is True
+
+    monkeypatch.setenv("PALLAS_AXON_POOL_IPS", "10.0.0.1")
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    assert compile_cache.tpu_host_configured() is True  # axon hook wins
+
+    # Stock TPU VM: no env vars at all, libtpu importable.
+    monkeypatch.delenv("PALLAS_AXON_POOL_IPS", raising=False)
+    monkeypatch.delenv("JAX_PLATFORMS", raising=False)
+    real_find = importlib.util.find_spec
+    monkeypatch.setattr(
+        importlib.util, "find_spec",
+        lambda name, *a: object() if name == "libtpu" else real_find(name, *a))
+    assert compile_cache.tpu_host_configured() is True
+
+    monkeypatch.setattr(importlib.util, "find_spec", lambda name, *a: None)
+    assert compile_cache.tpu_host_configured() is False

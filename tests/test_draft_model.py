@@ -115,6 +115,25 @@ def test_backend_url_knob():
     assert be.engine._draft_rt is not None
 
 
+def test_backend_propagates_target_window_to_draft():
+    """ADVICE r3: the draft must inherit the target's sliding_window (not
+    keep its preset) — the docs promise the draft runs the target's
+    vocab/window, and a mismatched span only lowers acceptance silently."""
+    from quorum_tpu.backends.tpu_backend import TpuBackend
+    from quorum_tpu.config import BackendSpec
+
+    be = TpuBackend.from_spec(BackendSpec(
+        name="DW",
+        url="tpu://llama-tiny?n_kv_heads=4&max_seq=256&sliding_window=64"
+            "&slots=1&spec_model=llama-tiny&spec_decode=4&max_tokens=4",
+        model="m"))
+    draft = be.engine._draft_rt.spec
+    target = be.engine.spec
+    assert draft.sliding_window == target.sliding_window == 64
+    assert draft.max_seq == target.max_seq
+    assert draft.vocab_size == target.vocab_size
+
+
 def test_ckpt_plus_spec_model_rejected():
     from quorum_tpu.backends.tpu_backend import TpuBackend
     from quorum_tpu.config import BackendSpec

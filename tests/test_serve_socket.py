@@ -168,7 +168,6 @@ async def test_int8_prefix_cached_serving_over_socket():
     assert saved and int(saved[0].rsplit(" ", 1)[1]) >= 16
 
 
-@pytest.mark.asyncio
 async def test_client_disconnect_frees_slot_and_counts_cancellation():
     """A client that drops its SSE connection mid-stream must not pin the
     engine slot for the rest of its max_tokens budget: the engine retires
