@@ -11,7 +11,7 @@ run:
 	python -m quorum_tpu.server.serve --port 8000
 
 dev:
-	python -m quorum_tpu.server.serve --port 8001 --log-level DEBUG
+	python -m quorum_tpu.server.serve --port 8001 --log-level DEBUG --watch
 
 test:
 	python -m pytest tests/ -x -q

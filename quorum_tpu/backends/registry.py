@@ -25,13 +25,21 @@ class BackendRegistry:
     def __init__(self, backends: Iterable[Backend] = ()):
         self._by_name: dict[str, Backend] = {}
         self._order: list[str] = []
+        # BackendSpec each backend was constructed from (when known) — the
+        # identity hot reload compares to decide reuse vs reconstruction.
+        self._spec_by_name: dict[str, BackendSpec] = {}
         for b in backends:
             self.add(b)
 
-    def add(self, backend: Backend) -> None:
+    def add(self, backend: Backend, spec: BackendSpec | None = None) -> None:
         if backend.name not in self._by_name:
             self._order.append(backend.name)
         self._by_name[backend.name] = backend
+        if spec is not None:
+            self._spec_by_name[backend.name] = spec
+
+    def spec_of(self, name: str) -> BackendSpec | None:
+        return self._spec_by_name.get(name)
 
     def get(self, name: str) -> Backend | None:
         return self._by_name.get(name)
@@ -105,7 +113,7 @@ def build_registry(config: Config, **overrides: Any) -> BackendRegistry:
             )
             continue
         try:
-            reg.add(factory(spec))
+            reg.add(factory(spec), spec=spec)
         except Exception:
             # A backend that fails to construct (bad tpu:// model id, missing
             # weights, ...) must not take the whole server down with it.
@@ -114,3 +122,48 @@ def build_registry(config: Config, **overrides: Any) -> BackendRegistry:
         if name not in reg:
             reg.add(backend)
     return reg
+
+
+def rebuild_registry(
+    config: Config, old: BackendRegistry, overrides: dict[str, Backend]
+) -> tuple[BackendRegistry, list[Backend]]:
+    """Registry for a *changed* config, reusing live backends where identity
+    (name + url + model) is unchanged — a dev-mode config edit must never
+    tear down a serving ``tpu://`` engine that the edit didn't touch.
+    (Unchanged-URL backends that DO reconstruct still re-attach to their
+    weights via the engine cache — ``get_engine`` keys on weight identity —
+    but instance reuse also preserves per-backend dispatch state.)
+
+    Returns ``(new_registry, dropped)`` — ``dropped`` are the old backends
+    no longer referenced, for the caller to close.
+    """
+    reg = BackendRegistry()
+    for spec in config.valid_backends:
+        if spec.name in overrides:
+            reg.add(overrides[spec.name])
+            continue
+        prev_spec = old.spec_of(spec.name)
+        prev = old.get(spec.name)
+        if (prev is not None and prev_spec is not None
+                and prev_spec.url == spec.url
+                and prev_spec.model == spec.model):
+            reg.add(prev, spec=spec)
+            continue
+        factory = SCHEME_FACTORIES.get(spec.scheme)
+        if factory is None:
+            logger.warning(
+                "Backend %s has unsupported URL scheme %r — skipped",
+                spec.name, spec.scheme)
+            continue
+        try:
+            reg.add(factory(spec), spec=spec)
+        except Exception:
+            logger.exception(
+                "Failed to construct backend %s (%s) — skipped",
+                spec.name, spec.url)
+    for name, backend in overrides.items():
+        if name not in reg:
+            reg.add(backend)
+    kept = {id(b) for b in reg.backends}
+    dropped = [b for b in old.backends if id(b) not in kept]
+    return reg, dropped

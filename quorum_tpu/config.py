@@ -171,6 +171,9 @@ class Config:
     """Parsed configuration plus the raw dict (kept for passthrough parity)."""
 
     raw: dict[str, Any]
+    # File the raw dict was loaded from, when it came from disk — the handle
+    # dev-mode hot reload watches (None for programmatic configs).
+    source_path: "Path | None" = None
 
     @property
     def backends(self) -> list[BackendSpec]:
@@ -215,7 +218,7 @@ class Config:
         )
 
     def copy(self) -> "Config":
-        return Config(raw=copy.deepcopy(self.raw))
+        return Config(raw=copy.deepcopy(self.raw), source_path=self.source_path)
 
 
 def load_config(path: str | os.PathLike | None = None) -> Config:
@@ -240,7 +243,7 @@ def load_config(path: str | os.PathLike | None = None) -> Config:
             if not isinstance(raw, dict):
                 raise ValueError(f"config root must be a mapping, got {type(raw)}")
             logger.info("Loaded configuration from %s", cand)
-            return Config(raw=raw)
+            return Config(raw=raw, source_path=cand)
         except Exception as e:  # parity: any failure → default (oai_proxy.py:52-63)
             logger.debug("Could not load config from %s: %s", cand, e)
 

@@ -118,19 +118,43 @@ async def _stream_with_role(
 def create_app(
     config: Config | None = None,
     registry: BackendRegistry | None = None,
+    watch_config: bool | None = None,
     **backend_overrides: Backend,
 ) -> App:
     """Build the ASGI application.
 
     Tests inject deterministic backends via ``backend_overrides`` (name →
     Backend) or a fully custom ``registry``.
+
+    ``watch_config`` enables dev-mode hot reload (default: the
+    ``QUORUM_TPU_CONFIG_WATCH`` env toggle): on each request the config
+    file's mtime is checked (rate-limited) and edits swap in a rebuilt
+    registry without dropping untouched live backends — see
+    ``quorum_tpu.server.reload``. Requires a file-backed config.
     """
     cfg = config if config is not None else load_config()
     reg = registry if registry is not None else build_registry(cfg, **backend_overrides)
 
+    from quorum_tpu.server.reload import ConfigWatcher, Runtime
+
+    rt = Runtime(cfg, reg)
+    if watch_config is None:
+        watch_config = os.environ.get("QUORUM_TPU_CONFIG_WATCH", "") == "1"
+    watcher = (ConfigWatcher(cfg.source_path, rt, backend_overrides)
+               if watch_config and cfg.source_path is not None
+               and registry is None else None)
+
     app = App()
+    app.state["runtime"] = rt
     app.state["config"] = cfg
     app.state["registry"] = reg
+
+    async def current() -> tuple[Config, BackendRegistry]:
+        """The live (config, registry) pair — post-reload when watching."""
+        if watcher is not None:
+            await watcher.poll()
+            app.state["config"], app.state["registry"] = rt.cfg, rt.reg
+        return rt.cfg, rt.reg
 
     @app.route("GET", "/health", "/v1/health")
     async def health(request: Request) -> Response:
@@ -145,6 +169,7 @@ def create_app(
         exposes no discovery endpoint — clients had to know the model name
         out of band; a local serving framework can simply list what it
         loaded. ``owned_by`` carries the backend name(s) serving the id."""
+        _, reg = await current()
         owners: dict[str, list[str]] = {}
         for backend in reg.backends:
             mid = getattr(backend, "model", "") or getattr(
@@ -162,6 +187,7 @@ def create_app(
         metrics-export gap the reference leaves open (SURVEY.md §5.5: two
         log channels, no metrics). One line set per tpu:// backend; HTTP
         backends have no local state to export."""
+        _, reg = await current()
         lines = [
             "# TYPE quorum_tpu_uptime_seconds gauge",
             f"quorum_tpu_uptime_seconds {time.monotonic() - started:.3f}",
@@ -237,6 +263,7 @@ def create_app(
             timer.log("stream", status=status)
 
     async def _chat_impl(request: Request, timer: PhaseTimer) -> Response:
+        cfg, reg = await current()
         try:
             body = await request.json()
             if not isinstance(body, dict):

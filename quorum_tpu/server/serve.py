@@ -174,6 +174,12 @@ def main() -> None:
         "--log-dir", default="logs",
         help="directory for the aggregation log channel (logs/aggregation.log)",
     )
+    parser.add_argument(
+        "--watch", action="store_true",
+        help="dev mode: hot-reload config.yaml edits in-process without "
+             "dropping live tpu:// engines (reference parity with its "
+             "uvicorn --reload-include '*.yaml' dev server)",
+    )
     args = parser.parse_args()
 
     logging.basicConfig(
@@ -189,7 +195,7 @@ def main() -> None:
 
     initialize()
     cfg = load_config(args.config)
-    app = create_app(cfg)
+    app = create_app(cfg, watch_config=True if args.watch else None)
     try:
         asyncio.run(serve(app, args.host, args.port))
     except KeyboardInterrupt:

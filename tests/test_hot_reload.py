@@ -1,0 +1,145 @@
+"""Dev-mode config hot reload (quorum_tpu/server/reload.py).
+
+Reference parity target: its dev server restarts the whole process on
+``config.yaml`` edits (/root/reference/Makefile:4, uvicorn
+``--reload-include "*.yaml"``). Here reload is in-process and incremental —
+a config edit changes routing on the NEXT request, live ``tpu://`` engines
+survive edits that don't touch them, and a malformed edit keeps the previous
+config serving (VERDICT r3 next-round item 8).
+"""
+
+import asyncio
+import os
+import time
+
+import httpx
+import yaml
+
+from quorum_tpu.config import load_config
+from quorum_tpu.server.app import create_app
+
+
+def _write(path, raw):
+    path.write_text(yaml.safe_dump(raw))
+    # The watcher signature is (mtime_ns, size); same-size rewrites within
+    # one mtime granule are possible on fast filesystems — nudge mtime so
+    # every test edit is observable.
+    st = os.stat(path)
+    os.utime(path, ns=(st.st_atime_ns, st.st_mtime_ns + 1_000_000))
+
+
+def _cfg(backends, timeout=120):
+    return {
+        "settings": {"timeout": timeout},
+        "primary_backends": backends,
+    }
+
+
+def _tiny(name, seed, extra=""):
+    return {"name": name,
+            "url": f"tpu://llama-tiny?seed={seed}&max_seq=256&slots=2"
+                   f"&max_tokens=4{extra}",
+            "model": "tiny"}
+
+
+def _client(app):
+    return httpx.AsyncClient(transport=httpx.ASGITransport(app=app),
+                             base_url="http://testserver")
+
+
+async def _wait_reload_window():
+    # The watcher rate-limits stat() to one per 0.5 s window.
+    await asyncio.sleep(0.6)
+
+
+async def test_edit_changes_routing_and_keeps_live_engine(tmp_path):
+    path = tmp_path / "config.yaml"
+    _write(path, _cfg([_tiny("A", seed=1)]))
+    cfg = load_config(path)
+    assert cfg.source_path == path
+    app = create_app(cfg, watch_config=True)
+
+    async with _client(app) as client:
+        body = {"model": "tiny", "max_tokens": 4, "temperature": 0.0,
+                "messages": [{"role": "user", "content": "reload probe"}]}
+        r1 = await client.post("/v1/chat/completions", json=body,
+                               headers={"Authorization": "Bearer t"})
+        assert r1.status_code == 200 and r1.json()["backend"] == "A"
+        engine_before = app.state["registry"].get("A").engine
+
+        # Rename the backend (same tpu:// URL) — routing must change on the
+        # next request, and the SAME backend-instance/engine must NOT be
+        # rebuilt... the name changed, so the instance is reconstructed, but
+        # the engine cache re-attaches it to the live weights.
+        _write(path, _cfg([_tiny("B", seed=1)]))
+        await _wait_reload_window()
+        r2 = await client.post("/v1/chat/completions", json=body,
+                               headers={"Authorization": "Bearer t"})
+        assert r2.status_code == 200 and r2.json()["backend"] == "B"
+        models = (await client.get("/v1/models")).json()
+        assert models["data"][0]["owned_by"] == "B"
+        engine_after = app.state["registry"].get("B").engine
+        assert engine_after is engine_before, (
+            "unchanged tpu:// URL must keep serving from the live engine")
+
+
+async def test_unchanged_backend_instance_is_reused(tmp_path):
+    path = tmp_path / "config.yaml"
+    _write(path, _cfg([_tiny("A", seed=1)], timeout=120))
+    cfg = load_config(path)
+    app = create_app(cfg, watch_config=True)
+
+    async with _client(app) as client:
+        body = {"model": "tiny", "max_tokens": 4, "temperature": 0.0,
+                "messages": [{"role": "user", "content": "x"}]}
+        await client.post("/v1/chat/completions", json=body,
+                          headers={"Authorization": "Bearer t"})
+        backend_before = app.state["registry"].get("A")
+
+        # Edit only the timeout: the backend identity (name, url, model) is
+        # untouched → the very INSTANCE survives the reload.
+        _write(path, _cfg([_tiny("A", seed=1)], timeout=77))
+        await _wait_reload_window()
+        await client.get("/v1/models")
+        assert app.state["registry"].get("A") is backend_before
+        assert app.state["config"].timeout == 77.0
+
+
+async def test_malformed_edit_keeps_previous_config(tmp_path):
+    path = tmp_path / "config.yaml"
+    _write(path, _cfg([_tiny("A", seed=1)]))
+    app = create_app(load_config(path), watch_config=True)
+
+    async with _client(app) as client:
+        body = {"model": "tiny", "max_tokens": 4, "temperature": 0.0,
+                "messages": [{"role": "user", "content": "x"}]}
+        r1 = await client.post("/v1/chat/completions", json=body,
+                               headers={"Authorization": "Bearer t"})
+        assert r1.status_code == 200
+
+        path.write_text("primary_backends: [:::not yaml")
+        st = os.stat(path)
+        os.utime(path, ns=(st.st_atime_ns, st.st_mtime_ns + 1_000_000))
+        await _wait_reload_window()
+        r2 = await client.post("/v1/chat/completions", json=body,
+                               headers={"Authorization": "Bearer t"})
+        assert r2.status_code == 200 and r2.json()["backend"] == "A"
+
+        # ...and a subsequent good edit applies cleanly.
+        _write(path, _cfg([_tiny("C", seed=1)]))
+        await _wait_reload_window()
+        r3 = await client.post("/v1/chat/completions", json=body,
+                               headers={"Authorization": "Bearer t"})
+        assert r3.status_code == 200 and r3.json()["backend"] == "C"
+
+
+async def test_watch_off_by_default(tmp_path):
+    path = tmp_path / "config.yaml"
+    _write(path, _cfg([_tiny("A", seed=1)]))
+    app = create_app(load_config(path))  # no watch_config, no env toggle
+
+    async with _client(app) as client:
+        _write(path, _cfg([_tiny("B", seed=1)]))
+        await _wait_reload_window()
+        models = (await client.get("/v1/models")).json()
+        assert models["data"][0]["owned_by"] == "A"
