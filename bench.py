@@ -578,14 +578,6 @@ def _make_hf_checkpoint(dirpath: str, tiny: bool) -> None:
     BPE tokenizer trained with the ``tokenizers`` library (tokenizer.json +
     tokenizer_config.json) — the same artifact set a downloaded hub
     checkpoint ships, no network involved."""
-    from transformers import GPT2Config, GPT2LMHeadModel
-
-    cfg = (GPT2Config(vocab_size=512, n_positions=256, n_embd=64,
-                      n_layer=2, n_head=4)
-           if tiny else GPT2Config())  # defaults = real GPT-2-124M shape
-    model = GPT2LMHeadModel(cfg).eval()
-    model.save_pretrained(dirpath, safe_serialization=True)
-
     import json as _json
 
     from tokenizers import Tokenizer
@@ -594,6 +586,11 @@ def _make_hf_checkpoint(dirpath: str, tiny: bool) -> None:
     from tokenizers.pre_tokenizers import ByteLevel
     from tokenizers.trainers import BpeTrainer
 
+    # Tokenizer FIRST: the model's vocab is sized to the ids the tokenizer
+    # can actually decode. A random-init model samples near-uniformly, so
+    # any embedding row without a tokenizer entry would emit an empty delta
+    # — with a 50257-row table over a small trained vocab, ~9 of 10 decode
+    # steps would vanish from the measured token stream.
     raw = Tokenizer(BPE(unk_token=None))
     raw.pre_tokenizer = ByteLevel(add_prefix_space=False)
     raw.decoder = ByteLevelDecoder()
@@ -604,7 +601,7 @@ def _make_hf_checkpoint(dirpath: str, tiny: bool) -> None:
         "Sphinx of black quartz, judge my vow and answer carefully.",
     ] * 64
     trainer = BpeTrainer(
-        vocab_size=min(500 if tiny else 5000, cfg.vocab_size - 1),
+        vocab_size=500 if tiny else 5000,
         special_tokens=["<|endoftext|>"], show_progress=False)
     raw.train_from_iterator(corpus, trainer)
     raw.save(os.path.join(dirpath, "tokenizer.json"))
@@ -612,6 +609,17 @@ def _make_hf_checkpoint(dirpath: str, tiny: bool) -> None:
         _json.dump({"tokenizer_class": "PreTrainedTokenizerFast",
                     "eos_token": "<|endoftext|>",
                     "bos_token": "<|endoftext|>"}, f)
+
+    from transformers import GPT2Config, GPT2LMHeadModel
+
+    vocab = raw.get_vocab_size()
+    cfg = (GPT2Config(vocab_size=vocab, n_positions=256, n_embd=64,
+                      n_layer=2, n_head=4)
+           if tiny
+           # GPT-2-124M transformer dims; vocab sized to the tokenizer.
+           else GPT2Config(vocab_size=vocab))
+    model = GPT2LMHeadModel(cfg).eval()
+    model.save_pretrained(dirpath, safe_serialization=True)
 
 
 async def bench_ckpt() -> dict:
@@ -683,7 +691,8 @@ async def bench_ckpt() -> dict:
             server.close()
             await server.wait_closed()
         return {
-            "ckpt_model": "gpt2-tiny-hf" if tiny else "gpt2-124m-hf",
+            "ckpt_model": ("gpt2-tiny-hf" if tiny
+                           else "gpt2-124m-arch-hf"),  # 124M dims, BPE vocab
             "ckpt_tokenizer": "bpe-subword",
             "ckpt_load_s": round(load_s, 2),
             "ckpt_ttft_ms": round(statistics.median(ttfts) * 1000, 2),
@@ -909,7 +918,8 @@ async def main() -> None:
         # "Measured" means a numeric metric — not the *_model / *_error
         # context keys seven_b_main emits beside a failure.
         measured = any(
-            k.startswith(("b7_", "b7q_")) and isinstance(v, (int, float))
+            k.startswith(("b7_", "b7q_", "ckpt_"))
+            and isinstance(v, (int, float))
             for k, v in out.items())
         sys.exit(0 if measured else 3)
     print(json.dumps(out))

@@ -93,6 +93,28 @@ class QueueFullError(Exception):
     """The engine's admission queue is at capacity (surface as HTTP 503)."""
 
 
+def _host_fetch(*arrays):
+    """``jax.device_get`` for program outputs the scheduler must read.
+
+    On a mesh that spans processes (multi-host serving, SPMD dispatch) XLA
+    may shard a program output over a cross-process axis, making it
+    non-addressable from any single host; every process then executes the
+    same allgather (symmetric — all hosts run identical dispatch sequences,
+    see tests/serving_worker.py) to assemble the global value. Addressable
+    arrays — every single-process mesh — take the plain device_get path
+    untouched. Returns a tuple for multiple arrays, the bare value for one.
+    """
+    def gather(x):
+        if isinstance(x, jax.Array) and not x.is_fully_addressable:
+            from jax.experimental import multihost_utils
+
+            return multihost_utils.process_allgather(x, tiled=True)
+        return x
+
+    out = jax.device_get(tuple(gather(x) for x in arrays))
+    return tuple(out) if len(arrays) > 1 else out[0]
+
+
 def _member_call(ens: int, fn, params, ck, cv, *, mean: bool = True):
     """Run a model call member-vmapped when ``ens`` > 1.
 
@@ -340,7 +362,7 @@ class _DraftRuntime:
             toks, self._ck, self._cv = self._advance_fn(t_bite, history)(
                 self.params, jnp.asarray(tokens), jnp.asarray(lengths),
                 jnp.asarray(wmask), self._ck, self._cv)
-            toks = np.asarray(jax.device_get(toks))
+            toks = np.asarray(_host_fetch(toks))
             for i, r in active:
                 if rem[i] <= 0:
                     continue
@@ -362,7 +384,7 @@ class _DraftRuntime:
             toks, self._ck, self._cv = self._extend_fn(g - 1, history)(
                 self.params, jnp.asarray(token), jnp.asarray(lengths),
                 jnp.asarray(wmask), self._ck, self._cv)
-            toks = np.asarray(jax.device_get(toks))  # [g-1, rows]
+            toks = np.asarray(_host_fetch(toks))  # [g-1, rows]
             for i, _ in active:
                 drafts[i].extend(int(t) for t in toks[:, i])
         return drafts
@@ -1547,8 +1569,8 @@ class InferenceEngine:
             self._temp, self._topp, self._topk,
             self._pp, self._fp, self._counts, self._bias,
         )
-        firsts, s_lp, top_ix, top_lp = jax.device_get(
-            (firsts, s_lp, top_ix, top_lp))
+        firsts, s_lp, top_ix, top_lp = _host_fetch(
+            firsts, s_lp, top_ix, top_lp)
         for m, req in live.items():
             flat = m * n_s + row
             self._resident[flat] = list(req.prompt_ids)
@@ -1729,6 +1751,7 @@ class InferenceEngine:
             self._temp, self._topp, self._topk,
             self._pp, self._fp, self._counts, self._bias,
         )
+        first, s_lp, top_ix, top_lp = _host_fetch(first, s_lp, top_ix, top_lp)
         if req.want_lp >= 0:
             req.lp.append((float(s_lp),
                            np.asarray(top_ix), np.asarray(top_lp)))
@@ -1846,9 +1869,9 @@ class InferenceEngine:
         dispatch pair — their tokens are overrun and discarded. Returns the
         slots that finished in THIS chunk."""
         if len(payload) == 4:
-            toks, s_lp, top_ix, top_lp = jax.device_get(payload)
+            toks, s_lp, top_ix, top_lp = _host_fetch(*payload)
         else:
-            (toks,) = jax.device_get(payload)
+            toks = _host_fetch(payload[0])
             s_lp = top_ix = top_lp = None
         done: set[int] = set()
         for i, req in active:
@@ -1900,7 +1923,7 @@ class InferenceEngine:
             self._lengths, self._keys, self._temp, self._topp, self._topk,
             self._counts,
         )
-        s0, model_toks, ok = jax.device_get((s0, model_toks, ok))
+        s0, model_toks, ok = _host_fetch(s0, model_toks, ok)
         self.n_spec_turns += 1
         for i, req in active:
             toks = [int(s0[i])]
