@@ -2,7 +2,7 @@
 # clean — /root/reference/Makefile:1-25), adapted to this environment: no uv,
 # no uvicorn — the bundled h11 ASGI server serves the app.
 
-.PHONY: install run dev test coverage bench dryrun clean
+.PHONY: install run dev test test-all coverage bench dryrun clean
 
 install:
 	pip install -e .
@@ -13,7 +13,13 @@ run:
 dev:
 	python -m quorum_tpu.server.serve --port 8001 --log-level DEBUG --watch
 
+# Fast tier: server/strategy/protocol tests (~2-3 min) — the pre-commit
+# loop. Engine-scale / compile-heavy / multi-process tests are marked
+# @pytest.mark.slow; run everything with `make test-all` (CI does).
 test:
+	python -m pytest tests/ -x -q -m "not slow"
+
+test-all:
 	python -m pytest tests/ -x -q
 
 coverage:

@@ -212,7 +212,10 @@ def main() -> None:
     bank({"onchip_started_ts": time.time(), "onchip_error": None})
 
     if "bench" not in skip:
-        bank(run_step("bench", [sys.executable, "bench.py"], budget=7300))
+        # Budget must exceed bench.py's own derived watchdog (phase budgets
+        # + probe windows + margin — ~9 000 s with the ckpt phase enabled),
+        # or a healthy run gets killed mid-int8-phase from outside.
+        bank(run_step("bench", [sys.executable, "bench.py"], budget=9600))
     if "ab" not in skip:
         bank({(k if k.startswith("ab_") else f"ab_{k}"): v
               for k, v in run_step(

@@ -15,6 +15,10 @@ from quorum_tpu.engine.engine import InferenceEngine, QueueFullError
 from quorum_tpu.models.model_config import resolve_spec
 from quorum_tpu.ops.sampling import SamplerConfig
 
+# Engine-scale / compile-heavy / multi-process: slow tier (make test skips,
+# make test-all and CI run everything — VERDICT r3 item 6).
+pytestmark = pytest.mark.slow
+
 TINY = resolve_spec("llama-tiny")  # max_seq 128
 
 

@@ -16,6 +16,11 @@ from quorum_tpu.parallel.distributed import (
     local_data_shard,
 )
 
+import pytest
+# Engine-scale / compile-heavy / multi-process: slow tier (make test skips,
+# make test-all and CI run everything — VERDICT r3 item 6).
+pytestmark = pytest.mark.slow
+
 
 def test_initialize_noop_single_process(monkeypatch):
     monkeypatch.delenv("JAX_COORDINATOR_ADDRESS", raising=False)

@@ -20,6 +20,11 @@ from quorum_tpu.engine.engine import InferenceEngine
 from quorum_tpu.models.model_config import MODEL_PRESETS
 from quorum_tpu.ops.sampling import SamplerConfig, sample_token, sample_token_rows
 
+import pytest
+# Engine-scale / compile-heavy / multi-process: slow tier (make test skips,
+# make test-all and CI run everything — VERDICT r3 item 6).
+pytestmark = pytest.mark.slow
+
 TINY = MODEL_PRESETS["llama-tiny"]
 
 

@@ -19,6 +19,10 @@ from quorum_tpu.models.model_config import resolve_spec
 from quorum_tpu.ops.sampling import SamplerConfig
 from quorum_tpu.parallel import MeshConfig, make_mesh
 
+# Engine-scale / compile-heavy / multi-process: slow tier (make test skips,
+# make test-all and CI run everything — VERDICT r3 item 6).
+pytestmark = pytest.mark.slow
+
 TINY = resolve_spec("llama-tiny", {"n_kv_heads": "4"})
 
 

@@ -15,6 +15,10 @@ from quorum_tpu.models import init_params, resolve_spec
 from quorum_tpu.models.transformer import forward_logits
 from quorum_tpu.ops.sampling import SamplerConfig
 
+# Engine-scale / compile-heavy / multi-process: slow tier (make test skips,
+# make test-all and CI run everything — VERDICT r3 item 6).
+pytestmark = pytest.mark.slow
+
 SPEC = resolve_spec("llama-tiny", {"max_seq": "64"})
 GREEDY = SamplerConfig(temperature=0.0)
 

@@ -15,6 +15,10 @@ import jax.numpy as jnp
 from quorum_tpu.models.hf_loader import load_hf_checkpoint, spec_from_hf_config
 from quorum_tpu.models.transformer import forward_logits
 
+# Engine-scale / compile-heavy / multi-process: slow tier (make test skips,
+# make test-all and CI run everything — VERDICT r3 item 6).
+pytestmark = pytest.mark.slow
+
 TOKENS = np.array([[3, 17, 5, 9, 250, 11, 42, 7]], dtype=np.int32)
 
 

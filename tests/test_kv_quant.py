@@ -24,6 +24,11 @@ from quorum_tpu.ops.attention import (
 )
 from quorum_tpu.ops.sampling import SamplerConfig
 
+import pytest
+# Engine-scale / compile-heavy / multi-process: slow tier (make test skips,
+# make test-all and CI run everything — VERDICT r3 item 6).
+pytestmark = pytest.mark.slow
+
 TINY = MODEL_PRESETS["llama-tiny"]
 
 

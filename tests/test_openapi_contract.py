@@ -24,6 +24,10 @@ from tests.test_contract_fixtures import (
     single_backend_config,
 )
 
+# Engine-scale / compile-heavy / multi-process: slow tier (make test skips,
+# make test-all and CI run everything — VERDICT r3 item 6).
+pytestmark = pytest.mark.slow
+
 DOC = yaml.safe_load(
     (Path(__file__).parent.parent / "api" / "openapi.yaml").read_text())
 

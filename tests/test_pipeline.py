@@ -18,6 +18,10 @@ from quorum_tpu.parallel import (
     shard_pytree_pp,
 )
 
+# Engine-scale / compile-heavy / multi-process: slow tier (make test skips,
+# make test-all and CI run everything — VERDICT r3 item 6).
+pytestmark = pytest.mark.slow
+
 SPEC = resolve_spec("llama-tiny", {"n_layers": "4", "max_seq": "64"})
 
 

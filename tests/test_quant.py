@@ -24,6 +24,10 @@ from quorum_tpu.models.transformer import forward_logits
 from quorum_tpu.parallel import MeshConfig, make_mesh
 from quorum_tpu.parallel.sharding import param_shardings
 
+# Engine-scale / compile-heavy / multi-process: slow tier (make test skips,
+# make test-all and CI run everything — VERDICT r3 item 6).
+pytestmark = pytest.mark.slow
+
 
 def test_quantize_leaf_error_bound():
     """|w - dq(q(w))| ≤ scale/2 + bf16 rounding, per channel."""

@@ -14,6 +14,11 @@ from quorum_tpu.ops.attention import prefill_attention
 from quorum_tpu.parallel.mesh import MeshConfig, make_mesh
 from quorum_tpu.parallel.ring_attention import ring_prefill_attention
 
+import pytest
+# Engine-scale / compile-heavy / multi-process: slow tier (make test skips,
+# make test-all and CI run everything — VERDICT r3 item 6).
+pytestmark = pytest.mark.slow
+
 
 def rand(seed, shape):
     return jax.random.normal(jax.random.PRNGKey(seed), shape, jnp.float32)

@@ -18,6 +18,11 @@ from quorum_tpu.parallel.sharding import (
     param_partition_specs,
 )
 
+import pytest
+# Engine-scale / compile-heavy / multi-process: slow tier (make test skips,
+# make test-all and CI run everything — VERDICT r3 item 6).
+pytestmark = pytest.mark.slow
+
 
 def test_mesh_shapes():
     mesh = make_mesh(MeshConfig(dp=2, tp=4))

@@ -21,6 +21,10 @@ from quorum_tpu.models.model_config import resolve_spec
 from quorum_tpu.ops.attention import decode_attention, prefill_attention
 from quorum_tpu.ops.sampling import SamplerConfig
 
+# Engine-scale / compile-heavy / multi-process: slow tier (make test skips,
+# make test-all and CI run everything — VERDICT r3 item 6).
+pytestmark = pytest.mark.slow
+
 GREEDY = SamplerConfig(temperature=0.0, top_p=1.0)
 WSPEC = {"n_kv_heads": "4", "max_seq": "128", "sliding_window": "16"}
 

@@ -14,6 +14,11 @@ from quorum_tpu.engine.engine import InferenceEngine
 from quorum_tpu.models.model_config import resolve_spec
 from quorum_tpu.ops.sampling import SamplerConfig
 
+import pytest
+# Engine-scale / compile-heavy / multi-process: slow tier (make test skips,
+# make test-all and CI run everything — VERDICT r3 item 6).
+pytestmark = pytest.mark.slow
+
 TINY = resolve_spec("llama-tiny")
 GREEDY = SamplerConfig(temperature=0.0)
 

@@ -11,6 +11,10 @@ from tests.conftest import make_client
 from quorum_tpu.backends.tpu_backend import TpuBackend, _StopMatcher
 from quorum_tpu.config import BackendSpec
 
+# Engine-scale / compile-heavy / multi-process: slow tier (make test skips,
+# make test-all and CI run everything — VERDICT r3 item 6).
+pytestmark = pytest.mark.slow
+
 
 def tiny_backend(name="TPU1", seed=0, model=""):
     return TpuBackend.from_spec(

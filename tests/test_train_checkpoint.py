@@ -19,6 +19,10 @@ from quorum_tpu.training.checkpoint import (
 )
 from quorum_tpu.training.trainer import make_train_step, train_init
 
+# Engine-scale / compile-heavy / multi-process: slow tier (make test skips,
+# make test-all and CI run everything — VERDICT r3 item 6).
+pytestmark = pytest.mark.slow
+
 SPEC = resolve_spec("llama-tiny", {"max_seq": "64"})
 
 

@@ -18,6 +18,10 @@ from quorum_tpu.ops.sampling import SamplerConfig
 from quorum_tpu.parallel import MeshConfig, make_mesh
 from quorum_tpu.parallel.ulysses import ulysses_prefill_attention
 
+# Engine-scale / compile-heavy / multi-process: slow tier (make test skips,
+# make test-all and CI run everything — VERDICT r3 item 6).
+pytestmark = pytest.mark.slow
+
 
 def _rand(seed, shape):
     return jax.random.normal(jax.random.PRNGKey(seed), shape, jnp.float32)
