@@ -504,7 +504,8 @@ def _probe_until(deadline: float) -> bool:
         wait = min(wait * 2, 300.0)
 
 
-def run_child_phase(flag: str, prefix: str, budget: int) -> dict:
+def run_child_phase(flag: str, prefix: str, budget: int,
+                    env_extra: "dict | None" = None) -> dict:
     """Run one bench phase in a SUBPROCESS and return its JSON metrics.
 
     Subprocesses for two reasons: the phase-1/2 engines (3 × 124M weights +
@@ -514,11 +515,15 @@ def run_child_phase(flag: str, prefix: str, budget: int) -> dict:
     client at a time, so each child must finish before the next starts."""
     import subprocess
 
+    env = None
+    if env_extra:
+        env = dict(os.environ)
+        env.update(env_extra)
     try:
         proc = subprocess.run(
             [sys.executable, os.path.abspath(__file__), flag],
             capture_output=True, text=True, timeout=budget,
-            cwd=os.path.dirname(os.path.abspath(__file__)),
+            cwd=os.path.dirname(os.path.abspath(__file__)), env=env,
         )
     except subprocess.TimeoutExpired as e:
         # A hung child (e.g. a wedged TPU tunnel) must not take down the
@@ -831,7 +836,13 @@ _BANKED: dict = {}
 
 _PHASE12_BUDGET = 1200
 _CKPT_BUDGET = 900
+_AB_BUDGET = 900   # stacked-vs-separate A/B arm (phases 1/2, STACKED=0)
 _MIN_CHILD_BUDGET = 300  # below this a phase can't even finish compiling
+# The A/B arm reruns phases 1/2 with three SEPARATE per-seed engines so the
+# driver artifact itself carries the stacked-members speedup comparison
+# (VERDICT r3 item 3) even when no interactive on-chip session ever got a
+# live tunnel. TPU runs only; "0" skips.
+BENCH_AB = os.environ.get("QUORUM_TPU_BENCH_AB", "1")
 
 
 def _derived_watchdog_budget() -> int:
@@ -848,6 +859,8 @@ def _derived_watchdog_budget() -> int:
             pass  # a malformed env var must not kill the guarantee
     total = _PHASE12_BUDGET + sum(
         b for _, _, gate, b, _ in _7B_PHASES if gate != "0")
+    if BENCH_AB != "0":
+        total += _AB_BUDGET
     if BENCH_CKPT != "0":
         total += _CKPT_BUDGET
     return total + 1800
@@ -883,13 +896,16 @@ async def main() -> None:
     # recovered mid-window; here each phase keeps probing (with backoff)
     # up to the moment a success could no longer leave it a useful budget
     # ahead of the later phases' reserved share.
-    plan = [("--phase12", "phase12", _PHASE12_BUDGET)]
+    plan = [("--phase12", "phase12", _PHASE12_BUDGET, None)]
+    if BENCH_AB != "0":
+        plan.append(("--phase12", "ab", _AB_BUDGET,
+                     {"QUORUM_TPU_BENCH_STACKED": "0"}))
     if BENCH_CKPT != "0":
-        plan.append(("--ckpt", "ckpt", _CKPT_BUDGET))
-    plan += [(flag, prefix, budget)
+        plan.append(("--ckpt", "ckpt", _CKPT_BUDGET, None))
+    plan += [(flag, prefix, budget, None)
              for flag, prefix, gate, budget, _ in _7B_PHASES if gate != "0"]
-    for i, (flag, prefix, budget) in enumerate(plan):
-        tail = sum(b for _, _, b in plan[i + 1:])
+    for i, (flag, prefix, budget, env_extra) in enumerate(plan):
+        tail = sum(b for _, _, b, _ in plan[i + 1:])
         if not _probe_until(deadline - tail - _MIN_CHILD_BUDGET):
             out[f"{prefix}_error"] = (
                 "skipped: device probe failed through its retry window")
@@ -899,7 +915,11 @@ async def main() -> None:
             out[f"{prefix}_error"] = (
                 f"skipped: only {child_budget}s left after probe delays")
             continue
-        out.update(run_child_phase(flag, prefix, child_budget))
+        got = run_child_phase(flag, prefix, child_budget,
+                              env_extra=env_extra)
+        if prefix == "ab":
+            got = _ab_keys(got)
+        out.update(got)
     if "value" not in out:
         # The headline phase missed its window (e.g. the tunnel only came
         # up during a later phase's probe). Any leftover time goes to one
@@ -923,6 +943,19 @@ async def main() -> None:
             for k, v in out.items())
         sys.exit(0 if measured else 3)
     print(json.dumps(out))
+
+
+def _ab_keys(got: dict) -> dict:
+    """Re-key the separate-engines A/B arm's top-level schema under ab_*
+    so it merges beside (not over) the stacked headline: the stacked win is
+    then readable directly off the artifact — value vs ab_p50_ttft_ms,
+    tokens_per_s vs ab_tokens_per_s."""
+    keep = {"value": "ab_p50_ttft_ms", "p50_total_ms": "ab_p50_total_ms",
+            "req_per_s": "ab_req_per_s", "tokens_per_s": "ab_tokens_per_s",
+            "stacked": "ab_stacked"}
+    out = {new: got[old] for old, new in keep.items() if old in got}
+    out.update({k: v for k, v in got.items() if k.startswith("ab_")})
+    return out
 
 
 def run_7b_phase() -> dict:

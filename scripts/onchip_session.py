@@ -211,16 +211,26 @@ def main() -> None:
     print("[onchip] device alive — starting the list", flush=True)
     bank({"onchip_started_ts": time.time(), "onchip_error": None})
 
+    bench_got: dict = {}
     if "bench" not in skip:
         # Budget must exceed bench.py's own derived watchdog (phase budgets
-        # + probe windows + margin — ~9 000 s with the ckpt phase enabled),
-        # or a healthy run gets killed mid-int8-phase from outside.
-        bank(run_step("bench", [sys.executable, "bench.py"], budget=9600))
+        # + probe windows + margin — ~9 900 s with the A/B and ckpt phases
+        # enabled), or a healthy run gets killed mid-int8-phase from outside.
+        bench_got = run_step("bench", [sys.executable, "bench.py"],
+                             budget=10800)
+        bank(bench_got)
     if "ab" not in skip:
-        bank({(k if k.startswith("ab_") else f"ab_{k}"): v
-              for k, v in run_step(
-            "ab", [sys.executable, "bench.py", "--phase12"], budget=1200,
-            env_extra={"QUORUM_TPU_BENCH_STACKED": "0"}).items()})
+        # bench.py's own plan now carries the stacked A/B (ab_* keys);
+        # rerun it here only when THIS run's arm didn't land — a previous
+        # session's banked ab_* keys must not pair stale separate-engine
+        # numbers with fresh headline numbers.
+        if any(k.startswith("ab_p50") for k in bench_got):
+            print("[onchip] bench already carried the stacked A/B — skipping")
+        else:
+            bank({(k if k.startswith("ab_") else f"ab_{k}"): v
+                  for k, v in run_step(
+                "ab", [sys.executable, "bench.py", "--phase12"], budget=1200,
+                env_extra={"QUORUM_TPU_BENCH_STACKED": "0"}).items()})
     if "kvq" not in skip:
         bank(run_step(
             "kvq", [sys.executable, "-c", _SERVE_ONE, KVQ_URL, "2", "kvq",

@@ -85,6 +85,67 @@ def test_probe_until_retries_across_window(monkeypatch):
     assert bench._probe_until(_time.time() - 1) is False
 
 
+def test_ab_keys_rekeys_top_level_schema():
+    """The separate-engines A/B arm must merge BESIDE the stacked headline
+    (ab_* keys), never clobber it."""
+    bench = _load_bench()
+    got = {"metric": "p50_ttft_ms", "value": 91.0, "unit": "ms",
+           "p50_total_ms": 300.0, "req_per_s": 2.5, "tokens_per_s": 290.0,
+           "mfu_pct": 0.1, "stacked": False, "ab_error": "x"}
+    out = bench._ab_keys(got)
+    assert out == {"ab_p50_ttft_ms": 91.0, "ab_p50_total_ms": 300.0,
+                   "ab_req_per_s": 2.5, "ab_tokens_per_s": 290.0,
+                   "ab_stacked": False, "ab_error": "x"}
+    # none of the headline's own keys survive un-prefixed
+    assert not set(out) & {"value", "metric", "tokens_per_s"}
+
+
+def test_tpu_orchestration_plan_end_to_end(monkeypatch, capsys):
+    """The TPU main() path with stubbed probes/children: every enabled
+    phase runs in order (headline → A/B arm (STACKED=0 env) → ckpt → b7 →
+    b7q), the A/B arm's schema lands re-keyed BESIDE the headline, and the
+    final merged JSON line prints. Would have caught the round-4 regression
+    where a mis-placed helper severed main()'s tail (no JSON, no exit
+    code)."""
+    import asyncio
+    import json
+
+    from quorum_tpu import compile_cache
+
+    bench = _load_bench()
+    # main() imports tpu_host_configured from compile_cache at call time.
+    monkeypatch.setattr(compile_cache, "tpu_host_configured", lambda: True)
+    monkeypatch.setattr(bench, "_probe_device", lambda budget=120: True)
+    monkeypatch.setattr(bench, "_probe_until", lambda deadline: True)
+
+    calls = []
+
+    def fake_child(flag, prefix, budget, env_extra=None):
+        calls.append((prefix, env_extra))
+        if prefix == "phase12":
+            return {"metric": "p50_ttft_ms", "value": 50.0, "unit": "ms",
+                    "vs_baseline": 2.0, "p50_total_ms": 100.0,
+                    "req_per_s": 4.0, "tokens_per_s": 400.0, "stacked": True}
+        if prefix == "ab":
+            return {"metric": "p50_ttft_ms", "value": 80.0, "unit": "ms",
+                    "p50_total_ms": 110.0, "req_per_s": 3.0,
+                    "tokens_per_s": 300.0, "stacked": False}
+        return {f"{prefix}_decode_tok_s": 1.0}
+
+    monkeypatch.setattr(bench, "run_child_phase", fake_child)
+    asyncio.run(bench.main())
+    lines = [ln for ln in capsys.readouterr().out.splitlines()
+             if ln.startswith("{")]
+    assert lines, "main() printed no JSON line"
+    rec = json.loads(lines[-1])
+    assert [c[0] for c in calls] == ["phase12", "ab", "ckpt", "b7", "b7q"]
+    assert calls[1][1] == {"QUORUM_TPU_BENCH_STACKED": "0"}
+    assert rec["value"] == 50.0 and rec["ab_p50_ttft_ms"] == 80.0
+    assert rec["tokens_per_s"] == 400.0 and rec["ab_tokens_per_s"] == 300.0
+    assert rec["ab_stacked"] is False and rec["stacked"] is True
+    assert rec["b7_decode_tok_s"] == 1.0 and rec["b7q_decode_tok_s"] == 1.0
+
+
 def test_watchdog_budget_derived_and_overridable(monkeypatch):
     """ADVICE r3: the watchdog budget must exceed the phase-budget sum (a
     slow-but-healthy run must not be shot by its own watchdog); an env
