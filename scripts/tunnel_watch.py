@@ -1,0 +1,107 @@
+"""Supervise the axon TPU tunnel and fire the on-chip runbook on recovery.
+
+The round-3/4 failure mode: the tunnel is dead for hours and every manual
+probe misses the recovery window. This watcher loops a cheap subprocess
+probe (the same marker discipline as bench._probe_device — PROBE_OK on a
+non-cpu backend, any-line scan) and the moment the chip answers it runs
+``scripts/onchip_session.py`` (which banks every measurement to
+ONCHIP.json as it lands) and commits the artifact.
+
+Safety:
+- ``--launch-deadline-s`` (default 4 h): after this, the watcher EXITS
+  instead of launching a multi-hour session — the driver's own
+  end-of-round bench must find the chip free, and a mid-computation kill
+  can wedge the tunnel for everyone.
+- One successful session → commit ONCHIP.json → exit.
+- Probes are short subprocesses; the watcher itself never touches jax.
+
+Run detached:  nohup python scripts/tunnel_watch.py > /tmp/tunnel_watch.log 2>&1 &
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+ONCHIP = os.path.join(REPO, "ONCHIP.json")
+
+
+def probe(budget: int = 150) -> bool:
+    try:
+        p = subprocess.run(
+            [sys.executable, "-c",
+             "import jax, jax.numpy as jnp;"
+             "x = jnp.ones((256,256), jnp.bfloat16);"
+             "(x @ x).block_until_ready();"
+             "print('PROBE_OK', jax.default_backend())"],
+            capture_output=True, text=True, timeout=budget)
+    except subprocess.TimeoutExpired:
+        return False
+    if p.returncode != 0:
+        return False
+    return any(
+        ln.startswith("PROBE_OK") and not ln.rstrip().endswith(" cpu")
+        for ln in (p.stdout or "").splitlines())
+
+
+def commit_onchip() -> None:
+    try:
+        with open(ONCHIP) as f:
+            got = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        print("[watch] no readable ONCHIP.json to commit", flush=True)
+        return
+    n_metrics = sum(1 for v in got.values() if isinstance(v, (int, float)))
+    subprocess.run(["git", "add", "ONCHIP.json"], cwd=REPO)
+    subprocess.run(
+        ["git", "commit", "-m",
+         f"ONCHIP: on-chip session results ({n_metrics} numeric keys)"],
+        cwd=REPO)
+    print(f"[watch] committed ONCHIP.json ({n_metrics} numeric keys)",
+          flush=True)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--interval-s", type=int, default=300,
+                    help="seconds between probes while the tunnel is dead")
+    ap.add_argument("--launch-deadline-s", type=int, default=4 * 3600,
+                    help="stop launching sessions this long after start "
+                         "(leave the chip free for the driver's own bench)")
+    ap.add_argument("--session-budget-s", type=int, default=6 * 3600,
+                    help="hard cap on one onchip_session run")
+    args = ap.parse_args()
+
+    deadline = time.time() + args.launch_deadline_s
+    n = 0
+    while time.time() < deadline:
+        n += 1
+        if probe():
+            print(f"[watch] probe {n}: ALIVE — launching onchip_session",
+                  flush=True)
+            try:
+                subprocess.run(
+                    [sys.executable, os.path.join("scripts",
+                                                  "onchip_session.py")],
+                    cwd=REPO, timeout=args.session_budget_s)
+            except subprocess.TimeoutExpired:
+                print("[watch] onchip_session exceeded its budget",
+                      flush=True)
+            commit_onchip()
+            return 0
+        left = deadline - time.time()
+        print(f"[watch] probe {n}: dead ({left/60:.0f} min of launch "
+              f"window left)", flush=True)
+        time.sleep(min(args.interval_s, max(1.0, left)))
+    print("[watch] launch window closed — exiting (chip left free for "
+          "the driver)", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
