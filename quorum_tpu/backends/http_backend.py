@@ -49,14 +49,17 @@ class HttpBackend:
     def _endpoint(self) -> str:
         return f"{self.url}/chat/completions"
 
-    async def complete(
-        self, body: dict[str, Any], headers: dict[str, str], timeout: float
+    async def _post_json(
+        self, endpoint: str, req_body: dict[str, Any],
+        headers: dict[str, str], timeout: float,
     ) -> CompletionResult:
-        req_body = prepare_body(body, self.model)
-        req_body["stream"] = False
+        """POST + the shared error-normalization/tagging contract: transport
+        failures → 500 proxy_error, invalid/non-object JSON → error body
+        with the upstream status, successful JSON tagged with the backend
+        name (oai_proxy.py:212)."""
         try:
             resp = await self._client.post(
-                self._endpoint,
+                endpoint,
                 json=req_body,
                 headers=_clean_headers(headers),
                 timeout=timeout,
@@ -73,7 +76,6 @@ class HttpBackend:
                 f"Invalid JSON from backend {self.name}", code=resp.status_code or 500
             )
         if isinstance(parsed, dict):
-            # Parity: tag successful JSON with the backend name (oai_proxy.py:212).
             parsed.setdefault("backend", self.name)
         else:
             parsed = oai.error_body(
@@ -85,6 +87,23 @@ class HttpBackend:
             body=parsed,
             headers=dict(resp.headers),
         )
+
+    async def complete(
+        self, body: dict[str, Any], headers: dict[str, str], timeout: float
+    ) -> CompletionResult:
+        req_body = prepare_body(body, self.model)
+        req_body["stream"] = False
+        return await self._post_json(self._endpoint, req_body, headers, timeout)
+
+    async def embed(
+        self, body: dict[str, Any], headers: dict[str, str], timeout: float
+    ) -> CompletionResult:
+        """Relay ``/embeddings`` upstream (same model-override precedence and
+        error normalization as :meth:`complete`; the endpoint is the only
+        difference)."""
+        req_body = prepare_body(body, self.model)
+        return await self._post_json(
+            f"{self.url}/embeddings", req_body, headers, timeout)
 
     async def stream(
         self, body: dict[str, Any], headers: dict[str, str], timeout: float

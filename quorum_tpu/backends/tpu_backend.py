@@ -93,6 +93,8 @@ import math
 import threading
 from typing import Any, AsyncIterator
 
+import numpy as np
+
 from quorum_tpu import oai
 from quorum_tpu.backends.base import BackendError, CompletionResult, prepare_body
 from quorum_tpu.config import BackendSpec
@@ -646,6 +648,106 @@ class TpuBackend:
         resp["choices"] = choices
         resp["backend"] = self.name
         return CompletionResult(backend_name=self.name, status_code=200, body=resp)
+
+    async def embed(
+        self, body: dict[str, Any], headers: dict[str, str], timeout: float
+    ) -> CompletionResult:
+        """OpenAI ``/embeddings`` from the engine's resident weights.
+
+        ``input`` accepts a string, a list of strings, one pre-tokenized id
+        list, or a list of id lists (the OpenAI schema); mixed lists, empty
+        input, out-of-vocab ids, >64 items, a non-float/base64
+        ``encoding_format``, or ``dimensions`` outside 1..d_model are 400s.
+        Vectors are mean-pooled final-norm hidden states, L2-normalized;
+        ``dimensions`` truncates then renormalizes (OpenAI matryoshka
+        semantics); inputs beyond ``max_seq`` keep their head. See
+        quorum_tpu/engine/embed.py for the device path.
+        """
+        import base64
+
+        from quorum_tpu.engine.embed import MAX_BATCH, embed_token_batch
+
+        effective = prepare_body(body, self.model)  # 400 when no model anywhere
+        raw = body.get("input")
+        if isinstance(raw, str):
+            if not raw:
+                raise _invalid_request("'input' must not be an empty string")
+            items: list[Any] = [raw]
+        elif isinstance(raw, list) and raw and all(
+                isinstance(x, int) and not isinstance(x, bool) for x in raw):
+            items = [raw]  # one pre-tokenized input
+        elif isinstance(raw, list) and raw:
+            items = raw
+        else:
+            raise _invalid_request(
+                "'input' must be a non-empty string, list of strings, or "
+                "token array(s)")
+        if len(items) > MAX_BATCH:
+            raise _invalid_request(
+                f"at most {MAX_BATCH} inputs per embeddings request")
+        vocab = self.engine.spec.vocab_size
+        token_lists: list[list[int]] = []
+        for x in items:
+            if isinstance(x, str) and x:
+                token_lists.append(self.tokenizer.encode(x))
+            elif isinstance(x, list) and x and all(
+                    isinstance(t, int) and not isinstance(t, bool)
+                    and 0 <= t < vocab for t in x):
+                token_lists.append(x)
+            else:
+                raise _invalid_request(
+                    "each 'input' item must be a string or a non-empty list "
+                    "of in-vocab token ids")
+        fmt = body.get("encoding_format", "float")
+        if fmt not in ("float", "base64"):
+            raise _invalid_request(
+                "'encoding_format' must be 'float' or 'base64'")
+        d_model = self.engine.spec.d_model
+        dims = body.get("dimensions", d_model)
+        if (not isinstance(dims, int) or isinstance(dims, bool)
+                or not 1 <= dims <= d_model):
+            raise _invalid_request(
+                f"'dimensions' must be an integer in 1..{d_model}")
+
+        def run():
+            return embed_token_batch(self.engine, token_lists,
+                                     member=self.member)
+
+        try:
+            vectors = await asyncio.wait_for(
+                asyncio.to_thread(run), timeout=timeout)
+        except asyncio.TimeoutError:
+            raise BackendError(
+                f"Backend {self.name} timed out after {timeout}s") from None
+        except BackendError:
+            raise
+        except Exception as e:
+            logger.exception("TPU backend %s embeddings failed", self.name)
+            raise BackendError(f"Backend {self.name} failed: {e}") from e
+
+        if dims < d_model:
+            vectors = vectors[:, :dims]
+            norms = np.linalg.norm(vectors, axis=-1, keepdims=True)
+            vectors = vectors / np.maximum(norms, 1e-9)
+        data = []
+        for i, v in enumerate(vectors):
+            if fmt == "base64":
+                emb: Any = base64.b64encode(
+                    v.astype("<f4").tobytes()).decode("ascii")
+            else:
+                emb = v.tolist()
+            data.append({"object": "embedding", "index": i, "embedding": emb})
+        n_tokens = sum(min(len(t), self.engine.spec.max_seq)
+                       for t in token_lists)
+        resp = {
+            "object": "list",
+            "data": data,
+            "model": effective.get("model") or self.model,
+            "usage": {"prompt_tokens": n_tokens, "total_tokens": n_tokens},
+            "backend": self.name,
+        }
+        return CompletionResult(
+            backend_name=self.name, status_code=200, body=resp)
 
     async def stream(
         self, body: dict[str, Any], headers: dict[str, str], timeout: float

@@ -714,7 +714,7 @@ def _layer_body(carry_x, block, spec: ModelSpec, positions, cos, sin, attn_fn,
 
 
 def _scan_layers(params, spec: ModelSpec, tokens, attn_fn, remat: bool,
-                 lengths=None):
+                 lengths=None, unembed: bool = True):
     b, t = tokens.shape
     positions = jnp.arange(t)
     x = _embed(params, spec, tokens, positions)
@@ -730,7 +730,7 @@ def _scan_layers(params, spec: ModelSpec, tokens, attn_fn, remat: bool,
         body = jax.checkpoint(body)
     x, _ = lax.scan(body, x, params["blocks"])
     x = _final_norm(params, spec, x)
-    return _unembed(params, spec, x)
+    return _unembed(params, spec, x) if unembed else x
 
 
 def forward_logits(
@@ -746,6 +746,28 @@ def forward_logits(
                        window=spec.sliding_window)
     return _scan_layers(
         params, spec, tokens, lambda q, k, v: attention(q, k, v, mask), remat
+    )
+
+
+def forward_hidden(
+    params: Params,
+    spec: ModelSpec,
+    tokens: jnp.ndarray,   # [B, T]
+    lengths: jnp.ndarray | None = None,  # [B] — gates MoE capacity for pads
+) -> jnp.ndarray:
+    """Final-norm hidden states [B, T, D] — the embeddings forward.
+
+    Same scanned body as :func:`forward_logits` minus the unembed matmul
+    (a [T, D]·[D, V] save — at 128k vocab the unembed dwarfs the pooled
+    read the embeddings path actually needs). Causal attention means a
+    valid position's state never depends on the right-padding behind it;
+    the caller masks pads out of its pooling instead.
+    """
+    mask = causal_mask(tokens.shape[1], tokens.shape[1],
+                       window=spec.sliding_window)
+    return _scan_layers(
+        params, spec, tokens, lambda q, k, v: attention(q, k, v, mask),
+        remat=False, lengths=lengths, unembed=False,
     )
 
 

@@ -385,6 +385,56 @@ def create_app(
         }
         return JSONResponse(first.result.body, status_code=first.result.status_code, headers=resp_headers)
 
+    @app.route("POST", "/embeddings", "/v1/embeddings")
+    async def embeddings(request: Request) -> Response:
+        """OpenAI embeddings surface, served from the chat models' resident
+        weights (quorum_tpu/engine/embed.py) or relayed to an ``http(s)://``
+        upstream. NOT a fan-out: one embedding space per response is the
+        only coherent contract, so the request routes to a single backend —
+        the one whose configured model matches the request model, else the
+        first embeddings-capable backend in config order. (Beyond
+        reference: it serves only /chat/completions and /health.)"""
+        cfg, reg = await current()
+        try:
+            body = await request.json()
+            if not isinstance(body, dict):
+                raise ValueError("request body must be a JSON object")
+        except Exception as e:
+            return JSONResponse(
+                {"error": {"message": f"Invalid JSON body: {e}",
+                           "type": "invalid_request_error"}},
+                status_code=400,
+            )
+        headers = _resolve_headers(request.headers)
+        if headers is None:
+            return _auth_error()
+        candidates = [b for b in reg.backends if hasattr(b, "embed")]
+        if not candidates:
+            return JSONResponse(
+                {"error": {"message": "No backend supports embeddings",
+                           "type": "configuration_error"}},
+                status_code=500,
+            )
+        req_model = body.get("model")
+        target = next(
+            (b for b in candidates if req_model and b.model == req_model),
+            candidates[0])
+        try:
+            result = await target.embed(body, headers, cfg.timeout)
+        except BackendError as e:
+            # Typed client errors keep their body verbatim (the same error
+            # contract as chat — docs/api.md error table).
+            err = e.body.get("error")
+            if isinstance(err, dict) and err.get("type") not in (None, "proxy_error"):
+                return JSONResponse(e.body, status_code=e.status_code)
+            msg = err.get("message", str(e)) if isinstance(err, dict) else str(e)
+            return JSONResponse(
+                {"error": {"message": f"Backend failed: {msg}",
+                           "type": "proxy_error"}},
+                status_code=e.status_code,
+            )
+        return JSONResponse(result.body, status_code=result.status_code)
+
     async def _single_stream(
         backend: Backend, body: dict[str, Any], headers: dict[str, str], timeout: float
     ) -> Response:

@@ -192,4 +192,10 @@ class App:
                     {"error": {"message": f"Error processing request: {e}", "type": "proxy_error"}},
                     status_code=500,
                 )
+        # Every response carries a request id (docs/api.md, api/openapi.yaml);
+        # the chat handler sets its own richer id first — setdefault keeps it.
+        import uuid
+
+        response.headers.setdefault("X-Request-Id",
+                                    f"req-{uuid.uuid4().hex[:16]}")
         await response(scope, receive, send)

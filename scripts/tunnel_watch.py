@@ -30,40 +30,56 @@ import time
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 ONCHIP = os.path.join(REPO, "ONCHIP.json")
 
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+# One probe discipline for the whole toolchain (bench._probe_device pinned
+# it in round 3; onchip_session carries the same helper) — a watcher with
+# its own copy could disagree with the session about tunnel liveness.
+from onchip_session import probe  # noqa: E402
 
-def probe(budget: int = 150) -> bool:
+
+def _mtime(path: str) -> float:
     try:
-        p = subprocess.run(
-            [sys.executable, "-c",
-             "import jax, jax.numpy as jnp;"
-             "x = jnp.ones((256,256), jnp.bfloat16);"
-             "(x @ x).block_until_ready();"
-             "print('PROBE_OK', jax.default_backend())"],
-            capture_output=True, text=True, timeout=budget)
-    except subprocess.TimeoutExpired:
-        return False
-    if p.returncode != 0:
-        return False
-    return any(
-        ln.startswith("PROBE_OK") and not ln.rstrip().endswith(" cpu")
-        for ln in (p.stdout or "").splitlines())
+        return os.stat(path).st_mtime
+    except OSError:
+        return 0.0
 
 
-def commit_onchip() -> None:
+def commit_onchip(started_after: float) -> bool:
+    """Commit ONCHIP.json iff THIS session refreshed it; honest rc checks.
+
+    ``started_after``: the artifact's mtime before the session — an
+    unchanged file means the session died before banking anything, and a
+    stale artifact from an earlier run must not be committed under a
+    message claiming fresh results."""
+    if _mtime(ONCHIP) <= started_after:
+        print("[watch] session banked nothing new — not committing",
+              flush=True)
+        return False
     try:
         with open(ONCHIP) as f:
             got = json.load(f)
     except (OSError, json.JSONDecodeError):
         print("[watch] no readable ONCHIP.json to commit", flush=True)
-        return
-    n_metrics = sum(1 for v in got.values() if isinstance(v, (int, float)))
-    subprocess.run(["git", "add", "ONCHIP.json"], cwd=REPO)
-    subprocess.run(
+        return False
+    n_metrics = sum(
+        1 for k, v in got.items()
+        if isinstance(v, (int, float)) and not isinstance(v, bool)
+        and k not in ("ts", "onchip_started_ts"))
+    if n_metrics == 0:
+        # A dead-at-start session banks only an error record + timestamps;
+        # committing that as "results" would be dishonest.
+        print("[watch] artifact has no measurements — not committing",
+              flush=True)
+        return False
+    add = subprocess.run(["git", "add", "ONCHIP.json"], cwd=REPO)
+    commit = subprocess.run(
         ["git", "commit", "-m",
          f"ONCHIP: on-chip session results ({n_metrics} numeric keys)"],
         cwd=REPO)
-    print(f"[watch] committed ONCHIP.json ({n_metrics} numeric keys)",
-          flush=True)
+    ok = add.returncode == 0 and commit.returncode == 0
+    print(f"[watch] commit of ONCHIP.json ({n_metrics} numeric keys): "
+          f"{'ok' if ok else 'FAILED'}", flush=True)
+    return ok
 
 
 def main() -> int:
@@ -84,16 +100,25 @@ def main() -> int:
         if probe():
             print(f"[watch] probe {n}: ALIVE — launching onchip_session",
                   flush=True)
+            before = _mtime(ONCHIP)
+            rc = None
             try:
-                subprocess.run(
+                rc = subprocess.run(
                     [sys.executable, os.path.join("scripts",
                                                   "onchip_session.py")],
-                    cwd=REPO, timeout=args.session_budget_s)
+                    cwd=REPO, timeout=args.session_budget_s).returncode
             except subprocess.TimeoutExpired:
                 print("[watch] onchip_session exceeded its budget",
                       flush=True)
-            commit_onchip()
-            return 0
+            committed = commit_onchip(started_after=before)
+            if committed:
+                return 0
+            if rc == 3:
+                # Tunnel died again at session start — keep watching.
+                print("[watch] session found the tunnel dead; resuming "
+                      "the probe loop", flush=True)
+                continue
+            return 1
         left = deadline - time.time()
         print(f"[watch] probe {n}: dead ({left/60:.0f} min of launch "
               f"window left)", flush=True)

@@ -51,7 +51,7 @@ def test_document_paths_match_served_routes():
     """The doc's path set IS the served surface (each under both the ""
     and "/v1" servers — app.py registers both prefixes)."""
     assert set(DOC["paths"]) == {
-        "/chat/completions", "/health", "/models", "/metrics"}
+        "/chat/completions", "/embeddings", "/health", "/models", "/metrics"}
     assert [s["url"] for s in DOC["servers"]] == ["/", "/v1"]
     post = DOC["paths"]["/chat/completions"]["post"]
     assert set(post["responses"]) == {"200", "400", "401", "500", "503"}
@@ -115,6 +115,23 @@ async def test_live_stream_frames_conform():
     assert frames, "no SSE frames"
     for frame in frames:
         check("CreateChatCompletionStreamResponse", frame)
+
+
+async def test_live_embeddings_conform():
+    async with make_client(single_backend_config()) as client:
+        resp = await client.post(
+            "/v1/embeddings",
+            json={"model": "tiny", "input": ["conformance", "probe"]},
+            headers={"Authorization": "Bearer t"})
+        assert resp.status_code == 200, resp.text
+        check("CreateEmbeddingResponse", resp.json())
+        bad = await client.post(
+            "/v1/embeddings", json={"model": "tiny", "input": []},
+            headers={"Authorization": "Bearer t"})
+        assert bad.status_code == 400
+        check("ErrorResponse", bad.json())
+    check("CreateEmbeddingRequest",
+          {"input": "x", "encoding_format": "base64", "dimensions": 16})
 
 
 async def test_live_aux_endpoints_conform():
