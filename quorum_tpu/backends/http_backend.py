@@ -105,6 +105,15 @@ class HttpBackend:
         return await self._post_json(
             f"{self.url}/embeddings", req_body, headers, timeout)
 
+    async def text_complete(
+        self, body: dict[str, Any], headers: dict[str, str], timeout: float
+    ) -> CompletionResult:
+        """Relay legacy ``/completions`` upstream (non-streaming)."""
+        req_body = prepare_body(body, self.model)
+        req_body["stream"] = False
+        return await self._post_json(
+            f"{self.url}/completions", req_body, headers, timeout)
+
     async def stream(
         self, body: dict[str, Any], headers: dict[str, str], timeout: float
     ) -> AsyncIterator[dict[str, Any]]:

@@ -156,6 +156,18 @@ def _request_number(body: dict[str, Any], key: str, default: float) -> float:
     return out
 
 
+def _top_dict(pairs) -> dict[str, float]:
+    """Legacy ``top_logprobs`` dict keyed by token TEXT: distinct ids can
+    decode to the same text (byte tokens inside a multi-byte character all
+    render the replacement char) — the first (highest, top_k order) logprob
+    wins rather than a later one silently overwriting it."""
+    out: dict[str, float] = {}
+    for text, lp in pairs:
+        if text not in out:
+            out[text] = float(lp)
+    return out
+
+
 def _invalid_request(message: str) -> BackendError:
     return BackendError(
         message,
@@ -420,9 +432,24 @@ class TpuBackend:
                     f"Invalid value for {key!r}: {val!r} (must be in [-2, 2])"
                 )
         # Tokenizer-aware templating: an instruct checkpoint's own chat
-        # template when present, the static fallback otherwise.
-        prompt = self.tokenizer.render_chat(body.get("messages") or [])
-        ids = self.tokenizer.encode(prompt)
+        # template when present, the static fallback otherwise. The legacy
+        # /completions path supplies raw prompt ids instead (no template —
+        # the prompt IS the context, _raw_prompt_ids is set internally by
+        # text_complete/its streaming twin and validated like any
+        # pre-tokenized input).
+        raw_ids = body.get("_raw_prompt_ids")
+        if raw_ids is not None:
+            vocab = self.engine.spec.vocab_size
+            if not (isinstance(raw_ids, list) and raw_ids and all(
+                    isinstance(t, int) and not isinstance(t, bool)
+                    and 0 <= t < vocab for t in raw_ids)):
+                raise _invalid_request(
+                    "prompt token ids must be a non-empty list of in-vocab "
+                    "integers")
+            ids = list(raw_ids)
+        else:
+            prompt = self.tokenizer.render_chat(body.get("messages") or [])
+            ids = self.tokenizer.encode(prompt)
         key = (
             "max_completion_tokens"
             if body.get("max_completion_tokens") is not None
@@ -744,6 +771,266 @@ class TpuBackend:
             "data": data,
             "model": effective.get("model") or self.model,
             "usage": {"prompt_tokens": n_tokens, "total_tokens": n_tokens},
+            "backend": self.name,
+        }
+        return CompletionResult(
+            backend_name=self.name, status_code=200, body=resp)
+
+    def _parse_prompts(self, raw: Any) -> list[tuple[str, list[int]]]:
+        """The /completions ``prompt`` field → [(text, token_ids)] — same
+        shape grammar as embeddings ``input`` (string / string list / one
+        id list / list of id lists); pre-tokenized prompts get their text
+        from the tokenizer so ``echo`` always has something to echo."""
+        if isinstance(raw, str):
+            if not raw:
+                raise _invalid_request("'prompt' must not be an empty string")
+            items: list[Any] = [raw]
+        elif isinstance(raw, list) and raw and all(
+                isinstance(x, int) and not isinstance(x, bool) for x in raw):
+            items = [raw]
+        elif isinstance(raw, list) and raw:
+            items = raw
+        else:
+            raise _invalid_request(
+                "'prompt' must be a non-empty string, list of strings, or "
+                "token array(s)")
+        vocab = self.engine.spec.vocab_size
+        prompts: list[tuple[str, list[int]]] = []
+        for x in items:
+            if isinstance(x, str) and x:
+                prompts.append((x, self.tokenizer.encode(x)))
+            elif isinstance(x, list) and x and all(
+                    isinstance(t, int) and not isinstance(t, bool)
+                    and 0 <= t < vocab for t in x):
+                prompts.append((self.tokenizer.decode(x), list(x)))
+            else:
+                raise _invalid_request(
+                    "each 'prompt' item must be a string or a non-empty "
+                    "list of in-vocab token ids")
+        return prompts
+
+    @staticmethod
+    def _parse_completions_logprobs(body: dict[str, Any]) -> "int | None":
+        lp = body.get("logprobs")
+        if lp is None or lp is False:
+            return None
+        if lp is True:  # chat-style boolean → "just the chosen token"
+            return 0
+        if not isinstance(lp, int) or isinstance(lp, bool) or not 0 <= lp <= 5:
+            raise _invalid_request(
+                f"Invalid value for 'logprobs': {lp!r} (must be an integer "
+                "in [0, 5])")
+        return lp
+
+    async def text_complete(
+        self, body: dict[str, Any], headers: dict[str, str], timeout: float
+    ) -> CompletionResult:
+        """Legacy OpenAI ``/completions``: raw-prompt generation and
+        teacher-forced scoring from the same resident weights.
+
+        The scoring contract eval harnesses rely on: ``echo=true`` with
+        ``logprobs=k`` returns every PROMPT token's logprob (first token
+        ``null``) computed in one forward (engine/score.py);
+        ``max_tokens=0`` is allowed exactly in that mode (pure scoring).
+        Generation reuses the chat engine machinery over raw prompt ids
+        (no chat template), with the full sampler/stop/penalty knob set.
+        Up to 8 prompts when generating (one engine slot each), 64 when
+        scoring only; ``n`` > 1 is rejected (send a prompt list instead);
+        ``best_of``/``suffix`` are unsupported on tpu:// backends (400).
+        """
+        import uuid
+
+        from quorum_tpu.engine.embed import MAX_BATCH
+        from quorum_tpu.engine.score import score_token_batch
+
+        effective = prepare_body(body, self.model)
+        # best_of=1 is the documented OpenAI default (a no-op) — only the
+        # actual search semantics are unsupported.
+        if body.get("best_of") not in (None, 1):
+            raise _invalid_request(
+                "'best_of' is not supported by tpu:// backends")
+        if body.get("suffix"):
+            raise _invalid_request(
+                "'suffix' is not supported by tpu:// backends")
+        prompts = self._parse_prompts(body.get("prompt"))
+        echo = bool(body.get("echo", False))
+        lp = self._parse_completions_logprobs(body)
+        n = body.get("n")
+        if n not in (None, 1):
+            raise _invalid_request(
+                "'n' > 1 is not supported on /completions — send a list of "
+                "prompts instead")
+        mt = body.get("max_tokens")
+        if mt is None:
+            mt = 16  # the documented OpenAI default for /completions
+        if not isinstance(mt, int) or isinstance(mt, bool) or mt < 0:
+            raise _invalid_request(
+                f"Invalid value for 'max_tokens': {mt!r} (integer >= 0)")
+        scoring = echo and lp is not None
+        if mt == 0 and not scoring:
+            raise _invalid_request(
+                "'max_tokens': 0 requires 'echo': true with 'logprobs' set "
+                "(the pure scoring mode)")
+        max_seq = self.engine.spec.max_seq
+        if scoring:
+            too_long = max(len(ids) for _, ids in prompts)
+            if too_long > max_seq:
+                raise _invalid_request(
+                    f"prompt of {too_long} tokens exceeds max_seq={max_seq} "
+                    "— a truncated prompt cannot be scored faithfully")
+            if len(prompts) > MAX_BATCH:
+                raise _invalid_request(
+                    f"at most {MAX_BATCH} prompts per scoring request")
+        if mt >= 1 and len(prompts) > self.MAX_N:
+            raise _invalid_request(
+                f"at most {self.MAX_N} prompts per generation request")
+
+        # One deadline across both phases: echo+logprobs with generation
+        # runs a scoring forward AND a decode — sequential full budgets
+        # would let the request take 2x the configured timeout.
+        import time as _time
+
+        deadline = _time.monotonic() + timeout
+
+        scores = None
+        if scoring:
+            def run_score():
+                return score_token_batch(
+                    self.engine, [ids for _, ids in prompts],
+                    member=self.member, top_k=lp)
+
+            try:
+                scores = await asyncio.wait_for(
+                    asyncio.to_thread(run_score),
+                    timeout=max(0.0, deadline - _time.monotonic()))
+            except asyncio.TimeoutError:
+                raise BackendError(
+                    f"Backend {self.name} timed out after {timeout}s"
+                ) from None
+            except BackendError:
+                raise
+            except Exception as e:
+                logger.exception("TPU backend %s scoring failed", self.name)
+                raise BackendError(
+                    f"Backend {self.name} failed: {e}") from e
+
+        outs: list = []
+        if mt >= 1:
+            plan_body = {k: v for k, v in body.items()
+                         if k not in ("prompt", "echo", "logprobs",
+                                      "stream", "max_tokens",
+                                      "max_completion_tokens")}
+            plan_body["max_tokens"] = mt
+            if lp is not None:
+                plan_body["logprobs"] = True
+                plan_body["top_logprobs"] = lp
+            plans = []
+            for _, ids in prompts:
+                pb = dict(plan_body)
+                pb["_raw_prompt_ids"] = ids
+                plans.append(self._plan(pb))
+            cancels = [threading.Event() for _ in plans]
+
+            def cancel_all():
+                for c in cancels:
+                    c.set()
+
+            try:
+                reqs = [self._submit_choice(plans[i], 0, cancels[i])
+                        for i in range(len(plans))]
+            except QueueFullError:
+                cancel_all()
+                raise _overloaded(self.name) from None
+
+            def run():
+                return [self._consume(plans[i], r)
+                        for i, r in enumerate(reqs)]
+
+            task = asyncio.create_task(asyncio.to_thread(run))
+            task.add_done_callback(lambda t: t.cancelled() or t.exception())
+            try:
+                outs = await asyncio.wait_for(
+                    asyncio.shield(task),
+                    timeout=max(0.0, deadline - _time.monotonic()))
+            except asyncio.TimeoutError:
+                cancel_all()
+                raise BackendError(
+                    f"Backend {self.name} timed out after {timeout}s"
+                ) from None
+            except BackendError:
+                raise
+            except Exception as e:
+                cancel_all()
+                logger.exception("TPU backend %s failed", self.name)
+                raise BackendError(f"Backend {self.name} failed: {e}") from e
+            except BaseException:
+                cancel_all()
+                raise
+
+        choices = []
+        total_completion = 0
+        for i, (text, ids) in enumerate(prompts):
+            gen_text, finish, lp_content = "", "length", None
+            if outs:
+                result, gen_text, lp_content = outs[i]
+                finish = result.finish_reason
+                total_completion += result.completion_tokens
+            choice: dict[str, Any] = {
+                "index": i,
+                "text": (text + gen_text) if echo else gen_text,
+                "finish_reason": finish,
+            }
+            if lp is not None:
+                tokens: list[str] = []
+                token_lps: list = []
+                tops: list = []
+                offsets: list[int] = []
+                pos = 0
+                if echo:
+                    score = scores[i]
+                    top = score.get("top")
+                    for j, tid in enumerate(ids):
+                        ttext = self.tokenizer.decode([int(tid)])
+                        tokens.append(ttext)
+                        offsets.append(pos)
+                        pos += len(ttext)
+                        token_lps.append(score["token_logprobs"][j])
+                        if j == 0:
+                            tops.append(None)  # no prefix → nothing to rank
+                        elif top is not None:
+                            t_ids, t_lps = top[j]
+                            tops.append(_top_dict(
+                                (self.tokenizer.decode([int(t)]), float(l))
+                                for t, l in zip(t_ids, t_lps)))
+                        else:
+                            tops.append({})
+                if lp_content:
+                    for e in lp_content:
+                        tokens.append(e["token"])
+                        offsets.append(pos)
+                        pos += len(e["token"])
+                        token_lps.append(e["logprob"])
+                        tops.append(_top_dict(
+                            (t["token"], t["logprob"])
+                            for t in e.get("top_logprobs", [])))
+                choice["logprobs"] = {
+                    "tokens": tokens,
+                    "token_logprobs": token_lps,
+                    "top_logprobs": tops,
+                    "text_offset": offsets,
+                }
+            else:
+                choice["logprobs"] = None
+            choices.append(choice)
+
+        resp = {
+            "id": f"cmpl-{uuid.uuid4().hex[:24]}",
+            "object": "text_completion",
+            "created": oai.now(),
+            "model": effective["model"],
+            "choices": choices,
+            "usage": self._usage(
+                sum(len(ids) for _, ids in prompts), total_completion),
             "backend": self.name,
         }
         return CompletionResult(

@@ -273,6 +273,9 @@ def create_app(
                 {"error": {"message": f"Invalid JSON body: {e}", "type": "invalid_request_error"}},
                 status_code=400,
             )
+        # Internal plan field (the /completions raw-prompt path) — never
+        # accepted from the wire: it would bypass chat templating.
+        body.pop("_raw_prompt_ids", None)
 
         headers = _resolve_headers(request.headers)
         if headers is None:
@@ -385,15 +388,27 @@ def create_app(
         }
         return JSONResponse(first.result.body, status_code=first.result.status_code, headers=resp_headers)
 
-    @app.route("POST", "/embeddings", "/v1/embeddings")
-    async def embeddings(request: Request) -> Response:
-        """OpenAI embeddings surface, served from the chat models' resident
-        weights (quorum_tpu/engine/embed.py) or relayed to an ``http(s)://``
-        upstream. NOT a fan-out: one embedding space per response is the
-        only coherent contract, so the request routes to a single backend —
-        the one whose configured model matches the request model, else the
-        first embeddings-capable backend in config order. (Beyond
-        reference: it serves only /chat/completions and /health.)"""
+    def _relay_backend_error(e: BackendError) -> Response:
+        """Typed client errors keep their body verbatim; everything else
+        normalizes to proxy_error (the chat error contract — docs/api.md)."""
+        err = e.body.get("error")
+        if isinstance(err, dict) and err.get("type") not in (None, "proxy_error"):
+            return JSONResponse(e.body, status_code=e.status_code)
+        msg = err.get("message", str(e)) if isinstance(err, dict) else str(e)
+        return JSONResponse(
+            {"error": {"message": f"Backend failed: {msg}",
+                       "type": "proxy_error"}},
+            status_code=e.status_code,
+        )
+
+    async def _single_backend_request(
+        request: Request, capability: str, what: str
+    ):
+        """Shared preamble for the no-fan-out endpoints (/embeddings,
+        /completions): parse + auth, strip internal-only fields, pick the
+        single target — the backend whose configured model matches the
+        request model, else the first capable one in config order. Returns
+        ``(cfg, body, headers, target)`` or an error Response."""
         cfg, reg = await current()
         try:
             body = await request.json()
@@ -405,13 +420,16 @@ def create_app(
                            "type": "invalid_request_error"}},
                 status_code=400,
             )
+        # Internal plan field (raw-prompt path) — never accepted from the
+        # wire, or a client could bypass chat templating with it.
+        body.pop("_raw_prompt_ids", None)
         headers = _resolve_headers(request.headers)
         if headers is None:
             return _auth_error()
-        candidates = [b for b in reg.backends if hasattr(b, "embed")]
+        candidates = [b for b in reg.backends if hasattr(b, capability)]
         if not candidates:
             return JSONResponse(
-                {"error": {"message": "No backend supports embeddings",
+                {"error": {"message": f"No backend supports {what}",
                            "type": "configuration_error"}},
                 status_code=500,
             )
@@ -419,21 +437,148 @@ def create_app(
         target = next(
             (b for b in candidates if req_model and b.model == req_model),
             candidates[0])
+        return (cfg, body, headers, target)
+
+    @app.route("POST", "/embeddings", "/v1/embeddings")
+    async def embeddings(request: Request) -> Response:
+        """OpenAI embeddings surface, served from the chat models' resident
+        weights (quorum_tpu/engine/embed.py) or relayed to an ``http(s)://``
+        upstream. NOT a fan-out: one embedding space per response is the
+        only coherent contract. (Beyond reference: it serves only
+        /chat/completions and /health.)"""
+        got = await _single_backend_request(request, "embed", "embeddings")
+        if isinstance(got, Response):
+            return got
+        cfg, body, headers, target = got
         try:
             result = await target.embed(body, headers, cfg.timeout)
         except BackendError as e:
-            # Typed client errors keep their body verbatim (the same error
-            # contract as chat — docs/api.md error table).
-            err = e.body.get("error")
-            if isinstance(err, dict) and err.get("type") not in (None, "proxy_error"):
-                return JSONResponse(e.body, status_code=e.status_code)
-            msg = err.get("message", str(e)) if isinstance(err, dict) else str(e)
-            return JSONResponse(
-                {"error": {"message": f"Backend failed: {msg}",
-                           "type": "proxy_error"}},
-                status_code=e.status_code,
-            )
+            return _relay_backend_error(e)
         return JSONResponse(result.body, status_code=result.status_code)
+
+    @app.route("POST", "/completions", "/v1/completions")
+    async def completions(request: Request) -> Response:
+        """Legacy OpenAI text completions (beyond reference): raw-prompt
+        generation plus the ``echo+logprobs`` teacher-forced scoring mode
+        eval harnesses use. Routes like /embeddings — one backend, no
+        fan-out. Streaming is supported on ``tpu://`` backends for a single
+        prompt without echo/logprobs; ``http(s)://`` backends relay
+        non-streaming only."""
+        got = await _single_backend_request(
+            request, "text_complete", "/completions")
+        if isinstance(got, Response):
+            return got
+        cfg, body, headers, target = got
+
+        if body.get("stream"):
+            if not hasattr(target, "_parse_prompts"):
+                return JSONResponse(
+                    {"error": {"message": "streaming /completions is only "
+                               "served by tpu:// backends",
+                               "type": "invalid_request_error"}},
+                    status_code=400,
+                )
+            # The non-streaming validations must hold here too — the chat
+            # stream machinery would otherwise accept n>1 and interleave
+            # two completions into one index-0 text stream.
+            bad = None
+            if body.get("echo") or body.get("logprobs") is not None:
+                bad = ("'echo'/'logprobs' are not supported with 'stream' "
+                       "on /completions")
+            elif body.get("n") not in (None, 1):
+                bad = ("'n' > 1 is not supported on /completions — send a "
+                       "list of prompts instead")
+            elif body.get("best_of") not in (None, 1):
+                bad = "'best_of' is not supported by tpu:// backends"
+            elif body.get("suffix"):
+                bad = "'suffix' is not supported by tpu:// backends"
+            if bad is not None:
+                return JSONResponse(
+                    {"error": {"message": bad,
+                               "type": "invalid_request_error"}},
+                    status_code=400,
+                )
+            try:
+                prompts = target._parse_prompts(body.get("prompt"))
+            except BackendError as e:
+                return _relay_backend_error(e)
+            if len(prompts) != 1:
+                return JSONResponse(
+                    {"error": {"message": "streaming /completions takes "
+                               "exactly one prompt",
+                               "type": "invalid_request_error"}},
+                    status_code=400,
+                )
+            sbody = {k: v for k, v in body.items()
+                     if k not in ("prompt", "echo", "logprobs", "stream",
+                                  "n", "best_of", "suffix")}
+            if ("max_tokens" not in sbody
+                    and "max_completion_tokens" not in sbody):
+                # The legacy default (16) — the chat plan would otherwise
+                # fall back to the backend's chat default and the same
+                # request would generate 4x more when streamed.
+                sbody["max_tokens"] = 16
+            sbody["_raw_prompt_ids"] = prompts[0][1]
+            model = body.get("model") or target.model or "unknown"
+            stream = target.stream(sbody, headers, cfg.timeout)
+            try:
+                first_chunk = await stream.__anext__()
+            except StopAsyncIteration:
+                first_chunk = None
+            except BackendError as e:
+                return _relay_backend_error(e)
+            return StreamingResponse(
+                _completions_stream(first_chunk, stream, model))
+
+        try:
+            result = await target.text_complete(body, headers, cfg.timeout)
+        except BackendError as e:
+            return _relay_backend_error(e)
+        return JSONResponse(result.body, status_code=result.status_code)
+
+    async def _completions_stream(
+        first_chunk: dict[str, Any] | None,
+        rest: AsyncIterator[dict[str, Any]],
+        model: str,
+    ) -> AsyncIterator[bytes]:
+        """chat.completion.chunk frames → text_completion SSE frames (the
+        legacy wire shape: choices[].text, no role/delta), [DONE]-terminated."""
+        cid = f"cmpl-{uuid.uuid4().hex[:24]}"
+        created = int(time.time())
+
+        def convert(chunk: dict[str, Any]) -> dict[str, Any] | None:
+            choice = (chunk.get("choices") or [{}])[0]
+            delta = choice.get("delta") or {}
+            content = delta.get("content")
+            finish = choice.get("finish_reason")
+            if content or finish:
+                return {"id": cid, "object": "text_completion",
+                        "created": created, "model": model,
+                        "choices": [{"index": 0, "text": content or "",
+                                     "logprobs": None,
+                                     "finish_reason": finish}]}
+            if chunk.get("usage") is not None and not chunk.get("choices"):
+                return {"id": cid, "object": "text_completion",
+                        "created": created, "model": model,
+                        "choices": [], "usage": chunk["usage"]}
+            return None  # role-only chunks have no legacy-wire analog
+
+        try:
+            for c in ([first_chunk] if first_chunk is not None else []):
+                out = convert(c)
+                if out is not None:
+                    yield sse.encode_event(out)
+            async for chunk in rest:
+                out = convert(chunk)
+                if out is not None:
+                    yield sse.encode_event(out)
+        except BackendError as e:
+            yield sse.encode_event(
+                {"id": cid, "object": "text_completion", "created": created,
+                 "model": model,
+                 "choices": [{"index": 0, "text": f"Backend failed: {e}",
+                              "logprobs": None, "finish_reason": "error"}]})
+        yield sse.encode_done()
 
     async def _single_stream(
         backend: Backend, body: dict[str, Any], headers: dict[str, str], timeout: float

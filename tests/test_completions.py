@@ -1,0 +1,266 @@
+"""Legacy OpenAI ``/completions``: raw-prompt generation + teacher-forced
+scoring (beyond reference — it proxies only /chat/completions).
+
+The scoring mode (``echo=true, logprobs=k, max_tokens=0``) is the contract
+eval harnesses use for perplexity; pins here cover its exactness properties
+(batch-of-one equals batched scoring, determinism, top-k containment), the
+legacy wire shape for generation and streaming, and the documented 400
+families.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from tests.conftest import make_client
+
+# Engine-scale / compile-heavy: slow tier (make test skips, make test-all
+# and CI run everything).
+pytestmark = pytest.mark.slow
+
+URL = "tpu://llama-tiny?seed=1&max_seq=256&slots=4&max_tokens=8"
+
+
+def cfg(url: str = URL, model: str = "tiny"):
+    return {
+        "settings": {"timeout": 300},
+        "primary_backends": [{"name": "C1", "url": url, "model": model}],
+    }
+
+
+async def post(client, body):
+    return await client.post("/v1/completions", json=body,
+                             headers={"Authorization": "Bearer t"})
+
+
+async def test_generation_wire_shape_and_determinism():
+    async with make_client(cfg()) as client:
+        body = {"model": "tiny", "prompt": "once upon a time",
+                "max_tokens": 8, "temperature": 0.0, "seed": 3}
+        r1 = await post(client, body)
+        assert r1.status_code == 200, r1.text
+        got = r1.json()
+        assert got["object"] == "text_completion"
+        assert got["id"].startswith("cmpl-")
+        assert got["backend"] == "C1" and got["model"] == "tiny"
+        (choice,) = got["choices"]
+        assert choice["index"] == 0 and choice["logprobs"] is None
+        assert choice["text"] and choice["finish_reason"] in ("stop", "length")
+        assert got["usage"]["completion_tokens"] >= 1
+        # byte tokenizer: one id per prompt byte, no specials added
+        assert got["usage"]["prompt_tokens"] == len("once upon a time")
+        r2 = await post(client, body)
+        assert r2.json()["choices"][0]["text"] == choice["text"]
+
+
+async def test_echo_prepends_prompt():
+    async with make_client(cfg()) as client:
+        got = (await post(client, {"prompt": "echo base", "echo": True,
+                                   "max_tokens": 4,
+                                   "temperature": 0.0})).json()
+        assert got["choices"][0]["text"].startswith("echo base")
+        assert len(got["choices"][0]["text"]) > len("echo base")
+
+
+async def test_scoring_mode_shape_and_batch_independence():
+    """max_tokens=0 + echo + logprobs: one logprob per prompt token (first
+    null), identical whether the prompt is scored alone or co-batched
+    beside a longer one, and identical across calls."""
+    async with make_client(cfg()) as client:
+        body = {"prompt": "anchor scoring text", "echo": True,
+                "logprobs": 0, "max_tokens": 0}
+        alone = (await post(client, body)).json()
+        (choice,) = alone["choices"]
+        lp = choice["logprobs"]
+        n_tok = alone["usage"]["prompt_tokens"]
+        assert alone["usage"]["completion_tokens"] == 0
+        assert len(lp["tokens"]) == len(lp["token_logprobs"]) == n_tok
+        assert lp["token_logprobs"][0] is None
+        assert all(isinstance(x, float) and x <= 0.0
+                   for x in lp["token_logprobs"][1:])
+        assert choice["text"] == "anchor scoring text"
+        assert lp["text_offset"][0] == 0
+        assert lp["text_offset"] == sorted(lp["text_offset"])
+
+        again = (await post(client, body)).json()
+        batched = (await post(client, {
+            "prompt": ["anchor scoring text",
+                       "a considerably longer companion prompt " * 4],
+            "echo": True, "logprobs": 0, "max_tokens": 0})).json()
+        a = alone["choices"][0]["logprobs"]["token_logprobs"][1:]
+        b = again["choices"][0]["logprobs"]["token_logprobs"][1:]
+        c = batched["choices"][0]["logprobs"]["token_logprobs"][1:]
+        np.testing.assert_array_equal(a, b)
+        np.testing.assert_allclose(a, c, atol=2e-4)
+        assert [ch["index"] for ch in batched["choices"]] == [0, 1]
+
+
+async def test_scoring_topk_contains_chosen_when_ranked():
+    """With logprobs=3, every scored position's top dict has 3 entries and
+    the actual token's logprob never beats the best alternative."""
+    async with make_client(cfg()) as client:
+        got = (await post(client, {"prompt": "ranking probe", "echo": True,
+                                   "logprobs": 3, "max_tokens": 0})).json()
+        lp = got["choices"][0]["logprobs"]
+        assert lp["top_logprobs"][0] is None
+        for actual, top in zip(lp["token_logprobs"][1:],
+                               lp["top_logprobs"][1:]):
+            # <= 3: distinct ids can decode to the same TEXT (bytes inside
+            # a multi-byte char all render the replacement char) and the
+            # legacy dict format can only carry one entry per text.
+            assert 1 <= len(top) <= 3
+            assert actual <= max(top.values()) + 1e-5
+
+
+async def test_generation_logprobs_align_with_text():
+    async with make_client(cfg()) as client:
+        got = (await post(client, {"prompt": "align me", "logprobs": 2,
+                                   "max_tokens": 6,
+                                   "temperature": 0.0})).json()
+        (choice,) = got["choices"]
+        lp = choice["logprobs"]
+        # Per-token decodes: a multi-byte char split across tokens renders
+        # replacement chars in tokens[] while the assembled text carries
+        # the real char (same convention as chat logprobs content[].token),
+        # so lengths/ordering are pinned rather than byte-exact joins.
+        assert len(lp["tokens"]) == got["usage"]["completion_tokens"]
+        assert len(lp["tokens"]) == len(lp["token_logprobs"]) == len(
+            lp["top_logprobs"]) == len(lp["text_offset"])
+        assert all(1 <= len(t) <= 2 for t in lp["top_logprobs"])
+        assert lp["text_offset"][0] == 0
+        assert lp["text_offset"] == sorted(lp["text_offset"])
+
+
+async def test_pretokenized_prompt():
+    async with make_client(cfg()) as client:
+        got = (await post(client, {"prompt": [[5, 6, 7, 8]],
+                                   "max_tokens": 4,
+                                   "temperature": 0.0})).json()
+        assert got["usage"]["prompt_tokens"] == 4
+        assert got["choices"][0]["text"]
+
+
+async def test_streaming_legacy_wire():
+    async with make_client(cfg()) as client:
+        resp = await client.post(
+            "/v1/completions",
+            json={"prompt": "stream me", "max_tokens": 6,
+                  "temperature": 0.0, "stream": True},
+            headers={"Authorization": "Bearer t"})
+        assert resp.status_code == 200
+        lines = [ln for ln in resp.text.splitlines()
+                 if ln.startswith("data: ")]
+        assert lines[-1] == "data: [DONE]"
+        frames = [json.loads(ln[len("data: "):]) for ln in lines[:-1]]
+        assert frames, "no frames"
+        assert all(f["object"] == "text_completion" for f in frames)
+        text = "".join(f["choices"][0]["text"] for f in frames
+                       if f["choices"])
+        assert text
+        finishes = [f["choices"][0]["finish_reason"] for f in frames
+                    if f["choices"]]
+        assert finishes[-1] in ("stop", "length")
+        # no chat-style delta/role keys anywhere on the legacy wire
+        assert all("delta" not in (f["choices"] or [{}])[0] for f in frames)
+
+        # streamed text matches the non-streaming result (greedy)
+        flat = (await post(client, {"prompt": "stream me", "max_tokens": 6,
+                                    "temperature": 0.0})).json()
+        assert text == flat["choices"][0]["text"]
+
+
+@pytest.mark.parametrize("body,fragment", [
+    ({"prompt": "x", "n": 2}, "'n' > 1"),
+    ({"prompt": "x", "max_tokens": 0}, "scoring"),
+    ({"prompt": "x", "logprobs": 6}, "logprobs"),
+    ({"prompt": "x", "best_of": 2}, "best_of"),
+    ({"prompt": "x", "suffix": "y"}, "suffix"),
+    ({"prompt": ""}, "prompt"),
+    ({"prompt": []}, "prompt"),
+    ({"prompt": "x " * 500, "echo": True, "logprobs": 0, "max_tokens": 0},
+     "max_seq"),
+    ({"prompt": ["a", "b"], "stream": True}, "exactly one prompt"),
+    ({"prompt": "x", "stream": True, "logprobs": 1}, "stream"),
+    ({"prompt": "x", "stream": True, "n": 2}, "'n' > 1"),
+    ({"prompt": "x", "stream": True, "best_of": 3}, "best_of"),
+])
+async def test_invalid_requests_400(body, fragment):
+    async with make_client(cfg()) as client:
+        resp = await post(client, {"model": "tiny", **body})
+        assert resp.status_code == 400, resp.text
+        err = resp.json()["error"]
+        assert err["type"] == "invalid_request_error"
+        assert fragment in err["message"], err["message"]
+
+
+async def test_best_of_one_is_a_noop():
+    """best_of=1 is the documented OpenAI default — clients that serialize
+    defaults must not be rejected."""
+    async with make_client(cfg()) as client:
+        resp = await post(client, {"prompt": "defaults", "best_of": 1,
+                                   "n": 1, "max_tokens": 2,
+                                   "temperature": 0.0})
+        assert resp.status_code == 200, resp.text
+
+
+async def test_raw_prompt_ids_not_injectable_from_wire():
+    """_raw_prompt_ids is internal: a wire body carrying it must not bypass
+    chat templating on /chat/completions (stripped at the route)."""
+    async with make_client(cfg()) as client:
+        body = {"model": "tiny", "max_tokens": 4, "temperature": 0.0,
+                "messages": [{"role": "user", "content": "legit"}]}
+        clean = await client.post("/v1/chat/completions", json=body,
+                                  headers={"Authorization": "Bearer t"})
+        injected = await client.post(
+            "/v1/chat/completions", json={**body, "_raw_prompt_ids": [5, 6]},
+            headers={"Authorization": "Bearer t"})
+        assert clean.status_code == injected.status_code == 200
+        assert (clean.json()["choices"][0]["message"]["content"]
+                == injected.json()["choices"][0]["message"]["content"])
+        # a templated chat prompt is longer than the injected 2 ids
+        assert injected.json()["usage"]["prompt_tokens"] > 2
+
+
+async def test_no_capable_backend_500_and_auth(monkeypatch):
+    from quorum_tpu.backends.fake import FakeBackend
+
+    monkeypatch.delenv("OPENAI_API_KEY", raising=False)
+    config = {"settings": {"timeout": 60},
+              "primary_backends": [
+                  {"name": "F", "url": "http://fake.example", "model": "m"}]}
+    async with make_client(config, F=FakeBackend("F", model="m")) as client:
+        resp = await post(client, {"prompt": "x"})
+        assert resp.status_code == 500
+        assert resp.json()["error"]["type"] == "configuration_error"
+        noauth = await client.post("/v1/completions", json={"prompt": "x"})
+        assert noauth.status_code == 401
+
+
+async def test_http_backend_relays_completions():
+    import httpx
+
+    from quorum_tpu.backends.http_backend import HttpBackend
+
+    seen = {}
+
+    def handler(request):
+        seen["path"] = request.url.path
+        seen["body"] = json.loads(request.content)
+        return httpx.Response(200, json={
+            "object": "text_completion", "id": "cmpl-up",
+            "choices": [{"index": 0, "text": "hi", "logprobs": None,
+                         "finish_reason": "stop"}],
+            "model": "cfg-model",
+            "usage": {"prompt_tokens": 1, "completion_tokens": 1,
+                      "total_tokens": 2}})
+
+    client = httpx.AsyncClient(transport=httpx.MockTransport(handler))
+    be = HttpBackend("H", "http://up.example/v1", model="cfg-model",
+                     client=client)
+    res = await be.text_complete({"model": "req", "prompt": "x"},
+                                 {"Authorization": "Bearer k"}, 30)
+    assert res.ok and res.body["backend"] == "H"
+    assert seen["path"] == "/v1/completions"
+    assert seen["body"]["model"] == "cfg-model" and seen["body"]["stream"] is False
+    await be.aclose()

@@ -51,7 +51,8 @@ def test_document_paths_match_served_routes():
     """The doc's path set IS the served surface (each under both the ""
     and "/v1" servers — app.py registers both prefixes)."""
     assert set(DOC["paths"]) == {
-        "/chat/completions", "/embeddings", "/health", "/models", "/metrics"}
+        "/chat/completions", "/completions", "/embeddings", "/health",
+        "/models", "/metrics"}
     assert [s["url"] for s in DOC["servers"]] == ["/", "/v1"]
     post = DOC["paths"]["/chat/completions"]["post"]
     assert set(post["responses"]) == {"200", "400", "401", "500", "503"}
@@ -115,6 +116,26 @@ async def test_live_stream_frames_conform():
     assert frames, "no SSE frames"
     for frame in frames:
         check("CreateChatCompletionStreamResponse", frame)
+
+
+async def test_live_completions_conform():
+    async with make_client(single_backend_config()) as client:
+        gen = await client.post(
+            "/v1/completions",
+            json={"model": "tiny", "prompt": "conformance", "max_tokens": 4,
+                  "temperature": 0.0, "logprobs": 2},
+            headers={"Authorization": "Bearer t"})
+        assert gen.status_code == 200, gen.text
+        check("CreateCompletionResponse", gen.json())
+        score = await client.post(
+            "/v1/completions",
+            json={"model": "tiny", "prompt": "score probe", "max_tokens": 0,
+                  "echo": True, "logprobs": 1},
+            headers={"Authorization": "Bearer t"})
+        assert score.status_code == 200, score.text
+        check("CreateCompletionResponse", score.json())
+    check("CreateCompletionRequest",
+          {"prompt": "x", "max_tokens": 0, "echo": True, "logprobs": 2})
 
 
 async def test_live_embeddings_conform():
