@@ -810,6 +810,55 @@ class TpuBackend:
         return prompts
 
     @staticmethod
+    def _validate_completions_common(body: dict[str, Any]) -> None:
+        """/completions rules shared by the flat and streaming paths —
+        one source for each rejection, so the two modes can never drift.
+        best_of=1 / n=1 are the documented OpenAI defaults (no-ops)."""
+        if body.get("n") not in (None, 1):
+            raise _invalid_request(
+                "'n' > 1 is not supported on /completions — send a list of "
+                "prompts instead")
+        if body.get("best_of") not in (None, 1):
+            raise _invalid_request(
+                "'best_of' is not supported by tpu:// backends")
+        if body.get("suffix"):
+            raise _invalid_request(
+                "'suffix' is not supported by tpu:// backends")
+
+    def plan_text_stream(
+        self, body: dict[str, Any]
+    ) -> tuple[dict[str, Any], str]:
+        """Validate a streaming /completions request and build the body its
+        chat-chunk stream runs on. Returns ``(stream_body, model)`` —
+        ``model`` under the same config-overrides-request precedence as
+        every other path. Raises the 400 family for echo/logprobs (no
+        streaming analog in the legacy wire), multi-prompt, and the shared
+        /completions rules."""
+        effective = prepare_body(body, self.model)
+        self._validate_completions_common(body)
+        # logprobs=false is the serialized default, not a request for
+        # logprobs — same mapping as _parse_completions_logprobs.
+        if body.get("echo") or body.get("logprobs") not in (None, False):
+            raise _invalid_request(
+                "'echo'/'logprobs' are not supported with 'stream' on "
+                "/completions")
+        prompts = self._parse_prompts(body.get("prompt"))
+        if len(prompts) != 1:
+            raise _invalid_request(
+                "streaming /completions takes exactly one prompt")
+        sbody = {k: v for k, v in body.items()
+                 if k not in ("prompt", "echo", "logprobs", "stream",
+                              "n", "best_of", "suffix")}
+        if ("max_tokens" not in sbody
+                and "max_completion_tokens" not in sbody):
+            # The legacy default (16): the chat plan would otherwise fall
+            # back to the backend's chat default and the same request
+            # would generate 4x more when streamed.
+            sbody["max_tokens"] = 16
+        sbody["_raw_prompt_ids"] = prompts[0][1]
+        return sbody, effective["model"]
+
+    @staticmethod
     def _parse_completions_logprobs(body: dict[str, Any]) -> "int | None":
         lp = body.get("logprobs")
         if lp is None or lp is False:
@@ -844,22 +893,10 @@ class TpuBackend:
         from quorum_tpu.engine.score import score_token_batch
 
         effective = prepare_body(body, self.model)
-        # best_of=1 is the documented OpenAI default (a no-op) — only the
-        # actual search semantics are unsupported.
-        if body.get("best_of") not in (None, 1):
-            raise _invalid_request(
-                "'best_of' is not supported by tpu:// backends")
-        if body.get("suffix"):
-            raise _invalid_request(
-                "'suffix' is not supported by tpu:// backends")
+        self._validate_completions_common(body)
         prompts = self._parse_prompts(body.get("prompt"))
         echo = bool(body.get("echo", False))
         lp = self._parse_completions_logprobs(body)
-        n = body.get("n")
-        if n not in (None, 1):
-            raise _invalid_request(
-                "'n' > 1 is not supported on /completions — send a list of "
-                "prompts instead")
         mt = body.get("max_tokens")
         if mt is None:
             mt = 16  # the documented OpenAI default for /completions

@@ -471,55 +471,19 @@ def create_app(
         cfg, body, headers, target = got
 
         if body.get("stream"):
-            if not hasattr(target, "_parse_prompts"):
+            if not hasattr(target, "plan_text_stream"):
                 return JSONResponse(
                     {"error": {"message": "streaming /completions is only "
                                "served by tpu:// backends",
                                "type": "invalid_request_error"}},
                     status_code=400,
                 )
-            # The non-streaming validations must hold here too — the chat
-            # stream machinery would otherwise accept n>1 and interleave
-            # two completions into one index-0 text stream.
-            bad = None
-            if body.get("echo") or body.get("logprobs") is not None:
-                bad = ("'echo'/'logprobs' are not supported with 'stream' "
-                       "on /completions")
-            elif body.get("n") not in (None, 1):
-                bad = ("'n' > 1 is not supported on /completions — send a "
-                       "list of prompts instead")
-            elif body.get("best_of") not in (None, 1):
-                bad = "'best_of' is not supported by tpu:// backends"
-            elif body.get("suffix"):
-                bad = "'suffix' is not supported by tpu:// backends"
-            if bad is not None:
-                return JSONResponse(
-                    {"error": {"message": bad,
-                               "type": "invalid_request_error"}},
-                    status_code=400,
-                )
+            # Validation lives with the backend (shared with the flat
+            # path) — the route only converts chunk shapes.
             try:
-                prompts = target._parse_prompts(body.get("prompt"))
+                sbody, model = target.plan_text_stream(body)
             except BackendError as e:
                 return _relay_backend_error(e)
-            if len(prompts) != 1:
-                return JSONResponse(
-                    {"error": {"message": "streaming /completions takes "
-                               "exactly one prompt",
-                               "type": "invalid_request_error"}},
-                    status_code=400,
-                )
-            sbody = {k: v for k, v in body.items()
-                     if k not in ("prompt", "echo", "logprobs", "stream",
-                                  "n", "best_of", "suffix")}
-            if ("max_tokens" not in sbody
-                    and "max_completion_tokens" not in sbody):
-                # The legacy default (16) — the chat plan would otherwise
-                # fall back to the backend's chat default and the same
-                # request would generate 4x more when streamed.
-                sbody["max_tokens"] = 16
-            sbody["_raw_prompt_ids"] = prompts[0][1]
-            model = body.get("model") or target.model or "unknown"
             stream = target.stream(sbody, headers, cfg.timeout)
             try:
                 first_chunk = await stream.__anext__()
@@ -591,18 +555,10 @@ def create_app(
             first_chunk = None
         except BackendError as e:
             # Failure before any token: JSON error with upstream status
-            # (oai_proxy.py:1107-1128 parity). A typed client/overload error
-            # (400 invalid_request_error, 503 overloaded_error) keeps its
-            # body verbatim — stream and non-stream must present the same
-            # error contract (docs/api.md error table).
-            err = e.body.get("error")
-            if isinstance(err, dict) and err.get("type") not in (None, "proxy_error"):
-                return JSONResponse(e.body, status_code=e.status_code)
-            msg = err.get("message", str(e)) if isinstance(err, dict) else str(e)
-            return JSONResponse(
-                {"error": {"message": f"Backend failed: {msg}", "type": "proxy_error"}},
-                status_code=e.status_code,
-            )
+            # (oai_proxy.py:1107-1128 parity); typed errors keep their body
+            # verbatim — stream and non-stream must present the same error
+            # contract (docs/api.md error table).
+            return _relay_backend_error(e)
         return StreamingResponse(_stream_with_role(first_chunk, stream, model))
 
     return app

@@ -194,6 +194,29 @@ async def test_invalid_requests_400(body, fragment):
         assert fragment in err["message"], err["message"]
 
 
+async def test_stream_accepts_serialized_defaults_and_model_precedence():
+    """logprobs=false / best_of=1 / n=1 are serialized client defaults —
+    streaming must accept them like the flat path; and streamed frames
+    carry the same config-overrides-request model string as flat
+    responses."""
+    async with make_client(cfg()) as client:
+        resp = await client.post(
+            "/v1/completions",
+            json={"model": "something-else", "prompt": "defaults",
+                  "max_tokens": 3, "temperature": 0.0, "stream": True,
+                  "logprobs": False, "best_of": 1, "n": 1},
+            headers={"Authorization": "Bearer t"})
+        assert resp.status_code == 200, resp.text
+        frames = [json.loads(ln[len("data: "):])
+                  for ln in resp.text.splitlines()
+                  if ln.startswith("data: ") and ln != "data: [DONE]"]
+        assert frames and all(f["model"] == "tiny" for f in frames)
+        flat = (await post(client, {"model": "something-else",
+                                    "prompt": "defaults", "max_tokens": 3,
+                                    "temperature": 0.0})).json()
+        assert flat["model"] == "tiny"
+
+
 async def test_best_of_one_is_a_noop():
     """best_of=1 is the documented OpenAI default — clients that serialize
     defaults must not be rejected."""
