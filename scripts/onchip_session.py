@@ -17,8 +17,13 @@ list; whatever the tunnel survives is kept:
      trace time).
   5. One ``QUORUM_TPU_PROFILE_DIR`` trace of steady-state 7B decode, to
      attribute the ~38% HBM-roofline gap (PERF §4).
+  6. int8 QUALITY at 7B scale: teacher-forced scoring (engine/score.py)
+     of one fixed prompt under bf16 and under quant=int8 of the SAME
+     seed-0 mistral-7b weights — mean |Δlogprob| and the ppl ratio. The
+     CPU suite pins quantization error only on tiny models; this is the
+     number that says int8 serving is quality-safe at the scale we ship.
 
-Usage: ``python scripts/onchip_session.py [--skip bench,ab,kvq,flash,profile]``
+Usage: ``python scripts/onchip_session.py [--skip bench,ab,kvq,flash,profile,qq]``
 Each step is a subprocess with its own budget; a wedged step is recorded
 and skipped, never fatal. Results: ``ONCHIP.json`` (merged dict, one key
 prefix per step) + profile trace under ``profiles/``.
@@ -194,6 +199,56 @@ else:
 """
 
 
+# Quality child: score one deterministic prompt with the engine's
+# teacher-forced path; prints {"lp": [...]} (prompt-token logprobs, first
+# dropped). One precision per process — bf16 weights alone are ~14.5 GB.
+_SCORE_ONE = r"""
+import json, sys
+model, quant = sys.argv[1], sys.argv[2]
+from quorum_tpu.models.model_config import resolve_spec
+from quorum_tpu.engine.engine import get_engine
+from quorum_tpu.engine.score import score_token_batch
+spec = resolve_spec(model, {"max_seq": "1024"})
+eng = get_engine(spec, n_slots=1,
+                 quant=(None if quant == "none" else quant))
+ids = [(i * 37 + 11) % (spec.vocab_size - 8) + 5 for i in range(512)]
+lp = score_token_batch(eng, [ids], top_k=0)[0]["token_logprobs"][1:]
+print(json.dumps({"lp": lp}))
+"""
+
+
+def quant_quality_step() -> dict:
+    import math
+
+    # Env override exists for the CPU test harness (a 7B forward on CPU
+    # takes minutes); the chip runs the real 7B default.
+    model = os.environ.get("QUORUM_TPU_QQ_MODEL", "mistral-7b")
+    arms = {}
+    diag = {}  # _error/_wall_s markers ride along even when lp salvaged
+    for arm in ("none", "int8"):
+        got = run_step(
+            f"qq_{arm}",
+            [sys.executable, "-c", _SCORE_ONE, model, arm],
+            budget=1500)
+        diag.update({k: v for k, v in got.items() if k != "lp"})
+        if "lp" not in got:
+            return diag
+        arms[arm] = got["lp"]
+    bf16, q8 = arms["none"], arms["int8"]
+    mean_abs = sum(abs(a - b) for a, b in zip(bf16, q8)) / len(bf16)
+    ppl = {k: math.exp(-sum(v) / len(v)) for k, v in
+           (("bf16", bf16), ("int8", q8))}
+    return {
+        **diag,
+        "qq_model": model,
+        "qq_n_scored_tokens": len(bf16),
+        "qq_mean_abs_dlogprob": round(mean_abs, 5),
+        "qq_ppl_bf16": round(ppl["bf16"], 4),
+        "qq_ppl_int8": round(ppl["int8"], 4),
+        "qq_ppl_ratio": round(ppl["int8"] / ppl["bf16"], 5),
+    }
+
+
 def main() -> None:
     skip = set()
     args = sys.argv[1:]
@@ -243,6 +298,8 @@ def main() -> None:
             bank(run_step(
                 arm, [sys.executable, "-c", _SERVE_ONE, B7_URL, "2", arm,
                       "1000", "skew"], budget=1500, env_extra=env))
+    if "qq" not in skip:
+        bank(quant_quality_step())
     if "profile" not in skip:
         prof_dir = os.path.join(REPO, "profiles")
         bank(run_step(

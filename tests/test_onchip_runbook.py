@@ -42,6 +42,22 @@ def test_bank_merges_and_survives_corruption(tmp_path, monkeypatch):
     assert not (tmp_path / "ONCHIP.json.tmp").exists()
 
 
+def test_quant_quality_step_end_to_end(monkeypatch):
+    """The int8-quality step runs both precision arms for real (tiny model
+    on CPU) and reports the delta/ppl summary with a sane shape."""
+    mod = _load()
+    monkeypatch.setenv("QUORUM_TPU_QQ_MODEL", "llama-tiny")
+    monkeypatch.setattr(mod, "probe_with_retry", lambda *a, **k: True)
+    got = mod.quant_quality_step()
+    assert got.get("qq_model") == "llama-tiny", got
+    assert got["qq_n_scored_tokens"] == 511
+    assert got["qq_mean_abs_dlogprob"] >= 0.0
+    assert got["qq_ppl_bf16"] > 0 and got["qq_ppl_int8"] > 0
+    # int8 of the same weights is a small perturbation, not a different
+    # model: ppl within a factor of 2 either way on the tiny proxy.
+    assert 0.5 < got["qq_ppl_ratio"] < 2.0, got
+
+
 def test_last_json_salvages_checkpoint_line():
     mod = _load()
     # A timed-out child's stdout can end mid-line; the intact checkpoint
