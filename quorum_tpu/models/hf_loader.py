@@ -143,6 +143,7 @@ def spec_from_hf_config(cfg: dict[str, Any]) -> ModelSpec:
             # silently wrong, full-causal matches stock HF behavior.
             sliding_window=(int(cfg.get("sliding_window") or 0)
                             if mt == "mistral" else 0),
+            **_rope_scaling_fields(cfg),
         ).validate()
     if mt == "gemma":
         d = cfg["hidden_size"]
@@ -161,6 +162,7 @@ def spec_from_hf_config(cfg: dict[str, Any]) -> ModelSpec:
             emb_scale=float(d) ** 0.5,          # embeddings scaled by sqrt(d)
             use_bias=bool(cfg.get("attention_bias", False)),
             tied_lm_head=bool(cfg.get("tie_word_embeddings", True)),
+            **_rope_scaling_fields(cfg),
         ).validate()
     if mt == "mixtral":
         d = cfg["hidden_size"]
@@ -178,8 +180,33 @@ def spec_from_hf_config(cfg: dict[str, Any]) -> ModelSpec:
             tied_lm_head=bool(cfg.get("tie_word_embeddings", False)),
             n_experts=cfg["num_local_experts"],
             experts_per_token=cfg["num_experts_per_tok"],
+            **_rope_scaling_fields(cfg),
         ).validate()
     raise ValueError(f"Unsupported model_type {mt!r}")
+
+
+def _rope_scaling_fields(cfg: dict) -> dict:
+    """HF ``rope_scaling`` → ModelSpec fields. Only the llama3 recipe (the
+    3.1/3.2 checkpoints) is implemented; other types fail loudly — a model
+    silently served with unscaled frequencies would degrade past its
+    original context without any error."""
+    rs = cfg.get("rope_scaling")
+    if not rs:
+        return {}
+    rtype = rs.get("rope_type") or rs.get("type") or "default"
+    if rtype == "default":
+        return {}
+    if rtype != "llama3":
+        raise ValueError(
+            f"Unsupported rope_scaling type {rtype!r} (only 'llama3')")
+    return {
+        "rope_scaling": "llama3",
+        "rope_scaling_factor": float(rs.get("factor", 8.0)),
+        "rope_low_freq_factor": float(rs.get("low_freq_factor", 1.0)),
+        "rope_high_freq_factor": float(rs.get("high_freq_factor", 4.0)),
+        "rope_original_max_seq": int(
+            rs.get("original_max_position_embeddings", 8192)),
+    }
 
 
 # ---- weight mapping --------------------------------------------------------

@@ -34,6 +34,17 @@ class ModelSpec:
     norm_offset: float = 0.0       # weight used as (offset + w); gemma: 1.0
     pos: str = "rope"              # "rope" | "learned"
     rope_theta: float = 10000.0
+    # Llama-3.1-style RoPE frequency scaling ("" = off, "llama3" = the
+    # wavelength-banded interpolation the 3.1/3.2 checkpoints ship):
+    # frequencies whose wavelength exceeds original_max/low_freq_factor
+    # divide by `factor`, those under original_max/high_freq_factor keep
+    # their value, the band between interpolates smoothly — long-context
+    # extension without retraining (ops/rotary.py:scaled_rope_inv_freq).
+    rope_scaling: str = ""
+    rope_scaling_factor: float = 8.0
+    rope_low_freq_factor: float = 1.0
+    rope_high_freq_factor: float = 4.0
+    rope_original_max_seq: int = 8192
     act: str = "swiglu"            # "swiglu" | "gelu" | "geglu" (gemma)
     emb_scale: float = 1.0         # embedding multiplier; gemma: sqrt(d_model)
     use_bias: bool = False         # attention/MLP biases (gpt2, qwen2-qkv)
@@ -59,6 +70,8 @@ class ModelSpec:
         assert self.act in ("swiglu", "gelu", "geglu")
         assert self.norm in ("rmsnorm", "layernorm")
         assert self.pos in ("rope", "learned")
+        assert self.rope_scaling in ("", "llama3"), (
+            f"unsupported rope_scaling {self.rope_scaling!r}")
         return self
 
 
@@ -81,6 +94,28 @@ MODEL_PRESETS: dict[str, ModelSpec] = {
         family="llama", vocab_size=128256, d_model=4096, n_layers=32, n_heads=32,
         n_kv_heads=8, head_dim=128, d_ff=14336, max_seq=8192, rope_theta=500000.0,
         tied_lm_head=False,
+    ),
+    # Llama-3.1-8B: identical transformer to llama-3-8b plus the llama3
+    # RoPE frequency scaling (factor 8 over the 8192-token original
+    # context — the published 3.1 long-context recipe; formula pinned
+    # bit-for-bit against transformers in tests/test_hf_loader.py).
+    # max_seq defaults to 16384 (the cache window actually allocated);
+    # raise via ?max_seq= up to the 131072 the scaling supports.
+    "llama-3.1-8b": ModelSpec(
+        family="llama", vocab_size=128256, d_model=4096, n_layers=32, n_heads=32,
+        n_kv_heads=8, head_dim=128, d_ff=14336, max_seq=16384, rope_theta=500000.0,
+        tied_lm_head=False, rope_scaling="llama3", rope_scaling_factor=8.0,
+        rope_low_freq_factor=1.0, rope_high_freq_factor=4.0,
+        rope_original_max_seq=8192,
+    ),
+    # Llama-3.2-1B: the small 3.2 config (16 layers, GQA 32q/8kv, tied
+    # head, llama3 scaling factor 32).
+    "llama-3.2-1b": ModelSpec(
+        family="llama", vocab_size=128256, d_model=2048, n_layers=16, n_heads=32,
+        n_kv_heads=8, head_dim=64, d_ff=8192, max_seq=16384, rope_theta=500000.0,
+        tied_lm_head=True, rope_scaling="llama3", rope_scaling_factor=32.0,
+        rope_low_freq_factor=1.0, rope_high_freq_factor=4.0,
+        rope_original_max_seq=8192,
     ),
     "mistral-7b": ModelSpec(
         family="llama", vocab_size=32000, d_model=4096, n_layers=32, n_heads=32,

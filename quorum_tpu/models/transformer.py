@@ -52,7 +52,7 @@ from quorum_tpu.ops.flash_decode import (
 from quorum_tpu.parallel.ring_attention import ring_prefill_attention
 from quorum_tpu.parallel.ulysses import ulysses_prefill_attention
 from quorum_tpu.ops.norms import layernorm, rmsnorm
-from quorum_tpu.ops.rotary import apply_rope, rope_cos_sin
+from quorum_tpu.ops.rotary import apply_rope, rope_cos_sin_for
 
 Params = dict[str, Any]
 
@@ -347,7 +347,7 @@ def prefill(
             "each device sees the full sequence, windows apply unchanged)")
     positions = jnp.arange(t)
     x = _embed(params, spec, tokens, positions)
-    cos, sin = rope_cos_sin(spec.max_seq, spec.head_dim, spec.rope_theta)
+    cos, sin = rope_cos_sin_for(spec)
     moe_mask = jnp.arange(t)[None, :] < lengths[:, None]  # [B,T] real tokens
 
     def body(carry_x, per_layer):
@@ -430,7 +430,7 @@ def prefill_segment(
     hist = spec.max_seq if history is None else min(history, spec.max_seq)
     positions = offset + jnp.arange(t)
     x = _embed(params, spec, tokens, positions)
-    cos, sin = rope_cos_sin(spec.max_seq, spec.head_dim, spec.rope_theta)
+    cos, sin = rope_cos_sin_for(spec)
     # causal over absolute positions: key j visible to query i iff j <= i
     qi = positions[:, None]
     ki = jnp.arange(hist)[None, :]
@@ -522,7 +522,7 @@ def decode_step(
         x = x * jnp.asarray(spec.emb_scale, x.dtype)
     if spec.pos == "learned":
         x = x + params["pos_emb"][lengths][:, None, :].astype(x.dtype)
-    cos, sin = rope_cos_sin(spec.max_seq, spec.head_dim, spec.rope_theta)
+    cos, sin = rope_cos_sin_for(spec)
 
     def write_row(cache_row, new_row, idx, allow):
         # cache_row [K, max_seq, hd] (or [K, max_seq] scale), new_row likewise
@@ -626,7 +626,7 @@ def decode_multi(
     pos = lengths[:, None] + jnp.arange(t)[None, :]              # [B,T]
     if spec.pos == "learned":
         x = x + params["pos_emb"][pos].astype(x.dtype)
-    cos, sin = rope_cos_sin(spec.max_seq, spec.head_dim, spec.rope_theta)
+    cos, sin = rope_cos_sin_for(spec)
     hist = spec.max_seq if history is None else min(history, spec.max_seq)
     allow = (jnp.ones((b,), bool) if write_mask is None else write_mask)
 
@@ -718,7 +718,7 @@ def _scan_layers(params, spec: ModelSpec, tokens, attn_fn, remat: bool,
     b, t = tokens.shape
     positions = jnp.arange(t)
     x = _embed(params, spec, tokens, positions)
-    cos, sin = rope_cos_sin(spec.max_seq, spec.head_dim, spec.rope_theta)
+    cos, sin = rope_cos_sin_for(spec)
     token_mask = (None if lengths is None
                   else jnp.arange(t)[None, :] < lengths[:, None])
 

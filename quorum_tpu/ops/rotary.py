@@ -10,14 +10,63 @@ from __future__ import annotations
 import jax.numpy as jnp
 
 
+def _base_inv_freq(head_dim: int, theta: float) -> jnp.ndarray:
+    return 1.0 / (theta ** (
+        jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
+
+
+def scaled_rope_inv_freq(
+    head_dim: int,
+    theta: float,
+    factor: float,
+    low_freq_factor: float,
+    high_freq_factor: float,
+    original_max_seq: int,
+) -> jnp.ndarray:
+    """Llama-3.1 frequency scaling (the published 3.1/3.2 recipe).
+
+    Banded by wavelength against the ORIGINAL training context:
+    wavelengths longer than ``original_max_seq / low_freq_factor`` divide
+    their frequency by ``factor`` (the pure long-range stretch), those
+    shorter than ``original_max_seq / high_freq_factor`` are untouched
+    (local syntax must not smear), and the band between interpolates
+    linearly in ``original_max_seq / wavelength``. Pinned bit-for-bit
+    against transformers' rope_scaling={"rope_type": "llama3"} in
+    tests/test_hf_loader.py."""
+    inv_freq = _base_inv_freq(head_dim, theta)
+    wavelen = 2.0 * jnp.pi / inv_freq
+    low_wavelen = original_max_seq / low_freq_factor
+    high_wavelen = original_max_seq / high_freq_factor
+    smooth = (original_max_seq / wavelen - low_freq_factor) / (
+        high_freq_factor - low_freq_factor)
+    mid = (1.0 - smooth) * inv_freq / factor + smooth * inv_freq
+    out = jnp.where(wavelen > low_wavelen, inv_freq / factor, mid)
+    return jnp.where(wavelen < high_wavelen, inv_freq, out)
+
+
 def rope_cos_sin(
-    max_seq: int, head_dim: int, theta: float = 10000.0
+    max_seq: int, head_dim: int, theta: float = 10000.0,
+    inv_freq: jnp.ndarray | None = None,
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
     """Tables of shape [max_seq, head_dim//2] in float32."""
-    inv_freq = 1.0 / (theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
+    if inv_freq is None:
+        inv_freq = _base_inv_freq(head_dim, theta)
     t = jnp.arange(max_seq, dtype=jnp.float32)
     freqs = jnp.outer(t, inv_freq)
     return jnp.cos(freqs), jnp.sin(freqs)
+
+
+def rope_cos_sin_for(spec) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Spec-driven tables: plain RoPE, or llama3-scaled frequencies when
+    the spec carries ``rope_scaling="llama3"``."""
+    inv_freq = None
+    if getattr(spec, "rope_scaling", "") == "llama3":
+        inv_freq = scaled_rope_inv_freq(
+            spec.head_dim, spec.rope_theta, spec.rope_scaling_factor,
+            spec.rope_low_freq_factor, spec.rope_high_freq_factor,
+            spec.rope_original_max_seq)
+    return rope_cos_sin(spec.max_seq, spec.head_dim, spec.rope_theta,
+                        inv_freq=inv_freq)
 
 
 def apply_rope(

@@ -47,7 +47,7 @@ except ImportError:  # pragma: no cover
 
 from quorum_tpu.models.model_config import ModelSpec
 from quorum_tpu.ops.attention import attention, causal_mask
-from quorum_tpu.ops.rotary import rope_cos_sin
+from quorum_tpu.ops.rotary import rope_cos_sin_for
 from quorum_tpu.parallel.mesh import AXIS_DP, AXIS_PP, AXIS_SP, AXIS_TP
 
 # NOTE: quorum_tpu.models.transformer is imported lazily inside functions —
@@ -112,7 +112,7 @@ def _pipeline_blocks(blocks, xs, spec: ModelSpec, mesh: Mesh, remat: bool):
 
     def local(blocks_local, xs_local):
         s = lax.axis_index(AXIS_PP)
-        cos, sin = rope_cos_sin(spec.max_seq, spec.head_dim, spec.rope_theta)
+        cos, sin = rope_cos_sin_for(spec)
 
         def stage(x):
             def body(c, blk):

@@ -62,6 +62,54 @@ def test_llama_gqa_checkpoint_parity(tmp_path):
     assert_close(our_logits(tmp_path), torch_logits(model, TOKENS))
 
 
+def test_llama3_rope_scaling_parity(tmp_path):
+    """The llama3 rope_scaling recipe (3.1/3.2 checkpoints) pinned
+    bit-for-bit against transformers' own implementation: positions past
+    the ORIGINAL context only make sense scaled, so the tiny config sets
+    original_max_position_embeddings below max_seq and the probe tokens
+    exercise positions in the scaled band."""
+    from transformers import LlamaConfig, LlamaForCausalLM
+
+    cfg = LlamaConfig(
+        vocab_size=512, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=128, rope_theta=10000.0,
+        tie_word_embeddings=False,
+        rope_scaling={"rope_type": "llama3", "factor": 8.0,
+                      "low_freq_factor": 1.0, "high_freq_factor": 4.0,
+                      "original_max_position_embeddings": 16},
+    )
+    model = LlamaForCausalLM(cfg).eval()
+    model.save_pretrained(tmp_path, safe_serialization=True)
+    spec, params = load_hf_checkpoint(tmp_path, dtype="float32")
+    assert spec.rope_scaling == "llama3"
+    assert spec.rope_scaling_factor == 8.0
+    assert spec.rope_original_max_seq == 16
+    long_tokens = np.arange(40, dtype=np.int32)[None, :] % 500 + 3
+    ours = np.asarray(forward_logits(params, spec, jnp.asarray(long_tokens)))
+    assert_close(ours, torch_logits(model, long_tokens))
+
+    # The scaling is load-bearing: dropping it must change the logits.
+    import dataclasses
+
+    unscaled = dataclasses.replace(spec, rope_scaling="")
+    diverged = np.asarray(
+        forward_logits(params, unscaled, jnp.asarray(long_tokens)))
+    assert np.abs(diverged - ours).max() > 1e-3
+
+
+def test_unsupported_rope_scaling_fails_loudly(tmp_path):
+    from quorum_tpu.models.hf_loader import spec_from_hf_config
+
+    with pytest.raises(ValueError, match="rope_scaling"):
+        spec_from_hf_config({
+            "model_type": "llama", "vocab_size": 512, "hidden_size": 32,
+            "intermediate_size": 64, "num_hidden_layers": 2,
+            "num_attention_heads": 4,
+            "rope_scaling": {"rope_type": "yarn", "factor": 4.0},
+        })
+
+
 def test_llama_attention_bias_parity(tmp_path):
     """qwen2-style attention: qkv biases present."""
     from transformers import LlamaConfig, LlamaForCausalLM

@@ -152,3 +152,34 @@ def test_sampling_temperature_distribution():
     toks = [int(sample_token(logits, k, SamplerConfig(temperature=1.0))[0]) for k in keys]
     assert max(set(toks), key=toks.count) == 2
     assert len(set(toks)) > 1  # not greedy
+
+
+def test_llama31_scaled_rope_preset_serves_and_scaling_is_load_bearing():
+    """The llama-3.1 preset (rope_scaling=llama3), tiny-ified via URL
+    overrides, serves through the engine; and the scaled tables really
+    differ from plain RoPE in the stretched band."""
+    import numpy as np
+
+    from quorum_tpu.engine.engine import InferenceEngine
+    from quorum_tpu.ops.rotary import rope_cos_sin, rope_cos_sin_for
+
+    tiny = {"n_layers": "2", "d_model": "64", "n_heads": "4",
+            "n_kv_heads": "2", "head_dim": "16", "d_ff": "128",
+            "vocab_size": "512", "max_seq": "128",
+            "rope_original_max_seq": "32"}
+    spec = resolve_spec("llama-3.1-8b", tiny)
+    assert spec.rope_scaling == "llama3"
+    eng = InferenceEngine(spec, decode_chunk=4, n_slots=1)
+    out = eng.generate([3, 4, 5, 6], max_new_tokens=6,
+                       sampler=SamplerConfig(temperature=0.0),
+                       seed=0).token_ids
+    eng.shutdown()
+    assert len(out) == 6
+
+    cos_s, _ = rope_cos_sin_for(spec)
+    cos_p, _ = rope_cos_sin(spec.max_seq, spec.head_dim, spec.rope_theta)
+    # Low-frequency (long-wavelength) components are stretched by the
+    # factor; the highest-frequency component is untouched.
+    assert float(np.abs(np.asarray(cos_s) - np.asarray(cos_p)).max()) > 0.1
+    np.testing.assert_allclose(np.asarray(cos_s[:, 0]),
+                               np.asarray(cos_p[:, 0]), atol=1e-6)
