@@ -47,7 +47,6 @@ from quorum_tpu.ops.flash_attention import flash_prefill_attention
 from quorum_tpu.ops.flash_decode import (
     flash_decode_attention,
     flash_decode_mode,
-    flash_decode_supported,
 )
 from quorum_tpu.parallel.ring_attention import ring_prefill_attention
 from quorum_tpu.parallel.ulysses import ulysses_prefill_attention

@@ -104,24 +104,36 @@ def probe_with_retry(window_s: int = 900) -> bool:
 
 def run_step(name: str, argv: list[str], budget: int,
              env_extra: dict | None = None) -> dict:
-    """Run one measurement subprocess; parse its last JSON line."""
+    """Run one measurement subprocess; parse its last JSON line.
+
+    The child runs in its OWN process group and a timeout kills the whole
+    group — bench.py spawns per-phase grandchildren, and killing only the
+    direct child would orphan the process actually holding the
+    single-holder TPU client."""
     if not probe_with_retry(300):
         return {f"{name}_error": "skipped: device probe failed"}
     env = dict(os.environ)
     env.update(env_extra or {})
     t0 = time.time()
+    proc = subprocess.Popen(
+        argv, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        cwd=REPO, env=env, start_new_session=True)
     try:
-        p = subprocess.run(argv, capture_output=True, text=True,
-                           timeout=budget, cwd=REPO, env=env)
-    except subprocess.TimeoutExpired as e:
-        stdout = e.stdout.decode(errors="replace") if isinstance(
-            e.stdout, bytes) else (e.stdout or "")
+        stdout, stderr = proc.communicate(timeout=budget)
+    except subprocess.TimeoutExpired:
+        import signal
+
+        try:
+            os.killpg(proc.pid, signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            pass
+        stdout, _ = proc.communicate()
         got = _last_json(stdout)
         got[f"{name}_error"] = f"timeout after {budget}s"
         return got
-    got = _last_json(p.stdout)
+    got = _last_json(stdout)
     if not got:
-        got = {f"{name}_error": f"rc={p.returncode}: {(p.stderr or '')[-300:]}"}
+        got = {f"{name}_error": f"rc={proc.returncode}: {(stderr or '')[-300:]}"}
     got[f"{name}_wall_s"] = round(time.time() - t0, 1)
     return got
 
@@ -217,7 +229,7 @@ print(json.dumps({"lp": lp}))
 """
 
 
-def quant_quality_step() -> dict:
+def quant_quality_step(arm_budget: int = 1500) -> dict:
     import math
 
     # Env override exists for the CPU test harness (a 7B forward on CPU
@@ -229,7 +241,7 @@ def quant_quality_step() -> dict:
         got = run_step(
             f"qq_{arm}",
             [sys.executable, "-c", _SCORE_ONE, model, arm],
-            budget=1500)
+            budget=arm_budget)
         diag.update({k: v for k, v in got.items() if k != "lp"})
         if "lp" not in got:
             return diag
@@ -266,14 +278,35 @@ def main() -> None:
     print("[onchip] device alive — starting the list", flush=True)
     bank({"onchip_started_ts": time.time(), "onchip_error": None})
 
+    # A supervisor (scripts/tunnel_watch.py) can hand this session a total
+    # budget; steps that no longer fit are SKIPPED (banked as such) so the
+    # session exits cleanly instead of being killed mid-computation —
+    # a SIGKILL mid-dispatch can wedge the single-holder TPU tunnel.
+    budget_env = os.environ.get("QUORUM_TPU_ONCHIP_BUDGET", "")
+    session_deadline = (time.time() + float(budget_env)) if budget_env else None
+
+    def fits(name: str, step_budget: int) -> int:
+        """Step budget trimmed to the session's remaining time; 0 = skip
+        (a trimmed run that could not finish anything useful is worse than
+        banking the skip and leaving the chip free)."""
+        if session_deadline is None:
+            return step_budget
+        left = int(session_deadline - time.time())
+        if left < min(step_budget, 900):
+            bank({f"{name}_error": "skipped: session budget exhausted"})
+            return 0
+        return min(step_budget, left)
+
     bench_got: dict = {}
     if "bench" not in skip:
         # Budget must exceed bench.py's own derived watchdog (phase budgets
         # + probe windows + margin — ~9 900 s with the A/B and ckpt phases
         # enabled), or a healthy run gets killed mid-int8-phase from outside.
-        bench_got = run_step("bench", [sys.executable, "bench.py"],
-                             budget=10800)
-        bank(bench_got)
+        b = fits("bench", 10800)
+        if b:
+            bench_got = run_step("bench", [sys.executable, "bench.py"],
+                                 budget=b)
+            bank(bench_got)
     if "ab" not in skip:
         # bench.py's own plan now carries the stacked A/B (ab_* keys);
         # rerun it here only when THIS run's arm didn't land — a previous
@@ -282,33 +315,44 @@ def main() -> None:
         if any(k.startswith("ab_p50") for k in bench_got):
             print("[onchip] bench already carried the stacked A/B — skipping")
         else:
-            bank({(k if k.startswith("ab_") else f"ab_{k}"): v
-                  for k, v in run_step(
-                "ab", [sys.executable, "bench.py", "--phase12"], budget=1200,
-                env_extra={"QUORUM_TPU_BENCH_STACKED": "0"}).items()})
+            b = fits("ab", 1200)
+            if b:
+                bank({(k if k.startswith("ab_") else f"ab_{k}"): v
+                      for k, v in run_step(
+                    "ab", [sys.executable, "bench.py", "--phase12"],
+                    budget=b,
+                    env_extra={"QUORUM_TPU_BENCH_STACKED": "0"}).items()})
     if "kvq" not in skip:
-        bank(run_step(
-            "kvq", [sys.executable, "-c", _SERVE_ONE, KVQ_URL, "2", "kvq",
-                    "600"], budget=1800))
+        b = fits("kvq", 1800)
+        if b:
+            bank(run_step(
+                "kvq", [sys.executable, "-c", _SERVE_ONE, KVQ_URL, "2",
+                        "kvq", "600"], budget=b))
     if "flash" not in skip:
         # ~1000 words ≈ 3000 byte-tokens: long row near the 4096 window,
         # short row at ~60 — the skew the kernel exists for.
         for arm, env in (("flash_off", {"QUORUM_TPU_FLASH_DECODE": "0"}),
                          ("flash_on", {"QUORUM_TPU_FLASH_DECODE": "1"})):
-            bank(run_step(
-                arm, [sys.executable, "-c", _SERVE_ONE, B7_URL, "2", arm,
-                      "1000", "skew"], budget=1500, env_extra=env))
+            b = fits(arm, 1500)
+            if b:
+                bank(run_step(
+                    arm, [sys.executable, "-c", _SERVE_ONE, B7_URL, "2",
+                          arm, "1000", "skew"], budget=b, env_extra=env))
     if "qq" not in skip:
-        bank(quant_quality_step())
+        b = fits("qq", 3100)  # two ~1500s precision arms
+        if b:
+            bank(quant_quality_step(arm_budget=b // 2))
     if "profile" not in skip:
-        prof_dir = os.path.join(REPO, "profiles")
-        bank(run_step(
-            "profile", [sys.executable, "-c", _SERVE_ONE, B7_URL, "2",
-                        "profile", "600"], budget=1500,
-            env_extra={"QUORUM_TPU_PROFILE_DIR": prof_dir}))
-        if os.path.isdir(prof_dir):
-            bank({"profile_artifacts": sum(
-                len(fs) for _, _, fs in os.walk(prof_dir))})
+        b = fits("profile", 1500)
+        if b:
+            prof_dir = os.path.join(REPO, "profiles")
+            bank(run_step(
+                "profile", [sys.executable, "-c", _SERVE_ONE, B7_URL, "2",
+                            "profile", "600"], budget=b,
+                env_extra={"QUORUM_TPU_PROFILE_DIR": prof_dir}))
+            if os.path.isdir(prof_dir):
+                bank({"profile_artifacts": sum(
+                    len(fs) for _, _, fs in os.walk(prof_dir))})
     print(f"[onchip] done — see {OUT}")
 
 

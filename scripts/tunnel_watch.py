@@ -91,25 +91,56 @@ def main() -> int:
                          "(leave the chip free for the driver's own bench)")
     ap.add_argument("--session-budget-s", type=int, default=6 * 3600,
                     help="hard cap on one onchip_session run")
+    ap.add_argument("--hard-end-s", type=int, default=0,
+                    help="absolute cap from watcher start: a launched "
+                         "session's budget is TRIMMED so it cannot still "
+                         "hold the single-holder TPU client past this "
+                         "point (0 = launch deadline + session budget)")
     args = ap.parse_args()
 
-    deadline = time.time() + args.launch_deadline_s
+    t0 = time.time()
+    hard_end = t0 + (args.hard_end_s
+                     or args.launch_deadline_s + args.session_budget_s)
+    # No point probing past the moment a launch could no longer get a
+    # useful (≥1800 s) budget.
+    deadline = min(t0 + args.launch_deadline_s, hard_end - 2100)
     n = 0
     while time.time() < deadline:
         n += 1
         if probe():
-            print(f"[watch] probe {n}: ALIVE — launching onchip_session",
-                  flush=True)
+            budget = int(min(args.session_budget_s,
+                             hard_end - time.time() - 300))
+            if budget < 1800:
+                print("[watch] tunnel alive but too close to the hard end "
+                      "for a useful session — leaving the chip free",
+                      flush=True)
+                return 0
+            print(f"[watch] probe {n}: ALIVE — launching onchip_session "
+                  f"(budget {budget}s)", flush=True)
             before = _mtime(ONCHIP)
             rc = None
+            # The session plans its own steps inside this budget and exits
+            # cleanly (QUORUM_TPU_ONCHIP_BUDGET); the group kill below is
+            # only the backstop for a wedged session, not the mechanism.
+            env = dict(os.environ)
+            env["QUORUM_TPU_ONCHIP_BUDGET"] = str(budget)
+            proc = subprocess.Popen(
+                [sys.executable, os.path.join("scripts",
+                                              "onchip_session.py")],
+                cwd=REPO, env=env, start_new_session=True)
             try:
-                rc = subprocess.run(
-                    [sys.executable, os.path.join("scripts",
-                                                  "onchip_session.py")],
-                    cwd=REPO, timeout=args.session_budget_s).returncode
+                rc = proc.wait(timeout=budget + 600)
             except subprocess.TimeoutExpired:
-                print("[watch] onchip_session exceeded its budget",
-                      flush=True)
+                import signal
+
+                try:
+                    os.killpg(proc.pid, signal.SIGKILL)
+                except (ProcessLookupError, PermissionError):
+                    pass
+                proc.wait()
+                print("[watch] onchip_session wedged past its budget — "
+                      "killed its process group; committing whatever was "
+                      "banked before the wedge", flush=True)
             committed = commit_onchip(started_after=before)
             if committed:
                 return 0

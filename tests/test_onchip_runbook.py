@@ -58,6 +58,28 @@ def test_quant_quality_step_end_to_end(monkeypatch):
     assert 0.5 < got["qq_ppl_ratio"] < 2.0, got
 
 
+def test_session_budget_exhaustion_skips_cleanly(tmp_path, monkeypatch):
+    """A supervisor-trimmed budget (QUORUM_TPU_ONCHIP_BUDGET) that cannot
+    fit any step makes the session bank explicit skip markers and exit
+    cleanly — never a mid-computation kill of the TPU holder."""
+    mod = _load()
+    out = tmp_path / "ONCHIP.json"
+    monkeypatch.setattr(mod, "OUT", str(out))
+    monkeypatch.setattr(mod, "probe_with_retry", lambda *a, **k: True)
+    monkeypatch.setenv("QUORUM_TPU_ONCHIP_BUDGET", "1")
+    calls = []
+    monkeypatch.setattr(mod, "run_step",
+                        lambda *a, **k: calls.append(a) or {"x": 1})
+    monkeypatch.setattr(mod.sys, "argv", ["onchip_session.py"])
+    mod.main()
+    assert calls == [], "no step may launch with an exhausted budget"
+    banked = json.loads(out.read_text())
+    for step in ("bench", "ab", "kvq", "flash_off", "flash_on", "qq",
+                 "profile"):
+        assert banked.get(f"{step}_error") == (
+            "skipped: session budget exhausted"), (step, banked)
+
+
 def test_last_json_salvages_checkpoint_line():
     mod = _load()
     # A timed-out child's stdout can end mid-line; the intact checkpoint
