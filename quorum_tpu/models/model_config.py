@@ -137,6 +137,12 @@ MODEL_PRESETS: dict[str, ModelSpec] = {
         n_kv_heads=4, head_dim=128, d_ff=18944, max_seq=8192, rope_theta=10000.0,
         use_bias=True, tied_lm_head=False,
     ),
+    # Qwen2.5-7B: same qwen2 architecture (qkv bias), θ=1e6
+    "qwen2.5-7b": ModelSpec(
+        family="llama", vocab_size=152064, d_model=3584, n_layers=28, n_heads=28,
+        n_kv_heads=4, head_dim=128, d_ff=18944, max_seq=8192, rope_theta=1000000.0,
+        use_bias=True, tied_lm_head=False,
+    ),
     # BASELINE.json config[4]: Mixtral-8x7B MoE
     "mixtral-8x7b": ModelSpec(
         family="mixtral", vocab_size=32000, d_model=4096, n_layers=32, n_heads=32,

@@ -102,13 +102,49 @@ def probe_with_retry(window_s: int = 900) -> bool:
         wait = min(wait * 2, 300.0)
 
 
+def kill_process_tree(pid: int) -> None:
+    """SIGKILL ``pid``, every /proc-walkable descendant, and each of their
+    process groups. One kill discipline for the whole toolchain: a step
+    child started in its own session is NOT reachable by killpg on its
+    parent's group, and an orphaned step is exactly the process holding
+    the single-holder TPU client."""
+    import signal
+
+    children: dict[int, list[int]] = {}
+    for p in os.listdir("/proc"):
+        if not p.isdigit():
+            continue
+        try:
+            with open(f"/proc/{p}/stat") as f:
+                ppid = int(f.read().rsplit(")", 1)[-1].split()[1])
+        except (OSError, ValueError, IndexError):
+            continue
+        children.setdefault(ppid, []).append(int(p))
+    doomed, stack = [], [pid]
+    while stack:
+        q = stack.pop()
+        doomed.append(q)
+        stack.extend(children.get(q, []))
+    for q in doomed:
+        for kill in (os.killpg, os.kill):
+            try:
+                kill(q, signal.SIGKILL)
+            except (ProcessLookupError, PermissionError, OSError):
+                pass
+
+
+# Worst-case host time probe_with_retry(300) can spend before a step's
+# child even starts — budget planners must reserve it per step.
+PROBE_OVERHEAD_S = 420
+
+
 def run_step(name: str, argv: list[str], budget: int,
              env_extra: dict | None = None) -> dict:
     """Run one measurement subprocess; parse its last JSON line.
 
-    The child runs in its OWN process group and a timeout kills the whole
-    group — bench.py spawns per-phase grandchildren, and killing only the
-    direct child would orphan the process actually holding the
+    The child runs in its OWN process group and a timeout kills its whole
+    process TREE — bench.py spawns per-phase grandchildren, and killing
+    only the direct child would orphan the process actually holding the
     single-holder TPU client."""
     if not probe_with_retry(300):
         return {f"{name}_error": "skipped: device probe failed"}
@@ -121,12 +157,7 @@ def run_step(name: str, argv: list[str], budget: int,
     try:
         stdout, stderr = proc.communicate(timeout=budget)
     except subprocess.TimeoutExpired:
-        import signal
-
-        try:
-            os.killpg(proc.pid, signal.SIGKILL)
-        except (ProcessLookupError, PermissionError):
-            pass
+        kill_process_tree(proc.pid)
         stdout, _ = proc.communicate()
         got = _last_json(stdout)
         got[f"{name}_error"] = f"timeout after {budget}s"
@@ -285,13 +316,17 @@ def main() -> None:
     budget_env = os.environ.get("QUORUM_TPU_ONCHIP_BUDGET", "")
     session_deadline = (time.time() + float(budget_env)) if budget_env else None
 
-    def fits(name: str, step_budget: int) -> int:
+    def fits(name: str, step_budget: int, n_children: int = 1) -> int:
         """Step budget trimmed to the session's remaining time; 0 = skip
         (a trimmed run that could not finish anything useful is worse than
-        banking the skip and leaving the chip free)."""
+        banking the skip and leaving the chip free). Each run_step can
+        spend PROBE_OVERHEAD_S on its probe window before the child even
+        starts, so that is reserved per child — otherwise a flaky tunnel
+        pushes a cleanly-planned session past its supervisor's backstop."""
         if session_deadline is None:
             return step_budget
-        left = int(session_deadline - time.time())
+        reserve = PROBE_OVERHEAD_S * n_children
+        left = int(session_deadline - time.time()) - reserve
         if left < min(step_budget, 900):
             bank({f"{name}_error": "skipped: session budget exhausted"})
             return 0
@@ -339,7 +374,7 @@ def main() -> None:
                     arm, [sys.executable, "-c", _SERVE_ONE, B7_URL, "2",
                           arm, "1000", "skew"], budget=b, env_extra=env))
     if "qq" not in skip:
-        b = fits("qq", 3100)  # two ~1500s precision arms
+        b = fits("qq", 3100, n_children=2)  # two ~1500s precision arms
         if b:
             bank(quant_quality_step(arm_budget=b // 2))
     if "profile" not in skip:

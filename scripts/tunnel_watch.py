@@ -31,10 +31,11 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 ONCHIP = os.path.join(REPO, "ONCHIP.json")
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
-# One probe discipline for the whole toolchain (bench._probe_device pinned
-# it in round 3; onchip_session carries the same helper) — a watcher with
-# its own copy could disagree with the session about tunnel liveness.
-from onchip_session import probe  # noqa: E402
+# One probe/kill discipline for the whole toolchain (bench._probe_device
+# pinned the probe in round 3; onchip_session carries the shared helpers) —
+# a watcher with its own copies could disagree with the session about
+# liveness, or kill only part of a process tree.
+from onchip_session import kill_process_tree, probe  # noqa: E402
 
 
 def _mtime(path: str) -> float:
@@ -129,17 +130,16 @@ def main() -> int:
                                               "onchip_session.py")],
                 cwd=REPO, env=env, start_new_session=True)
             try:
-                rc = proc.wait(timeout=budget + 600)
+                # Backstop only (the session plans inside its budget); the
+                # wait can never extend past the operator's hard end —
+                # that is the whole point of --hard-end-s.
+                rc = proc.wait(timeout=max(
+                    1.0, min(budget + 600, hard_end - time.time())))
             except subprocess.TimeoutExpired:
-                import signal
-
-                try:
-                    os.killpg(proc.pid, signal.SIGKILL)
-                except (ProcessLookupError, PermissionError):
-                    pass
+                kill_process_tree(proc.pid)
                 proc.wait()
                 print("[watch] onchip_session wedged past its budget — "
-                      "killed its process group; committing whatever was "
+                      "killed its process tree; committing whatever was "
                       "banked before the wedge", flush=True)
             committed = commit_onchip(started_after=before)
             if committed:

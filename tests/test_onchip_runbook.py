@@ -80,6 +80,45 @@ def test_session_budget_exhaustion_skips_cleanly(tmp_path, monkeypatch):
             "skipped: session budget exhausted"), (step, banked)
 
 
+def test_kill_process_tree_reaches_own_session_grandchildren():
+    """The kill discipline must reach a grandchild running in its OWN
+    session (run_step starts step children with start_new_session=True) —
+    killpg on the parent's group alone orphans exactly the process that
+    holds the single-holder TPU client."""
+    import subprocess
+    import sys
+    import time
+
+    mod = _load()
+    parent = subprocess.Popen([sys.executable, "-c", (
+        "import subprocess, sys, time\n"
+        "subprocess.Popen([sys.executable, '-c',"
+        " 'import time; time.sleep(600)'], start_new_session=True)\n"
+        "time.sleep(600)\n")], start_new_session=True)
+    gchildren = []
+    for _ in range(30):
+        time.sleep(0.5)
+        out = subprocess.run(["ps", "-eo", "pid,ppid"],
+                             capture_output=True, text=True).stdout
+        rows = [ln.split() for ln in out.splitlines()[1:]
+                if len(ln.split()) == 2]
+        gchildren = [int(p) for p, pp in rows
+                     if pp.isdigit() and int(pp) == parent.pid]
+        if gchildren:
+            break
+    assert gchildren, "test harness never saw the grandchild"
+    mod.kill_process_tree(parent.pid)
+    parent.wait()
+    time.sleep(0.5)
+    for g in gchildren:
+        try:
+            with open(f"/proc/{g}/stat") as f:
+                state = f.read().rsplit(")", 1)[-1].split()[0]
+            assert state == "Z", f"grandchild {g} alive in state {state}"
+        except (ProcessLookupError, OSError):
+            pass  # already reaped — dead is dead
+
+
 def test_last_json_salvages_checkpoint_line():
     mod = _load()
     # A timed-out child's stdout can end mid-line; the intact checkpoint
