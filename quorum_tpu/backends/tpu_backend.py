@@ -156,6 +156,16 @@ def _request_number(body: dict[str, Any], key: str, default: float) -> float:
     return out
 
 
+def _reject_mixed(items: list, field: str) -> None:
+    """Strings and token arrays cannot mix in one request (the documented
+    contract, matching OpenAI) — per-item validation alone would silently
+    accept the mix."""
+    if (any(isinstance(x, str) for x in items)
+            and any(isinstance(x, list) for x in items)):
+        raise _invalid_request(
+            f"'{field}' must not mix strings and token arrays")
+
+
 def _top_dict(pairs) -> dict[str, float]:
     """Legacy ``top_logprobs`` dict keyed by token TEXT: distinct ids can
     decode to the same text (byte tokens inside a multi-byte character all
@@ -705,6 +715,7 @@ class TpuBackend:
             items = [raw]  # one pre-tokenized input
         elif isinstance(raw, list) and raw:
             items = raw
+            _reject_mixed(items, "input")
         else:
             raise _invalid_request(
                 "'input' must be a non-empty string, list of strings, or "
@@ -790,6 +801,7 @@ class TpuBackend:
             items = [raw]
         elif isinstance(raw, list) and raw:
             items = raw
+            _reject_mixed(items, "prompt")
         else:
             raise _invalid_request(
                 "'prompt' must be a non-empty string, list of strings, or "

@@ -2015,6 +2015,19 @@ def shutdown_all_engines(timeout: float = 30.0) -> None:
         _ENGINES.clear()
 
 
+def release_engine(engine: "InferenceEngine", timeout: float = 30.0) -> None:
+    """Shut ONE engine down and evict it from the shared cache — the hot
+    reload path for a backend whose edit dropped or re-specced it. Without
+    the eviction the strong ``_ENGINES`` reference keeps weights, KV cache,
+    and the scheduler thread resident forever (at 7B scale the next engine
+    build then OOMs the device)."""
+    with _ENGINES_LOCK:
+        for key, eng in list(_ENGINES.items()):
+            if eng is engine:
+                del _ENGINES[key]
+    engine.shutdown(timeout=timeout)
+
+
 def _load_draft_ckpt(draft_ckpt: str, target_max_seq: int,
                      dtype: str | None = None):
     """(spec, params) for a draft checkpoint, window-matched to the target.

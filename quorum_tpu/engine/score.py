@@ -36,10 +36,14 @@ def _score_fn(engine, b_bucket: int, t_bucket: int, top_k: int):
     spec = engine.spec
     stacked = engine.members > 1 or engine.ensemble > 1
 
-    def run(params, tokens, member):
+    def run(params, tokens, lengths, member):
         if stacked:
             params = jax.tree.map(lambda x: x[member], params)
-        logits = forward_logits(params, spec, tokens)  # [B, T, V]
+        # lengths gates MoE expert capacity: without it, an earlier row's
+        # pad tokens would evict a later row's REAL tokens from the fixed
+        # capacity buffers, making logprobs batch-composition-dependent.
+        logits = forward_logits(params, spec, tokens,
+                                lengths=lengths)  # [B, T, V]
         lps = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
         # Position j's row predicts token j+1: shift so out[:, j] scores
         # tokens[:, j] (j >= 1); column 0 is meaningless and masked by the
@@ -77,10 +81,12 @@ def score_token_batch(
     t_bucket = _seq_bucket(max(len(t) for t in token_lists), max_seq)
     b_bucket = _batch_bucket(n)
     tokens = np.zeros((b_bucket, t_bucket), np.int32)
+    lengths = np.zeros((b_bucket,), np.int32)
     for i, t in enumerate(token_lists):
         tokens[i, : len(t)] = t
+        lengths[i] = len(t)
     out = _score_fn(engine, b_bucket, t_bucket, top_k)(
-        engine.params, tokens, np.int32(member))
+        engine.params, tokens, lengths, np.int32(member))
     from quorum_tpu.engine.engine import _host_fetch
 
     fetched = [np.asarray(x) for x in _host_fetch(*out)] if len(out) > 1 \

@@ -737,14 +737,19 @@ def forward_logits(
     spec: ModelSpec,
     tokens: jnp.ndarray,  # [B, T]
     remat: bool = False,
+    lengths: jnp.ndarray | None = None,  # [B] — gates MoE capacity for pads
 ) -> jnp.ndarray:
     """Full-sequence logits [B, T, V] — the training-step / eval forward
     (no KV cache; used by the multi-chip dry run's loss+grad and by tests
-    that check prefill/decode consistency against a cache-free ground truth)."""
+    that check prefill/decode consistency against a cache-free ground
+    truth). Right-padded batches of MoE models must pass ``lengths`` —
+    pad rows would otherwise consume expert capacity ahead of later rows'
+    real tokens (see _moe_mlp_grouped)."""
     mask = causal_mask(tokens.shape[1], tokens.shape[1],
                        window=spec.sliding_window)
     return _scan_layers(
-        params, spec, tokens, lambda q, k, v: attention(q, k, v, mask), remat
+        params, spec, tokens, lambda q, k, v: attention(q, k, v, mask),
+        remat, lengths=lengths,
     )
 
 

@@ -22,6 +22,7 @@ and requires a file-backed config.
 
 from __future__ import annotations
 
+import asyncio
 import logging
 import os
 import time
@@ -60,6 +61,7 @@ class ConfigWatcher:
         self._overrides = dict(overrides)
         self._sig = self._stat_sig()
         self._next_check = 0.0
+        self._busy = False
 
     def _stat_sig(self) -> tuple[int, int] | None:
         try:
@@ -70,6 +72,8 @@ class ConfigWatcher:
 
     async def poll(self) -> None:
         """Reload if the file changed; called at request arrival."""
+        if self._busy:
+            return  # a rebuild is in flight; serve on the previous config
         now = time.monotonic()
         if now < self._next_check:
             return
@@ -78,27 +82,48 @@ class ConfigWatcher:
         if sig == self._sig:
             return
         self._sig = sig
-        await self._reload()
+        self._busy = True
+        try:
+            await self._reload()
+        finally:
+            self._busy = False
 
     async def _reload(self) -> None:
-        try:
+        rt = self._runtime
+
+        def build() -> tuple[Config, Any, list[Backend]]:
             raw: Any = yaml.safe_load(self.path.read_text())
             if not isinstance(raw, dict):
                 raise ValueError(
                     f"config root must be a mapping, got {type(raw).__name__}")
+            new_cfg = Config(raw=raw, source_path=self.path)
+            new_reg, dropped = rebuild_registry(new_cfg, rt.reg,
+                                                self._overrides)
+            return new_cfg, new_reg, dropped
+
+        # Off the event loop: constructing a changed tpu:// backend loads
+        # weights and compiles (minutes at 7B) — in-flight streams must
+        # keep draining on the previous registry meanwhile. The build must
+        # also never take down serving: ANY failure (YAML typo, valid YAML
+        # with a malformed backends shape, a bad tpu:// URL) keeps the
+        # previous config and logs; the next successful edit applies.
+        try:
+            new_cfg, new_reg, dropped = await asyncio.to_thread(build)
         except Exception as e:
-            # Keep serving on the previous config — a mid-edit save or a
-            # YAML typo must not drop live traffic.
             logger.error("Config reload from %s failed (%s); keeping the "
                          "previous configuration", self.path, e)
             return
-        rt = self._runtime
-        new_cfg = Config(raw=raw, source_path=self.path)
-        new_reg, dropped = rebuild_registry(new_cfg, rt.reg, self._overrides)
         rt.cfg, rt.reg = new_cfg, new_reg
         logger.info(
             "Config reloaded from %s: %d backend(s) active, %d dropped",
             self.path, len(new_reg), len(dropped))
+        # Release what the edit dropped: HTTP clients close; tpu:// engines
+        # shut down and leave the shared cache UNLESS a kept backend still
+        # serves from the same engine (engines are shared by weight
+        # identity).
+        kept_engines = {id(getattr(b, "engine", None))
+                        for b in new_reg.backends} - {id(None)}
+        released: set[int] = set()
         for b in dropped:
             close = getattr(b, "aclose", None)
             if close is not None:
@@ -107,3 +132,15 @@ class ConfigWatcher:
                 except Exception:
                     logger.exception("Closing dropped backend %s failed",
                                      b.name)
+            engine = getattr(b, "engine", None)
+            if (engine is not None and id(engine) not in kept_engines
+                    and id(engine) not in released):
+                released.add(id(engine))
+                from quorum_tpu.engine.engine import release_engine
+
+                try:
+                    await asyncio.to_thread(release_engine, engine)
+                except Exception:
+                    logger.exception(
+                        "Releasing dropped backend %s's engine failed",
+                        b.name)

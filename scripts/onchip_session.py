@@ -334,13 +334,19 @@ def main() -> None:
 
     bench_got: dict = {}
     if "bench" not in skip:
-        # Budget must exceed bench.py's own derived watchdog (phase budgets
-        # + probe windows + margin — ~9 900 s with the A/B and ckpt phases
-        # enabled), or a healthy run gets killed mid-int8-phase from outside.
+        # Full budget (10800 s) exceeds bench.py's own derived watchdog
+        # (~9 900 s with the A/B and ckpt phases). A TRIMMED budget is
+        # handed to bench as QUORUM_TPU_BENCH_WATCHDOG so bench replans its
+        # phases INSIDE it and exits cleanly — killing a bench that still
+        # believes in its full plan is the mid-dispatch SIGKILL this whole
+        # mechanism exists to avoid; the outer timeout (+300 s) is only
+        # the backstop.
         b = fits("bench", 10800)
         if b:
+            env = ({"QUORUM_TPU_BENCH_WATCHDOG": str(b)}
+                   if b < 10800 else None)
             bench_got = run_step("bench", [sys.executable, "bench.py"],
-                                 budget=b)
+                                 budget=b + 300, env_extra=env)
             bank(bench_got)
     if "ab" not in skip:
         # bench.py's own plan now carries the stacked A/B (ab_* keys);

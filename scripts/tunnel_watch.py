@@ -65,7 +65,8 @@ def commit_onchip(started_after: float) -> bool:
     n_metrics = sum(
         1 for k, v in got.items()
         if isinstance(v, (int, float)) and not isinstance(v, bool)
-        and k not in ("ts", "onchip_started_ts"))
+        and k not in ("ts", "onchip_started_ts")
+        and not k.endswith("_wall_s"))  # diagnostics, not measurements
     if n_metrics == 0:
         # A dead-at-start session banks only an error record + timestamps;
         # committing that as "results" would be dishonest.

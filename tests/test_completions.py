@@ -132,6 +132,30 @@ async def test_generation_logprobs_align_with_text():
         assert lp["text_offset"] == sorted(lp["text_offset"])
 
 
+def test_moe_scoring_batch_composition_independent():
+    """MoE teacher-forced scoring must pass lengths: an earlier row's PAD
+    tokens (identical embeddings → identical routing) would otherwise
+    flood one expert's capacity queue ahead of a later row's real tokens
+    and silently change its logprobs. cf=1.5 < E/k=2 keeps drops live."""
+    import numpy as np
+
+    from quorum_tpu.engine.engine import InferenceEngine
+    from quorum_tpu.engine.score import score_token_batch
+    from quorum_tpu.models.model_config import resolve_spec
+
+    spec = resolve_spec("mixtral-tiny", {"max_seq": "128",
+                                         "moe_capacity_factor": "1.5"})
+    eng = InferenceEngine(spec, decode_chunk=4, n_slots=1)
+    short = [(i % 97) + 3 for i in range(20)]
+    long = [(i % 89) + 5 for i in range(120)]
+    alone = score_token_batch(eng, [long])[0]["token_logprobs"][1:]
+    # co-batched beside the short prompt: its 108 pad positions sit in the
+    # flattened stream BEFORE long's real tokens
+    batched = score_token_batch(eng, [short, long])[1]["token_logprobs"][1:]
+    eng.shutdown()
+    np.testing.assert_allclose(alone, batched, atol=2e-4)
+
+
 async def test_pretokenized_prompt():
     async with make_client(cfg()) as client:
         got = (await post(client, {"prompt": [[5, 6, 7, 8]],
@@ -178,6 +202,7 @@ async def test_streaming_legacy_wire():
     ({"prompt": "x", "suffix": "y"}, "suffix"),
     ({"prompt": ""}, "prompt"),
     ({"prompt": []}, "prompt"),
+    ({"prompt": ["text", [5, 6]]}, "must not mix"),
     ({"prompt": "x " * 500, "echo": True, "logprobs": 0, "max_tokens": 0},
      "max_seq"),
     ({"prompt": ["a", "b"], "stream": True}, "exactly one prompt"),

@@ -159,6 +159,7 @@ async def test_model_routing_picks_matching_backend():
     ({"input": []}, "input"),
     ({"input": ""}, "input"),
     ({"input": ["ok", 5]}, "each 'input' item"),
+    ({"input": ["ok", [5, 6]]}, "must not mix"),
     ({"input": [[999999]]}, "in-vocab"),
     ({"input": "x", "encoding_format": "binary"}, "encoding_format"),
     ({"input": "x", "dimensions": 0}, "dimensions"),

@@ -138,6 +138,59 @@ async def test_malformed_edit_keeps_previous_config(tmp_path):
         assert r3.status_code == 200 and r3.json()["backend"] == "C"
 
 
+async def test_valid_yaml_bad_shape_keeps_previous_config(tmp_path):
+    """A config that parses as YAML but has a malformed backends shape
+    (scalar entries) must behave like a YAML typo: previous config keeps
+    serving, the triggering request succeeds, no crash."""
+    path = tmp_path / "config.yaml"
+    _write(path, _cfg([_tiny("A", seed=1)]))
+    app = create_app(load_config(path), watch_config=True)
+
+    async with _client(app) as client:
+        body = {"model": "tiny", "max_tokens": 4, "temperature": 0.0,
+                "messages": [{"role": "user", "content": "x"}]}
+        assert (await client.post("/v1/chat/completions", json=body,
+                                  headers={"Authorization": "Bearer t"})
+                ).status_code == 200
+        path.write_text("primary_backends:\n  - just-a-string\n")
+        st = os.stat(path)
+        os.utime(path, ns=(st.st_atime_ns, st.st_mtime_ns + 1_000_000))
+        await _wait_reload_window()
+        r = await client.post("/v1/chat/completions", json=body,
+                              headers={"Authorization": "Bearer t"})
+        assert r.status_code == 200 and r.json()["backend"] == "A"
+
+
+async def test_dropped_engine_is_released(tmp_path):
+    """An edit that drops a tpu:// backend (weights no longer referenced)
+    must shut its engine down and evict it from the shared cache — not
+    leak HBM-scale state behind a no-op aclose."""
+    from quorum_tpu.engine.engine import _ENGINES
+
+    path = tmp_path / "config.yaml"
+    _write(path, _cfg([_tiny("A", seed=41)]))
+    app = create_app(load_config(path), watch_config=True)
+
+    async with _client(app) as client:
+        body = {"model": "tiny", "max_tokens": 4, "temperature": 0.0,
+                "messages": [{"role": "user", "content": "x"}]}
+        await client.post("/v1/chat/completions", json=body,
+                          headers={"Authorization": "Bearer t"})
+        old_engine = app.state["registry"].get("A").engine
+        assert any(e is old_engine for e in _ENGINES.values())
+
+        # different seed = different weights: the old engine has no keeper
+        _write(path, _cfg([_tiny("A", seed=42)]))
+        await _wait_reload_window()
+        r = await client.post("/v1/chat/completions", json=body,
+                              headers={"Authorization": "Bearer t"})
+        assert r.status_code == 200
+        new_engine = app.state["registry"].get("A").engine
+        assert new_engine is not old_engine
+        assert not any(e is old_engine for e in _ENGINES.values()), (
+            "dropped engine still in the shared cache")
+
+
 async def test_watch_off_by_default(tmp_path):
     path = tmp_path / "config.yaml"
     _write(path, _cfg([_tiny("A", seed=1)]))
