@@ -343,8 +343,14 @@ def main() -> None:
         # the backstop.
         b = fits("bench", 10800)
         if b:
-            env = ({"QUORUM_TPU_BENCH_WATCHDOG": str(b)}
-                   if b < 10800 else None)
+            # bench.py defaults its orchestrator deadline to the ~1500 s
+            # driver kill window; THIS run is supervised with a real
+            # multi-hour budget, so say so explicitly — without
+            # QUORUM_TPU_BENCH_DEADLINE_S the session's bench would skip
+            # every post-headline phase at the driver-window default.
+            env = {"QUORUM_TPU_BENCH_DEADLINE_S": str(b)}
+            if b < 10800:
+                env["QUORUM_TPU_BENCH_WATCHDOG"] = str(b)
             bench_got = run_step("bench", [sys.executable, "bench.py"],
                                  budget=b + 300, env_extra=env)
             bank(bench_got)

@@ -74,9 +74,12 @@ def commit_onchip(started_after: float) -> bool:
               flush=True)
         return False
     add = subprocess.run(["git", "add", "ONCHIP.json"], cwd=REPO)
+    # ``-- ONCHIP.json`` scopes the commit to the artifact alone: anything
+    # else the operator had staged must not be swept into this commit.
     commit = subprocess.run(
         ["git", "commit", "-m",
-         f"ONCHIP: on-chip session results ({n_metrics} numeric keys)"],
+         f"ONCHIP: on-chip session results ({n_metrics} numeric keys)",
+         "--", "ONCHIP.json"],
         cwd=REPO)
     ok = add.returncode == 0 and commit.returncode == 0
     print(f"[watch] commit of ONCHIP.json ({n_metrics} numeric keys): "
