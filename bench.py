@@ -1130,10 +1130,19 @@ def _watchdog(prefix: str | None) -> None:
 
     # Children keep the phase-sum budget (their real lifetime is the
     # parent's subprocess timeout; this is only a wedge backstop). The
-    # PARENT's watchdog must sit just past its own orchestrator deadline
-    # (_deadline_cap) and still inside the driver's external window, so a
-    # wedge bark beats the rc-124 kill.
-    budget = _derived_watchdog_budget() if prefix else _deadline_cap() + 120
+    # PARENT's watchdog on a TPU host must sit just past its own
+    # orchestrator deadline (_deadline_cap) and still inside the driver's
+    # external window, so a wedge bark beats the rc-124 kill. A CPU smoke
+    # run keeps the phase-sum budget: there is no tunnel to wedge, and a
+    # slow-but-healthy full-size run must not be shot at the (much
+    # tighter) driver-window cap.
+    if prefix:
+        budget = _derived_watchdog_budget()
+    else:
+        from quorum_tpu.compile_cache import tpu_host_configured
+
+        budget = (_deadline_cap() + 120 if tpu_host_configured()
+                  else _derived_watchdog_budget())
     if budget <= 0:
         return
 

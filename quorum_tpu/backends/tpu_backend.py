@@ -186,8 +186,10 @@ def _invalid_request(message: str) -> BackendError:
     )
 
 
-def _overloaded(name: str) -> BackendError:
-    msg = f"Backend {name} is overloaded: admission queue full; retry later"
+def _overloaded(name: str, why: str = "admission queue full") -> BackendError:
+    """503 with the actual saturated resource named — an operator debugging
+    the error must not tune the chat queue when the scoring gate tripped."""
+    msg = f"Backend {name} is overloaded: {why}; retry later"
     return BackendError(
         msg, status_code=503,
         body=oai.error_body(msg, type_="overloaded_error", code=503),
@@ -393,6 +395,29 @@ class TpuBackend:
     # dropped these, VERDICT r2 missing item 1).
     _UNSUPPORTED = ("tools", "tool_choice", "functions", "function_call")
     MAX_N = 8
+
+    def _acquire_score_slot(self) -> None:
+        """Admit one scoring/embedding device forward or raise 503.
+
+        The gate (``engine.score_gate``, shared per engine — stacked
+        members and ckpt backends on one engine contend for the same
+        chip) bounds the direct to_thread device forwards the slot queue
+        does not cover (ADVICE r4)."""
+        if not self.engine.score_gate.acquire(blocking=False):
+            raise _overloaded(self.name, "scoring/embedding gate saturated")
+
+    def _release_score_slot(self) -> None:
+        self.engine.score_gate.release()
+
+    async def _shielded_to_thread(self, fn, timeout: float):
+        """Run ``fn`` on a thread the event loop cannot cancel mid-device-
+        work: the shield guarantees fn executes exactly once even when the
+        wait times out or the client drops, so fn's own finally (slot/gate
+        release) always runs. Raises asyncio.TimeoutError on expiry while
+        the device work continues in the background."""
+        task = asyncio.create_task(asyncio.to_thread(fn))
+        task.add_done_callback(lambda t: t.cancelled() or t.exception())
+        return await asyncio.wait_for(asyncio.shield(task), timeout=timeout)
 
     def _plan(self, body: dict[str, Any]) -> dict[str, Any]:
         effective = prepare_body(body, self.model)
@@ -640,12 +665,8 @@ class TpuBackend:
         def run():
             return [self._consume(plan, r) for r in reqs]
 
-        task = asyncio.create_task(asyncio.to_thread(run))
-        # If we abandon the task on timeout, still retrieve its eventual
-        # exception so asyncio doesn't log "exception was never retrieved".
-        task.add_done_callback(lambda t: t.cancelled() or t.exception())
         try:
-            outs = await asyncio.wait_for(asyncio.shield(task), timeout=timeout)
+            outs = await self._shielded_to_thread(run, timeout)
         except asyncio.TimeoutError:
             # Abort the on-device loop at the next chunk boundary; don't hold
             # the request open waiting for the full generation.
@@ -747,13 +768,21 @@ class TpuBackend:
             raise _invalid_request(
                 f"'dimensions' must be an integer in 1..{d_model}")
 
+        self._acquire_score_slot()  # 503 when saturated (ADVICE r4)
+
         def run():
-            return embed_token_batch(self.engine, token_lists,
-                                     member=self.member)
+            try:
+                return embed_token_batch(self.engine, token_lists,
+                                         member=self.member)
+            finally:
+                # The slot frees when the DEVICE work ends, not when the
+                # client's wait ends — a timed-out request's forward still
+                # occupies the chip; _shielded_to_thread guarantees this
+                # finally runs exactly once.
+                self._release_score_slot()
 
         try:
-            vectors = await asyncio.wait_for(
-                asyncio.to_thread(run), timeout=timeout)
+            vectors = await self._shielded_to_thread(run, timeout)
         except asyncio.TimeoutError:
             raise BackendError(
                 f"Backend {self.name} timed out after {timeout}s") from None
@@ -943,15 +972,20 @@ class TpuBackend:
 
         scores = None
         if scoring:
+            self._acquire_score_slot()  # 503 when saturated (ADVICE r4)
+
             def run_score():
-                return score_token_batch(
-                    self.engine, [ids for _, ids in prompts],
-                    member=self.member, top_k=lp)
+                try:
+                    return score_token_batch(
+                        self.engine, [ids for _, ids in prompts],
+                        member=self.member, top_k=lp)
+                finally:
+                    # Freed when the device work ends (see embed()).
+                    self._release_score_slot()
 
             try:
-                scores = await asyncio.wait_for(
-                    asyncio.to_thread(run_score),
-                    timeout=max(0.0, deadline - _time.monotonic()))
+                scores = await self._shielded_to_thread(
+                    run_score, max(0.0, deadline - _time.monotonic()))
             except asyncio.TimeoutError:
                 raise BackendError(
                     f"Backend {self.name} timed out after {timeout}s"
@@ -995,12 +1029,9 @@ class TpuBackend:
                 return [self._consume(plans[i], r)
                         for i, r in enumerate(reqs)]
 
-            task = asyncio.create_task(asyncio.to_thread(run))
-            task.add_done_callback(lambda t: t.cancelled() or t.exception())
             try:
-                outs = await asyncio.wait_for(
-                    asyncio.shield(task),
-                    timeout=max(0.0, deadline - _time.monotonic()))
+                outs = await self._shielded_to_thread(
+                    run, max(0.0, deadline - _time.monotonic()))
             except asyncio.TimeoutError:
                 cancel_all()
                 raise BackendError(
@@ -1038,8 +1069,19 @@ class TpuBackend:
                 if echo:
                     score = scores[i]
                     top = score.get("top")
+                    # Incremental detokenization (the streaming path's own
+                    # tool): byte-level BPE tokens can split one multi-byte
+                    # UTF-8 character, and per-token decode([tid]) would
+                    # emit replacement chars whose lengths drift
+                    # tokens/text_offset away from the echoed prompt
+                    # string (ADVICE r4). feed() emits only complete
+                    # characters, so every offset indexes correctly into
+                    # the returned text.
+                    detok = self.tokenizer.detokenizer()
                     for j, tid in enumerate(ids):
-                        ttext = self.tokenizer.decode([int(tid)])
+                        ttext = detok.feed(int(tid))
+                        if j == len(ids) - 1:
+                            ttext += detok.flush()
                         tokens.append(ttext)
                         offsets.append(pos)
                         pos += len(ttext)

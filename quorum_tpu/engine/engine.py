@@ -78,6 +78,9 @@ MIN_BUCKET = 16
 DEFAULT_SLOTS = 4
 DEFAULT_PREFILL_CHUNK = 512
 DEFAULT_MAX_PENDING = 128
+# Concurrent scoring/embedding device forwards per engine (see
+# ``score_gate`` in InferenceEngine.__init__); excess requests 503.
+SCORE_GATE_SLOTS = 2
 TOP_LOGPROBS = 20  # top alternatives computed per step (OpenAI's API maximum)
 # Prefix caching: reuse a free slot's resident KV prefix only when the match
 # is at least this long — shorter matches aren't worth routing through the
@@ -459,6 +462,14 @@ class InferenceEngine:
         self.members = max(1, int(members))
         self.decode_chunk = max(1, decode_chunk)
         self.n_slots = max(1, n_slots)
+        # Admission gate for the direct device forwards (embeddings,
+        # teacher-forced scoring): chat decode is slot-queue-gated, but
+        # those paths dispatch straight to the device — and a timed-out
+        # client wait leaves the device thread running, so unbounded
+        # submissions would pile uncancellable device work against live
+        # decode (ADVICE r4). Acquire with blocking=False and 503 on
+        # saturation (backends/tpu_backend.py).
+        self.score_gate = threading.Semaphore(SCORE_GATE_SLOTS)
         # Queue capacity scales with members: a stacked engine absorbs the
         # whole fan-out's admissions in ONE queue, so M members must carry
         # the aggregate capacity M separate engines would have had.

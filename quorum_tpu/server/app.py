@@ -407,7 +407,11 @@ def create_app(
         """Shared preamble for the no-fan-out endpoints (/embeddings,
         /completions): parse + auth, strip internal-only fields, pick the
         single target — the backend whose configured model matches the
-        request model, else the first capable one in config order. Returns
+        request model; with no model in the request, the first capable one
+        in config order. A requested model no capable backend is pinned to
+        falls to a blank-model backend (it forwards/serves whatever the
+        request names) or — with every candidate pinned elsewhere — 404s
+        with OpenAI's ``model_not_found``. Returns
         ``(cfg, body, headers, target)`` or an error Response."""
         cfg, reg = await current()
         try:
@@ -436,7 +440,26 @@ def create_app(
         req_model = body.get("model")
         target = next(
             (b for b in candidates if req_model and b.model == req_model),
-            candidates[0])
+            None)
+        if target is None and req_model:
+            # A typo'd or unserved model must NOT silently fall to a
+            # different model's backend — eval harnesses key results on
+            # `model`, and OpenAI answers model_not_found here. A backend
+            # with a blank configured model is the exception: it serves or
+            # relays whatever the request names.
+            target = next((b for b in candidates if not b.model), None)
+            if target is None:
+                return JSONResponse(
+                    {"error": {
+                        "message": f"The model '{req_model}' does not "
+                                   "exist or is not served by any "
+                                   f"backend with {what} support",
+                        "type": "invalid_request_error",
+                        "param": "model",
+                        "code": "model_not_found"}},
+                    status_code=404)
+        if target is None:
+            target = candidates[0]
         return (cfg, body, headers, target)
 
     @app.route("POST", "/embeddings", "/v1/embeddings")

@@ -219,15 +219,15 @@ async def test_invalid_requests_400(body, fragment):
         assert fragment in err["message"], err["message"]
 
 
-async def test_stream_accepts_serialized_defaults_and_model_precedence():
+async def test_stream_accepts_serialized_defaults_and_config_model():
     """logprobs=false / best_of=1 / n=1 are serialized client defaults —
-    streaming must accept them like the flat path; and streamed frames
-    carry the same config-overrides-request model string as flat
-    responses."""
+    streaming must accept them like the flat path; a request without a
+    model falls to the configured backend, and frames carry its configured
+    model string like flat responses."""
     async with make_client(cfg()) as client:
         resp = await client.post(
             "/v1/completions",
-            json={"model": "something-else", "prompt": "defaults",
+            json={"prompt": "defaults",
                   "max_tokens": 3, "temperature": 0.0, "stream": True,
                   "logprobs": False, "best_of": 1, "n": 1},
             headers={"Authorization": "Bearer t"})
@@ -236,10 +236,27 @@ async def test_stream_accepts_serialized_defaults_and_model_precedence():
                   for ln in resp.text.splitlines()
                   if ln.startswith("data: ") and ln != "data: [DONE]"]
         assert frames and all(f["model"] == "tiny" for f in frames)
-        flat = (await post(client, {"model": "something-else",
-                                    "prompt": "defaults", "max_tokens": 3,
+        flat = (await post(client, {"prompt": "defaults", "max_tokens": 3,
                                     "temperature": 0.0})).json()
         assert flat["model"] == "tiny"
+
+
+async def test_unknown_model_is_404_not_silent_fallback():
+    """ADVICE r4: a typo'd model on the no-fan-out endpoints must answer
+    OpenAI's model_not_found, never be silently scored by a different
+    model's backend (eval harnesses key results on `model`)."""
+    async with make_client(cfg()) as client:
+        resp = await post(client, {"model": "something-else",
+                                   "prompt": "x", "max_tokens": 2})
+        assert resp.status_code == 404, resp.text
+        err = resp.json()["error"]
+        assert err["code"] == "model_not_found"
+        assert err["param"] == "model"
+        assert "something-else" in err["message"]
+        # the configured name still serves
+        ok = await post(client, {"model": "tiny", "prompt": "x",
+                                 "max_tokens": 2, "temperature": 0.0})
+        assert ok.status_code == 200, ok.text
 
 
 async def test_best_of_one_is_a_noop():
@@ -312,3 +329,27 @@ async def test_http_backend_relays_completions():
     assert seen["path"] == "/v1/completions"
     assert seen["body"]["model"] == "cfg-model" and seen["body"]["stream"] is False
     await be.aclose()
+
+
+async def test_echo_logprobs_offsets_multibyte_utf8():
+    """ADVICE r4: echo-mode token texts / text_offset must track the
+    echoed prompt string even when byte-level tokens split a multi-byte
+    UTF-8 character — per-token decode would emit replacement chars whose
+    lengths drift every later offset."""
+    async with make_client(cfg()) as client:
+        prompt = "café au läit"  # é/ä are 2 UTF-8 bytes → 2 byte-tokens
+        resp = await post(client, {"model": "tiny", "prompt": prompt,
+                                   "echo": True, "logprobs": 0,
+                                   "max_tokens": 0})
+        assert resp.status_code == 200, resp.text
+        choice = resp.json()["choices"][0]
+        assert choice["text"] == prompt
+        lp = choice["logprobs"]
+        toks, offs = lp["tokens"], lp["text_offset"]
+        assert len(toks) == len(offs) == len(lp["token_logprobs"])
+        assert "".join(toks) == prompt  # no replacement chars, no drift
+        pos = 0
+        for t, o in zip(toks, offs):
+            assert o == pos  # each offset indexes its token's start
+            assert prompt[o:o + len(t)] == t
+            pos += len(t)

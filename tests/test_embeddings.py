@@ -225,3 +225,40 @@ async def test_no_capable_backend_500():
         resp = await post_embed(client, {"input": "x"})
         assert resp.status_code == 500
         assert resp.json()["error"]["type"] == "configuration_error"
+
+
+async def test_scoring_admission_gate_503(monkeypatch):
+    """ADVICE r4: embed/score device forwards are admission-gated — with
+    MAX_SCORE_INFLIGHT forwards occupying the device, the next request
+    503s (same _overloaded contract as a full chat queue) instead of
+    piling uncancellable device work against live decode."""
+    import asyncio
+    import threading
+
+    import numpy as _np
+
+    from quorum_tpu.engine import embed as embed_mod
+
+    release = threading.Event()
+
+    def blocked_embed(engine, token_lists, member=0):
+        release.wait(timeout=30)
+        return _np.ones((len(token_lists), 64), _np.float32)
+
+    monkeypatch.setattr(embed_mod, "embed_token_batch", blocked_embed)
+    async with make_client(one_backend_config()) as client:
+        async def one():
+            return await post_embed(client, {"input": "x"})
+
+        tasks = [asyncio.create_task(one()) for _ in range(3)]
+        # let all three reach the gate while the device threads block
+        await asyncio.sleep(0.5)
+        release.set()
+        codes = sorted(r.status_code for r in await asyncio.gather(*tasks))
+        assert codes == [200, 200, 503], codes
+        err = next(r for r in [t.result() for t in tasks]
+                   if r.status_code == 503).json()["error"]
+        assert err["type"] == "overloaded_error"
+        # slots freed: the next request is admitted again
+        ok = await post_embed(client, {"input": "y"})
+        assert ok.status_code == 200, ok.text

@@ -190,3 +190,24 @@ async def test_live_errors_conform(req, headers, status, err_type,
         body = resp.json()
         check("ErrorResponse", body)
         assert body["error"]["type"] == err_type
+
+
+def test_no_fanout_routes_document_model_not_found():
+    """ADVICE r4: the no-fan-out endpoints 404 on an unserved model; the
+    contract documents the full status family for both."""
+    for route in ("/completions", "/embeddings"):
+        post = DOC["paths"][route]["post"]
+        assert {"200", "400", "401", "404", "500", "503"} <= set(
+            post["responses"]), route
+
+
+async def test_live_model_not_found_conforms():
+    async with make_client(single_backend_config()) as client:
+        resp = await client.post(
+            "/v1/completions",
+            json={"model": "no-such-model", "prompt": "x", "max_tokens": 1},
+            headers={"Authorization": "Bearer t"})
+        assert resp.status_code == 404, resp.text
+        body = resp.json()
+        check("ErrorResponse", body)
+        assert body["error"]["code"] == "model_not_found"
