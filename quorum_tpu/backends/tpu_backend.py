@@ -419,6 +419,25 @@ class TpuBackend:
         task.add_done_callback(lambda t: t.cancelled() or t.exception())
         return await asyncio.wait_for(asyncio.shield(task), timeout=timeout)
 
+    async def _gated_to_thread(self, fn, timeout: float):
+        """Score-gated device forward: acquire a slot (503 when
+        saturated — ADVICE r4), run ``fn`` shielded, and free the slot
+        when the DEVICE work ends — not when the client's wait ends, so a
+        timed-out request's still-running forward keeps its slot."""
+        self._acquire_score_slot()
+
+        def gated():
+            try:
+                return fn()
+            finally:
+                self._release_score_slot()
+
+        # No await sits between the acquire and the task creation inside
+        # _shielded_to_thread, so no cancellation point can leak the slot;
+        # once the task exists the shield guarantees gated() runs and
+        # releases exactly once.
+        return await self._shielded_to_thread(gated, timeout)
+
     def _plan(self, body: dict[str, Any]) -> dict[str, Any]:
         effective = prepare_body(body, self.model)
         for key in self._UNSUPPORTED:
@@ -768,21 +787,12 @@ class TpuBackend:
             raise _invalid_request(
                 f"'dimensions' must be an integer in 1..{d_model}")
 
-        self._acquire_score_slot()  # 503 when saturated (ADVICE r4)
-
         def run():
-            try:
-                return embed_token_batch(self.engine, token_lists,
-                                         member=self.member)
-            finally:
-                # The slot frees when the DEVICE work ends, not when the
-                # client's wait ends — a timed-out request's forward still
-                # occupies the chip; _shielded_to_thread guarantees this
-                # finally runs exactly once.
-                self._release_score_slot()
+            return embed_token_batch(self.engine, token_lists,
+                                     member=self.member)
 
         try:
-            vectors = await self._shielded_to_thread(run, timeout)
+            vectors = await self._gated_to_thread(run, timeout)
         except asyncio.TimeoutError:
             raise BackendError(
                 f"Backend {self.name} timed out after {timeout}s") from None
@@ -972,19 +982,13 @@ class TpuBackend:
 
         scores = None
         if scoring:
-            self._acquire_score_slot()  # 503 when saturated (ADVICE r4)
-
             def run_score():
-                try:
-                    return score_token_batch(
-                        self.engine, [ids for _, ids in prompts],
-                        member=self.member, top_k=lp)
-                finally:
-                    # Freed when the device work ends (see embed()).
-                    self._release_score_slot()
+                return score_token_batch(
+                    self.engine, [ids for _, ids in prompts],
+                    member=self.member, top_k=lp)
 
             try:
-                scores = await self._shielded_to_thread(
+                scores = await self._gated_to_thread(
                     run_score, max(0.0, deadline - _time.monotonic()))
             except asyncio.TimeoutError:
                 raise BackendError(

@@ -445,8 +445,10 @@ def create_app(
             # A typo'd or unserved model must NOT silently fall to a
             # different model's backend — eval harnesses key results on
             # `model`, and OpenAI answers model_not_found here. A backend
-            # with a blank configured model is the exception: it serves or
-            # relays whatever the request names.
+            # with a blank configured model is the exception: only
+            # http(s):// relays can be blank (TpuBackend.model falls back
+            # to its model_id — pinned by test_embeddings), and a relay
+            # forwards the requested name for the UPSTREAM to validate.
             target = next((b for b in candidates if not b.model), None)
             if target is None:
                 return JSONResponse(

@@ -21,6 +21,18 @@ if "xla_force_host_platform_device_count" not in flags:
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 
+# Persistent XLA compile cache for the suite (quorum_tpu/compile_cache.py's
+# explicit opt-in — same-host CPU reuse is safe): the slow tier is dominated
+# by engine-scale tests whose cost is compiling the same tiny serving
+# programs over and over — identical HLO recurs across modules (the
+# module-scoped engine shutdown below forces rebuilds) and across runs
+# (seeds change weights, not programs). Set QUORUM_TPU_COMPILE_CACHE=0 to
+# opt out; CI restores the directory via actions/cache.
+os.environ.setdefault(
+    "QUORUM_TPU_COMPILE_CACHE",
+    os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                 ".jax_compile_cache"))
+
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")

@@ -262,3 +262,24 @@ async def test_scoring_admission_gate_503(monkeypatch):
         # slots freed: the next request is admitted again
         ok = await post_embed(client, {"input": "y"})
         assert ok.status_code == 200, ok.text
+
+
+def test_tpu_backend_model_never_blank():
+    """The no-fan-out endpoints' blank-model fallback assumes only
+    http(s):// relays can be blank — a blank-model tpu backend would serve
+    arbitrary requested names from unrelated local weights. Pinned: a
+    config omitting `model` yields a tpu backend named by its model_id."""
+    from quorum_tpu.backends.registry import build_registry
+    from quorum_tpu.config import Config
+
+    raw = {"settings": {"timeout": 30},
+           "primary_backends": [
+               {"name": "A",
+                "url": "tpu://llama-tiny?seed=1&max_seq=64&slots=1"}]}
+    reg = build_registry(Config(raw=raw))
+    b = reg.backends[0]
+    try:
+        assert b.model == b.model_id == "llama-tiny"
+        assert b.model  # never blank
+    finally:
+        b.engine.shutdown()
