@@ -23,11 +23,13 @@ dev:
 #                  engine-scale tests recompile identical HLO otherwise)
 # CI restores the cache dir across runs (actions/cache) and adds
 # pytest-xdist (-n 4 --dist loadscope) on its multi-core runners.
+# PYTEST_EXTRA lets CI (or an operator) add flags without re-encoding the
+# invocation — e.g. `make test-all PYTEST_EXTRA="-n 4 --dist loadscope"`.
 test:
-	python -m pytest tests/ -x -q -m "not slow"
+	python -m pytest tests/ -x -q -m "not slow" $(PYTEST_EXTRA)
 
 test-all:
-	python -m pytest tests/ -x -q
+	python -m pytest tests/ -x -q $(PYTEST_EXTRA)
 
 coverage:
 	@python -c "import pytest_cov" 2>/dev/null \

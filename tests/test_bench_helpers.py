@@ -10,6 +10,8 @@ import importlib.util
 import os
 import sys
 
+import pytest
+
 
 def _load_bench():
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -318,3 +320,36 @@ def test_sigkill_mid_probe_leaves_parseable_snapshot():
     assert rec is not None, f"no parseable JSON line survived: {out[-500:]!r}"
     assert rec["metric"] == "p50_ttft_ms" and rec["value"] == -1.0
     assert "phase12" in rec.get("status", "") or "phase12_error" in rec
+
+
+@pytest.mark.slow  # engine-scale: int8 engine + 8192 window + 5k prefill
+def test_7bq_child_end_to_end_tiny(monkeypatch):
+    """The int8 north-star child (--7bq: quantized serving + prefix-cache
+    + co-batch + 5k-token chunked-prefill long-context) end to end on a
+    tiny model — this exact path must work first-try in a live tunnel
+    window, and the final JSON line must carry the b7q_* schema including
+    the long-context keys."""
+    import subprocess as sp
+
+    bench = _load_bench()
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["QUORUM_TPU_BENCH_7B_QUANT"] = "1"
+    env["QUORUM_TPU_BENCH_7B_QUANT_MODEL"] = "llama-tiny"
+    env["QUORUM_TPU_BENCH_7B_MAX_TOKENS"] = "24"
+    proc = sp.run([sys.executable, os.path.join(repo, "bench.py"), "--7bq"],
+                  capture_output=True, text=True, cwd=repo, env=env,
+                  timeout=1800)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    rec = bench._last_json_line(proc.stdout)
+    assert rec, proc.stdout[-500:]
+    assert "b7q_error" not in rec, rec
+    assert rec["b7q_model"] == "llama-tiny+int8"
+    assert rec["b7q_decode_tok_s"] > 0 and rec["b7q_ttft_ms"] > 0
+    assert rec["b7q_tok_s_c2"] > 0
+    assert rec["b7q_prefix_cold_ttft_ms"] >= rec["b7q_prefix_warm_ttft_ms"] > 0
+    # the long-context phase really ran against the 8192 window
+    assert rec["b7q_long_prompt_tokens"] == 5000
+    assert rec["b7q_long_ttft_ms"] > 0 and rec["b7q_long_decode_tok_s"] > 0

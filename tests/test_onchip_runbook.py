@@ -8,6 +8,7 @@ mid-write kill, and children whose stdout ends mid-line.
 import importlib.util
 import json
 import os
+import sys
 
 import pytest
 # Engine-scale / compile-heavy / multi-process: slow tier (make test skips,
@@ -127,3 +128,51 @@ def test_last_json_salvages_checkpoint_line():
     assert mod._last_json(stdout) == {"good": 1}
     assert mod._last_json("") == {}
     assert mod._last_json(None) == {}
+
+
+def test_full_session_rehearsal_on_cpu(tmp_path, monkeypatch):
+    """Dress rehearsal of the WHOLE runbook (main(), every step) against
+    tiny models on CPU: a live tunnel window is too precious to be the
+    first time scripts/onchip_session.py executes end-to-end. Probes are
+    stubbed alive; everything else — subprocess plumbing, process-group
+    kill discipline wiring, banked-key schema per step — runs for real."""
+    mod = _load()
+    out = tmp_path / "ONCHIP.json"
+    monkeypatch.setattr(mod, "OUT", str(out))
+    monkeypatch.setattr(mod, "probe_with_retry", lambda *a, **k: True)
+    # Tiny analogs of the real step URLs (same knob set, CPU-sized):
+    monkeypatch.setattr(mod, "KVQ_URL", (
+        "tpu://llama-tiny?max_seq=2048&slots=2&decode_chunk=8"
+        "&max_tokens=16&quant=int8&kv_quant=int8&prefill_chunk=256"))
+    monkeypatch.setattr(mod, "B7_URL", (
+        "tpu://llama-tiny?max_seq=4096&slots=2&decode_chunk=8"
+        "&max_tokens=16&prefill_chunk=256"))
+    # The bench and qq children read these from the inherited env:
+    for k, v in (("QUORUM_TPU_QQ_MODEL", "llama-tiny"),
+                 ("QUORUM_TPU_BENCH_MODEL", "gpt2-tiny"),
+                 ("QUORUM_TPU_BENCH_TTFT_REQUESTS", "2"),
+                 ("QUORUM_TPU_BENCH_THROUGHPUT_REQUESTS", "4"),
+                 ("QUORUM_TPU_BENCH_MAX_TOKENS", "8"),
+                 ("QUORUM_TPU_BENCH_7B", "0"),
+                 ("QUORUM_TPU_BENCH_7B_QUANT", "0"),
+                 ("QUORUM_TPU_BENCH_CKPT", "0")):
+        monkeypatch.setenv(k, v)
+    monkeypatch.delenv("QUORUM_TPU_ONCHIP_BUDGET", raising=False)
+    monkeypatch.setattr(sys, "argv", ["onchip_session.py"])
+    mod.main()
+
+    banked = json.loads(out.read_text())
+    # Every step banked its keys; none banked an error.
+    errors = {k: v for k, v in banked.items()
+              if k.endswith("_error") and v}
+    assert not errors, errors
+    assert banked["value"] > 0  # bench headline (phase 1/2) landed
+    assert banked["tokens_per_s"] > 0
+    assert any(k.startswith("ab_p50") for k in banked), sorted(banked)
+    assert banked["kvq_decode_tok_s"] > 0
+    assert banked["flash_off_agg_decode_tok_s"] > 0
+    assert banked["flash_on_agg_decode_tok_s"] > 0
+    assert banked["qq_model"] == "llama-tiny"
+    assert 0.5 < banked["qq_ppl_ratio"] < 2.0
+    assert banked["profile_ttft_ms"] > 0
+    assert banked.get("profile_artifacts", 0) >= 0
