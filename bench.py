@@ -473,6 +473,42 @@ def _core_7b_metrics(model, prefix, quant, rates, c2_tok_s, ttfts,
     return out
 
 
+def _banked_onchip() -> "dict | None":
+    """Real-silicon numbers banked by an earlier on-chip session
+    (scripts/onchip_session.py writes ONCHIP.json as each measurement
+    lands; scripts/tunnel_watch.py commits it). Merged — clearly nested
+    and timestamped, never mixed with this run's top-level keys — into
+    the bench output, so a tunnel that was alive mid-session but dead at
+    driver time still delivers silicon numbers in the driver artifact.
+    None when the file is absent, unreadable, or carries no measurements
+    (a dead-at-start session banks only error/timestamp keys)."""
+    if os.environ.get("QUORUM_TPU_BENCH_ONCHIP_MERGE") == "0":
+        # Set by onchip_session for its own bench step: that bench's
+        # output is banked straight back into ONCHIP.json, so merging
+        # here would re-embed the prior artifact one level deeper on
+        # every supervised session.
+        return None
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "ONCHIP.json")
+    try:
+        with open(path) as f:
+            got = json.load(f)
+    except (OSError, ValueError):  # ValueError covers JSON + unicode errors
+        return None
+    if not isinstance(got, dict):
+        return None
+    got.pop("onchip", None)  # never re-nest a legacy self-embedded copy
+    # POSITIVE numerics only: a failed session banks the headline
+    # sentinels (value -1.0, vs_baseline 0.0), which are not measurements.
+    n_metrics = sum(
+        1 for k, v in got.items()
+        if isinstance(v, (int, float)) and not isinstance(v, bool)
+        and v > 0
+        and k not in ("ts", "onchip_started_ts")
+        and not k.endswith("_wall_s"))
+    return got if n_metrics else None
+
+
 def _env_int(name: str) -> "int | None":
     """Parse an int env knob; malformed values read as UNSET — the whole
     un-blankable-output guarantee depends on reaching main(), so a typo'd
@@ -1003,6 +1039,11 @@ async def main() -> None:
 
     global _PHASE_NOW
     out = _BANKED
+    banked = _banked_onchip()
+    if banked is not None:
+        # Nested, never flat: a prior session's numbers must not read as
+        # THIS run's measurements (fresh keys stay top-level beside it).
+        out["onchip"] = banked
     deadline = time.time() + _deadline_cap() - 60
     # Priority order under the (driver-window-sized) deadline: the stacked
     # headline first — it alone sets ``value`` — then the north-star int8

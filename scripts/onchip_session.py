@@ -348,7 +348,12 @@ def main() -> None:
             # multi-hour budget, so say so explicitly — without
             # QUORUM_TPU_BENCH_DEADLINE_S the session's bench would skip
             # every post-headline phase at the driver-window default.
-            env = {"QUORUM_TPU_BENCH_DEADLINE_S": str(b)}
+            # This bench's output is banked back into ONCHIP.json below —
+            # it must not merge the existing artifact into itself (bench's
+            # _banked_onchip), or every session nests the prior artifact
+            # one level deeper.
+            env = {"QUORUM_TPU_BENCH_DEADLINE_S": str(b),
+                   "QUORUM_TPU_BENCH_ONCHIP_MERGE": "0"}
             if b < 10800:
                 env["QUORUM_TPU_BENCH_WATCHDOG"] = str(b)
             bench_got = run_step("bench", [sys.executable, "bench.py"],

@@ -65,6 +65,7 @@ def commit_onchip(started_after: float) -> bool:
     n_metrics = sum(
         1 for k, v in got.items()
         if isinstance(v, (int, float)) and not isinstance(v, bool)
+        and v > 0  # headline sentinels (value -1.0) are not measurements
         and k not in ("ts", "onchip_started_ts")
         and not k.endswith("_wall_s"))  # diagnostics, not measurements
     if n_metrics == 0:

@@ -353,3 +353,63 @@ def test_7bq_child_end_to_end_tiny(monkeypatch):
     # the long-context phase really ran against the 8192 window
     assert rec["b7q_long_prompt_tokens"] == 5000
     assert rec["b7q_long_ttft_ms"] > 0 and rec["b7q_long_decode_tok_s"] > 0
+
+
+def test_banked_onchip_merges_nested(monkeypatch, capsys, tmp_path):
+    """A prior session's ONCHIP.json rides the driver artifact under the
+    nested 'onchip' key — real-silicon numbers from a mid-session tunnel
+    window survive a driver-time dead tunnel — while an error-only (dead
+    at start) artifact is ignored."""
+    import asyncio
+    import json
+
+    from quorum_tpu import compile_cache
+
+    bench = _load_bench()
+    real_loader = bench._banked_onchip  # before the stub below replaces it
+    monkeypatch.setattr(compile_cache, "tpu_host_configured", lambda: True)
+    monkeypatch.setattr(bench, "_probe_until", lambda deadline: True)
+    monkeypatch.setattr(
+        bench, "run_child_phase",
+        lambda flag, prefix, budget, env_extra=None: (
+            {"metric": "p50_ttft_ms", "value": 50.0, "unit": "ms",
+             "vs_baseline": 2.0} if prefix == "phase12" else {}))
+
+    good = {"b7_decode_tok_s": 34.6, "onchip_started_ts": 1.0}
+    monkeypatch.setattr(bench, "_banked_onchip", lambda: good)
+    asyncio.run(bench.main())
+    lines = [ln for ln in capsys.readouterr().out.splitlines()
+             if ln.startswith("{")]
+    rec = json.loads(lines[-1])
+    assert rec["onchip"]["b7_decode_tok_s"] == 34.6
+    assert rec["value"] == 50.0  # fresh keys stay top-level
+    assert "b7_decode_tok_s" not in rec  # banked never flattens
+
+    # the loader itself: error-only artifacts read as None
+    onchip = tmp_path / "ONCHIP.json"
+    monkeypatch.setattr(bench.os.path, "dirname", lambda p: str(tmp_path))
+    onchip.write_text(json.dumps(
+        {"onchip_error": "tunnel dead at session start", "ts": 5.0}))
+    assert real_loader() is None
+    # headline sentinels of a failed bench step are not measurements
+    onchip.write_text(json.dumps(
+        {"metric": "p50_ttft_ms", "value": -1.0, "vs_baseline": 0.0,
+         "error": "phases 1/2 failed", "onchip_started_ts": 5.0}))
+    assert real_loader() is None
+    # valid JSON that is not an object must not crash the run
+    onchip.write_text("[1, 2, 3]")
+    assert real_loader() is None
+    # a legacy self-embedded copy is stripped, never re-nested
+    onchip.write_text(json.dumps(
+        {"onchip_error": None, "onchip_started_ts": 5.0,
+         "kvq_decode_tok_s": 30.2, "kvq_wall_s": 60.0,
+         "onchip": {"old": 1}}))
+    assert real_loader() == {"onchip_error": None, "onchip_started_ts": 5.0,
+                             "kvq_decode_tok_s": 30.2, "kvq_wall_s": 60.0}
+    # the supervised session's own bench step never merges (it would bank
+    # the merge straight back into ONCHIP.json, nesting forever)
+    monkeypatch.setenv("QUORUM_TPU_BENCH_ONCHIP_MERGE", "0")
+    assert real_loader() is None
+    monkeypatch.delenv("QUORUM_TPU_BENCH_ONCHIP_MERGE")
+    onchip.unlink()
+    assert real_loader() is None
