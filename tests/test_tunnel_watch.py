@@ -1,0 +1,85 @@
+"""The tunnel watcher's commit discipline (scripts/tunnel_watch.py).
+
+commit_onchip is the step that banks the round's most important artifact;
+its rules get real-git pins: commit ONLY the artifact (never sweep the
+operator's staged files — ADVICE r4), only when THIS session refreshed it,
+and only when it carries actual measurements.
+"""
+
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+# Multi-process / real-git: slow tier.
+pytestmark = pytest.mark.slow
+
+
+def _load():
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "scripts", "tunnel_watch.py")
+    spec = importlib.util.spec_from_file_location("tunnel_watch", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _git(repo, *argv):
+    return subprocess.run(["git", "-C", str(repo), *argv],
+                          capture_output=True, text=True, check=True)
+
+
+@pytest.fixture()
+def repo(tmp_path):
+    _git(tmp_path, "init", "-q")
+    _git(tmp_path, "config", "user.email", "t@t")
+    _git(tmp_path, "config", "user.name", "t")
+    (tmp_path / "base.txt").write_text("base\n")
+    _git(tmp_path, "add", "base.txt")
+    _git(tmp_path, "commit", "-q", "-m", "base")
+    return tmp_path
+
+
+def test_commit_scoped_to_artifact_only(repo, monkeypatch):
+    """ADVICE r4: files the operator had staged must NOT be swept into the
+    ONCHIP commit."""
+    mod = _load()
+    monkeypatch.setattr(mod, "REPO", str(repo))
+    monkeypatch.setattr(mod, "ONCHIP", str(repo / "ONCHIP.json"))
+    # operator's unrelated staged work
+    (repo / "wip.txt").write_text("do not sweep\n")
+    _git(repo, "add", "wip.txt")
+    (repo / "ONCHIP.json").write_text(json.dumps(
+        {"onchip_error": None, "onchip_started_ts": 5.0,
+         "b7_decode_tok_s": 34.6}))
+    assert mod.commit_onchip(started_after=0.0) is True
+    shown = _git(repo, "show", "--name-only", "--format=", "HEAD").stdout
+    assert shown.split() == ["ONCHIP.json"]
+    # the operator's staged file is still staged, not committed
+    status = _git(repo, "status", "--short").stdout
+    assert "A  wip.txt" in status
+
+
+def test_no_commit_without_measurements_or_freshness(repo, monkeypatch):
+    mod = _load()
+    monkeypatch.setattr(mod, "REPO", str(repo))
+    onchip = repo / "ONCHIP.json"
+    monkeypatch.setattr(mod, "ONCHIP", str(onchip))
+    head = _git(repo, "rev-parse", "HEAD").stdout
+
+    # error-only artifact (dead-at-start session): no commit
+    onchip.write_text(json.dumps(
+        {"onchip_error": "tunnel dead at session start", "ts": 5.0}))
+    assert mod.commit_onchip(started_after=0.0) is False
+    # headline sentinels are not measurements either
+    onchip.write_text(json.dumps(
+        {"value": -1.0, "vs_baseline": 0.0, "onchip_started_ts": 5.0}))
+    assert mod.commit_onchip(started_after=0.0) is False
+    # real measurements but STALE (mtime predates the session): no commit
+    onchip.write_text(json.dumps({"b7_decode_tok_s": 34.6}))
+    mtime = os.stat(onchip).st_mtime
+    assert mod.commit_onchip(started_after=mtime + 1) is False
+    assert _git(repo, "rev-parse", "HEAD").stdout == head
