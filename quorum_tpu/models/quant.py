@@ -28,6 +28,13 @@ Design (TPU/XLA-first, validated on a real v5e — see PERF.md):
     dot operand) — measured *slower* than bf16 on the real chip (41.5 vs
     29.6 ms/decode-step at 7B): XLA materializes the dequantized bf16
     operand in HBM instead of fusing, so traffic goes up, not down.
+    **On XLA:CPU only** (tests, CPU quality measurements) the same integer
+    products run as an f32 GEMM instead: CPU has no native int8 dot and
+    lowers the int8 einsum to a materialized O(t×d_in×d_out) temp — see
+    :func:`qeinsum`. CPU-measured int8 numbers (PERF.md quality ladder)
+    therefore carry f32-accumulation rounding the chip's int32 path does
+    not; tiny-dims equality of the two branches is pinned in
+    tests/test_quant.py.
   - Weight scales are per-output-channel (the einsum's non-contracted
     weight axis): weight quantization error stays relative per channel
     (≤ 1/254 of the channel's max |w|). Activation scales are per-row
@@ -84,16 +91,35 @@ def dq(leaf: Any, dtype=jnp.bfloat16):
     return leaf
 
 
+def _use_native_int8() -> bool:
+    """Native int8×int8→int32 einsum vs f32-GEMM formulation.
+
+    TPU: native (the MXU int8 path — 2× the bf16 rate). XLA:CPU: the
+    int8 einsum has no dot lowering and becomes a MATERIALIZED
+    broadcast-multiply-reduce — an O(tokens × d_in × d_out) int32 temp,
+    120+ GB at 7B dims (observed OOM scoring mistral-7b int8 on a 125 GB
+    host) — so CPU computes the same integer products as an f32 GEMM,
+    exact up to f32 accumulation rounding. ``QUORUM_TPU_QEINSUM_INT8=1/0``
+    forces either branch (tests pin tiny-dims equality of the two)."""
+    import os
+
+    knob = os.environ.get("QUORUM_TPU_QEINSUM_INT8", "")
+    if knob in ("0", "1"):
+        return knob == "1"
+    return jax.default_backend() != "cpu"
+
+
 def qeinsum(eq: str, x: jnp.ndarray, leaf: Any) -> jnp.ndarray:
     """``jnp.einsum(eq, x, w)`` where ``w`` may be an int8-quantized leaf.
 
     Plain leaf: the usual bf16×bf16 MXU einsum accumulating in f32.
     Quantized leaf (dynamic w8a8): ``x`` is quantized per row over its
     LAST axis — which is the contraction axis at every transformer call
-    site — the einsum runs int8×int8→int32 natively on the MXU, and the
-    result is rescaled by ``einsum(eq, xs, qs)`` (both scales carry a
-    size-1 contraction dim, so the same equation computes their outer
-    product broadcast to the output shape). Returns f32.
+    site — the integer einsum runs natively int8×int8→int32 on the MXU
+    (f32 GEMM on CPU, see :func:`_use_native_int8`), and the result is
+    rescaled by ``einsum(eq, xs, qs)`` (both scales carry a size-1
+    contraction dim, so the same equation computes their outer product
+    broadcast to the output shape). Returns f32.
     """
     if not is_quantized(leaf):
         return jnp.einsum(eq, x, leaf, preferred_element_type=jnp.float32)
@@ -101,8 +127,14 @@ def qeinsum(eq: str, x: jnp.ndarray, leaf: Any) -> jnp.ndarray:
     amax = jnp.max(jnp.abs(xf), axis=-1, keepdims=True)
     xs = jnp.maximum(amax, 1e-30) / 127.0
     x8 = jnp.clip(jnp.round(xf / xs), -127, 127).astype(jnp.int8)
-    y = jnp.einsum(eq, x8, leaf["q8"], preferred_element_type=jnp.int32)
-    return y.astype(jnp.float32) * jnp.einsum(eq, xs, leaf["qs"])
+    if _use_native_int8():
+        y = jnp.einsum(eq, x8, leaf["q8"],
+                       preferred_element_type=jnp.int32).astype(jnp.float32)
+    else:
+        y = jnp.einsum(eq, x8.astype(jnp.float32),
+                       leaf["q8"].astype(jnp.float32),
+                       preferred_element_type=jnp.float32)
+    return y * jnp.einsum(eq, xs, leaf["qs"])
 
 
 def quantize_params(params: Mapping[str, Any]) -> dict[str, Any]:

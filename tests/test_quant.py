@@ -172,3 +172,22 @@ def test_ckpt_quant_logits_close_to_transformers(tmp_path):
     assert rel < 0.05, f"relative error vs transformers {rel:.4f}"
     agree = (qlogits.argmax(-1) == theirs.argmax(-1)).mean()
     assert agree >= 0.85, f"argmax agreement {agree:.2f}"
+
+
+def test_native_int8_and_f32_gemm_branches_agree(monkeypatch):
+    """The shipping TPU branch (native int8 einsum) must compute the same
+    products as the CPU f32-GEMM formulation. At tiny contraction dims the
+    f32 accumulation is exact (sums < 2^24), so equality is EXACT — a
+    regression in the chip-only branch fails here on CPU."""
+    from quorum_tpu.models.quant import qeinsum, quantize_leaf
+
+    rng = np.random.default_rng(7)
+    x = jnp.asarray(rng.normal(size=(5, 64)).astype(np.float32))
+    w = jnp.asarray(rng.normal(size=(64, 48)).astype(np.float32))
+    leaf = quantize_leaf(w, -2)
+
+    monkeypatch.setenv("QUORUM_TPU_QEINSUM_INT8", "1")  # force native path
+    native = np.asarray(qeinsum("td,df->tf", x, leaf))
+    monkeypatch.setenv("QUORUM_TPU_QEINSUM_INT8", "0")  # force f32 GEMM
+    gemm = np.asarray(qeinsum("td,df->tf", x, leaf))
+    np.testing.assert_array_equal(native, gemm)
