@@ -582,7 +582,8 @@ class InferenceEngine:
         elif self.quant == "int8":
             # Init + quantize fused in one program: the bf16 weights are
             # per-leaf intermediates, so llama-3-8b (16.1 GB bf16 / 8.1 GB
-            # int8) comes up on a single 16 GB chip.
+            # int8) comes up on a single 16 GB chip. (On XLA:CPU the
+            # helper splits into two programs — see its docstring.)
             from quorum_tpu.models.quant import init_params_quantized_sharded
 
             self.params = init_params_quantized_sharded(spec, self.mesh, seed)

@@ -174,10 +174,20 @@ def init_params_quantized_sharded(spec, mesh, seed: int = 0) -> dict[str, Any]:
     """Random-init + quantize fused into one compiled program: the bf16
     weights exist only as per-leaf intermediates (freed after their quantize
     op), so even models whose bf16 form exceeds HBM come up quantized —
-    llama-3-8b (16.1 GB bf16 / 8.1 GB int8) on one 16 GB v5e."""
-    from quorum_tpu.models.init import init_params
+    llama-3-8b (16.1 GB bf16 / 8.1 GB int8) on one 16 GB v5e.
+
+    On XLA:CPU the fused program's buffer assignment instead holds ~20 B/
+    param of init intermediates live at once — 142.2 GB measured
+    (``compiled.memory_analysis()``) at mistral-7b, an OOM on a 125 GB
+    host — so CPU runs two programs: bf16 init (65.4 GB temp + 14.5 GB
+    out), then donated quantize (26.8 GB temp), peaking near the bf16
+    footprint."""
+    from quorum_tpu.models.init import init_params, init_params_sharded
     from quorum_tpu.parallel.sharding import param_shardings
 
+    if jax.default_backend() == "cpu":
+        return quantize_params_sharded(
+            init_params_sharded(spec, mesh, seed), mesh)
     shapes = jax.eval_shape(lambda: quantize_params(init_params(spec, seed)))
     shardings = param_shardings(mesh, shapes)
     return jax.jit(
