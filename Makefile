@@ -2,7 +2,7 @@
 # clean — /root/reference/Makefile:1-25), adapted to this environment: no uv,
 # no uvicorn — the bundled h11 ASGI server serves the app.
 
-.PHONY: install run dev test test-all coverage bench dryrun clean
+.PHONY: install run dev test test-all coverage bench dryrun metrics-check clean
 
 install:
 	pip install -e .
@@ -38,6 +38,13 @@ coverage:
 
 bench:
 	python bench.py
+
+# Promtool-style exposition lint (pure Python, no extra deps): spins the
+# app over a tiny tpu:// backend, pulls the FULL /metrics output, and
+# fails on malformed lines, duplicated TYPE lines, non-monotonic histogram
+# buckets, or _sum/_count inconsistencies. See docs/observability.md.
+metrics-check:
+	python -m pytest tests/test_exposition.py -x -q $(PYTEST_EXTRA)
 
 # Multi-chip sharding validation on a virtual 8-device CPU mesh.
 # dryrun_multichip re-execs itself with a clean env (JAX_PLATFORMS=cpu,

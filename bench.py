@@ -668,9 +668,11 @@ async def seven_b_main(quant: bool) -> None:
     except Exception as e:
         # _CHILD_BANKED second: a checkpointed "+int8"-tagged model name
         # beats the bare fallback; the error key always lands last.
+        # flush: the parent may SIGKILL this child right after the exception
+        # (budget expiry) — the error line must not die in the pipe buffer.
         print(json.dumps(
             {f"{prefix}_model": model, **_CHILD_BANKED,
-             f"{prefix}_error": f"{type(e).__name__}: {e}"}))
+             f"{prefix}_error": f"{type(e).__name__}: {e}"}), flush=True)
 
 
 def _make_hf_checkpoint(dirpath: str, tiny: bool) -> None:
@@ -1118,15 +1120,26 @@ async def main() -> None:
         out.update({"metric": "p50_ttft_ms", "value": -1.0, "unit": "ms",
                     "vs_baseline": 0.0,
                     "error": out.get("phase12_error", "phases 1/2 failed")})
-        print(json.dumps(out))
+        # flush: a SIGKILL racing process exit (driver window, watchdog)
+        # must not drop the completed-run line from the pipe buffer.
+        print(json.dumps(out), flush=True)
         # "Measured" means a numeric metric — not the *_model / *_error
-        # context keys seven_b_main emits beside a failure.
+        # context keys seven_b_main emits beside a failure. Banked on-chip
+        # silicon numbers (the nested "onchip" dict a prior tunnel session
+        # committed) count too: a dead-at-driver-time tunnel with real
+        # measurements banked is a partial success, not total failure.
         measured = any(
             k.startswith(("b7_", "b7q_", "ckpt_"))
             and isinstance(v, (int, float))
             for k, v in out.items())
+        onchip = out.get("onchip", {})
+        measured = measured or (isinstance(onchip, dict) and any(
+            isinstance(v, (int, float)) and not isinstance(v, bool) and v > 0
+            for k, v in onchip.items()
+            if k not in ("ts", "onchip_started_ts")
+            and not k.endswith("_wall_s")))
         sys.exit(0 if measured else 3)
-    print(json.dumps(out))
+    print(json.dumps(out), flush=True)
 
 
 def _ab_keys(got: dict) -> dict:

@@ -111,6 +111,7 @@ from quorum_tpu.engine.engine import (
 )
 from quorum_tpu.engine.tokenizer import get_tokenizer
 from quorum_tpu.models.model_config import resolve_spec
+from quorum_tpu.observability import current_trace, trace_span
 from quorum_tpu.ops.sampling import SamplerConfig
 from quorum_tpu.parallel.mesh import MeshConfig, make_mesh, single_device_mesh
 
@@ -685,7 +686,13 @@ class TpuBackend:
             return [self._consume(plan, r) for r in reqs]
 
         try:
-            outs = await self._shielded_to_thread(run, timeout)
+            # Backend-tagged span over the whole generation (submit to last
+            # token drained): /debug/traces then shows the engine's own
+            # queue-wait/prefill/decode spans nested inside this window.
+            with trace_span(current_trace(), "backend-generate",
+                            backend=self.name, choices=plan["n"],
+                            prompt_tokens=len(plan["prompt_ids"])):
+                outs = await self._shielded_to_thread(run, timeout)
         except asyncio.TimeoutError:
             # Abort the on-device loop at the next chunk boundary; don't hold
             # the request open waiting for the full generation.

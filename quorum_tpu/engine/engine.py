@@ -48,6 +48,7 @@ from __future__ import annotations
 
 import queue
 import threading
+import time
 import weakref
 from dataclasses import dataclass, field
 from typing import Iterator
@@ -58,6 +59,7 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from quorum_tpu import observability as obs
 from quorum_tpu.compile_cache import enable_persistent_compile_cache
 from quorum_tpu.models.init import init_params, init_params_sharded
 from quorum_tpu.models.model_config import ModelSpec
@@ -177,6 +179,7 @@ class _Request:
         "prompt_ids", "budget", "temperature", "top_p", "top_k", "seed",
         "eos_id", "cancel", "chunk_hint", "out", "emitted",
         "pp", "fp", "bias_row", "want_lp", "lp", "hist", "ngram", "member",
+        "trace", "t_submit", "tspans",
     )
 
     def __init__(self, prompt_ids, budget, sampler: SamplerConfig, seed, eos_id,
@@ -199,6 +202,12 @@ class _Request:
         self.want_lp = want_lp        # -1 = no logprobs; else #top alternatives
         self.member = member          # stacked-members engine: weight set index
         self.lp: list = []
+        # Request-scoped tracing: the server's trace (when this submission
+        # happens inside a traced request context) rides along so the
+        # scheduler thread can append queue-wait/prefill/decode spans to it.
+        self.trace = obs.current_trace()
+        self.t_submit = time.perf_counter()
+        self.tspans: dict = {}  # span kind -> (last span, turn count)
         # Prompt-lookup drafting state: the running token history and an
         # incrementally-maintained 2-gram → position index ("lagged": a pair
         # is recorded only once a token FOLLOWS it, so the index never
@@ -230,12 +239,14 @@ class _Admission:
     a match (the slot's cache rows [0, offset) already hold this prompt's
     K/V from a previous request) — only the suffix is prefilled."""
 
-    __slots__ = ("req", "slot", "offset")
+    __slots__ = ("req", "slot", "offset", "offset0", "t_start")
 
     def __init__(self, req: _Request, slot: int, offset: int = 0):
         self.req = req
         self.slot = slot
         self.offset = offset
+        self.offset0 = offset            # reused-prefix length (tracing)
+        self.t_start = time.perf_counter()
 
 
 class _DraftRuntime:
@@ -628,6 +639,11 @@ class InferenceEngine:
         self.n_overlapped = 0  # decode chunks dispatched ahead of the read
         self.n_spec_turns = 0      # speculative verify dispatches
         self.n_spec_accepted = 0   # draft tokens accepted across them
+        self.n_decode_chunks = 0   # plain batched decode dispatch turns
+        # Occupancy accounting: active rows summed over every scheduler turn
+        # (decode chunks AND verify turns) — average batch occupancy is
+        # decode_busy_rows_total / (decode_chunks_total + spec_turns_total).
+        self.n_decode_rows = 0
         # Draft-MODEL speculative decoding (spec_model=…): a second, small
         # model proposes each verify turn's draft instead of prompt lookup.
         # Subject to spec_clean gating like all speculation; excluded
@@ -1293,6 +1309,8 @@ class InferenceEngine:
                 "cancellations_total": self.n_cancelled,
                 "spec_turns_total": self.n_spec_turns,
                 "spec_accepted_total": self.n_spec_accepted,
+                "decode_chunks_total": self.n_decode_chunks,
+                "decode_busy_rows_total": self.n_decode_rows,
                 "prefix_hits_total": self.prefix_hits,
                 "prefix_tokens_saved_total": self.prefix_tokens_saved,
                 "overlapped_chunks_total": self.n_overlapped,
@@ -1356,6 +1374,47 @@ class InferenceEngine:
                     # failed or will fail fast on their next admission.
                     pass
 
+    # Individual scheduler-turn spans recorded per request per kind before
+    # coalescing kicks in: a multi-thousand-token generation must not fill
+    # the trace's MAX_SPANS budget with identical decode entries (the
+    # aggregate/sse-flush spans recorded at stream end still need room).
+    TURN_SPAN_CAP = 32
+
+    def _turn_span(self, req: _Request, name: str, t0: float, t1: float,
+                   **meta) -> None:
+        """Record one scheduler turn (decode chunk / spec-verify) on the
+        request's trace; past TURN_SPAN_CAP turns of a kind, extend that
+        kind's last span (summing steps/accepted, counting the coalesced
+        turns) instead of appending."""
+        trace = req.trace
+        if trace is None:
+            return
+        span, count = req.tspans.get(name, (None, 0))
+        count += 1
+        if span is not None and count > self.TURN_SPAN_CAP:
+            span.end = trace.rel(t1)
+            for k in ("steps", "accepted"):
+                if k in meta and isinstance(span.meta.get(k), int):
+                    span.meta[k] += meta[k]
+            if "occupancy" in meta:
+                span.meta["occupancy"] = max(
+                    span.meta.get("occupancy", 0), meta["occupancy"])
+            span.meta["coalesced_turns"] = count - self.TURN_SPAN_CAP + 1
+        else:
+            span = trace.add_span_abs(name, t0, t1, **meta)
+        req.tspans[name] = (span, count)
+
+    @staticmethod
+    def _note_admitted(req: _Request) -> None:
+        """A pending request just claimed a slot: close its queue-wait —
+        the histogram observation plus (when the request is traced) the
+        queue-wait span, tagged with the member whose rows it landed on."""
+        now = time.perf_counter()
+        obs.QUEUE_WAIT.observe(now - req.t_submit)
+        if req.trace is not None:
+            req.trace.add_span_abs("queue-wait", req.t_submit, now,
+                                   member=req.member)
+
     @staticmethod
     def _lcp(a: list[int], b: list[int]) -> int:
         n = min(len(a), len(b))
@@ -1407,6 +1466,7 @@ class InferenceEngine:
                 self.n_cancelled += 1
                 req.out.put(("end", None))
                 continue
+            self._note_admitted(req)
             # Reuse caps at len(prompt)-1 (the final prompt token must run
             # through a segment so the register path's first decode step has
             # its position's logits to sample from) and is aligned DOWN to a
@@ -1501,6 +1561,7 @@ class InferenceEngine:
                             self.prefix_hits += 1
                             self.prefix_tokens_saved += reuse
                         self._pending.remove(r)
+                        self._note_admitted(r)
                         self._claimed.add(slot)
                         self._resident[slot] = r.prompt_ids[:reuse]
                         admit_chunked = _Admission(r, slot, offset=reuse)
@@ -1553,6 +1614,7 @@ class InferenceEngine:
                 self.n_cancelled += 1
                 req.out.put(("end", None))
                 continue
+            self._note_admitted(req)
             n = len(req.prompt_ids)
             tokens[m, 0, :n] = req.prompt_ids
             lengths[m, 0] = n
@@ -1570,6 +1632,7 @@ class InferenceEngine:
             live[m] = req
         if not live:
             return
+        t0 = time.perf_counter()
         (firsts, s_lp, top_ix, top_lp,
          self._ck, self._cv, self._token, self._lengths, self._keys,
          self._temp, self._topp, self._topk,
@@ -1583,6 +1646,13 @@ class InferenceEngine:
         )
         firsts, s_lp, top_ix, top_lp = _host_fetch(
             firsts, s_lp, top_ix, top_lp)
+        t1 = time.perf_counter()
+        obs.PREFILL.observe(t1 - t0)
+        for m, req in live.items():
+            if req.trace is not None:
+                req.trace.add_span_abs(
+                    "prefill", t0, t1, tokens=len(req.prompt_ids),
+                    bucket=bucket, slot=row, coalesced=len(live))
         for m, req in live.items():
             flat = m * n_s + row
             self._resident[flat] = list(req.prompt_ids)
@@ -1696,6 +1766,15 @@ class InferenceEngine:
             self._temp, self._topp, self._topk,
             self._pp, self._fp, self._counts, self._bias,
         )
+        t1 = time.perf_counter()
+        # Wall time from slot claim to cache-complete: chunked admissions
+        # include the decode turns interleaved between segments — that IS
+        # the latency the admitted request experienced.
+        obs.PREFILL.observe(t1 - adm.t_start)
+        if req.trace is not None:
+            req.trace.add_span_abs(
+                "prefill", adm.t_start, t1, tokens=len(prompt),
+                slot=adm.slot, chunked=True, reused=adm.offset0)
         with self._cond:
             self._slots[adm.slot] = req
         self._release_admission(adm)
@@ -1739,6 +1818,7 @@ class InferenceEngine:
             self._claimed.discard(adm.slot)
 
     def _admit(self, req: _Request, slot: int) -> None:
+        t0 = time.perf_counter()
         n_prompt = len(req.prompt_ids)
         bucket = prefill_bucket(n_prompt, self.spec.max_seq)
         tokens = np.zeros((1, bucket), np.int32)
@@ -1764,6 +1844,11 @@ class InferenceEngine:
             self._pp, self._fp, self._counts, self._bias,
         )
         first, s_lp, top_ix, top_lp = _host_fetch(first, s_lp, top_ix, top_lp)
+        t1 = time.perf_counter()
+        obs.PREFILL.observe(t1 - t0)
+        if req.trace is not None:
+            req.trace.add_span_abs("prefill", t0, t1,
+                                   tokens=n_prompt, bucket=bucket, slot=slot)
         if req.want_lp >= 0:
             req.lp.append((float(s_lp),
                            np.asarray(top_ix), np.asarray(top_lp)))
@@ -1812,6 +1897,7 @@ class InferenceEngine:
             if any(d is not None for d in drafts.values()):
                 self._run_verify_step(active, g, max_len, drafts)
                 return
+        t0 = time.perf_counter()
         history = prefill_bucket(max_len + n_steps, self.spec.max_seq)
         mask = np.zeros((self._rows,), np.int32)
         for i, _ in active:
@@ -1845,6 +1931,14 @@ class InferenceEngine:
         done = self._emit_chunk(active, payload1, set())
         if payload2 is not None:
             done |= self._emit_chunk(active, payload2, done)
+        t1 = time.perf_counter()
+        n_chunks = 1 if payload2 is None else 2
+        obs.DECODE_CHUNK.observe(t1 - t0)
+        self.n_decode_chunks += n_chunks
+        self.n_decode_rows += len(active) * n_chunks
+        for i, req in active:
+            self._turn_span(req, "decode", t0, t1, steps=n_steps * n_chunks,
+                            occupancy=len(active), history=history)
         if done:
             with self._cond:
                 for i, req in active:
@@ -1918,6 +2012,7 @@ class InferenceEngine:
     def _run_verify_step(self, active, g: int, max_len: int, drafts) -> None:
         """One speculative dispatch: verify each row's draft against the
         model's own sampled chain (greedy rows: argmax)."""
+        t0 = time.perf_counter()
         history = prefill_bucket(max_len + g + 1, self.spec.max_seq)
         mask = np.zeros((self._rows,), np.int32)
         tokens = np.zeros((self._rows, g + 1), np.int32)
@@ -1936,7 +2031,10 @@ class InferenceEngine:
             self._counts,
         )
         s0, model_toks, ok = _host_fetch(s0, model_toks, ok)
+        t1 = time.perf_counter()
+        obs.DECODE_CHUNK.observe(t1 - t0)
         self.n_spec_turns += 1
+        self.n_decode_rows += len(active)
         for i, req in active:
             toks = [int(s0[i])]
             for j in range(g):
@@ -1954,6 +2052,10 @@ class InferenceEngine:
             # an EOS/budget/cancel finish never inflate the metric. The
             # chain's first token (s0) is the model's own step, not a draft.
             self.n_spec_accepted += max(0, req.emitted - emitted_before - 1)
+            self._turn_span(
+                req, "spec-verify", t0, t1, drafted=g,
+                accepted=max(0, req.emitted - emitted_before - 1),
+                occupancy=len(active))
             if finished:
                 with self._cond:
                     self._release_slot(i, req)

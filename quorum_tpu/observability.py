@@ -1,4 +1,4 @@
-"""Observability: aggregation log channel, request ids, per-phase timing.
+"""Observability: request tracing, latency histograms, log channels, profiling.
 
 Parity with the reference's two-channel logging (SURVEY.md §5.5):
 the ``aggregation`` logger records individual backend responses, aggregator
@@ -7,10 +7,24 @@ prompts, and final combined output; :func:`setup_aggregation_log` attaches the
 (/root/reference/src/quorum/oai_proxy.py:17-37) — here it is explicit and
 lazy, so importing the package has no filesystem side effects.
 
-Beyond parity (the reference had static ``chatcmpl-parallel*`` ids and no
-timing): every request gets a unique id surfaced in the ``X-Request-Id``
-response header, and :class:`PhaseTimer` records wall-clock per phase
-(fanout / aggregate / stream) into one structured log line per request.
+Beyond parity (the reference had static ``chatcmpl-parallel*`` ids, no timing,
+and no metrics at all), this module is the instrumentation spine every layer
+records into:
+
+  - :class:`Histogram` / :class:`MetricsRegistry` — Prometheus histogram
+    families (``_bucket``/``_sum``/``_count`` exposition) exported on
+    ``/metrics``: request duration, TTFT, inter-token gap, queue wait,
+    prefill, decode-chunk. Pure stdlib, thread-safe, O(buckets) memory.
+  - :class:`RequestTrace` — the request-scoped span recorder: every request
+    gets one trace (id surfaced in ``X-Request-Id``) that the server,
+    strategies, backends, and the engine scheduler append spans to
+    (queue-wait → prefill → decode → aggregate → sse-flush), plus wire-level
+    TTFT and per-token flush timings. Supersedes the round-1 ``PhaseTimer``
+    (kept as an alias — the API is a superset).
+  - :class:`TraceStore` — bounded ring buffer of completed traces plus the
+    in-flight set, served as JSON from ``GET /debug/traces``.
+  - :func:`validate_exposition` — a promtool-style pure-Python checker for
+    the full ``/metrics`` text (``make metrics-check``).
 
 TPU profiling: when ``QUORUM_TPU_PROFILE_DIR`` is set, :func:`maybe_profile`
 wraps a request in ``jax.profiler.trace`` so device timelines land in
@@ -19,12 +33,16 @@ TensorBoard-readable traces — the TPU-native analog of a CPU profiler.
 
 from __future__ import annotations
 
+import bisect
 import contextlib
+import contextvars
 import logging
 import os
 import threading
 import time
+from collections import deque
 from pathlib import Path
+from typing import Any, Iterator
 
 logger = logging.getLogger(__name__)
 aggregation_logger = logging.getLogger("aggregation")
@@ -57,41 +75,628 @@ def setup_aggregation_log(log_dir: str | os.PathLike = "logs") -> Path:
     return path
 
 
-class PhaseTimer:
-    """Accumulates named phase durations for one request.
+# ---- histogram metrics -----------------------------------------------------
 
-    Usage::
+# Serving-latency bucket ladder: sub-millisecond (intra-chunk host work)
+# through minutes (a long generation behind a queue). Upper bounds in
+# seconds, strictly increasing; +Inf is implicit.
+DEFAULT_BUCKETS = (
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+    1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0,
+)
 
-        timer = PhaseTimer(request_id)
-        with timer.phase("fanout"):
-            ...
-        timer.log("parallel", n_backends=3)
-    """
 
-    def __init__(self, request_id: str):
+def _fmt_float(v: float) -> str:
+    """Prometheus sample value: shortest exact-enough decimal repr."""
+    out = repr(float(v))
+    return out
+
+
+def _esc_label(v: str) -> str:
+    """Prometheus label-value escaping: backslash, quote, newline."""
+    return v.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def _fmt_labels(labels: tuple[tuple[str, str], ...], extra: str = "") -> str:
+    parts = [f'{k}="{_esc_label(v)}"' for k, v in labels]
+    if extra:
+        parts.append(extra)
+    return "{" + ",".join(parts) + "}" if parts else ""
+
+
+class Histogram:
+    """One Prometheus histogram family: thread-safe ``observe`` plus text
+    exposition with cumulative ``_bucket`` samples, ``_sum`` and ``_count``.
+
+    Per-bucket counts are stored non-cumulative and summed at expose time, so
+    ``observe`` is O(log buckets) (bisect) under a short lock. Labeled
+    children share the family (one ``# TYPE`` line, samples grouped)."""
+
+    def __init__(self, name: str, help_text: str,
+                 buckets: tuple[float, ...] = DEFAULT_BUCKETS):
+        if list(buckets) != sorted(set(buckets)):
+            raise ValueError(f"histogram buckets must strictly increase: {buckets}")
+        self.name = name
+        self.help = help_text
+        self.buckets = tuple(float(b) for b in buckets)
+        self._lock = threading.Lock()
+        # label-tuple -> [per-bucket counts..., +Inf count, sum, count]
+        self._series: dict[tuple[tuple[str, str], ...], list] = {}
+
+    def observe(self, value: float, **labels: str) -> None:
+        key = tuple(sorted((k, str(v)) for k, v in labels.items()))
+        idx = bisect.bisect_left(self.buckets, float(value))
+        with self._lock:
+            row = self._series.get(key)
+            if row is None:
+                row = [0] * (len(self.buckets) + 1) + [0.0, 0]
+                self._series[key] = row
+            row[idx] += 1
+            row[-2] += float(value)
+            row[-1] += 1
+
+    def snapshot(self) -> dict:
+        """{labels: {"buckets": cumulative counts, "sum": s, "count": n}}."""
+        with self._lock:
+            series = {k: list(v) for k, v in self._series.items()}
+        out = {}
+        for key, row in series.items():
+            cum, total = [], 0
+            for c in row[: len(self.buckets) + 1]:
+                total += c
+                cum.append(total)
+            out[key] = {"buckets": cum, "sum": row[-2], "count": row[-1]}
+        return out
+
+    def expose(self) -> list[str]:
+        lines = [f"# HELP {self.name} {self.help}",
+                 f"# TYPE {self.name} histogram"]
+        snap = self.snapshot() or {(): {"buckets": [0] * (len(self.buckets) + 1),
+                                        "sum": 0.0, "count": 0}}
+        for key in sorted(snap):
+            s = snap[key]
+            bounds = [_fmt_float(b) for b in self.buckets] + ["+Inf"]
+            for ub, c in zip(bounds, s["buckets"]):
+                le = 'le="%s"' % ub
+                lines.append(f"{self.name}_bucket{_fmt_labels(key, le)} {c}")
+            lines.append(f"{self.name}_sum{_fmt_labels(key)} {_fmt_float(s['sum'])}")
+            lines.append(f"{self.name}_count{_fmt_labels(key)} {s['count']}")
+        return lines
+
+
+class MetricsRegistry:
+    """Ordered collection of histogram families with one-call exposition."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._hists: dict[str, Histogram] = {}
+
+    def histogram(self, name: str, help_text: str,
+                  buckets: tuple[float, ...] = DEFAULT_BUCKETS) -> Histogram:
+        with self._lock:
+            h = self._hists.get(name)
+            if h is None:
+                h = Histogram(name, help_text, buckets)
+                self._hists[name] = h
+            return h
+
+    def expose(self) -> list[str]:
+        with self._lock:
+            hists = list(self._hists.values())
+        lines: list[str] = []
+        for h in hists:
+            lines.extend(h.expose())
+        return lines
+
+    def reset(self) -> None:
+        """Drop all recorded samples (tests)."""
+        with self._lock:
+            for h in self._hists.values():
+                with h._lock:
+                    h._series.clear()
+
+
+METRICS = MetricsRegistry()
+
+# The canonical serving-latency families (ISSUE 1 acceptance set + the
+# engine-phase pair the scheduler records). All in seconds.
+REQUEST_DURATION = METRICS.histogram(
+    "quorum_tpu_request_duration_seconds",
+    "End-to-end request wall time (headers in to last byte out).")
+TTFT = METRICS.histogram(
+    "quorum_tpu_ttft_seconds",
+    "Time to first content byte on the SSE wire.")
+INTER_TOKEN = METRICS.histogram(
+    "quorum_tpu_inter_token_seconds",
+    "Gap between consecutive content flushes on the SSE wire.",
+    buckets=(0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+             0.25, 0.5, 1.0, 2.5, 5.0))
+QUEUE_WAIT = METRICS.histogram(
+    "quorum_tpu_queue_wait_seconds",
+    "Engine admission-queue wait (submit to slot claim).")
+PREFILL = METRICS.histogram(
+    "quorum_tpu_prefill_seconds",
+    "Prompt prefill wall time (admission start to cache-complete; chunked "
+    "admissions include interleaved decode turns).")
+DECODE_CHUNK = METRICS.histogram(
+    "quorum_tpu_decode_chunk_seconds",
+    "One batched decode dispatch+drain turn of the scheduler loop.",
+    buckets=(0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+             1.0, 2.5, 5.0, 10.0))
+
+
+# ---- request-scoped tracing ------------------------------------------------
+
+# Span budget per trace: a pathological 100k-token generation must not grow
+# an unbounded span list; past the cap only the drop counter advances.
+MAX_SPANS = 512
+# Wire flush-timing budget per trace (ttft + the first N inter-token gaps).
+MAX_TOKEN_TIMES = 2048
+
+
+class Span:
+    """One timed phase inside a request. ``start``/``end`` are seconds
+    relative to the trace's origin; ``meta`` carries small tags (backend,
+    bucket, occupancy...)."""
+
+    __slots__ = ("name", "start", "end", "meta")
+
+    def __init__(self, name: str, start: float, end: float | None = None,
+                 meta: dict | None = None):
+        self.name = name
+        self.start = start
+        self.end = end
+        self.meta = meta or {}
+
+    @property
+    def duration(self) -> float | None:
+        return None if self.end is None else self.end - self.start
+
+    def to_dict(self) -> dict:
+        out = {
+            "name": self.name,
+            "start_s": round(self.start, 6),
+            "end_s": None if self.end is None else round(self.end, 6),
+            "duration_ms": (None if self.end is None
+                            else round((self.end - self.start) * 1000, 3)),
+        }
+        if self.meta:
+            out["meta"] = self.meta
+        return out
+
+
+class RequestTrace:
+    """Span recorder for ONE request, appended to from any thread.
+
+    The server creates it per request; the engine scheduler, strategies, and
+    the SSE wire wrapper record into it through :func:`current_trace` /
+    direct references. Also the :class:`PhaseTimer` replacement: ``phase()``
+    (context manager), ``phases`` (name → accumulated seconds), ``total``
+    and ``log()`` keep the round-1 API."""
+
+    def __init__(self, request_id: str, mode: str = ""):
         self.request_id = request_id
-        self._start = time.perf_counter()
-        self.phases: dict[str, float] = {}
+        self._t0 = time.perf_counter()
+        self.started_at = time.time()
+        self._lock = threading.Lock()
+        self.spans: list[Span] = []
+        self.dropped_spans = 0
+        self.meta: dict = {"mode": mode} if mode else {}
+        self.ttft: float | None = None
+        self.token_times: list[float] = []  # wire flush times, rel. seconds
+        self.n_tokens = 0        # content flushes, NOT capped like the list
+        self._last_token_t: float | None = None
+        self.n_flushes = 0
+        self.status: int | None = None
+        self.duration: float | None = None  # set by finish()
+
+    # -- clocks --------------------------------------------------------------
+
+    def now(self) -> float:
+        """Seconds since this trace began (the span timebase)."""
+        return time.perf_counter() - self._t0
+
+    def rel(self, perf_t: float) -> float:
+        """A ``time.perf_counter()`` stamp → this trace's timebase."""
+        return perf_t - self._t0
+
+    # -- spans ---------------------------------------------------------------
+
+    def add_span(self, name: str, start: float, end: float | None = None,
+                 **meta: Any) -> Span:
+        """Record a span with trace-relative times (see :meth:`rel`).
+
+        Completed traces are immutable: a timed-out request's still-running
+        device loop keeps calling in for minutes after the trace was
+        published to /debug/traces — those late spans are counted in
+        ``dropped_spans``, never appended (the returned detached span keeps
+        callers' ``span.end = ...`` stamping harmless)."""
+        span = Span(name, start, end, meta or None)
+        with self._lock:
+            if self.duration is not None or len(self.spans) >= MAX_SPANS:
+                self.dropped_spans += 1
+            else:
+                self.spans.append(span)
+        return span
+
+    def add_span_abs(self, name: str, start_perf: float, end_perf: float,
+                     **meta: Any) -> Span:
+        """Record a span from two ``time.perf_counter()`` stamps."""
+        return self.add_span(name, self.rel(start_perf), self.rel(end_perf),
+                             **meta)
 
     @contextlib.contextmanager
-    def phase(self, name: str):
-        t0 = time.perf_counter()
+    def span(self, name: str, **meta: Any) -> Iterator[Span]:
+        s = self.add_span(name, self.now(), **meta)
         try:
-            yield
+            yield s
         finally:
-            self.phases[name] = self.phases.get(name, 0.0) + (time.perf_counter() - t0)
+            s.end = self.now()
+
+    # -- wire timing ---------------------------------------------------------
+
+    def mark_flush(self, content: bool) -> None:
+        """One SSE write hit the wire; ``content`` flags a token-bearing
+        chunk (role chunks and [DONE] don't set TTFT)."""
+        t = self.now()
+        with self._lock:
+            if self.duration is not None:
+                return  # completed traces are immutable (see add_span)
+            self.n_flushes += 1
+            if not content:
+                return
+            if self.ttft is None:
+                self.ttft = t
+                TTFT.observe(t)
+            else:
+                # Gap from the LAST flush, tracked independently of the
+                # capped token_times list — past the cap each gap must
+                # still measure one flush, not the distance back to entry
+                # MAX_TOKEN_TIMES.
+                INTER_TOKEN.observe(t - self._last_token_t)
+            self._last_token_t = t
+            self.n_tokens += 1
+            if len(self.token_times) < MAX_TOKEN_TIMES:
+                self.token_times.append(t)
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def finish(self, status: int | None = None) -> None:
+        """Close the trace: stamp status + total duration, observe the
+        request-duration histogram, close any still-open spans (a client
+        disconnect can abandon one mid-phase). Idempotent."""
+        with self._lock:
+            if self.duration is not None:
+                return
+            self.duration = self.now()
+            if status is not None:
+                self.status = status
+            for s in self.spans:
+                if s.end is None:
+                    s.end = self.duration
+        # Status-class label: a flood of fast-failing 4xxs must not read as
+        # serving latency collapsing on a dashboard's unlabeled p50.
+        klass = (f"{self.status // 100}xx" if self.status is not None
+                 else "unknown")
+        REQUEST_DURATION.observe(self.duration, status=klass)
+
+    # -- serialization -------------------------------------------------------
+
+    def to_dict(self) -> dict:
+        with self._lock:
+            spans = sorted(self.spans, key=lambda s: s.start)
+            out = {
+                "request_id": self.request_id,
+                "started_at": self.started_at,
+                "in_flight": self.duration is None,
+                "status": self.status,
+                "duration_ms": (None if self.duration is None
+                                else round(self.duration * 1000, 3)),
+                "ttft_ms": (None if self.ttft is None
+                            else round(self.ttft * 1000, 3)),
+                "tokens": self.n_tokens,
+                "sse_flushes": self.n_flushes,
+                "token_times_ms": [round(t * 1000, 3)
+                                   for t in self.token_times],
+                "spans": [s.to_dict() for s in spans],
+                "dropped_spans": self.dropped_spans,
+            }
+            if self.meta:
+                out["meta"] = dict(self.meta)
+        return out
+
+    def summary(self) -> dict:
+        """The /debug/traces list row: the scalar fields only — built
+        directly, NOT via to_dict(), so listing a full ring never
+        materializes (and discards) thousands of span/timing dicts under
+        live traces' locks."""
+        with self._lock:
+            return {
+                "request_id": self.request_id,
+                "started_at": self.started_at,
+                "in_flight": self.duration is None,
+                "status": self.status,
+                "duration_ms": (None if self.duration is None
+                                else round(self.duration * 1000, 3)),
+                "ttft_ms": (None if self.ttft is None
+                            else round(self.ttft * 1000, 3)),
+                "tokens": self.n_tokens,
+                "sse_flushes": self.n_flushes,
+                "dropped_spans": self.dropped_spans,
+                **({"meta": dict(self.meta)} if self.meta else {}),
+            }
+
+    # -- PhaseTimer compatibility -------------------------------------------
+
+    @property
+    def phases(self) -> dict[str, float]:
+        """Accumulated seconds per span name (closed spans only)."""
+        with self._lock:
+            out: dict[str, float] = {}
+            for s in self.spans:
+                if s.end is not None:
+                    out[s.name] = out.get(s.name, 0.0) + (s.end - s.start)
+        return out
+
+    phase = span  # with timer.phase("fanout"): ... (round-1 API)
 
     @property
     def total(self) -> float:
-        return time.perf_counter() - self._start
+        return self.duration if self.duration is not None else self.now()
 
-    def log(self, mode: str, **extra) -> None:
+    def log(self, mode: str, **extra: Any) -> None:
+        """One structured summary line per request (the round-1
+        ``PhaseTimer.log`` extended with ttft/tokens/queue visibility)."""
         detail = " ".join(f"{k}={v}" for k, v in extra.items())
-        phases = " ".join(f"{k}={v * 1000:.1f}ms" for k, v in self.phases.items())
+        phases = " ".join(f"{k}={v * 1000:.1f}ms"
+                          for k, v in self.phases.items())
+        wire = ""
+        if self.ttft is not None:
+            wire = f"ttft={self.ttft * 1000:.1f}ms tokens={self.n_tokens}"
         logger.info(
-            "request %s mode=%s total=%.1fms %s %s",
-            self.request_id, mode, self.total * 1000, phases, detail,
+            "request %s mode=%s total=%.1fms %s %s %s",
+            self.request_id, mode, self.total * 1000, phases, wire, detail,
         )
+
+
+PhaseTimer = RequestTrace  # round-1 name; the API is a superset
+
+
+class TraceStore:
+    """In-flight traces plus a bounded ring of completed ones."""
+
+    def __init__(self, capacity: int | None = None):
+        if capacity is None:
+            capacity = int(os.environ.get("QUORUM_TPU_TRACE_CAPACITY", "256"))
+        self.capacity = max(1, capacity)
+        self._lock = threading.Lock()
+        self._inflight: dict[str, RequestTrace] = {}
+        self._completed: deque[RequestTrace] = deque(maxlen=self.capacity)
+
+    def start(self, trace: RequestTrace) -> RequestTrace:
+        with self._lock:
+            self._inflight[trace.request_id] = trace
+        return trace
+
+    def complete(self, trace: RequestTrace) -> None:
+        with self._lock:
+            self._inflight.pop(trace.request_id, None)
+            self._completed.append(trace)
+
+    def get(self, request_id: str) -> RequestTrace | None:
+        with self._lock:
+            t = self._inflight.get(request_id)
+            if t is not None:
+                return t
+            for t in self._completed:
+                if t.request_id == request_id:
+                    return t
+        return None
+
+    def snapshot(self, limit: int | None = None) -> dict:
+        """Summaries of every in-flight trace plus completed ones newest
+        first — the whole ring by default (it is already bounded by
+        ``capacity``); ``limit`` trims the listing further."""
+        with self._lock:
+            inflight = list(self._inflight.values())
+            completed = list(self._completed)
+        completed.reverse()  # newest first
+        rows = inflight + completed
+        if limit is not None:
+            rows = rows[:limit]
+        return {
+            "capacity": self.capacity,
+            "in_flight": len(inflight),
+            "completed": len(completed),
+            "traces": [t.summary() for t in rows],
+        }
+
+    def reset(self) -> None:
+        with self._lock:
+            self._inflight.clear()
+            self._completed.clear()
+
+
+TRACES = TraceStore()
+
+_current_trace: contextvars.ContextVar[RequestTrace | None] = \
+    contextvars.ContextVar("quorum_tpu_trace", default=None)
+
+
+def current_trace() -> RequestTrace | None:
+    """The trace of the request this task/thread is serving, if any."""
+    return _current_trace.get()
+
+
+@contextlib.contextmanager
+def use_trace(trace: RequestTrace | None) -> Iterator[RequestTrace | None]:
+    """Bind ``trace`` as the current trace for this context (None is a
+    no-op bind, so callers can pass through an optional trace)."""
+    token = _current_trace.set(trace)
+    try:
+        yield trace
+    finally:
+        _current_trace.reset(token)
+
+
+@contextlib.contextmanager
+def trace_span(trace: RequestTrace | None, name: str, **meta: Any):
+    """``trace.span(...)`` tolerant of ``trace is None``."""
+    if trace is None:
+        yield None
+        return
+    with trace.span(name, **meta) as s:
+        yield s
+
+
+def finish_request_trace(trace: RequestTrace, status: int | None = None,
+                         mode: str = "") -> None:
+    """Request teardown: close the trace, move it to the completed ring,
+    and emit the one structured per-request summary line."""
+    trace.finish(status=status)
+    TRACES.complete(trace)
+    trace.log(mode or trace.meta.get("mode", ""), status=trace.status)
+
+
+# ---- exposition validation -------------------------------------------------
+
+def validate_exposition(text: str) -> list[str]:
+    """Promtool-style pure-Python check of a Prometheus text exposition.
+
+    Returns a list of human-readable problems (empty = valid). Checks line
+    grammar, one ``# TYPE`` line per family (samples grouped after it),
+    numeric sample values, histogram bucket monotonicity, a ``+Inf`` bucket,
+    and ``_count`` == the ``+Inf`` bucket per labeled series."""
+    import re
+
+    errors: list[str] = []
+    typed: dict[str, str] = {}
+    seen_sample_families: set[str] = set()
+    # family -> labelkey -> {"buckets": [(le, v)...], "count": v, "sum": v}
+    hist: dict[str, dict[str, dict]] = {}
+    name_re = re.compile(r"[a-zA-Z_:][a-zA-Z0-9_:]*")
+    sample_re = re.compile(
+        r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{([^}]*)\})?\s+(\S+)(\s+\S+)?$")
+    label_re = re.compile(r'^([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"$')
+
+    def family_of(name: str) -> str:
+        for suffix in ("_bucket", "_sum", "_count"):
+            if name.endswith(suffix) and name[: -len(suffix)] in typed \
+                    and typed[name[: -len(suffix)]] == "histogram":
+                return name[: -len(suffix)]
+        return name
+
+    for n, raw in enumerate(text.splitlines(), 1):
+        line = raw
+        if not line.strip():
+            continue
+        if line.startswith("# TYPE "):
+            parts = line.split()
+            if len(parts) != 4 or not name_re.fullmatch(parts[2]) or \
+                    parts[3] not in ("counter", "gauge", "histogram",
+                                     "summary", "untyped"):
+                errors.append(f"line {n}: malformed TYPE line: {raw!r}")
+                continue
+            fam = parts[2]
+            if fam in typed:
+                errors.append(f"line {n}: duplicate TYPE line for {fam}")
+            if fam in seen_sample_families:
+                errors.append(
+                    f"line {n}: TYPE for {fam} appears after its samples")
+            typed[fam] = parts[3]
+            continue
+        if line.startswith("#"):
+            continue  # HELP / comments
+        m = sample_re.match(line)
+        if m is None:
+            errors.append(f"line {n}: malformed sample line: {raw!r}")
+            continue
+        name, _, labelstr, value, _ = m.groups()
+        labels: dict[str, str] = {}
+        if labelstr:
+            for part in _split_labels(labelstr):
+                lm = label_re.match(part.strip())
+                if lm is None:
+                    errors.append(f"line {n}: malformed label {part!r}")
+                    continue
+                labels[lm.group(1)] = lm.group(2)
+        try:
+            val = float(value)
+        except ValueError:
+            errors.append(f"line {n}: non-numeric value {value!r}")
+            continue
+        fam = family_of(name)
+        seen_sample_families.add(fam)
+        if typed.get(fam) == "histogram":
+            series = hist.setdefault(fam, {})
+            key = ",".join(f"{k}={v}" for k, v in sorted(labels.items())
+                           if k != "le")
+            entry = series.setdefault(key, {"buckets": [], "count": None,
+                                            "sum": None})
+            if name.endswith("_bucket"):
+                if "le" not in labels:
+                    errors.append(f"line {n}: _bucket sample without le label")
+                else:
+                    le = (float("inf") if labels["le"] == "+Inf"
+                          else float(labels["le"]))
+                    entry["buckets"].append((le, val))
+            elif name.endswith("_count"):
+                entry["count"] = val
+            elif name.endswith("_sum"):
+                entry["sum"] = val
+    for fam, series in hist.items():
+        for key, entry in series.items():
+            buckets = entry["buckets"]
+            if not buckets:
+                errors.append(f"{fam}{{{key}}}: histogram with no buckets")
+                continue
+            if buckets[-1][0] != float("inf"):
+                errors.append(f"{fam}{{{key}}}: missing +Inf bucket")
+            for (le1, v1), (le2, v2) in zip(buckets, buckets[1:]):
+                if le2 <= le1:
+                    errors.append(
+                        f"{fam}{{{key}}}: bucket bounds not increasing "
+                        f"({le1} -> {le2})")
+                if v2 < v1:
+                    errors.append(
+                        f"{fam}{{{key}}}: bucket counts not monotonic "
+                        f"(le={le1}:{v1} > le={le2}:{v2})")
+            if entry["count"] is None:
+                errors.append(f"{fam}{{{key}}}: missing _count sample")
+            elif buckets and buckets[-1][0] == float("inf") \
+                    and entry["count"] != buckets[-1][1]:
+                errors.append(
+                    f"{fam}{{{key}}}: _count {entry['count']} != +Inf "
+                    f"bucket {buckets[-1][1]}")
+            if entry["sum"] is None:
+                errors.append(f"{fam}{{{key}}}: missing _sum sample")
+    return errors
+
+
+def _split_labels(labelstr: str) -> list[str]:
+    """Split ``a="x",b="y,z"`` on commas outside quoted values."""
+    parts, buf, in_q, esc = [], [], False, False
+    for ch in labelstr:
+        if esc:
+            buf.append(ch)
+            esc = False
+            continue
+        if ch == "\\":
+            buf.append(ch)
+            esc = True
+            continue
+        if ch == '"':
+            in_q = not in_q
+            buf.append(ch)
+            continue
+        if ch == "," and not in_q:
+            parts.append("".join(buf))
+            buf = []
+            continue
+        buf.append(ch)
+    if buf:
+        parts.append("".join(buf))
+    return parts
 
 
 _profile_lock = threading.Lock()

@@ -25,6 +25,7 @@ handler turned it into a 500).
 
 from __future__ import annotations
 
+import asyncio
 import contextlib
 import json
 import logging
@@ -34,7 +35,14 @@ import uuid
 from typing import Any, AsyncIterator
 
 from quorum_tpu import oai, sse
-from quorum_tpu.observability import PhaseTimer, maybe_profile
+from quorum_tpu.observability import (
+    METRICS,
+    TRACES,
+    RequestTrace,
+    finish_request_trace,
+    maybe_profile,
+    use_trace,
+)
 from quorum_tpu.backends.base import Backend, BackendError
 from quorum_tpu.backends.registry import BackendRegistry, build_registry
 from quorum_tpu.config import Config, load_config
@@ -216,53 +224,92 @@ def create_app(
                     lines.append(
                         f'quorum_tpu_engine_{key}{{backend="{name}"}} {m[key]}'
                     )
+        # Latency histogram families (request duration, TTFT, inter-token,
+        # queue wait, prefill, decode chunk) — recorded by the tracing spine
+        # across server/strategy/engine layers (observability.METRICS).
+        lines.extend(METRICS.expose())
         return Response(
             ("\n".join(lines) + "\n").encode(),
             media_type="text/plain; version=0.0.4",
         )
 
+    @app.route("GET", "/debug/traces", "/v1/debug/traces")
+    async def debug_traces(request: Request) -> Response:
+        """Ring buffer of completed request traces plus the in-flight set:
+        per-request span timelines (queue-wait → prefill → decode →
+        aggregate → sse-flush), TTFT, and per-token wire timings — the
+        drill-down surface behind the aggregate histograms on /metrics."""
+        return JSONResponse(TRACES.snapshot())
+
+    @app.route("GET", "/debug/traces/{request_id}",
+               "/v1/debug/traces/{request_id}")
+    async def debug_trace_one(request: Request) -> Response:
+        trace = TRACES.get(request.path_params["request_id"])
+        if trace is None:
+            return JSONResponse(
+                {"error": {"message": "trace not found (expired from the "
+                           "ring buffer, or the id was never traced)",
+                           "type": "invalid_request_error"}},
+                status_code=404,
+            )
+        return JSONResponse(trace.to_dict())
+
     @app.route("POST", "/chat/completions", "/v1/chat/completions")
     async def chat_completions(request: Request) -> Response:
-        """Request-id + timing + profiling wrapper around the dispatch logic.
-        The id is echoed in X-Request-Id (the reference only had static
-        chatcmpl-parallel* ids, SURVEY.md §5.5). For SSE the profiler/timer
-        scope must cover the *stream* — the device work happens while the ASGI
-        server drives the iterator, after this handler returns — so the scope
-        is closed from the iterator's finally, not here."""
+        """Request-id + tracing + profiling wrapper around the dispatch
+        logic. Every request gets a :class:`RequestTrace` (id echoed in
+        X-Request-Id; spans land on /debug/traces; latencies land on the
+        /metrics histograms). For SSE the trace/profiler scope must cover
+        the *stream* — the device work happens while the ASGI server drives
+        the iterator, after this handler returns — so the scope is closed
+        from the iterator's finally, not here."""
         rid = f"req-{uuid.uuid4().hex[:16]}"
-        timer = PhaseTimer(rid)
+        trace = TRACES.start(RequestTrace(rid))
         scope = contextlib.ExitStack()
         scope.enter_context(maybe_profile(rid))
         try:
-            response = await _chat_impl(request, timer)
+            with use_trace(trace):
+                response = await _chat_impl(request, trace)
+        except (asyncio.CancelledError, GeneratorExit):
+            # Client disconnect, not a server error: 499 (the nginx
+            # client-closed-request convention) keeps impatient clients out
+            # of the 5xx request-duration series on dashboards.
+            scope.close()
+            finish_request_trace(trace, status=499)
+            raise
         except BaseException:
             scope.close()
+            finish_request_trace(trace, status=500)
             raise
         response.headers.setdefault("X-Request-Id", rid)
         if isinstance(response, StreamingResponse):
             response.iterator = _finish_scope_after(
-                response.iterator, scope, timer, response.status_code
+                sse.instrument_stream(response.iterator, trace),
+                scope, trace, response.status_code,
             )
         else:
             scope.close()
-            timer.log("complete", status=response.status_code)
+            finish_request_trace(trace, status=response.status_code,
+                                 mode="complete")
         return response
 
     async def _finish_scope_after(
         iterator: AsyncIterator[bytes],
         scope: contextlib.ExitStack,
-        timer: PhaseTimer,
+        trace: RequestTrace,
         status: int,
     ) -> AsyncIterator[bytes]:
         try:
-            with timer.phase("stream"):
-                async for chunk in iterator:
-                    yield chunk
+            async for chunk in iterator:
+                yield chunk
+        except (GeneratorExit, asyncio.CancelledError):
+            status = 499  # client left mid-stream (see chat_completions)
+            raise
         finally:
             scope.close()
-            timer.log("stream", status=status)
+            finish_request_trace(trace, status=status, mode="stream")
 
-    async def _chat_impl(request: Request, timer: PhaseTimer) -> Response:
+    async def _chat_impl(request: Request, trace: RequestTrace) -> Response:
         cfg, reg = await current()
         try:
             body = await request.json()
@@ -332,17 +379,23 @@ def create_app(
                 status_code=400,
             )
 
+        trace.meta["mode"] = (
+            ("parallel-" if is_parallel else "single-")
+            + ("stream" if is_streaming else "complete"))
+        trace.meta["backends"] = [b.name for b in targets]
+
         if is_streaming:
             if is_parallel:
                 plan = StreamPlan.from_config(cfg, reg, body)
                 return StreamingResponse(
-                    parallel_stream(plan, body, headers, timeout)
+                    parallel_stream(plan, body, headers, timeout,
+                                    trace=trace)
                 )
             return await _single_stream(targets[0], body, headers, timeout)
 
         # Non-streaming. Parity: every backend is called even in non-parallel
         # mode (oai_proxy.py:1132-1137).
-        with timer.phase("fanout"):
+        with trace.span("fanout", backends=len(targets)):
             outcomes = await fanout_complete(targets, body, headers, timeout)
         successes = [o for o in outcomes if o.ok]
         if not successes:
@@ -373,7 +426,7 @@ def create_app(
             )
 
         if is_parallel:
-            with timer.phase("combine"):
+            with trace.span("aggregate", strategy=cfg.strategy_name):
                 combined = await combine_outcomes(
                     cfg, reg, outcomes, body, headers, aggregator_timeout=timeout
                 )

@@ -20,6 +20,9 @@ class Request:
         self.scope = scope
         self._receive = receive
         self._body: bytes | None = None
+        # Filled by the router for routes registered with a trailing
+        # ``/{param}`` segment (e.g. /debug/traces/{request_id}).
+        self.path_params: dict[str, str] = {}
 
     @property
     def method(self) -> str:
@@ -147,15 +150,44 @@ class App:
 
     def __init__(self) -> None:
         self._routes: dict[tuple[str, str], Handler] = {}
+        # (method, prefix, param name, handler) for ``.../{param}`` routes —
+        # matched when the exact table misses and the remainder after the
+        # prefix is one non-empty segment.
+        self._param_routes: list[tuple[str, str, str, Handler]] = []
         self.state: dict[str, Any] = {}
 
     def route(self, method: str, *paths: str) -> Callable[[Handler], Handler]:
         def register(handler: Handler) -> Handler:
             for p in paths:
-                self._routes[(method.upper(), p)] = handler
+                if p.endswith("}") and "/{" in p:
+                    prefix, _, param = p.rpartition("/")
+                    self._param_routes.append(
+                        (method.upper(), prefix + "/", param[1:-1], handler))
+                else:
+                    self._routes[(method.upper(), p)] = handler
             return handler
 
         return register
+
+    @staticmethod
+    def _tail_segment(path: str, prefix: str) -> str | None:
+        """The single non-empty segment after ``prefix``, or None — the one
+        param-route matching predicate (shared by dispatch and the
+        405-vs-404 decision, so the two can never drift)."""
+        if not path.startswith(prefix):
+            return None
+        rest = path[len(prefix):]
+        return rest if rest and "/" not in rest else None
+
+    def _match_param_route(self, request: Request) -> Handler | None:
+        for method, prefix, param, handler in self._param_routes:
+            if method != request.method:
+                continue
+            rest = self._tail_segment(request.path, prefix)
+            if rest is not None:
+                request.path_params[param] = rest
+                return handler
+        return None
 
     async def __call__(self, scope, receive, send) -> None:
         if scope["type"] == "lifespan":
@@ -172,8 +204,15 @@ class App:
         request = Request(scope, receive)
         handler = self._routes.get((request.method, request.path))
         if handler is None:
+            handler = self._match_param_route(request)
+        if handler is None:
             known_paths = {p for (_, p) in self._routes}
-            if request.path in known_paths:
+            # A param route of another method still makes the path "known":
+            # POST /debug/traces/abc must 405 like POST /metrics does.
+            param_known = any(
+                self._tail_segment(request.path, prefix) is not None
+                for (_, prefix, _, _) in self._param_routes)
+            if request.path in known_paths or param_known:
                 response: Response = JSONResponse(
                     {"error": {"message": "Method not allowed", "type": "invalid_request_error"}},
                     status_code=405,

@@ -14,7 +14,7 @@ Frames follow the OpenAI streaming contract: each event is a single
 from __future__ import annotations
 
 import json
-from typing import Any, Iterator
+from typing import Any, AsyncIterator, Iterator
 
 DONE = "[DONE]"
 
@@ -28,6 +28,40 @@ def encode_event(payload: dict[str, Any] | str) -> bytes:
 
 def encode_done() -> bytes:
     return encode_event(DONE)
+
+
+async def instrument_stream(iterator: AsyncIterator[bytes],
+                            trace) -> AsyncIterator[bytes]:
+    """Wire-level latency capture: pass bytes through, marking every flush
+    on the request's trace (observability.RequestTrace.mark_flush).
+
+    TTFT and inter-token gaps are measured HERE — at the last point before
+    the ASGI send — not in the engine, so they include detokenization,
+    strategy merging, and JSON encoding: what the client actually waits
+    for. A flush counts as token-bearing when the frame carries a content
+    delta (role-only chunks and ``[DONE]`` never set TTFT); an sse-flush
+    span covering first-to-last write lands on the trace at close."""
+    if trace is None:
+        async for chunk in iterator:
+            yield chunk
+        return
+    span = None
+    try:
+        async for chunk in iterator:
+            if span is None:
+                span = trace.add_span("sse-flush", trace.now())
+            # Every frame on this stream is encode_event's compact JSON
+            # (separators=(",", ":")), so a non-empty content delta always
+            # serializes with text after '"content":"' — an upstream's
+            # empty-content warm-up frame must not set TTFT.
+            content = (b'"content":' in chunk
+                       and b'"content":""' not in chunk
+                       and b'"content":null' not in chunk)
+            trace.mark_flush(content)
+            yield chunk
+    finally:
+        if span is not None:
+            span.end = trace.now()
 
 
 class SSEParser:

@@ -26,6 +26,7 @@ from typing import Any, Sequence
 
 from quorum_tpu.backends.base import Backend
 from quorum_tpu.config import AggregateParams
+from quorum_tpu.observability import current_trace, trace_span
 
 logger = logging.getLogger(__name__)
 aggregation_logger = logging.getLogger("aggregation")
@@ -122,7 +123,12 @@ async def aggregate_responses(
         "stream": False,
     }
     try:
-        result = await aggregator.complete(body, clean_headers, timeout)
+        # The synthesis hop is usually the tail-latency dominator of an
+        # aggregate-strategy request — span it with the aggregator's name so
+        # /debug/traces shows where the time went.
+        with trace_span(current_trace(), "aggregator-call",
+                        backend=aggregator.name):
+            result = await aggregator.complete(body, clean_headers, timeout)
         if result.ok:
             content = result.content
             aggregation_logger.info("Aggregator response: %s", content)

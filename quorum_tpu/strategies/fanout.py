@@ -13,6 +13,7 @@ from dataclasses import dataclass
 from typing import Any
 
 from quorum_tpu.backends.base import Backend, BackendError, CompletionResult
+from quorum_tpu.observability import current_trace, trace_span, use_trace
 
 
 @dataclass
@@ -50,10 +51,19 @@ class BackendOutcome:
 
 
 async def _call_one(
-    backend: Backend, body: dict[str, Any], headers: dict[str, str], timeout: float
+    backend: Backend, body: dict[str, Any], headers: dict[str, str],
+    timeout: float, trace=None,
 ) -> BackendOutcome:
     try:
-        result = await backend.complete(body, headers, timeout)
+        # The per-backend hop span (tagged with the backend name) plus the
+        # trace re-bind: gather() runs each call as its own task, so the
+        # request context must travel explicitly for a tpu:// backend's
+        # engine submission to attach its scheduler spans.
+        with use_trace(trace), trace_span(trace, "fanout-call",
+                                          backend=backend.name) as span:
+            result = await backend.complete(body, headers, timeout)
+            if span is not None:
+                span.meta["status"] = result.status_code
         return BackendOutcome(backend=backend, result=result)
     except BackendError as e:
         return BackendOutcome(backend=backend, error=e)
@@ -68,8 +78,9 @@ async def fanout_complete(
     timeout: float,
 ) -> list[BackendOutcome]:
     """Call every backend concurrently; outcomes in backend order."""
+    trace = current_trace()
     return list(
         await asyncio.gather(
-            *[_call_one(b, body, headers, timeout) for b in backends]
+            *[_call_one(b, body, headers, timeout, trace) for b in backends]
         )
     )

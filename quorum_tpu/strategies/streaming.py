@@ -43,6 +43,7 @@ from quorum_tpu.backends.registry import BackendRegistry
 from quorum_tpu.config import AggregateParams, Config
 from quorum_tpu.filtering import strip_thinking_tags
 from quorum_tpu.native import make_thinking_filter
+from quorum_tpu.observability import trace_span, use_trace
 from quorum_tpu.strategies.aggregate import aggregate_responses
 
 logger = logging.getLogger(__name__)
@@ -119,13 +120,22 @@ async def _pump(
     headers: dict[str, str],
     timeout: float,
     queue: asyncio.Queue,
+    trace=None,
 ) -> None:
-    """Drive one backend stream, pushing (index, text | _DONE) into the queue."""
+    """Drive one backend stream, pushing (index, text | _DONE) into the queue.
+
+    The request trace is re-bound inside this task (``use_trace``) so a
+    ``tpu://`` backend's engine submission — which happens at the stream's
+    first ``__anext__``, on THIS task, after the server handler already
+    returned — still attaches its queue-wait/prefill/decode spans; the
+    fan-out hop itself is recorded as a backend-tagged span."""
     try:
-        async for chunk in backend.stream(body, headers, timeout):
-            text = oai.extract_delta_content(chunk)
-            if text:
-                await queue.put((index, text))
+        with use_trace(trace), trace_span(trace, "fanout-stream",
+                                          backend=backend.name, index=index):
+            async for chunk in backend.stream(body, headers, timeout):
+                text = oai.extract_delta_content(chunk)
+                if text:
+                    await queue.put((index, text))
     except Exception as e:
         logger.warning("Backend %s (%d) stream failed: %s", backend.name, index, e)
         aggregation_logger.error("Error processing backend %d: %s", index, e)
@@ -139,6 +149,7 @@ async def parallel_stream(
     headers: dict[str, str],
     timeout: float,
     aggregator_timeout: float | None = None,
+    trace=None,
 ) -> AsyncIterator[bytes]:
     """Merge N backend streams into one OpenAI-compatible SSE byte stream."""
     aggregation_logger.info("Starting streaming aggregation process")
@@ -152,7 +163,7 @@ async def parallel_stream(
     collected = ["" for _ in range(n)]
     queue: asyncio.Queue = asyncio.Queue()
     tasks = [
-        asyncio.create_task(_pump(i, b, body, headers, timeout, queue))
+        asyncio.create_task(_pump(i, b, body, headers, timeout, queue, trace))
         for i, b in enumerate(plan.backends)
     ]
 
@@ -201,18 +212,28 @@ async def parallel_stream(
             ]
         if labeled:
             if plan.strategy_name == "aggregate" and plan.aggregator is not None and plan.aggregate_params:
-                combined = await aggregate_responses(
-                    labeled,
-                    plan.aggregator,
-                    plan.aggregate_params,
-                    plan.user_query,
-                    headers,
-                    aggregator_timeout or timeout,
-                )
+                # use_trace: this generator body runs under the ASGI server
+                # (the handler's context binding is gone), so the trace must
+                # be re-bound for the aggregator hop's nested spans
+                # (aggregator-call, a tpu:// aggregator's engine spans) to
+                # attach — the same reason _pump re-binds.
+                with use_trace(trace), trace_span(
+                        trace, "aggregate", strategy=plan.strategy_name,
+                        aggregator=plan.aggregator.name):
+                    combined = await aggregate_responses(
+                        labeled,
+                        plan.aggregator,
+                        plan.aggregate_params,
+                        plan.user_query,
+                        headers,
+                        aggregator_timeout or timeout,
+                    )
                 if plan.hide_final:
                     combined = strip_thinking_tags(combined, plan.thinking_tags, hide=True)
             else:
-                combined = plan.separator.join(text for _, text in labeled)
+                with trace_span(trace, "aggregate",
+                                strategy=plan.strategy_name):
+                    combined = plan.separator.join(text for _, text in labeled)
             aggregation_logger.info("Final aggregated streaming content: %s", combined)
             yield sse.encode_event(oai.final_chunk(combined, model=PROXY_MODEL_NAME))
         else:

@@ -1,0 +1,165 @@
+"""Prometheus exposition conformance: the pure-Python promtool-style
+validator (observability.validate_exposition) plus live /metrics checks —
+histogram bucket monotonicity, _sum/_count consistency, and exactly one
+# TYPE line per metric family. Run standalone via ``make metrics-check``."""
+
+from quorum_tpu.observability import (
+    DEFAULT_BUCKETS,
+    Histogram,
+    validate_exposition,
+)
+from tests.conftest import make_client
+
+# ---- validator unit tests (no server, no jax) ------------------------------
+
+
+def test_validator_accepts_reference_shapes():
+    text = "\n".join([
+        "# HELP demo_seconds a demo",
+        "# TYPE demo_seconds histogram",
+        'demo_seconds_bucket{le="0.1"} 1',
+        'demo_seconds_bucket{le="1.0"} 3',
+        'demo_seconds_bucket{le="+Inf"} 4',
+        "demo_seconds_sum 2.5",
+        "demo_seconds_count 4",
+        "# TYPE demo_total counter",
+        'demo_total{backend="LLM1",mode="a,b"} 7',
+        "# TYPE demo_gauge gauge",
+        "demo_gauge 3.14",
+    ]) + "\n"
+    assert validate_exposition(text) == []
+
+
+def test_validator_flags_malformed_lines():
+    bad = "\n".join([
+        "# TYPE demo_total counter",
+        "demo_total seven",           # non-numeric value
+        'demo_total{unclosed="x" 1',  # unterminated label set
+        "# TYPE demo_total counter",  # duplicate TYPE
+    ]) + "\n"
+    errors = validate_exposition(bad)
+    assert any("non-numeric" in e for e in errors)
+    assert any("malformed sample" in e for e in errors)
+    assert any("duplicate TYPE" in e for e in errors)
+
+
+def test_validator_flags_histogram_inconsistencies():
+    text = "\n".join([
+        "# TYPE h_seconds histogram",
+        'h_seconds_bucket{le="0.1"} 5',
+        'h_seconds_bucket{le="1.0"} 3',    # non-monotonic counts
+        'h_seconds_bucket{le="+Inf"} 6',
+        "h_seconds_sum 1.0",
+        "h_seconds_count 7",               # != +Inf bucket
+        "# TYPE g_seconds histogram",
+        'g_seconds_bucket{le="0.5"} 2',    # no +Inf bucket
+        "g_seconds_sum 0.5",
+        "g_seconds_count 2",
+    ]) + "\n"
+    errors = validate_exposition(text)
+    assert any("not monotonic" in e for e in errors)
+    assert any("_count" in e and "+Inf" in e for e in errors)
+    assert any("missing +Inf" in e for e in errors)
+
+
+def test_validator_flags_type_after_samples():
+    text = "\n".join([
+        "late_total 1",
+        "# TYPE late_total counter",
+    ]) + "\n"
+    assert any("after its samples" in e for e in validate_exposition(text))
+
+
+def test_histogram_expose_is_valid_and_cumulative():
+    h = Histogram("t_seconds", "t")
+    for v in (0.002, 0.002, 0.3, 7.0, 1000.0):
+        h.observe(v)
+    h.observe(0.05, backend="A")
+    text = "\n".join(h.expose()) + "\n"
+    assert validate_exposition(text) == []
+    snap = h.snapshot()
+    unlabeled = snap[()]
+    assert unlabeled["count"] == 5
+    assert unlabeled["buckets"][-1] == 5          # +Inf holds everything
+    assert abs(unlabeled["sum"] - 1007.304) < 1e-6
+    # cumulative counts never decrease
+    assert unlabeled["buckets"] == sorted(unlabeled["buckets"])
+    labeled = snap[(("backend", "A"),)]
+    assert labeled["count"] == 1
+
+
+def test_default_buckets_strictly_increase():
+    assert list(DEFAULT_BUCKETS) == sorted(set(DEFAULT_BUCKETS))
+
+
+# ---- live /metrics conformance ---------------------------------------------
+
+
+def _config():
+    return {
+        "settings": {"timeout": 60},
+        "primary_backends": [
+            {"name": "LLM1", "url": "tpu://llama-tiny?seed=3&slots=2",
+             "model": "t"},
+        ],
+    }
+
+
+async def test_live_metrics_exposition_validates():
+    """The FULL /metrics output — engine gauges/counters plus every
+    histogram family — passes the validator after real traffic, with one
+    TYPE line per family and consistent histogram series."""
+    async with make_client(_config()) as client:
+        resp = await client.post(
+            "/chat/completions",
+            json={"model": "t", "max_tokens": 5,
+                  "messages": [{"role": "user", "content": "hi"}]},
+            headers={"Authorization": "Bearer x"},
+        )
+        assert resp.status_code == 200
+        stream = await client.post(
+            "/chat/completions",
+            json={"model": "t", "max_tokens": 5, "stream": True,
+                  "messages": [{"role": "user", "content": "hi"}]},
+            headers={"Authorization": "Bearer x"},
+        )
+        assert stream.status_code == 200
+        text = (await client.get("/metrics")).text
+
+    assert validate_exposition(text) == [], validate_exposition(text)
+
+    # exactly one TYPE line per family across the whole exposition
+    type_lines = [ln for ln in text.splitlines() if ln.startswith("# TYPE ")]
+    families = [ln.split()[2] for ln in type_lines]
+    assert len(families) == len(set(families)), families
+
+    # the acceptance histogram families, each with samples after traffic
+    for fam in ("quorum_tpu_ttft_seconds",
+                "quorum_tpu_inter_token_seconds",
+                "quorum_tpu_queue_wait_seconds",
+                "quorum_tpu_prefill_seconds",
+                "quorum_tpu_decode_chunk_seconds"):
+        assert f"# TYPE {fam} histogram" in text, fam
+        assert f'{fam}_bucket{{le="+Inf"}}' in text, fam
+        assert f"{fam}_sum" in text and f"{fam}_count" in text, fam
+    # request duration is labeled by status class (2xx here)
+    assert "# TYPE quorum_tpu_request_duration_seconds histogram" in text
+    assert ('quorum_tpu_request_duration_seconds_bucket'
+            '{status="2xx",le="+Inf"}') in text
+    assert 'quorum_tpu_request_duration_seconds_count{status="2xx"}' in text
+
+    # _count == +Inf bucket and bucket monotonicity for one family, by hand
+    # (belt to the validator's braces)
+    inf = count = None
+    prev = -1
+    for ln in text.splitlines():
+        if ln.startswith("quorum_tpu_queue_wait_seconds_bucket"):
+            v = int(float(ln.rsplit(" ", 1)[1]))
+            assert v >= prev
+            prev = v
+            if 'le="+Inf"' in ln:
+                inf = v
+        elif ln.startswith("quorum_tpu_queue_wait_seconds_count"):
+            count = int(float(ln.rsplit(" ", 1)[1]))
+    assert inf is not None and count is not None and inf == count
+    assert count >= 1  # the requests above really were observed

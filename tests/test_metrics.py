@@ -44,3 +44,21 @@ async def test_metrics_exposition():
         assert 'quorum_tpu_engine_failures_total{backend="LLM1"} 0' in after
         # prometheus text format: TYPE comments present
         assert "# TYPE quorum_tpu_engine_tokens_total counter" in after
+        # step-loop occupancy counters (ISSUE 1): decode dispatch turns and
+        # the busy-row sum they stepped
+        assert "# TYPE quorum_tpu_engine_decode_chunks_total counter" in after
+        assert ("# TYPE quorum_tpu_engine_decode_busy_rows_total counter"
+                in after)
+        # latency histogram families with full exposition triplets
+        for fam in ("quorum_tpu_request_duration_seconds",
+                    "quorum_tpu_ttft_seconds",
+                    "quorum_tpu_inter_token_seconds",
+                    "quorum_tpu_queue_wait_seconds"):
+            assert f"# TYPE {fam} histogram" in after, fam
+            assert f"{fam}_sum" in after, fam
+            assert f"{fam}_count" in after, fam
+        # request duration carries a status-class label so error floods
+        # don't read as latency improvements
+        assert ('quorum_tpu_request_duration_seconds_bucket'
+                '{status="2xx",le="+Inf"}') in after
+        assert 'quorum_tpu_queue_wait_seconds_bucket{le="+Inf"}' in after

@@ -56,7 +56,7 @@ def test_document_paths_match_served_routes():
     and "/v1" servers — app.py registers both prefixes)."""
     assert set(DOC["paths"]) == {
         "/chat/completions", "/completions", "/embeddings", "/health",
-        "/models", "/metrics"}
+        "/models", "/metrics", "/debug/traces", "/debug/traces/{request_id}"}
     assert [s["url"] for s in DOC["servers"]] == ["/", "/v1"]
     post = DOC["paths"]["/chat/completions"]["post"]
     assert set(post["responses"]) == {"200", "400", "401", "500", "503"}
