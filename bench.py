@@ -52,7 +52,11 @@ Prints ONE JSON line:
    "b7q_hbm_bw_util_pct": ..., "b7q_prefix_*": ...,
    "b7_tok_s_c2"/"b7q_tok_s_c2": co-batched 2-stream aggregate tokens/s,
    "b7q_long_*": ~5k-token-prompt TTFT (chunked prefill) + decode tok/s
-   against the 8192-token cache window}
+   against the 8192-token cache window,
+   "main_*"/"b7_*"/"b7q_*" dispatch accounting: *_dispatches_per_req (device
+   dispatches per request), *_sync_dispatches_per_req (the subset the host
+   BLOCKED on — the decode_pipeline ring hides the rest), *_pipeline_depth,
+   *_overrun_tokens (0 when rows finish on device — PERF.md §2)}
 
 The ``*_prefix_*`` keys measure automatic prefix caching where it matters —
 7B prefill dominates TTFT there: a long shared system preamble is sent
@@ -268,6 +272,43 @@ def _child_checkpoint(d: dict) -> None:
     print(json.dumps(dict(_CHILD_BANKED)), flush=True)
 
 
+async def _engine_counters(client) -> dict:
+    """Engine counters from the live server's /metrics exposition —
+    requests/chunks/overlap/pipeline numbers for the phase report."""
+    import re
+
+    resp = await client.get("/metrics",
+                            headers={"Authorization": "Bearer bench"})
+    out: dict = {}
+    for name in ("requests_total", "decode_chunks_total",
+                 "overlapped_chunks_total", "overrun_tokens_total",
+                 "spec_turns_total", "decode_pipeline"):
+        m = re.search(rf"^quorum_tpu_engine_{name}\{{[^}}]*\}} (\S+)$",
+                      resp.text, re.M)
+        if m:
+            out[name] = float(m.group(1))
+    return out
+
+
+def _dispatch_report(prefix: str, counters: dict) -> dict:
+    """Per-phase dispatch accounting: device dispatches per request, how
+    many of them the host actually BLOCKED on (total − overlapped — the
+    pipeline hides the rest), and the configured ring depth (PERF.md §2)."""
+    reqs = counters.get("requests_total") or 0
+    if not reqs:
+        return {}
+    chunks = counters.get("decode_chunks_total", 0)
+    chunks += counters.get("spec_turns_total", 0)
+    synced = chunks - counters.get("overlapped_chunks_total", 0)
+    return {
+        f"{prefix}_dispatches_per_req": round(chunks / reqs, 2),
+        f"{prefix}_sync_dispatches_per_req": round(synced / reqs, 2),
+        f"{prefix}_pipeline_depth": int(counters.get("decode_pipeline", 1)),
+        f"{prefix}_overrun_tokens": int(
+            counters.get("overrun_tokens_total", 0)),
+    }
+
+
 async def bench_7b(model: str, url: str, prefix: str, quant: bool,
                    long_ctx: bool = False) -> dict:
     """Serve a 7B-class model through the full socket stack; return the
@@ -339,6 +380,7 @@ async def bench_7b(model: str, url: str, prefix: str, quant: bool,
                 f"{prefix}_decode_tok_s": round(statistics.median(rates), 2),
                 f"{prefix}_ttft_ms": round(
                     statistics.median(ttfts) * 1000, 2),
+                **_dispatch_report(prefix, await _engine_counters(client)),
             })
 
             # Co-batched throughput: both slots decode concurrently in ONE
@@ -816,9 +858,10 @@ async def ckpt_main() -> None:
         print(json.dumps({"ckpt_error": f"{type(e).__name__}: {e}"}))
 
 
-async def _main_phases(client) -> tuple[list, list, list, float]:
+async def _main_phases(client) -> tuple[list, list, list, float, dict]:
     """Warmup + phase 1 (latency) + phase 2 (throughput) against a live
-    client; returns (ttfts, totals, token_counts, throughput_wall_s)."""
+    client; returns (ttfts, totals, token_counts, throughput_wall_s,
+    dispatch_report)."""
     for _ in range(N_WARMUP):  # compile prefill/decode programs
         await one_stream(client)
         await one_complete(client)
@@ -843,10 +886,14 @@ async def _main_phases(client) -> tuple[list, list, list, float]:
         *[bounded() for _ in range(N_THROUGHPUT_REQUESTS)]
     )
     wall = time.perf_counter() - t0
-    return ttfts, totals, token_counts, wall
+    # Dispatch accounting over the whole phase-1+2 window: how many device
+    # dispatches each request cost and how many the host blocked on (the
+    # depth-K ring hides the rest — PERF.md §2).
+    dispatch = _dispatch_report("main", await _engine_counters(client))
+    return ttfts, totals, token_counts, wall, dispatch
 
 
-async def _serve_and_run(stacked: bool) -> tuple[list, list, list, float]:
+async def _serve_and_run(stacked: bool) -> tuple[list, list, list, float, dict]:
     import httpx
 
     from quorum_tpu.server.serve import start_server
@@ -871,7 +918,8 @@ async def phase12_main(extra: "dict | None" = None) -> None:
     stacked = os.environ.get("QUORUM_TPU_BENCH_STACKED", "1") != "0"
     stacked_fallback = False
     try:
-        ttfts, totals, token_counts, wall = await _serve_and_run(stacked)
+        ttfts, totals, token_counts, wall, dispatch = await _serve_and_run(
+            stacked)
     except Exception as e:
         if not stacked:
             raise
@@ -885,7 +933,8 @@ async def phase12_main(extra: "dict | None" = None) -> None:
 
         shutdown_all_engines()
         stacked_fallback = True
-        ttfts, totals, token_counts, wall = await _serve_and_run(False)
+        ttfts, totals, token_counts, wall, dispatch = await _serve_and_run(
+            False)
 
     p50_ttft_ms = statistics.median(ttfts) * 1000
     p50_total_ms = statistics.median(totals) * 1000
@@ -916,6 +965,7 @@ async def phase12_main(extra: "dict | None" = None) -> None:
         **({"stacked_fallback": True} if stacked_fallback else {}),
         "max_tokens": MAX_TOKENS,
         "params_per_model": n_params,
+        **dispatch,
         **(extra or {}),
     }))
 

@@ -20,6 +20,16 @@ URL grammar:  ``tpu://<model-id>?<spec overrides>&<engine options>``
                    sharded over the sp axis (long-context serving)
   seed=            weight-init seed (distinct seeds ≈ distinct ensemble members)
   decode_chunk=    tokens per device dispatch (default 8)
+  decode_pipeline= decode-dispatch ring depth (default 2): the scheduler
+                   keeps up to K decode chunks in flight on the device and
+                   blocks only on the oldest, hiding the host turnaround
+                   (device_get + detok + SSE + scheduling) behind device
+                   time. 1 = fully synchronous dispatch. Safe at any depth:
+                   EOS / token-budget finishes are detected ON DEVICE
+                   inside the chunk, so rows never produce overrun tokens
+                   (engine metric overrun_tokens_total stays 0 for them).
+                   Structural: applies when this backend constructs the
+                   engine; backends sharing an engine share its depth
   slots=           concurrent batch width of the engine's KV cache (default 4;
                    applies when this backend constructs the engine — backends
                    sharing an engine share its slot count)
@@ -99,6 +109,7 @@ from quorum_tpu import oai
 from quorum_tpu.backends.base import BackendError, CompletionResult, prepare_body
 from quorum_tpu.config import BackendSpec
 from quorum_tpu.engine.engine import (
+    DEFAULT_DECODE_PIPELINE,
     DEFAULT_MAX_PENDING,
     DEFAULT_PREFILL_CHUNK,
     DEFAULT_SLOTS,
@@ -299,6 +310,8 @@ class TpuBackend:
                 f"member={member} out of range for members={members}")
         eng_kw = dict(
             n_slots=n_slots,
+            decode_pipeline=int(
+                opts.get("decode_pipeline", DEFAULT_DECODE_PIPELINE)),
             prefill_chunk=int(opts.get("prefill_chunk", DEFAULT_PREFILL_CHUNK)),
             max_pending=int(opts.get("queue", DEFAULT_MAX_PENDING)),
             # spec_model implies speculation: default g=4 when the knob
@@ -653,8 +666,23 @@ class TpuBackend:
                 break
         tail = matcher.feed(detok.flush()) + matcher.flush()
         pieces.append(tail)
-        if lp_content is not None and tail:
-            lp_content.extend(self._take_aligned(pending_lp, len(tail)))
+        if lp_content is not None:
+            if matcher.hit:
+                # Stop matched: entries for swallowed tokens stay dropped;
+                # the tail can still ship the entries it covers.
+                if tail:
+                    lp_content.extend(
+                        self._take_aligned(pending_lp, len(tail)))
+            else:
+                # No stop: every delivered token's entry ships. Character
+                # alignment alone strands entries here — a token's
+                # context-free decode text ('�' per byte of a split UTF-8
+                # char) can be LONGER than what it contributed to the
+                # incrementally-detokenized content, so the emitted chars
+                # run out before the entries do (the pre-existing flaky
+                # len(logprobs.content) failure in test_openai_knobs).
+                lp_content.extend(pending_lp)
+                pending_lp = []
         if matcher.hit:
             # A stop string can complete only in the flushed detokenizer
             # tail; the finish reason must still say "stop", not "length".
@@ -1209,6 +1237,14 @@ class TpuBackend:
                     finishes[idx] = "stop"
                 if tail:
                     emit(tail)
+                if pending_lp and not matcher.hit:
+                    # Same stranding fix as _consume: without a stop hit,
+                    # every delivered token's entry ships — in a final
+                    # (possibly empty-content) delta when byte-level decode
+                    # lengths outran the incremental text.
+                    rest, pending_lp = list(pending_lp), []
+                    loop.call_soon_threadsafe(
+                        queue.put_nowait, ("text", idx, ("", rest)))
                 loop.call_soon_threadsafe(queue.put_nowait, ("end", idx, None))
             except Exception as e:  # normalized below on the consumer side
                 loop.call_soon_threadsafe(queue.put_nowait, ("err", idx, e))
@@ -1227,23 +1263,39 @@ class TpuBackend:
                 yield oai.chunk(id=chunk_id, model=model,
                                 delta={"role": "assistant"}, index=i)
             while ended < n:
-                kind, idx, val = await asyncio.wait_for(
+                # Batch the drain: one decode chunk delivers its k tokens
+                # to the queue within microseconds of each other, so after
+                # the (possibly blocking) first get, everything already
+                # queued rides the same batch. Every event but the batch's
+                # last is marked MoreChunk — the SSE writer then emits k
+                # events with ONE socket flush (sse-coalescing contract;
+                # the per-flush trace marks count the frames inside).
+                events = [await asyncio.wait_for(
                     queue.get(), timeout=max(0.0, deadline - loop.time())
-                )
-                if kind == "text":
-                    text, lp = val
-                    out = oai.chunk(id=chunk_id, model=model,
-                                    delta={"content": text}, index=idx)
-                    if plan["logprobs"] >= 0:
-                        out["choices"][0]["logprobs"] = {
-                            "content": lp, "refusal": None}
-                    yield out
-                elif kind == "end":
-                    ended += 1
-                    yield oai.chunk(id=chunk_id, model=model, delta={},
-                                    finish_reason=finishes[idx], index=idx)
-                else:
-                    raise BackendError(f"Backend {self.name} failed: {val}") from val
+                )]
+                while True:
+                    try:
+                        events.append(queue.get_nowait())
+                    except asyncio.QueueEmpty:
+                        break
+                for pos, (kind, idx, val) in enumerate(events):
+                    more = pos < len(events) - 1
+                    if kind == "text":
+                        text, lp = val
+                        out = oai.chunk(id=chunk_id, model=model,
+                                        delta={"content": text}, index=idx)
+                        if plan["logprobs"] >= 0:
+                            out["choices"][0]["logprobs"] = {
+                                "content": lp, "refusal": None}
+                        yield oai.more(out) if more else out
+                    elif kind == "end":
+                        ended += 1
+                        out = oai.chunk(id=chunk_id, model=model, delta={},
+                                        finish_reason=finishes[idx], index=idx)
+                        yield oai.more(out) if more else out
+                    else:
+                        raise BackendError(
+                            f"Backend {self.name} failed: {val}") from val
         except asyncio.TimeoutError:
             cancel_all()  # abort the device loops at the next chunk boundary
             raise BackendError(f"Backend {self.name} timed out after {timeout}s")

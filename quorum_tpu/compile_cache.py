@@ -19,6 +19,17 @@ same machine, so CPU hosts are opt-in: ``QUORUM_TPU_COMPILE_CACHE=1`` (or
 ``jax_compilation_cache_dir`` (jax config or JAX_COMPILATION_CACHE_DIR env)
 is never overridden.
 
+**CPU determinism caveat** (why the test suite runs with the cache OFF —
+tests/conftest.py): on XLA:CPU, one logical program can legitimately
+compile to several numerically different executables (e.g. a
+layout-specialized variant for donated-buffer steady state vs the first
+call's fresh arrays). In-process, jax compiles each variant fresh and the
+results are repeatable; with the persistent cache, a variant DESERIALIZED
+from an entry another process/engine instance wrote can differ in float
+reassociation from the in-process compile — and a near-tie sample then
+flips between two otherwise-identical generations. Harmless for serving
+throughput, fatal for bit-exact determinism tests.
+
 No reference equivalent: the reference proxy compiles nothing
 (/root/reference/src/quorum/oai_proxy.py is pure HTTP dispatch); this is
 TPU-runtime surface the reference never needed.

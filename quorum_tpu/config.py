@@ -13,8 +13,11 @@ quorum_tpu extends ``primary_backends[].url`` with a ``tpu://`` scheme:
 
   tpu://<model-id>?family=llama&layers=4&d_model=256&...   in-process JAX model
 
-Query parameters configure the model (see :mod:`quorum_tpu.models.registry`);
-anything absent falls back to the named preset for ``<model-id>``.
+Query parameters configure the model (see :mod:`quorum_tpu.models.registry`)
+and the serving engine (``decode_chunk=``, ``decode_pipeline=``, ``slots=``,
+``quant=``, … — the full grammar is the docstring of
+:mod:`quorum_tpu.backends.tpu_backend`); anything absent falls back to the
+named preset for ``<model-id>`` and the engine defaults.
 
 Loading semantics preserved from the reference (oai_proxy.py:40-63): read
 ``config.yaml`` from the repo/cwd root, and on *any* failure fall back to a

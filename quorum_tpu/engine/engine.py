@@ -21,6 +21,14 @@ concurrent requests):
   - **Chunked decode**: each dispatch scans ``decode_chunk`` steps, so the
     host syncs once per chunk, not per token; admission happens at chunk
     boundaries (a new request waits at most one chunk + its own prefill).
+  - **Depth-K dispatch pipeline** (``decode_pipeline=K``, default 2): the
+    scheduler keeps up to K decode chunks in flight and blocks only on the
+    oldest, so the device rolls chunk-to-chunk while the host detokenizes,
+    SSE-emits, and schedules. Safe at any depth because finish detection
+    is ON DEVICE: per-row EOS and remaining-budget checks run inside the
+    chunk program (a finished row stops sampling and stops writing cache),
+    and each chunk returns per-row ``n_valid`` — overrun tokens are never
+    produced for EOS/budget finishes, at any K (PERF.md §2).
   - **Determinism**: each request's sampling stream is keyed by its own seed
     at admission, and every op is row-independent, so results don't depend on
     which slot a request lands in or what else is co-batched with it.
@@ -50,6 +58,7 @@ import queue
 import threading
 import time
 import weakref
+from collections import deque
 from dataclasses import dataclass, field
 from typing import Iterator
 
@@ -64,6 +73,7 @@ from quorum_tpu.compile_cache import enable_persistent_compile_cache
 from quorum_tpu.models.init import init_params, init_params_sharded
 from quorum_tpu.models.model_config import ModelSpec
 from quorum_tpu.models.transformer import (
+    decode_chunk,
     decode_multi,
     decode_step,
     init_cache,
@@ -80,6 +90,15 @@ MIN_BUCKET = 16
 DEFAULT_SLOTS = 4
 DEFAULT_PREFILL_CHUNK = 512
 DEFAULT_MAX_PENDING = 128
+# Decode-dispatch pipeline depth: how many decode chunks the scheduler keeps
+# in flight on the device, blocking only on the oldest. 1 = fully
+# synchronous (dispatch, read, repeat); 2 = the depth the old "paired chunk
+# dispatch" special case provided; deeper hides more consecutive host
+# turnarounds (PERF.md §2). Safe at any depth because finish detection is
+# ON DEVICE: a row that hits EOS or its token budget mid-chunk stops
+# sampling/writing inside the program, so in-flight chunks never produce
+# overrun tokens for it.
+DEFAULT_DECODE_PIPELINE = 2
 # Concurrent scoring/embedding device forwards per engine (see
 # ``score_gate`` in InferenceEngine.__init__); excess requests 503.
 SCORE_GATE_SLOTS = 2
@@ -229,6 +248,26 @@ class _Request:
         iff it equals the token the model itself SAMPLES there."""
         return (self.pp == 0.0 and self.fp == 0.0
                 and self.bias_row is None and self.want_lp < 0)
+
+
+class _InflightChunk:
+    """One dispatched-but-unread decode chunk in the scheduler's ring.
+
+    ``payload`` holds the chunk program's output arrays (jax futures until
+    fetched); ``active`` the (row, request) pairs the chunk was dispatched
+    over — the reap maps rows back through it, skipping rows whose slot was
+    released (or re-admitted) in the meantime. ``depth`` is the ring depth
+    at dispatch (0 = the blocking chunk), recorded on the decode span."""
+
+    __slots__ = ("payload", "active", "n_steps", "t0", "history", "depth")
+
+    def __init__(self, payload, active, n_steps, t0, history, depth):
+        self.payload = payload
+        self.active = active
+        self.n_steps = n_steps
+        self.t0 = t0
+        self.history = history
+        self.depth = depth
 
 
 class _Admission:
@@ -421,6 +460,7 @@ class InferenceEngine:
         *,
         seed: int = 0,
         decode_chunk: int = 8,
+        decode_pipeline: int = DEFAULT_DECODE_PIPELINE,
         params=None,
         n_slots: int = DEFAULT_SLOTS,
         prefill_chunk: int = DEFAULT_PREFILL_CHUNK,
@@ -472,6 +512,9 @@ class InferenceEngine:
         # (/root/reference/src/quorum/oai_proxy.py:182-192).
         self.members = max(1, int(members))
         self.decode_chunk = max(1, decode_chunk)
+        # Depth of the decode-dispatch ring (see DEFAULT_DECODE_PIPELINE):
+        # up to this many chunks in flight; the host blocks on the oldest.
+        self.decode_pipeline = max(1, int(decode_pipeline))
         self.n_slots = max(1, n_slots)
         # Admission gate for the direct device forwards (embeddings,
         # teacher-forced scoring): chat decode is slot-queue-gated, but
@@ -637,6 +680,16 @@ class InferenceEngine:
         self.n_failures = 0
         self.n_cancelled = 0   # requests retired because cancel was set
         self.n_overlapped = 0  # decode chunks dispatched ahead of the read
+        # Tokens the device produced that never reached a consumer. With
+        # on-device finish accounting this stays 0 for EOS/budget finishes
+        # at ANY pipeline depth; host-side finishes the device cannot see
+        # (stop-sequence hits, cancellation) still waste the tokens of
+        # already-dispatched chunks.
+        self.n_overrun = 0
+        # The in-flight decode-chunk ring (scheduler thread only): oldest
+        # first; each entry is (payload arrays, active rows at dispatch,
+        # n_steps, dispatch stamp, history bucket, depth at dispatch).
+        self._inflight: deque = deque()
         self.n_spec_turns = 0      # speculative verify dispatches
         self.n_spec_accepted = 0   # draft tokens accepted across them
         self.n_decode_chunks = 0   # plain batched decode dispatch turns
@@ -702,6 +755,14 @@ class InferenceEngine:
         self._token = jax.device_put(np.zeros((s,), np.int32), rep)
         self._lengths = jax.device_put(np.zeros((s,), np.int32), rep)
         self._keys = jax.device_put(np.zeros((s, 2), np.uint32), rep)
+        # On-device finish accounting (the state that makes depth-K dispatch
+        # safe): per-row liveness, remaining token budget, and EOS id (−1 =
+        # none). Set at admission/registration, updated by every decode
+        # chunk ON DEVICE — a chunk dispatched before the host has read its
+        # predecessor still knows which rows already finished.
+        self._live = jax.device_put(np.zeros((s,), bool), rep)
+        self._budget = jax.device_put(np.zeros((s,), np.int32), rep)
+        self._eos = jax.device_put(np.full((s,), -1, np.int32), rep)
         self._temp = jax.device_put(np.ones((s,), np.float32), rep)
         self._topp = jax.device_put(np.ones((s,), np.float32), rep)
         self._topk = jax.device_put(np.zeros((s,), np.int32), rep)
@@ -737,9 +798,9 @@ class InferenceEngine:
         ens = self.ensemble
 
         def admit(params, tokens, lengths1, slot, seed, temp1, topp1, topk1,
-                  pp1, fp1, bias_row,
+                  pp1, fp1, bias_row, budget1, eos1,
                   ck, cv, token_s, lengths_s, keys_s, temp_s, topp_s, topk_s,
-                  pp_s, fp_s, counts_s, bias_s):
+                  pp_s, fp_s, counts_s, bias_s, live_s, budget_s, eos_s):
             # mesh is None whenever ens > 1 (sp is rejected with ensembles)
             logits, ck, cv = _member_call(
                 ens,
@@ -776,6 +837,12 @@ class InferenceEngine:
                 fp_s.at[slot].set(fp1),
                 counts_s.at[slot].set(counts_row),
                 bias_s.at[slot].set(bias_row),
+                # Finish state: the admit already produced token 1, so the
+                # remaining budget is budget−1; the row is live unless that
+                # first token exhausted it or WAS the EOS.
+                live_s.at[slot].set((budget1 > 1) & (first != eos1)),
+                budget_s.at[slot].set(budget1 - 1),
+                eos_s.at[slot].set(eos1),
             )
 
         fn = jax.jit(
@@ -784,6 +851,7 @@ class InferenceEngine:
                 "ck", "cv", "token_s", "lengths_s", "keys_s",
                 "temp_s", "topp_s", "topk_s",
                 "pp_s", "fp_s", "counts_s", "bias_s",
+                "live_s", "budget_s", "eos_s",
             ),
         )
         self._admit_cache[bucket] = fn
@@ -808,9 +876,9 @@ class InferenceEngine:
         mem = self.members
 
         def admit(params, tokens, lengths, slot, enables, seeds,
-                  temps, topps, topks, pps, fps, bias_rows,
+                  temps, topps, topks, pps, fps, bias_rows, budgets, eoss,
                   ck, cv, token_s, lengths_s, keys_s, temp_s, topp_s, topk_s,
-                  pp_s, fp_s, counts_s, bias_s):
+                  pp_s, fp_s, counts_s, bias_s, live_s, budget_s, eos_s):
             # tokens [M, 1, bucket]; lengths [M, 1]; slot scalar int32;
             # enables [M] bool; sampler knobs [M]; bias_rows [M, V].
             def one(p, tok, lens, k, v, gate):
@@ -851,6 +919,9 @@ class InferenceEngine:
                 upd(fp_s, fps),
                 upd(counts_s, counts_rows),
                 upd(bias_s, bias_rows),
+                upd(live_s, (budgets > 1) & (firsts != eoss)),
+                upd(budget_s, budgets - 1),
+                upd(eos_s, eoss),
             )
 
         fn = jax.jit(
@@ -859,6 +930,7 @@ class InferenceEngine:
                 "ck", "cv", "token_s", "lengths_s", "keys_s",
                 "temp_s", "topp_s", "topk_s",
                 "pp_s", "fp_s", "counts_s", "bias_s",
+                "live_s", "budget_s", "eos_s",
             ),
         )
         self._admit_cache[("members", bucket)] = fn
@@ -908,9 +980,9 @@ class InferenceEngine:
         vocab = self.spec.vocab_size
 
         def register(slot, last_tok, n_minus1, seed, temp1, topp1, topk1,
-                     pp1, fp1, bias_row,
+                     pp1, fp1, bias_row, budget1, eos1,
                      token_s, lengths_s, keys_s, temp_s, topp_s, topk_s,
-                     pp_s, fp_s, counts_s, bias_s):
+                     pp_s, fp_s, counts_s, bias_s, live_s, budget_s, eos_s):
             return (
                 token_s.at[slot].set(last_tok),
                 lengths_s.at[slot].set(n_minus1),
@@ -922,6 +994,11 @@ class InferenceEngine:
                 fp_s.at[slot].set(fp1),
                 counts_s.at[slot].set(jnp.zeros((vocab,), jnp.int32)),
                 bias_s.at[slot].set(bias_row),
+                # No token emitted yet (the first samples in the next decode
+                # chunk), so the full budget remains and the row is live.
+                live_s.at[slot].set(budget1 > 0),
+                budget_s.at[slot].set(budget1),
+                eos_s.at[slot].set(eos1),
             )
 
         fn = jax.jit(
@@ -929,6 +1006,7 @@ class InferenceEngine:
             donate_argnames=(
                 "token_s", "lengths_s", "keys_s", "temp_s", "topp_s", "topk_s",
                 "pp_s", "fp_s", "counts_s", "bias_s",
+                "live_s", "budget_s", "eos_s",
             ),
         )
         self._admit_cache["register"] = fn
@@ -943,7 +1021,14 @@ class InferenceEngine:
         logprobs; ``history`` (a power-of-two ≥ the longest active sequence
         after this chunk) bounds each step's attention reads to the live
         cache prefix instead of the full padded max_seq row (decode is
-        HBM-bound — this is the decode-side bandwidth fix)."""
+        HBM-bound — this is the decode-side bandwidth fix).
+
+        The per-step model/cache/finish machinery lives in
+        :func:`transformer.decode_chunk`: rows finish ON DEVICE (EOS or
+        budget), so the chunk returns per-row ``n_valid`` and updated
+        ``live``/``budget`` state — what lets the scheduler keep
+        ``decode_pipeline`` chunks in flight without producing overrun
+        tokens for rows that finish mid-window."""
         fn = self._decode_cache.get((n_steps, want_lp, history))
         if fn is not None:
             return fn
@@ -955,78 +1040,83 @@ class InferenceEngine:
         ens = self.ensemble
         mem = self.members
 
-        def chunk(params, active, ck, cv, token_s, lengths_s, keys_s,
-                  temp_s, topp_s, topk_s, pp_s, fp_s, counts_s, bias_s):
-            live = active > 0
+        def chunk(params, active, eos_s, ck, cv, token_s, lengths_s, keys_s,
+                  temp_s, topp_s, topk_s, pp_s, fp_s, counts_s, bias_s,
+                  live_s, budget_s):
+            # Inactive slots run the forward (batch is static) but their
+            # K/V write is masked off — a slot mid-chunked-admission must
+            # not have its freshly prefilled cache clobbered by the dummy
+            # position-0 write. live_s additionally drops rows that already
+            # finished on device in an earlier in-flight chunk.
+            live0 = (active > 0) & live_s & (budget_s > 0)
 
-            def step(carry, _):
-                tok, lens, ck, cv, keys, counts = carry
-                # Inactive slots run the forward (batch is static) but their
-                # K/V write is masked off — a slot mid-chunked-admission must
-                # not have its freshly prefilled cache clobbered by the dummy
-                # position-0 write.
-                pos = jnp.where(live, lens, 0)
-                if mem > 1:
-                    # Stacked members: one dispatch advances every member's
-                    # slots (fold/unfold via _stacked_rows_call; sampling
-                    # stays flat).
-                    logits, ck, cv = _stacked_rows_call(
+            if mem > 1:
+                # Stacked members: one dispatch advances every member's
+                # slots (fold/unfold via _stacked_rows_call; sampling
+                # stays flat).
+                def model_call(ck, cv, tok, pos, wm):
+                    return _stacked_rows_call(
                         mem, n_s,
-                        lambda p, k, v, t, ps, wm: decode_step(
-                            p, spec, t, ps, k, v, write_mask=wm,
+                        lambda p, k, v, t, ps, w: decode_step(
+                            p, spec, t, ps, k, v, write_mask=w,
                             history=history),
-                        params, ck, cv, tok, pos, live)
-                else:
-                    logits, ck, cv = _member_call(
+                        params, ck, cv, tok, pos, wm)
+            else:
+                def model_call(ck, cv, tok, pos, wm):
+                    return _member_call(
                         ens,
                         lambda p, k, v: decode_step(
-                            p, spec, tok, pos, k, v, write_mask=live,
+                            p, spec, tok, pos, k, v, write_mask=wm,
                             history=history),
-                        params, ck, cv,
-                    )
+                        params, ck, cv)
+
+            def sample_fn(logits, live, carry):
+                keys, counts = carry
                 # OpenAI sampling knobs, applied per row on the f32 logits:
                 # logit_bias adds; presence/frequency penalties subtract
                 # based on the slot's generated-token counts.
-                adj = (logits.astype(jnp.float32) + bias_s
+                adj = (logits + bias_s
                        - fp_s[:, None] * counts
                        - pp_s[:, None] * (counts > 0))
                 split = jax.vmap(jax.random.split)(keys)  # [S, 2, 2]
                 nxt = sample_token_rows(
                     adj, split[:, 1], temp_s, topp_s, topk_s
                 )
-                nxt = jnp.where(live, nxt, tok)
                 counts = counts.at[jnp.arange(n_rows), nxt].add(
                     live.astype(jnp.int32))
-                lens = lens + live.astype(lens.dtype)
                 if want_lp:
                     lp_all = jax.nn.log_softmax(adj)        # [S, V]
                     s_lp = jnp.take_along_axis(
                         lp_all, nxt[:, None], axis=1)[:, 0]
                     top_lp, top_ix = lax.top_k(lp_all, n_top)  # [S, n_top]
-                    out = (nxt, s_lp, top_ix, top_lp)
+                    aux = (s_lp, top_ix, top_lp)
                 else:
-                    out = nxt
-                return (nxt, lens, ck, cv, split[:, 0], counts), out
+                    aux = ()
+                return nxt, (split[:, 0], counts), aux
 
-            (token_s, lengths_s, ck, cv, keys_s, counts_s), ys = lax.scan(
-                step, (token_s, lengths_s, ck, cv, keys_s, counts_s),
-                None, length=n_steps,
-            )
+            (toks, _valid, n_valid, live_end, budget_s, ck, cv, lengths_s,
+             (keys_s, counts_s), aux) = decode_chunk(
+                params, spec, n_steps, token_s, lengths_s, live0, budget_s,
+                eos_s, ck, cv, sample_fn, (keys_s, counts_s),
+                history=history, model_call=model_call)
             if want_lp:
-                toks, s_lp, top_ix, top_lp = ys
+                s_lp, top_ix, top_lp = aux
                 lp_out = (s_lp.T, top_ix.transpose(1, 0, 2),
                           top_lp.transpose(1, 0, 2))
             else:
-                toks = ys
                 lp_out = ()
-            # [n_steps, S, ...] → [S, n_steps, ...]
-            return ((toks.T,) + lp_out
-                    + (ck, cv, token_s, lengths_s, keys_s, counts_s))
+            # Rows outside this chunk's active set keep their liveness (a
+            # slot mid-admission must not be marked dead under the ring).
+            live_s = jnp.where(active > 0, live_end, live_s)
+            token_s = jnp.where(active > 0, toks[:, -1], token_s)
+            return ((toks, n_valid) + lp_out
+                    + (ck, cv, token_s, lengths_s, keys_s, counts_s,
+                       live_s, budget_s))
 
         fn = jax.jit(
             chunk,
             donate_argnames=("ck", "cv", "token_s", "lengths_s", "keys_s",
-                             "counts_s"),
+                             "counts_s", "live_s", "budget_s"),
         )
         self._decode_cache[(n_steps, want_lp, history)] = fn
         return fn
@@ -1057,7 +1147,7 @@ class InferenceEngine:
         mem = self.members
 
         def verify(params, active, tokens, ck, cv, token_s, lengths_s, keys_s,
-                   temp_s, topp_s, topk_s, counts_s):
+                   temp_s, topp_s, topk_s, counts_s, live_s, budget_s):
             live = active > 0
             pos = jnp.where(live, lengths_s, 0)
             if mem > 1:
@@ -1124,6 +1214,13 @@ class InferenceEngine:
                 jnp.moveaxis(key_chain, 0, 1),                   # [S,g+1,2]
                 (emitted - 1)[:, None, None], axis=1)[:, 0]
             new_keys = jnp.where(live[:, None], key_sel, keys_s)
+            # Keep the on-device budget honest through verify turns: a later
+            # pipelined decode chunk reads budget_s to bound the row, so the
+            # emitted count must come off here too. (EOS finishes inside the
+            # chain are the host's to handle — verify turns run with the
+            # pipeline drained, and the host releases the row immediately.)
+            budget_s = budget_s - emitted * live.astype(budget_s.dtype)
+            live_s = jnp.where(live, budget_s > 0, live_s)
             return (
                 s0, model_rest, ok,
                 ck, cv,
@@ -1131,12 +1228,13 @@ class InferenceEngine:
                 lengths_s + emitted * live.astype(lengths_s.dtype),
                 new_keys,
                 counts_s,
+                live_s, budget_s,
             )
 
         fn = jax.jit(
             verify,
             donate_argnames=("ck", "cv", "token_s", "lengths_s", "keys_s",
-                             "counts_s"),
+                             "counts_s", "live_s", "budget_s"),
         )
         self._decode_cache[("verify", g, history)] = fn
         return fn
@@ -1314,6 +1412,9 @@ class InferenceEngine:
                 "prefix_hits_total": self.prefix_hits,
                 "prefix_tokens_saved_total": self.prefix_tokens_saved,
                 "overlapped_chunks_total": self.n_overlapped,
+                "overrun_tokens_total": self.n_overrun,
+                "decode_pipeline": self.decode_pipeline,
+                "inflight_chunks": len(self._inflight),
             }
 
     def shutdown(self, timeout: float = 30.0) -> None:
@@ -1354,16 +1455,17 @@ class InferenceEngine:
         while True:
             with self._cond:
                 while not (self._stop or self._pending or self._admitting
-                           or any(self._slots)):
+                           or any(self._slots) or self._inflight):
                     self._cond.wait()
                 if self._stop and not (
                     self._pending or self._admitting or any(self._slots)
+                    or self._inflight
                 ):
                     return
             try:
                 self._start_admissions()
                 self._step_admissions()
-                if any(self._slots):
+                if any(self._slots) or self._inflight:
                     self._run_chunk()
             except Exception as e:  # fail open: wake every waiting consumer
                 try:
@@ -1607,6 +1709,8 @@ class InferenceEngine:
         topks = np.zeros((mem,), np.int32)
         pps = np.zeros((mem,), np.float32)
         fps = np.zeros((mem,), np.float32)
+        budgets = np.ones((mem,), np.int32)
+        eoss = np.full((mem,), -1, np.int32)
         bias_rows = self._zero_bias_mem  # copy-on-write below
         live: dict[int, _Request] = {}
         for m, req in group.items():
@@ -1625,6 +1729,8 @@ class InferenceEngine:
             topks[m] = req.top_k
             pps[m] = req.pp
             fps[m] = req.fp
+            budgets[m] = req.budget
+            eoss[m] = req.eos_id if req.eos_id is not None else -1
             if req.bias_row is not None:
                 if bias_rows is self._zero_bias_mem:
                     bias_rows = bias_rows.copy()
@@ -1637,12 +1743,14 @@ class InferenceEngine:
          self._ck, self._cv, self._token, self._lengths, self._keys,
          self._temp, self._topp, self._topk,
          self._pp, self._fp, self._counts, self._bias,
+         self._live, self._budget, self._eos,
          ) = self._admit_fn_members(bucket)(
             self.params, tokens, lengths, np.int32(row), enables, seeds,
-            temps, topps, topks, pps, fps, bias_rows,
+            temps, topps, topks, pps, fps, bias_rows, budgets, eoss,
             self._ck, self._cv, self._token, self._lengths, self._keys,
             self._temp, self._topp, self._topk,
             self._pp, self._fp, self._counts, self._bias,
+            self._live, self._budget, self._eos,
         )
         firsts, s_lp, top_ix, top_lp = _host_fetch(
             firsts, s_lp, top_ix, top_lp)
@@ -1751,7 +1859,8 @@ class InferenceEngine:
         bias = req.bias_row if req.bias_row is not None else self._zero_bias
         (self._token, self._lengths, self._keys, self._temp,
          self._topp, self._topk, self._pp, self._fp,
-         self._counts, self._bias) = self._register_fn()(
+         self._counts, self._bias,
+         self._live, self._budget, self._eos) = self._register_fn()(
             np.int32(adm.slot),
             np.int32(prompt[-1]),
             np.int32(len(prompt) - 1),
@@ -1762,9 +1871,12 @@ class InferenceEngine:
             np.float32(req.pp),
             np.float32(req.fp),
             bias,
+            np.int32(req.budget),
+            np.int32(req.eos_id if req.eos_id is not None else -1),
             self._token, self._lengths, self._keys,
             self._temp, self._topp, self._topk,
             self._pp, self._fp, self._counts, self._bias,
+            self._live, self._budget, self._eos,
         )
         t1 = time.perf_counter()
         # Wall time from slot claim to cache-complete: chunked admissions
@@ -1827,7 +1939,8 @@ class InferenceEngine:
         (first, s_lp, top_ix, top_lp,
          self._ck, self._cv, self._token, self._lengths, self._keys,
          self._temp, self._topp, self._topk,
-         self._pp, self._fp, self._counts, self._bias) = self._admit_fn(bucket)(
+         self._pp, self._fp, self._counts, self._bias,
+         self._live, self._budget, self._eos) = self._admit_fn(bucket)(
             self.params,
             tokens,
             np.asarray([n_prompt], np.int32),
@@ -1839,9 +1952,12 @@ class InferenceEngine:
             np.float32(req.pp),
             np.float32(req.fp),
             bias,
+            np.int32(req.budget),
+            np.int32(req.eos_id if req.eos_id is not None else -1),
             self._ck, self._cv, self._token, self._lengths, self._keys,
             self._temp, self._topp, self._topk,
             self._pp, self._fp, self._counts, self._bias,
+            self._live, self._budget, self._eos,
         )
         first, s_lp, top_ix, top_lp = _host_fetch(first, s_lp, top_ix, top_lp)
         t1 = time.perf_counter()
@@ -1859,91 +1975,165 @@ class InferenceEngine:
             with self._cond:
                 self._slots[slot] = req
 
-    def _run_chunk(self) -> None:
+    def _sweep_cancelled(self) -> None:
+        """Release rows whose cancel event is set (client gone, stop string
+        hit): they are masked out of every not-yet-dispatched chunk; tokens
+        still arriving from in-flight chunks are counted as overrun."""
         with self._cond:
             active = [(i, r) for i, r in enumerate(self._slots) if r is not None]
-        # Drop cancelled requests before spending device time on them.
         for i, r in active:
             if r.cancel.is_set():
                 self.n_cancelled += 1
                 r.out.put(("end", None))
                 with self._cond:
                     self._release_slot(i, r)
+
+    def _active_rows(self) -> list:
         with self._cond:
-            active = [(i, r) for i, r in enumerate(self._slots) if r is not None]
+            return [(i, r) for i, r in enumerate(self._slots) if r is not None]
+
+    def _run_chunk(self) -> None:
+        self._sweep_cancelled()
+        active = self._active_rows()
         if not active:
+            self._drain_inflight()
             return
-        # Fixed chunk size per hint value: tailoring n_steps to remaining
-        # budgets would compile a program per distinct tail length; a few
-        # over-generated (discarded) steps at the end of a request are cheaper
-        # than surprise XLA compiles inside a serving window.
-        n_steps = max(1, min(r.chunk_hint or self.decode_chunk for _, r in active))
-        want_lp = any(r.want_lp >= 0 for _, r in active)
-        # History bucket: longest active sequence after this chunk, rounded
-        # to a power of two — every step's attention reads only cache[:hb].
         max_len = max(len(r.prompt_ids) + r.emitted for _, r in active)
         g = self.spec_decode
         if (g > 0
                 and all(r.spec_clean for _, r in active)
                 and max_len + g + 1 <= self.spec.max_seq):
-            if self._draft_rt is not None:
-                drafts = self._draft_rt.draft_all(active, g)
-            else:
-                drafts = {i: self._draft(r, g) for i, r in active}
-            # Fall through to the chunked path when NO row has a draft —
-            # a draftless verify step would emit 1 token per dispatch and
-            # forfeit decode_chunk amortization for nothing. (A draft MODEL
-            # always drafts.)
-            if any(d is not None for d in drafts.values()):
-                self._run_verify_step(active, g, max_len, drafts)
+            # Speculative turns are host-synchronous (the draft needs the
+            # request's full accepted history): drain the ring first, then
+            # re-check — rows can finish or get cancelled inside the drain.
+            self._drain_inflight()
+            self._sweep_cancelled()
+            active = self._active_rows()
+            if not active:
                 return
-        t0 = time.perf_counter()
-        history = prefill_bucket(max_len + n_steps, self.spec.max_seq)
-        mask = np.zeros((self._rows,), np.int32)
-        for i, _ in active:
-            mask[i] = 1
-        payload1 = self._dispatch_chunk(mask, n_steps, want_lp, history)
-        # Dispatch overlap: enqueue the NEXT chunk before blocking on this
-        # one's tokens — jax dispatch is async, so the device rolls straight
-        # from chunk N into N+1 while the host reads/emits N's tokens.
-        # Without it the device idles for the whole host turnaround every
-        # chunk (device_get + detok + SSE + scheduling — comparable to the
-        # chunk itself at small-model scale). Only when nothing needs a
-        # decision between the two: no admission waiting (it would be
-        # delayed one chunk), and one more chunk can't run off max_seq.
-        # Rows that finish (EOS/budget) inside chunk N keep decoding
-        # through N+1; their extra tokens are simply discarded.
-        payload2 = None
+            max_len = max(len(r.prompt_ids) + r.emitted for _, r in active)
+            if (all(r.spec_clean for _, r in active)
+                    and max_len + g + 1 <= self.spec.max_seq):
+                if self._draft_rt is not None:
+                    drafts = self._draft_rt.draft_all(active, g)
+                else:
+                    drafts = {i: self._draft(r, g) for i, r in active}
+                # Fall through to the chunked path when NO row has a draft —
+                # a draftless verify step would emit 1 token per dispatch and
+                # forfeit decode_chunk amortization for nothing. (A draft
+                # MODEL always drafts.)
+                if any(d is not None for d in drafts.values()):
+                    self._run_verify_step(active, g, max_len, drafts)
+                    return
+        # Depth-K pipelined decode: top the ring up, then block on (only)
+        # the oldest chunk. The device rolls chunk-to-chunk while the host
+        # detokenizes, SSE-emits, and schedules the next iteration.
+        self._fill_inflight()
+        if self._inflight:
+            self._reap_oldest()
+
+    def _target_depth(self) -> int:
+        """How deep the ring may run right now. Admission pressure caps it
+        at 1 (dispatch-then-drain): when a pending request could actually
+        claim a slot, or a chunked admission is mid-prefill, every extra
+        in-flight chunk would delay the admission by a whole chunk on
+        device (its programs chain behind the ring). Pending requests with
+        NO free slot do not cap the depth — they cannot admit until a row
+        finishes anyway, and deep dispatch is exactly what finishes rows
+        sooner."""
         with self._cond:
-            idle = not self._pending and not self._admitting and not self._stop
-        history2 = prefill_bucket(max_len + 2 * n_steps, self.spec.max_seq)
-        if (idle
-                and max_len + 2 * n_steps <= self.spec.max_seq
-                # at least one row can still be decoding in chunk N+1 —
-                # otherwise the whole second chunk is guaranteed discard
-                and any(r.budget - r.emitted > n_steps for _, r in active)
-                # never compile synchronously between the pair: a first-use
-                # history bucket would stall chunk N's already-computed
-                # tokens behind a full XLA compile
-                and (n_steps, want_lp, history2) in self._decode_cache):
-            payload2 = self._dispatch_chunk(mask, n_steps, want_lp, history2)
-            self.n_overlapped += 1
-        done = self._emit_chunk(active, payload1, set())
-        if payload2 is not None:
-            done |= self._emit_chunk(active, payload2, done)
+            if self._stop or self._admitting:
+                return 1
+            if not self._pending:
+                return self.decode_pipeline
+            members = {r.member for r in self._pending}
+            for m in members:
+                lo = m * self.n_slots
+                for i in range(lo, lo + self.n_slots):
+                    if self._slots[i] is None and i not in self._claimed:
+                        return 1
+            return self.decode_pipeline
+
+    def _fill_inflight(self) -> None:
+        target = self._target_depth()
+        while len(self._inflight) < target:
+            active = [(i, r) for i, r in self._active_rows()
+                      if not r.cancel.is_set()]
+            if not active:
+                return
+            depth = len(self._inflight)
+            # Fixed chunk size per hint value: tailoring n_steps to remaining
+            # budgets would compile a program per distinct tail length; the
+            # on-device budget mask stops a finished row's sampling mid-chunk
+            # anyway, so tail steps cost forward FLOPs, never wrong tokens.
+            n_steps = max(
+                1, min(r.chunk_hint or self.decode_chunk for _, r in active))
+            want_lp = any(r.want_lp >= 0 for _, r in active)
+            # Planned lengths: host-known emitted counts plus every step
+            # already in flight — an upper bound on where rows can be when
+            # this chunk runs (rows that finish on device stop short of it).
+            ahead = sum(c.n_steps for c in self._inflight)
+            planned = max(len(r.prompt_ids) + r.emitted for _, r in active)
+            planned += ahead
+            history = prefill_bucket(
+                min(planned + n_steps, self.spec.max_seq), self.spec.max_seq)
+            if depth > 0:
+                # Dispatching AHEAD of the read is worth it only when some
+                # row can still be decoding in this chunk (the device budget
+                # would otherwise mask the whole window off), and only onto
+                # a warm program — a first-use history bucket would stall
+                # the already-computed older chunks behind a full XLA
+                # compile.
+                if not any(r.budget - r.emitted > ahead for _, r in active):
+                    return
+                if (n_steps, want_lp, history) not in self._decode_cache:
+                    return
+            mask = np.zeros((self._rows,), np.int32)
+            for i, _ in active:
+                mask[i] = 1
+            t0 = time.perf_counter()
+            payload = self._dispatch_chunk(mask, n_steps, want_lp, history)
+            self._inflight.append(
+                _InflightChunk(payload, active, n_steps, t0, history, depth))
+            if depth > 0:
+                self.n_overlapped += 1
+            obs.PIPELINE_DEPTH.set(len(self._inflight))
+
+    def _reap_oldest(self) -> None:
+        """Block on the oldest in-flight chunk and deliver its tokens.
+
+        Timing covers the reap interval (blocking fetch + delivery), NOT
+        dispatch-to-reap: an overlapped chunk's dispatch stamp predates up
+        to K−1 older chunks' device time, so measuring from it would
+        inflate DECODE_CHUNK (and overlap the per-request decode spans)
+        with pipeline depth. At K=1 the reap starts right after the async
+        dispatch, so the interval matches the old dispatch+drain turn; the
+        dispatch-to-reap latency is kept as the span's ``inflight`` attr."""
+        c = self._inflight.popleft()
+        t0 = time.perf_counter()
+        done = self._emit_chunk(c.active, c.payload)
         t1 = time.perf_counter()
-        n_chunks = 1 if payload2 is None else 2
         obs.DECODE_CHUNK.observe(t1 - t0)
-        self.n_decode_chunks += n_chunks
-        self.n_decode_rows += len(active) * n_chunks
-        for i, req in active:
-            self._turn_span(req, "decode", t0, t1, steps=n_steps * n_chunks,
-                            occupancy=len(active), history=history)
+        obs.PIPELINE_DEPTH.set(len(self._inflight))
+        self.n_decode_chunks += 1
+        self.n_decode_rows += len(c.active)
+        for i, req in c.active:
+            if self._slots[i] is req or i in done:
+                self._turn_span(req, "decode", t0, t1, steps=c.n_steps,
+                                occupancy=len(c.active), history=c.history,
+                                depth=c.depth,
+                                inflight=round(t0 - c.t0, 6))
         if done:
             with self._cond:
-                for i, req in active:
-                    if i in done:
+                for i, req in c.active:
+                    if i in done and self._slots[i] is req:
                         self._release_slot(i, req)
+
+    def _drain_inflight(self) -> None:
+        """Reap every in-flight chunk — the pipeline's drain point before
+        host-synchronous turns (speculative verify) and on shutdown."""
+        while self._inflight:
+            self._reap_oldest()
 
     def _release_slot(self, i: int, req: _Request) -> None:
         """Free a slot whose request finished/cancelled. Caller holds _cond.
@@ -1954,42 +2144,55 @@ class InferenceEngine:
 
     def _dispatch_chunk(self, mask, n_steps: int, want_lp: bool, history: int):
         """Enqueue one decode chunk (non-blocking — jax arrays are futures);
-        chains the per-slot device state so a second dispatch can follow
-        before the first is read. Returns the chunk's output arrays."""
+        chains the per-slot device state so further dispatches can follow
+        before this one is read. Returns the chunk's output arrays."""
         out = self._decode_fn(n_steps, want_lp, history)(
-            self.params, mask, self._ck, self._cv, self._token, self._lengths,
-            self._keys, self._temp, self._topp, self._topk,
+            self.params, mask, self._eos, self._ck, self._cv, self._token,
+            self._lengths, self._keys, self._temp, self._topp, self._topk,
             self._pp, self._fp, self._counts, self._bias,
+            self._live, self._budget,
         )
         if want_lp:
-            (toks, s_lp, top_ix, top_lp, self._ck, self._cv, self._token,
-             self._lengths, self._keys, self._counts) = out
-            return (toks, s_lp, top_ix, top_lp)
-        (toks, self._ck, self._cv, self._token, self._lengths,
-         self._keys, self._counts) = out
-        return (toks,)
+            (toks, n_valid, s_lp, top_ix, top_lp, self._ck, self._cv,
+             self._token, self._lengths, self._keys, self._counts,
+             self._live, self._budget) = out
+            return (toks, n_valid, s_lp, top_ix, top_lp)
+        (toks, n_valid, self._ck, self._cv, self._token, self._lengths,
+         self._keys, self._counts, self._live, self._budget) = out
+        return (toks, n_valid)
 
-    def _emit_chunk(self, active, payload, skip: set[int]) -> set[int]:
+    def _emit_chunk(self, active, payload) -> set[int]:
         """Block on one dispatched chunk's outputs and deliver its tokens.
-        Rows in ``skip`` already finished in an earlier chunk of the same
-        dispatch pair — their tokens are overrun and discarded. Returns the
-        slots that finished in THIS chunk."""
-        if len(payload) == 4:
-            toks, s_lp, top_ix, top_lp = _host_fetch(*payload)
+
+        ``n_valid[i]`` (computed ON DEVICE) bounds row i's delivery: a row
+        that finished mid-chunk in an earlier in-flight chunk produced
+        nothing here, so nothing is discarded. Tokens produced for a row
+        the host has since released (cancellation, stop strings — finishes
+        the device cannot see) count into ``overrun_tokens_total``.
+        Returns the slots that finished in THIS chunk."""
+        if len(payload) == 5:
+            toks, n_valid, s_lp, top_ix, top_lp = _host_fetch(*payload)
         else:
-            toks = _host_fetch(payload[0])
+            toks, n_valid = _host_fetch(*payload)
             s_lp = top_ix = top_lp = None
         done: set[int] = set()
         for i, req in active:
-            if i in skip:
+            k = int(n_valid[i])
+            if self._slots[i] is not req:
+                # Released (or re-admitted) while this chunk was in flight:
+                # every token the device still produced for the row is
+                # overrun.
+                self.n_overrun += k
                 continue
-            for j, t in enumerate(toks[i]):
+            before = req.emitted
+            for j in range(k):
                 if req.want_lp >= 0 and s_lp is not None:
                     req.lp.append(
                         (float(s_lp[i, j]), top_ix[i, j], top_lp[i, j]))
-                if self._emit(req, int(t)):
+                if self._emit(req, int(toks[i, j])):
                     done.add(i)
                     break
+            self.n_overrun += k - (req.emitted - before)
         return done
 
     @staticmethod
@@ -2025,10 +2228,11 @@ class InferenceEngine:
             else:
                 tokens[i, 1:] = -1  # never matches → accepts only s0
         (s0, model_toks, ok, self._ck, self._cv, self._token, self._lengths,
-         self._keys, self._counts) = self._verify_fn(g, history)(
+         self._keys, self._counts,
+         self._live, self._budget) = self._verify_fn(g, history)(
             self.params, mask, tokens, self._ck, self._cv, self._token,
             self._lengths, self._keys, self._temp, self._topp, self._topk,
-            self._counts,
+            self._counts, self._live, self._budget,
         )
         s0, model_toks, ok = _host_fetch(s0, model_toks, ok)
         t1 = time.perf_counter()
@@ -2093,6 +2297,10 @@ class InferenceEngine:
             self._claimed = set()
             self._pending = []
             self._resident = [[] for _ in range(self._rows)]
+        # In-flight chunk payloads reference (possibly poisoned) device
+        # arrays from before the failure — drop them unread.
+        self._inflight.clear()
+        obs.PIPELINE_DEPTH.set(0)
         # Wake consumers first — the state rebuild below can itself fail, and
         # doomed requests must never hang on their queues.
         self.n_failures += len(doomed)
@@ -2166,6 +2374,7 @@ def get_engine(
     mesh: Mesh | None = None,
     *,
     seed: int = 0,
+    decode_pipeline: int = DEFAULT_DECODE_PIPELINE,
     n_slots: int = DEFAULT_SLOTS,
     prefill_chunk: int = DEFAULT_PREFILL_CHUNK,
     max_pending: int = DEFAULT_MAX_PENDING,
@@ -2184,9 +2393,9 @@ def get_engine(
     ensemble, members, draft model) plus the cache representation (kv_quant) —
     dispatch knobs like decode_chunk are per-call, so two backends that differ
     only in chunking share one set of weights on device. ``n_slots``/
-    ``prefill_chunk``/``max_pending`` (structural properties of the
-    preallocated cache and the scheduler) apply at first construction; later
-    callers share the existing engine as-is. ``spec_decode`` and
+    ``prefill_chunk``/``max_pending``/``decode_pipeline`` (structural
+    properties of the preallocated cache and the scheduler) apply at first
+    construction; later callers share the existing engine as-is. ``spec_decode`` and
     ``prefix_cache`` are NOT structural: a shared engine runs with the
     maximum draft length any of its backends requested, and a
     ``prefix_cache=0`` from ANY backend disables reuse on the shared engine
@@ -2216,6 +2425,7 @@ def get_engine(
                     draft_ckpt, spec.max_seq)
             eng = InferenceEngine(
                 spec, mesh, seed=seed, n_slots=n_slots,
+                decode_pipeline=decode_pipeline,
                 prefill_chunk=prefill_chunk, max_pending=max_pending,
                 spec_decode=spec_decode, quant=quant,
                 prefix_cache=prefix_cache, ensemble=ensemble,
@@ -2236,6 +2446,7 @@ def get_engine_from_ckpt(
     mesh: Mesh | None = None,
     *,
     dtype: str | None = None,
+    decode_pipeline: int = DEFAULT_DECODE_PIPELINE,
     n_slots: int = DEFAULT_SLOTS,
     prefill_chunk: int = DEFAULT_PREFILL_CHUNK,
     max_pending: int = DEFAULT_MAX_PENDING,
@@ -2289,6 +2500,7 @@ def get_engine_from_ckpt(
                     draft_resolved, spec.max_seq, dtype=dtype)
             eng = InferenceEngine(
                 spec, mesh, params=params, n_slots=n_slots,
+                decode_pipeline=decode_pipeline,
                 prefill_chunk=prefill_chunk, max_pending=max_pending,
                 spec_decode=spec_decode, quant=quant,
                 prefix_cache=prefix_cache, ensemble=ensemble,

@@ -596,6 +596,75 @@ def decode_step(
     return _unembed(params, spec, x[:, 0, :]), cache_k, cache_v
 
 
+def decode_chunk(
+    params: Params,
+    spec: ModelSpec,
+    n_steps: int,
+    token: jnp.ndarray,    # [B] current token ids
+    lengths: jnp.ndarray,  # [B] #tokens already in cache per row
+    live: jnp.ndarray,     # [B] bool: rows decoding in this chunk
+    budget: jnp.ndarray,   # [B] int32: tokens each row may still produce
+    eos: jnp.ndarray,      # [B] int32: per-row EOS id (-1 = none)
+    cache_k: jnp.ndarray,
+    cache_v: jnp.ndarray,
+    sample_fn,
+    sample_carry,
+    history: int | None = None,
+    model_call=None,
+):
+    """``n_steps`` decode steps with **on-device finish accounting**.
+
+    The chunked-decode program of the depth-K dispatch pipeline: the host
+    keeps several of these in flight and blocks only on the oldest, so the
+    device must know — without a host round trip — when a row is done.
+    After a row samples its EOS (``eos``, −1 disables) or its remaining
+    token ``budget`` reaches zero, the row's ``live`` flag drops: it stops
+    sampling (its token freezes), stops writing cache, and stops advancing
+    ``lengths`` — overrun tokens are never produced, only the forward's
+    static batch lanes still run. Each chunk therefore returns per-row
+    ``n_valid``: how many of its ``n_steps`` tokens are real.
+
+    ``sample_fn(logits_f32 [B, V], live [B], carry) -> (next [B] int32,
+    carry, aux)`` supplies sampling — the engine threads its PRNG keys and
+    penalty counts through ``carry`` and collects per-step ``aux`` (logprob
+    records) stacked over steps. ``model_call(ck, cv, tok, pos, live)``
+    overrides the forward for member-vmapped engines; the default is
+    :func:`decode_step` on ``params``.
+
+    Returns ``(tokens [B, n_steps], valid [B, n_steps] bool, n_valid [B],
+    live, budget, cache_k, cache_v, lengths, sample_carry, aux)`` — the
+    finish state (``live``/``budget``) is device-resident engine state, so
+    a later in-flight chunk dispatched before the host has read this one
+    still skips the rows that finished here.
+    """
+    if model_call is None:
+        def model_call(ck, cv, tok, pos, wm):
+            return decode_step(params, spec, tok, pos, ck, cv,
+                               write_mask=wm, history=history)
+
+    def step(carry, _):
+        tok, lens, lv, bud, ck, cv, s_carry = carry
+        pos = jnp.where(lv, lens, 0)
+        logits, ck, cv = model_call(ck, cv, tok, pos, lv)
+        nxt, s_carry, aux = sample_fn(logits.astype(jnp.float32), lv, s_carry)
+        nxt = jnp.where(lv, nxt, tok)
+        lens = lens + lv.astype(lens.dtype)
+        bud = bud - lv.astype(bud.dtype)
+        # The row's own finish check, applied AFTER this step's token (the
+        # EOS token itself is valid and delivered): next step it is dead.
+        fin = lv & ((nxt == eos) | (bud <= 0))
+        out = (nxt, lv) + tuple(aux)
+        return (nxt, lens, lv & ~fin, bud, ck, cv, s_carry), out
+
+    (token, lengths, live, budget, cache_k, cache_v, sample_carry), ys = \
+        lax.scan(step, (token, lengths, live, budget, cache_k, cache_v,
+                        sample_carry), None, length=n_steps)
+    toks, valid = ys[0].T, ys[1].T                    # [B, n_steps]
+    n_valid = jnp.sum(valid.astype(jnp.int32), axis=1)
+    return (toks, valid, n_valid, live, budget, cache_k, cache_v, lengths,
+            sample_carry, ys[2:])
+
+
 def decode_multi(
     params: Params,
     spec: ModelSpec,

@@ -26,6 +26,27 @@ from typing import Any
 OBJECT_CHUNK = "chat.completion.chunk"
 OBJECT_COMPLETION = "chat.completion"
 
+
+class MoreChunk(dict):
+    """A stream chunk known to be IMMEDIATELY followed by another ready
+    chunk — the SSE-coalescing hint. When one decode chunk delivers k
+    tokens, the backend marks the first k−1 events with this type so the
+    server's SSE writer joins all k frames into ONE socket flush instead of
+    k separate writes (each a syscall + a client wakeup). A plain dict
+    everywhere else: serializes identically, and consumers that ignore the
+    hint (strategy fan-in, tests iterating a backend stream directly) see
+    an ordinary chunk."""
+
+
+def more(chunk: dict) -> "MoreChunk":
+    """Mark a stream chunk as having a successor already available."""
+    return MoreChunk(chunk)
+
+
+def has_more(chunk: Any) -> bool:
+    """True when the SSE writer should withhold the flush for ``chunk``."""
+    return isinstance(chunk, MoreChunk)
+
 PARALLEL_ID = "chatcmpl-parallel"
 PARALLEL_FINAL_ID = "chatcmpl-parallel-final"
 

@@ -164,12 +164,41 @@ class Histogram:
         return lines
 
 
+class Gauge:
+    """One Prometheus gauge: thread-safe ``set`` plus exposition.
+
+    Process-wide last-writer-wins semantics (the scheduler threads of
+    several engines share one family); fine for the depth-style gauges this
+    registry carries — they describe "now", not an accumulation."""
+
+    def __init__(self, name: str, help_text: str):
+        self.name = name
+        self.help = help_text
+        self._lock = threading.Lock()
+        self._value = 0.0
+
+    def set(self, value: float) -> None:
+        with self._lock:
+            self._value = float(value)
+
+    @property
+    def value(self) -> float:
+        with self._lock:
+            return self._value
+
+    def expose(self) -> list[str]:
+        return [f"# HELP {self.name} {self.help}",
+                f"# TYPE {self.name} gauge",
+                f"{self.name} {_fmt_float(self.value)}"]
+
+
 class MetricsRegistry:
-    """Ordered collection of histogram families with one-call exposition."""
+    """Ordered collection of histogram/gauge families, one-call exposition."""
 
     def __init__(self) -> None:
         self._lock = threading.Lock()
         self._hists: dict[str, Histogram] = {}
+        self._gauges: dict[str, Gauge] = {}
 
     def histogram(self, name: str, help_text: str,
                   buckets: tuple[float, ...] = DEFAULT_BUCKETS) -> Histogram:
@@ -180,12 +209,20 @@ class MetricsRegistry:
                 self._hists[name] = h
             return h
 
+    def gauge(self, name: str, help_text: str) -> Gauge:
+        with self._lock:
+            g = self._gauges.get(name)
+            if g is None:
+                g = Gauge(name, help_text)
+                self._gauges[name] = g
+            return g
+
     def expose(self) -> list[str]:
         with self._lock:
-            hists = list(self._hists.values())
+            families = list(self._hists.values()) + list(self._gauges.values())
         lines: list[str] = []
-        for h in hists:
-            lines.extend(h.expose())
+        for fam in families:
+            lines.extend(fam.expose())
         return lines
 
     def reset(self) -> None:
@@ -194,6 +231,8 @@ class MetricsRegistry:
             for h in self._hists.values():
                 with h._lock:
                     h._series.clear()
+            for g in self._gauges.values():
+                g.set(0.0)
 
 
 METRICS = MetricsRegistry()
@@ -220,9 +259,16 @@ PREFILL = METRICS.histogram(
     "admissions include interleaved decode turns).")
 DECODE_CHUNK = METRICS.histogram(
     "quorum_tpu_decode_chunk_seconds",
-    "One batched decode dispatch+drain turn of the scheduler loop.",
+    "One blocking decode-chunk reap (fetch + delivery) of the scheduler "
+    "loop; pipelined chunks' in-flight wait is excluded.",
     buckets=(0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
              1.0, 2.5, 5.0, 10.0))
+# Depth of the decode-dispatch ring right now (engine/engine.py: chunks
+# dispatched but not yet read; 0 when the pipeline is drained). Last-writer-
+# wins across engines sharing the process.
+PIPELINE_DEPTH = METRICS.gauge(
+    "quorum_tpu_decode_pipeline_inflight",
+    "Decode chunks currently in flight on the device (dispatch ring depth).")
 
 
 # ---- request-scoped tracing ------------------------------------------------
@@ -335,28 +381,36 @@ class RequestTrace:
 
     # -- wire timing ---------------------------------------------------------
 
-    def mark_flush(self, content: bool) -> None:
-        """One SSE write hit the wire; ``content`` flags a token-bearing
-        chunk (role chunks and [DONE] don't set TTFT)."""
+    def mark_flush(self, content: "bool | int") -> None:
+        """One SSE write hit the wire; ``content`` counts the token-bearing
+        frames it carried (role chunks and [DONE] don't set TTFT; a
+        coalesced write ships several content frames in one flush — bools
+        are accepted for the uncoalesced single-frame case)."""
         t = self.now()
+        count = int(content)
         with self._lock:
             if self.duration is not None:
                 return  # completed traces are immutable (see add_span)
             self.n_flushes += 1
-            if not content:
+            if count <= 0:
                 return
             if self.ttft is None:
                 self.ttft = t
                 TTFT.observe(t)
             else:
-                # Gap from the LAST flush, tracked independently of the
-                # capped token_times list — past the cap each gap must
+                # Gap from the LAST content flush, tracked independently of
+                # the capped token_times list — past the cap each gap must
                 # still measure one flush, not the distance back to entry
-                # MAX_TOKEN_TIMES.
+                # MAX_TOKEN_TIMES. One observation per FLUSH: frames inside
+                # a coalesced write arrived together, a zero gap per extra
+                # frame would fake wire latency the client never saw.
                 INTER_TOKEN.observe(t - self._last_token_t)
             self._last_token_t = t
-            self.n_tokens += 1
-            if len(self.token_times) < MAX_TOKEN_TIMES:
+            self.n_tokens += count
+            # All of a coalesced flush's tokens hit the wire at t.
+            for _ in range(count):
+                if len(self.token_times) >= MAX_TOKEN_TIMES:
+                    break
                 self.token_times.append(t)
 
     # -- lifecycle -----------------------------------------------------------

@@ -100,6 +100,31 @@ def _resolve_headers(request_headers: dict[str, str]) -> dict[str, str] | None:
     return headers
 
 
+class _SSECoalescer:
+    """The MoreChunk buffer-and-flush contract, shared by both stream
+    generators: frames of chunks marked ``oai.MoreChunk`` (the backend saw
+    further events already queued — one decode chunk's k tokens) buffer and
+    ship with the next unmarked chunk's flush — k events, ONE socket write.
+    ``add`` returns the bytes to write now (b"" while buffering); ``drain``
+    returns whatever is still buffered and must be called before emitting
+    an error frame or [DONE] so a stream never strands marked frames."""
+
+    def __init__(self) -> None:
+        self._buf: list[bytes] = []
+
+    def add(self, chunk: dict[str, Any], frame: bytes | None) -> bytes:
+        if frame is not None:
+            self._buf.append(frame)
+        if not oai.has_more(chunk) and self._buf:
+            return self.drain()
+        return b""
+
+    def drain(self) -> bytes:
+        out = b"".join(self._buf)
+        self._buf.clear()
+        return out
+
+
 async def _stream_with_role(
     first_chunk: dict[str, Any] | None,
     rest: AsyncIterator[dict[str, Any]],
@@ -107,19 +132,27 @@ async def _stream_with_role(
 ) -> AsyncIterator[bytes]:
     """Single-backend SSE normalization (oai_proxy.py:888-956 parity):
     synthetic role chunk first, duplicate upstream role-only chunk skipped,
-    trailing [DONE] guaranteed."""
+    trailing [DONE] guaranteed, MoreChunk runs coalesced per flush."""
     yield sse.encode_event(oai.chunk(id="chatcmpl-role", model=model, delta={"role": "assistant"}))
+    co = _SSECoalescer()
     try:
         if first_chunk is not None:
             delta = (first_chunk.get("choices") or [{}])[0].get("delta") or {}
             is_dup_role = bool(delta.get("role")) and not delta.get("content")
             if not is_dup_role:
-                yield sse.encode_event(first_chunk)
+                if out := co.add(first_chunk, sse.encode_event(first_chunk)):
+                    yield out
         async for chunk in rest:
-            yield sse.encode_event(chunk)
+            if out := co.add(chunk, sse.encode_event(chunk)):
+                yield out
     except BackendError as e:
-        # Mid-stream failure: surface as an SSE error chunk, then terminate.
+        # Mid-stream failure: flush anything buffered, then surface as an
+        # SSE error chunk and terminate.
+        if out := co.drain():
+            yield out
         yield sse.encode_event(oai.error_chunk(f"Backend failed: {e}", model=model))
+    if out := co.drain():
+        yield out
     yield sse.encode_done()
 
 
@@ -201,7 +234,7 @@ def create_app(
             f"quorum_tpu_uptime_seconds {time.monotonic() - started:.3f}",
         ]
         gauges = ("slots", "members", "busy_slots", "admitting", "pending",
-                  "queue_limit")
+                  "queue_limit", "decode_pipeline", "inflight_chunks")
         # One snapshot per distinct engine: backends sharing one cached
         # engine (get_engine) must not double-count its load. Each family's
         # TYPE line appears exactly once, with all its samples grouped —
@@ -605,21 +638,28 @@ def create_app(
                         "choices": [], "usage": chunk["usage"]}
             return None  # role-only chunks have no legacy-wire analog
 
+        def encode(chunk: dict[str, Any]) -> bytes | None:
+            out = convert(chunk)
+            return sse.encode_event(out) if out is not None else None
+
+        co = _SSECoalescer()
         try:
-            for c in ([first_chunk] if first_chunk is not None else []):
-                out = convert(c)
-                if out is not None:
-                    yield sse.encode_event(out)
+            if first_chunk is not None:
+                if flushed := co.add(first_chunk, encode(first_chunk)):
+                    yield flushed
             async for chunk in rest:
-                out = convert(chunk)
-                if out is not None:
-                    yield sse.encode_event(out)
+                if flushed := co.add(chunk, encode(chunk)):
+                    yield flushed
         except BackendError as e:
+            if flushed := co.drain():
+                yield flushed
             yield sse.encode_event(
                 {"id": cid, "object": "text_completion", "created": created,
                  "model": model,
                  "choices": [{"index": 0, "text": f"Backend failed: {e}",
                               "logprobs": None, "finish_reason": "error"}]})
+        if flushed := co.drain():
+            yield flushed
         yield sse.encode_done()
 
     async def _single_stream(

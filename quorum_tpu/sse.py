@@ -38,9 +38,15 @@ async def instrument_stream(iterator: AsyncIterator[bytes],
     TTFT and inter-token gaps are measured HERE — at the last point before
     the ASGI send — not in the engine, so they include detokenization,
     strategy merging, and JSON encoding: what the client actually waits
-    for. A flush counts as token-bearing when the frame carries a content
-    delta (role-only chunks and ``[DONE]`` never set TTFT); an sse-flush
-    span covering first-to-last write lands on the trace at close."""
+    for. A flush counts as token-bearing when it carries a content delta
+    (role-only chunks and ``[DONE]`` never set TTFT); an sse-flush span
+    covering first-to-last write lands on the trace at close.
+
+    One yielded byte chunk = one socket flush, but since SSE write
+    coalescing it may carry SEVERAL ``data:`` frames (one decode chunk's k
+    tokens ship in one write) — the content count per flush is taken
+    per-frame, so ``trace.n_tokens`` still counts delivered deltas while
+    ``n_flushes`` counts actual writes."""
     if trace is None:
         async for chunk in iterator:
             yield chunk
@@ -54,10 +60,12 @@ async def instrument_stream(iterator: AsyncIterator[bytes],
             # (separators=(",", ":")), so a non-empty content delta always
             # serializes with text after '"content":"' — an upstream's
             # empty-content warm-up frame must not set TTFT.
-            content = (b'"content":' in chunk
-                       and b'"content":""' not in chunk
-                       and b'"content":null' not in chunk)
-            trace.mark_flush(content)
+            n_content = sum(
+                1 for frame in chunk.split(b"\n\n")
+                if (b'"content":' in frame
+                    and b'"content":""' not in frame
+                    and b'"content":null' not in frame))
+            trace.mark_flush(n_content)
             yield chunk
     finally:
         if span is not None:

@@ -1,0 +1,116 @@
+"""Host-path microbench: what the decode-dispatch pipeline buys on CPU.
+
+Runs a tiny random-init engine (no checkpoint, no TPU) through the same
+compiled serving programs the real chip runs, once per pipeline depth, and
+reports the dispatch accounting the PR-1 counters expose:
+
+  - ``dispatches_per_request``  decode chunks the generation cost
+  - ``syncs_per_request``       dispatches the host BLOCKED on (chunk
+                                dispatched with an empty ring); the pipelined
+                                remainder overlapped the host turnaround
+  - ``overrun_tokens``          tokens produced but discarded (0 when rows
+                                finish on device — EOS/budget at any depth)
+  - ``host_turnaround_share``   fraction of the K=1 wall time the deeper
+                                pipeline hid (≈ turnaround/(turnaround +
+                                chunk time) when fully hidden — PERF.md §2)
+
+Usage:  python scripts/hostpath_bench.py [--tokens N] [--chunk C] [--depth K]
+Prints one human-readable block and one machine-parsable JSON line.
+``make hostpath-bench`` runs it; tests/test_hostpath_bench.py is the suite's
+smoke over the same entry point.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import statistics
+import sys
+import time
+
+# Runnable as `python scripts/hostpath_bench.py` from a checkout without
+# `pip install -e`: the repo root (not scripts/) must be importable.
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def run(tokens: int = 64, chunk: int = 4, depth: int = 4,
+        repeats: int = 3) -> dict:
+    """Generate ``tokens`` greedily at decode_pipeline=1 and =``depth`` on
+    fresh tiny engines; return the dispatch/sync/overrun accounting plus
+    wall times (median of ``repeats`` after a compile warm-up)."""
+    if depth < 2:
+        # depth 1 IS the K=1 baseline leg — comparing it against itself
+        # would report run-to-run noise as a pipeline win.
+        raise ValueError("depth must be >= 2 (1 is the baseline leg)")
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+
+    from quorum_tpu.engine.engine import InferenceEngine
+    from quorum_tpu.models.model_config import MODEL_PRESETS
+    from quorum_tpu.ops.sampling import SamplerConfig
+
+    spec = MODEL_PRESETS["llama-tiny"]
+    greedy = SamplerConfig(temperature=0.0)
+    prompt = [5, 6, 7]
+    out: dict = {"tokens": tokens, "decode_chunk": chunk, "depth": depth}
+    streams: dict[int, list[int]] = {}
+
+    for k in (1, depth):
+        eng = InferenceEngine(spec, decode_chunk=chunk, decode_pipeline=k)
+        eng.generate(prompt, max_new_tokens=tokens, sampler=greedy)  # warm-up
+        c0, o0, v0 = eng.n_decode_chunks, eng.n_overlapped, eng.n_overrun
+        walls = []
+        for _ in range(repeats):
+            t0 = time.perf_counter()
+            res = eng.generate(prompt, max_new_tokens=tokens, sampler=greedy)
+            walls.append(time.perf_counter() - t0)
+        streams[k] = res.token_ids
+        dispatches = (eng.n_decode_chunks - c0) / repeats
+        overlapped = (eng.n_overlapped - o0) / repeats
+        out[f"k{k}_dispatches_per_request"] = dispatches
+        out[f"k{k}_syncs_per_request"] = dispatches - overlapped
+        out[f"k{k}_overrun_tokens"] = eng.n_overrun - v0
+        out[f"k{k}_wall_s"] = round(statistics.median(walls), 4)
+        out[f"k{k}_tok_s"] = round(tokens / statistics.median(walls), 1)
+        eng.shutdown()
+
+    t1, tk = out["k1_wall_s"], out[f"k{depth}_wall_s"]
+    # The wall time the deeper ring hid is host turnaround that K=1 spent
+    # synchronized: its share of the K=1 request is the measured stand-in
+    # for turnaround/(turnaround + chunk time).
+    out["host_turnaround_share"] = round(max(0.0, t1 - tk) / t1, 3) if t1 else 0.0
+    out["tokens_match"] = streams[1] == streams[depth]
+    return out
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--tokens", type=int, default=64)
+    ap.add_argument("--chunk", type=int, default=4)
+    ap.add_argument("--depth", type=int, default=4)
+    ap.add_argument("--repeats", type=int, default=3)
+    args = ap.parse_args()
+    if args.depth < 2:
+        ap.error("--depth must be >= 2 (1 is the K=1 baseline both legs run)")
+    m = run(args.tokens, args.chunk, args.depth, args.repeats)
+    k = args.depth
+    print(f"host-path microbench (llama-tiny, {m['tokens']} tokens, "
+          f"decode_chunk={m['decode_chunk']}):")
+    print(f"  K=1 : {m['k1_dispatches_per_request']:.1f} dispatches/req, "
+          f"{m['k1_syncs_per_request']:.1f} blocking syncs/req, "
+          f"{m['k1_tok_s']} tok/s")
+    print(f"  K={k} : {m[f'k{k}_dispatches_per_request']:.1f} dispatches/req, "
+          f"{m[f'k{k}_syncs_per_request']:.1f} blocking syncs/req, "
+          f"{m[f'k{k}_tok_s']} tok/s")
+    print(f"  overrun tokens: K=1 {m['k1_overrun_tokens']}, "
+          f"K={k} {m[f'k{k}_overrun_tokens']} (on-device finish)")
+    print(f"  host-turnaround share hidden by K={k}: "
+          f"{m['host_turnaround_share']:.1%}")
+    print(f"  token-for-token identical: {m['tokens_match']}")
+    print(json.dumps(m), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,24 @@
+"""Smoke over the host-path microbench (``make hostpath-bench``).
+
+Runs the same entry point the Makefile target runs, at a budget small
+enough for the fast tier (NOT slow-marked — this is the CPU-measurable
+proof of the decode-dispatch pipeline, wired into every suite run), and
+pins the dispatch accounting the bench reports:
+
+  - strictly fewer blocking host syncs per request at K=4 than K=1 for a
+    >=8-chunk generation (the ISSUE acceptance counter check)
+  - zero overrun tokens when rows finish on device
+  - token-for-token identical output across depths
+"""
+
+from scripts.hostpath_bench import run
+
+
+def test_hostpath_bench_counters():
+    m = run(tokens=32, chunk=4, depth=4, repeats=1)
+    assert m["k1_dispatches_per_request"] >= 8
+    assert m["k4_syncs_per_request"] < m["k1_syncs_per_request"]
+    assert m["k1_overrun_tokens"] == 0
+    assert m["k4_overrun_tokens"] == 0
+    assert m["tokens_match"] is True
+    assert 0.0 <= m["host_turnaround_share"] < 1.0

@@ -1,0 +1,113 @@
+"""SSE write coalescing: k ready events ship as k frames in ONE socket
+flush, and the per-flush trace marks stay correct (ISSUE satellite).
+
+The contract has three parts, each pinned here at its own layer:
+
+  1. The tpu backend's stream marks every event it KNOWS is followed by an
+     already-queued successor as ``oai.MoreChunk``.
+  2. The server's byte generators buffer marked frames and yield the join —
+     one yielded bytes chunk = one ASGI send = one socket flush.
+  3. ``sse.instrument_stream`` counts content frames per flush, so
+     ``trace.n_tokens`` still counts delivered deltas while ``n_flushes``
+     counts actual writes.
+"""
+
+import asyncio
+
+from quorum_tpu import oai, sse
+from quorum_tpu.observability import RequestTrace
+
+
+def _chunk(text, more=False, **kw):
+    c = oai.chunk(id="chatcmpl-x", model="m", delta={"content": text}, **kw)
+    return oai.more(c) if more else c
+
+
+def _collect(agen):
+    async def go():
+        return [b async for b in agen]
+
+    return asyncio.run(go())
+
+
+def test_marked_chunks_join_into_one_flush():
+    from quorum_tpu.server.app import _stream_with_role
+
+    async def rest():
+        # one decode chunk delivered 3 tokens: first two marked
+        yield _chunk("a", more=True)
+        yield _chunk("b", more=True)
+        yield _chunk("c")
+        yield _chunk("d")  # next chunk's lone token: its own flush
+
+    flushes = _collect(_stream_with_role(None, rest(), "m"))
+    # role flush + coalesced(a,b,c) + d + [DONE]
+    assert len(flushes) == 4
+    joined = flushes[1]
+    assert joined.count(b"data: ") == 3
+    assert b'"content":"a"' in joined and b'"content":"c"' in joined
+    assert flushes[2].count(b"data: ") == 1
+    assert flushes[-1] == sse.encode_done()
+    # every flush is still a valid SSE byte run (parser sees 6 events)
+    events = list(sse.iter_data_events(b"".join(flushes)))
+    assert len(events) == 6
+
+
+def test_stream_never_strands_marked_frames():
+    """A stream ending on a marked chunk (producer raced the close) must
+    still flush it before [DONE]."""
+    from quorum_tpu.server.app import _stream_with_role
+
+    async def rest():
+        yield _chunk("tail", more=True)
+
+    flushes = _collect(_stream_with_role(None, rest(), "m"))
+    assert any(b'"content":"tail"' in f for f in flushes)
+    assert flushes[-1] == sse.encode_done()
+
+
+def test_instrument_stream_counts_frames_per_flush():
+    trace = RequestTrace("req-1")
+
+    async def wire():
+        yield sse.encode_event(oai.chunk(
+            id="x", model="m", delta={"role": "assistant"}))  # no content
+        yield (sse.encode_event(_chunk("a")) + sse.encode_event(_chunk("b"))
+               + sse.encode_event(_chunk("c")))               # one flush, 3 tokens
+        yield sse.encode_event(_chunk("d"))
+        yield sse.encode_done()
+
+    _collect(sse.instrument_stream(wire(), trace))
+    assert trace.n_flushes == 4
+    assert trace.n_tokens == 4          # 3 coalesced + 1 single
+    assert trace.ttft is not None
+    assert len(trace.token_times) == 4
+    # the 3 coalesced tokens hit the wire together
+    assert trace.token_times[0] == trace.token_times[1] == trace.token_times[2]
+
+
+def test_backend_stream_marks_ready_batches():
+    """Driving the real TpuBackend.stream over a scripted engine: events
+    drained from the queue in one batch carry the MoreChunk marker on all
+    but the last."""
+    from quorum_tpu.backends.tpu_backend import TpuBackend
+    from quorum_tpu.config import BackendSpec
+    from tests.test_openai_knobs import _MultiScriptEngine
+
+    b = TpuBackend.from_spec(BackendSpec(
+        name="co", url="tpu://llama-tiny?seed=2", model="m"))
+    b.engine = _MultiScriptEngine([[65, 66, 67, 68]])
+
+    async def go():
+        marked, total = 0, 0
+        async for ch in b.stream(
+            {"model": "m", "messages": [{"role": "user", "content": "q"}],
+             "max_tokens": 4, "stream": True}, {}, 60):
+            total += 1
+            marked += 1 if oai.has_more(ch) else 0
+        return marked, total
+
+    marked, total = asyncio.run(go())
+    assert total >= 2
+    # the final chunk of the stream is never marked (nothing follows it)
+    assert marked < total
