@@ -2,7 +2,7 @@
 # clean — /root/reference/Makefile:1-25), adapted to this environment: no uv,
 # no uvicorn — the bundled h11 ASGI server serves the app.
 
-.PHONY: install run dev test test-all coverage bench hostpath-bench dryrun metrics-check clean
+.PHONY: install run dev test test-all coverage bench hostpath-bench prefix-bench dryrun metrics-check clean
 
 install:
 	pip install -e .
@@ -47,6 +47,14 @@ bench:
 # tests/test_hostpath_bench.py runs the same entry point as a fast smoke.
 hostpath-bench:
 	JAX_PLATFORMS=cpu python scripts/hostpath_bench.py
+
+# Tiny-model CPU microbench of the tiered KV prefix store under slot
+# churn (more conversations than slots, multi-turn): prints the prefill
+# tokens the host store saves, restore latency, and pins output equality
+# store-on vs store-off (docs/prefix_cache.md). tests/test_prefix_bench.py
+# runs the same entry point as a fast smoke.
+prefix-bench:
+	JAX_PLATFORMS=cpu python scripts/prefix_bench.py
 
 # Promtool-style exposition lint (pure Python, no extra deps): spins the
 # app over a tiny tpu:// backend, pulls the FULL /metrics output, and

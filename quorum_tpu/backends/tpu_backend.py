@@ -88,6 +88,24 @@ URL grammar:  ``tpu://<model-id>?<spec overrides>&<engine options>``
                    whose prompt prefix is already resident in a free slot's
                    KV cache admits into that slot and prefills only the
                    suffix — multi-turn histories re-prefill nothing
+  prefix_store=host    tiered KV prefix store (default off): released
+                   slots' KV prefixes are snapshotted device→host into a
+                   chunk-granular trie (byte-budget LRU), and an admission
+                   whose store match beats the slot-resident LCP restores
+                   the prefix host→device and prefills only the tail — a
+                   conversation's history survives its slot being
+                   reclaimed under churn (docs/prefix_cache.md). Holds the
+                   cache's NATIVE representation, so kv_quant=int8 halves
+                   host bytes too. Structural (applies when this backend
+                   constructs the engine); rejected with members=/
+                   ensemble=/sp>1 and with prefill_chunk too small to
+                   chunk (the restore rides chunked prefill)
+  prefix_store_bytes=  host byte budget for the store (default 1g);
+                   accepts a plain byte count or a k/m/g binary suffix
+                   (e.g. 512m). Least-recently-used chunks evict past it
+  prefix_store_chunk=  store retention granularity in tokens (default:
+                   the engine's prefill_chunk). Only whole chunks are
+                   stored/matched/evicted
   max_tokens=      default completion budget when the request has none
 
 Contract parity with the dispatcher: configured model overrides the request
@@ -127,6 +145,26 @@ from quorum_tpu.ops.sampling import SamplerConfig
 from quorum_tpu.parallel.mesh import MeshConfig, make_mesh, single_device_mesh
 
 logger = logging.getLogger(__name__)
+
+
+def _parse_bytes_opt(name: str, raw: str) -> int:
+    """Byte-count URL option: a plain integer or a k/m/g binary suffix
+    (``prefix_store_bytes=512m``). Strict — a typo must fail at config
+    time, not silently size a cache to zero."""
+    val = str(raw).strip().lower()
+    mult = 1
+    if val and val[-1] in "kmg":
+        mult = {"k": 1 << 10, "m": 1 << 20, "g": 1 << 30}[val[-1]]
+        val = val[:-1]
+    try:
+        out = int(val) * mult
+    except ValueError:
+        raise ValueError(
+            f"invalid {name}={raw!r} (an integer byte count, optionally "
+            "with a k/m/g suffix)") from None
+    if out < 1:
+        raise ValueError(f"invalid {name}={raw!r} (must be positive)")
+    return out
 
 
 def _parse_bool_opt(name: str, raw: str) -> bool:
@@ -327,6 +365,34 @@ class TpuBackend:
             ensemble=int(opts.get("ensemble", 1)),
             sp_impl=opts.get("sp_impl", "ring"),
         )
+        store = str(opts.get("prefix_store", "")).strip().lower()
+        if store in ("", "0", "none", "off"):
+            store = ""
+        elif store != "host":
+            raise ValueError(
+                f"invalid prefix_store={opts.get('prefix_store')!r} "
+                "(host, or none/0/off to disable)")
+        if store:
+            if members > 1:
+                # Checked at config time (the engine re-checks): a stacked
+                # fan-out URL must fail fast with the reason, not after a
+                # members engine without the store was silently shared.
+                raise ValueError(
+                    "prefix_store=host does not compose with members=N "
+                    "(the stacked cache carries a member axis the "
+                    "snapshot/restore programs do not address) — run "
+                    "separate engines or drop prefix_store")
+            eng_kw["prefix_store"] = store
+            if "prefix_store_bytes" in opts:
+                eng_kw["prefix_store_bytes"] = _parse_bytes_opt(
+                    "prefix_store_bytes", opts["prefix_store_bytes"])
+            eng_kw["prefix_store_chunk"] = int(
+                opts.get("prefix_store_chunk", 0))
+        elif "prefix_store_bytes" in opts or "prefix_store_chunk" in opts:
+            raise ValueError(
+                "prefix_store_bytes=/prefix_store_chunk= have no effect "
+                "without prefix_store=host — a silently ignored sizing "
+                "knob hides a misconfiguration")
         spec_model = opts.get("spec_model", "")
         spec_ckpt = opts.get("spec_ckpt", "")
         if spec_model and ckpt:

@@ -1,0 +1,15 @@
+"""Tiered KV caching: host-memory retention of decoded prefixes.
+
+The engine's slot-resident prefix cache (engine/engine.py) is tier 0 — free
+to hit, but its capacity is the 8–32 KV slots and a hit requires the
+conversation's slot to still be free *and* un-overwritten. This package is
+tier 1: :class:`~quorum_tpu.cache.prefix_store.PrefixStore` keeps
+chunk-granular KV prefixes in host RAM (byte-budget LRU), so a multi-turn
+conversation whose slot was reclaimed under load restores its history
+host→device and prefills only the tail. See docs/prefix_cache.md.
+"""
+
+from quorum_tpu.cache.prefix_store import (  # noqa: F401
+    DEFAULT_PREFIX_STORE_BYTES,
+    PrefixStore,
+)

@@ -15,7 +15,9 @@ quorum_tpu extends ``primary_backends[].url`` with a ``tpu://`` scheme:
 
 Query parameters configure the model (see :mod:`quorum_tpu.models.registry`)
 and the serving engine (``decode_chunk=``, ``decode_pipeline=``, ``slots=``,
-``quant=``, … — the full grammar is the docstring of
+``quant=``, ``prefix_store=host``/``prefix_store_bytes=``/
+``prefix_store_chunk=`` for the tiered host KV prefix store, … — the full
+grammar is the docstring of
 :mod:`quorum_tpu.backends.tpu_backend`); anything absent falls back to the
 named preset for ``<model-id>`` and the engine defaults.
 

@@ -42,6 +42,13 @@ concurrent requests):
     advances ALL members in a single member-vmapped program — N models'
     streams for one host turnaround per dispatch. Distinct from
     ``ensemble=M`` (one consensus stream from averaged logits).
+  - **Tiered prefix caching**: each slot's resident token prefix is reusable
+    zero-copy (tier 0); with ``prefix_store=host`` the engine additionally
+    snapshots released slots' KV prefixes to a chunk-granular host-RAM
+    store (quorum_tpu/cache/prefix_store.py, byte-budget LRU) and restores
+    the longest match host→device at admission when it beats the
+    slot-resident LCP — multi-turn conversations survive slot eviction
+    under churn (docs/prefix_cache.md).
   - **Quantized representations**: ``quant=int8`` stores weights int8 with
     per-channel scales (native int8 MXU matmuls); ``kv_quant=int8`` stores
     the KV cache as (int8, per-token scale) pairs with native int8 decode
@@ -54,6 +61,7 @@ The reference has no analog — its "backends" are HTTP calls
 
 from __future__ import annotations
 
+import logging
 import queue
 import threading
 import time
@@ -69,6 +77,10 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from quorum_tpu import observability as obs
+from quorum_tpu.cache.prefix_store import (
+    DEFAULT_PREFIX_STORE_BYTES,
+    PrefixStore,
+)
 from quorum_tpu.compile_cache import enable_persistent_compile_cache
 from quorum_tpu.models.init import init_params, init_params_sharded
 from quorum_tpu.models.model_config import ModelSpec
@@ -85,6 +97,8 @@ from quorum_tpu.parallel.mesh import single_device_mesh
 from quorum_tpu.parallel.sharding import kv_cache_sharding, shard_pytree
 
 enable_persistent_compile_cache()  # restart compiles become disk reads
+
+logger = logging.getLogger(__name__)
 
 MIN_BUCKET = 16
 DEFAULT_SLOTS = 4
@@ -107,6 +121,11 @@ TOP_LOGPROBS = 20  # top alternatives computed per step (OpenAI's API maximum)
 # is at least this long — shorter matches aren't worth routing through the
 # segment path (whose first token costs one extra decode-chunk boundary).
 MIN_PREFIX_REUSE = 16
+# Max dispatched-but-unfetched prefix-store snapshots: each pins a device-
+# resident KV slice until the worker fetches it, so the bound is what keeps
+# snapshot device memory finite under churn faster than one worker drains
+# (past it, releases simply go unsnapshotted — a future store miss).
+SNAP_QUEUE_MAX = 8
 _CKPT_ENSEMBLE_ERROR = ("ensemble members are seeded random inits; a "
                         "checkpoint provides only one weight set")
 _CKPT_MEMBERS_ERROR = ("stacked members are seeded random inits; a "
@@ -276,15 +295,20 @@ class _Admission:
 
     ``offset`` starts at the reused-prefix length when prefix caching found
     a match (the slot's cache rows [0, offset) already hold this prompt's
-    K/V from a previous request) — only the suffix is prefilled."""
+    K/V from a previous request) — only the suffix is prefilled.
+    ``restored`` is the portion of that reuse that came from the HOST
+    prefix store (0 = pure slot-resident reuse); kept separate so the
+    admission span can attribute cache effectiveness per tier."""
 
-    __slots__ = ("req", "slot", "offset", "offset0", "t_start")
+    __slots__ = ("req", "slot", "offset", "offset0", "restored", "t_start")
 
-    def __init__(self, req: _Request, slot: int, offset: int = 0):
+    def __init__(self, req: _Request, slot: int, offset: int = 0,
+                 restored: int = 0):
         self.req = req
         self.slot = slot
         self.offset = offset
         self.offset0 = offset            # reused-prefix length (tracing)
+        self.restored = restored         # of which: host-store restore
         self.t_start = time.perf_counter()
 
 
@@ -468,6 +492,9 @@ class InferenceEngine:
         spec_decode: int = 0,
         quant: str | None = None,
         prefix_cache: bool = True,
+        prefix_store: str | None = None,
+        prefix_store_bytes: int = DEFAULT_PREFIX_STORE_BYTES,
+        prefix_store_chunk: int = 0,
         ensemble: int = 1,
         members: int = 1,
         kv_quant: str | None = None,
@@ -601,6 +628,68 @@ class InferenceEngine:
         # conversations re-send their whole history; the repeated prefix
         # costs nothing on device.
         self.prefix_cache = bool(prefix_cache) and self.prefill_chunk > 0
+        # Tiered KV prefix store (quorum_tpu/cache/prefix_store.py,
+        # docs/prefix_cache.md): a host-RAM cache tier behind the
+        # slot-resident prefix cache. On slot release the valid KV prefix is
+        # snapshotted device→host in chunk-aligned pieces (async, off the
+        # scheduler's hot turn); on admission, a store match longer than the
+        # slot-resident LCP is restored host→device and the admission rides
+        # the chunked-prefill machinery with a nonzero offset.
+        mode = (prefix_store or "").strip().lower() or None
+        if mode not in (None, "host"):
+            raise ValueError(
+                f"unsupported prefix_store mode {prefix_store!r} "
+                "(host or none)")
+        if mode:
+            if self.members > 1:
+                raise ValueError(
+                    "prefix_store does not compose with members>1: the "
+                    "stacked cache carries a member axis the single-slot "
+                    "snapshot/restore programs do not address — run "
+                    "separate engines or drop prefix_store")
+            if self.ensemble > 1:
+                raise ValueError(
+                    "prefix_store does not compose with ensemble>1 (the "
+                    "member-stacked cache is not snapshot/restored)")
+            if self._use_sp:
+                raise ValueError(
+                    "prefix_store does not compose with sp>1: sequence-"
+                    "parallel serving disables chunked prefill, which the "
+                    "restore path's nonzero-offset tail prefill rides")
+            if self.prefill_chunk <= 0:
+                raise ValueError(
+                    "prefix_store requires chunked prefill (prefill_chunk "
+                    ">= 16 after power-of-two alignment): restoring a "
+                    "prefix prefills only the tail, through the segment "
+                    "machinery")
+            chunk = int(prefix_store_chunk) or self.prefill_chunk
+            if chunk > self.spec.max_seq:
+                raise ValueError(
+                    f"prefix_store_chunk={chunk} exceeds max_seq="
+                    f"{self.spec.max_seq}: no prefix could ever be stored")
+            self.prefix_store: PrefixStore | None = PrefixStore(
+                chunk, int(prefix_store_bytes))
+            # Device→host fetches run on this worker so the scheduler's hot
+            # turn only *dispatches* the snapshot slices (jax futures).
+            self._snap_queue: queue.Queue = queue.Queue()
+            self._snap_thread = threading.Thread(
+                target=self._snapshot_worker,
+                name=f"prefix-store-{id(self):x}", daemon=True)
+            self._snap_thread.start()
+        else:
+            self.prefix_store = None
+        # Slot releases whose snapshot dispatch is deferred to the next
+        # scheduler turn (the release sites hold _cond; a first-use XLA
+        # compile of the snapshot program must not run under the lock).
+        # _snap_backlog counts queued-but-not-yet-handed-to-the-worker
+        # snapshots — it bridges the window between popping the list and
+        # enqueueing the fetch, so drain_prefix_store can't slip through.
+        self._pending_snaps: list[tuple[int, list[int]]] = []
+        self._snap_backlog = 0
+        self.prefix_store_hits = 0
+        self.prefix_store_tokens_restored = 0
+        self.prefix_store_snapshots_dropped = 0
+        self.prefix_store_restore_s = 0.0
         # Host-side slot space is FLAT across members: row m·n_slots + s is
         # member m's slot s. With members == 1 this is exactly the slot axis.
         self._rows = self.members * self.n_slots
@@ -1012,6 +1101,227 @@ class InferenceEngine:
         self._admit_cache["register"] = fn
         return fn
 
+    def _snapshot_fn(self, n: int):
+        """Jitted: slice ``n`` cache positions of one slot starting at a
+        dynamic offset — the device→host snapshot's device half. Non-
+        donating (it READS the live cache); one program per chunk-aligned
+        length, generic over the cache pytree (bf16 arrays or int8
+        (values, scales) pairs — the host store receives the native
+        representation either way)."""
+        fn = self._admit_cache.get(("snap", n))
+        if fn is None:
+            def snap(ck, cv, slot, offset):
+                def take(a):
+                    # values [L, S, K, T, hd] / scales [L, S, K, T]
+                    starts = (0, slot, 0, offset) + (0,) * (a.ndim - 4)
+                    sizes = ((a.shape[0], 1, a.shape[2], n)
+                             + tuple(a.shape[4:]))
+                    return lax.dynamic_slice(a, starts, sizes)[:, 0]
+
+                return jax.tree.map(take, (ck, cv))
+
+            fn = jax.jit(snap)
+            self._admit_cache[("snap", n)] = fn
+        return fn
+
+    def _restore_fn(self, n: int):
+        """Jitted: write an ``n``-token host KV slice into positions
+        [start, start+n) of one slot (host→device restore) — ``start`` is
+        traced, so skipping a slot-resident overlap costs no extra
+        compile. Donates the cache like every other cache-writing program;
+        ``n`` is always a prefill_chunk multiple, so the program count is
+        bounded by max_seq/prefill_chunk."""
+        fn = self._admit_cache.get(("restore", n))
+        if fn is None:
+            def restore(ck, cv, slot, start, host):
+                def put(a, h):
+                    # values [L, S, K, T, hd] / scales [L, S, K, T] — the
+                    # position axis is 3, same layout as ``_snapshot_fn``.
+                    starts = (0, slot, 0, start) + (0,) * (a.ndim - 4)
+                    return lax.dynamic_update_slice(a, h[:, None], starts)
+
+                return jax.tree.map(put, (ck, cv), host)
+
+            fn = jax.jit(restore, donate_argnames=("ck", "cv"))
+            self._admit_cache[("restore", n)] = fn
+        return fn
+
+    # ---- host prefix store (tier behind the slot-resident cache) ----------
+
+    def _queue_snapshot(self, slot: int) -> None:
+        """Note a released slot whose KV prefix should be snapshotted to the
+        host store. Caller holds ``_cond``; the device dispatch is deferred
+        to the next scheduler turn (``_dispatch_snapshots``) so a first-use
+        XLA compile never runs under the lock — safe because only the
+        scheduler thread mutates the cache, and the next admission into the
+        slot happens after the deferred dispatch."""
+        if self.prefix_store is None:
+            return
+        tokens = self._resident[slot]
+        c = self.prefix_store.chunk_tokens
+        n = len(tokens) - len(tokens) % c
+        if n >= max(c, MIN_PREFIX_REUSE):
+            self._pending_snaps.append((slot, tokens[:n]))
+            self._snap_backlog += 1
+
+    def _dispatch_snapshots(self) -> None:
+        """Dispatch deferred snapshot slices (scheduler thread, lock NOT
+        held) and hand the resulting jax futures to the store worker, which
+        blocks on the device→host fetch off the hot turn. Only the chunks
+        the store does not already cover are sliced — a conversation's
+        turn-N release re-snapshots just the tokens turn N added."""
+        with self._cond:
+            pending, self._pending_snaps = self._pending_snaps, []
+        for slot, tokens in pending:
+            try:
+                with self._cond:
+                    # The slot may have been re-admitted this same turn; its
+                    # rows [0, len(tokens)) are still the snapshot's prefix
+                    # ONLY while the resident view still starts with it.
+                    stale = self._resident[slot][: len(tokens)] != tokens
+                if stale:
+                    continue
+                # Each queued item pins a device-resident slice until the
+                # worker fetches it: under churn faster than one worker
+                # drains, an unbounded queue would grow device memory
+                # without limit. Past the cap the snapshot is dropped —
+                # an unsnapshotted release is simply a future store miss.
+                if self._snap_queue.qsize() >= SNAP_QUEUE_MAX:
+                    self.prefix_store_snapshots_dropped += 1
+                    continue
+                have = self.prefix_store.covered(tokens)
+                if have >= len(tokens):
+                    continue
+                payload = self._snapshot_fn(len(tokens) - have)(
+                    self._ck, self._cv, np.int32(slot), np.int32(have))
+                self._snap_queue.put((tokens, have, payload))
+            except Exception:
+                # Snapshots are opportunistic: a failed slice (first-use
+                # compile error, poisoned cache after an engine fault)
+                # loses ONE snapshot, never the scheduler turn — and the
+                # finally below keeps the backlog honest either way, so
+                # drain_prefix_store cannot hang on a leaked count.
+                logger.exception("prefix-store snapshot dispatch failed")
+            finally:
+                with self._cond:
+                    self._snap_backlog -= 1
+
+    def _snapshot_worker(self) -> None:
+        """Store-insert worker: fetch dispatched snapshot slices to host
+        (the blocking half) and insert them chunk-split into the trie."""
+        while True:
+            item = self._snap_queue.get()
+            try:
+                if item is None:
+                    return
+                tokens, have, payload = item
+                leaves = [np.asarray(x)
+                          for x in jax.device_get(jax.tree.leaves(payload))]
+                c = self.prefix_store.chunk_tokens
+                n_chunks = (len(tokens) - have) // c
+                # Contiguous copies per chunk: a view would pin the whole
+                # fetched slice alive after its siblings are LRU-evicted,
+                # drifting the store's byte accounting from real memory.
+                chunk_payloads = [
+                    [np.ascontiguousarray(leaf[:, :, i * c:(i + 1) * c])
+                     for leaf in leaves]
+                    for i in range(n_chunks)
+                ]
+                self.prefix_store.insert(tokens, have, chunk_payloads)
+            except Exception:
+                # A poisoned array (engine failure mid-flight) loses this
+                # snapshot, never the worker: the store must keep serving.
+                logger.exception("prefix-store snapshot insert failed")
+            finally:
+                self._snap_queue.task_done()
+
+    def drain_prefix_store(self) -> None:
+        """Block until every queued snapshot has landed in the host store —
+        a test/bench affordance; serving never needs to wait (a snapshot
+        still in flight is simply a store miss). Waits out three stages in
+        order: engine quiescence first — a caller that just consumed its
+        ``end`` sentinel can get here BEFORE the scheduler's
+        ``_release_slot`` queues the snapshot (the sentinel is emitted
+        inside the reap, the release happens after), and a finished request
+        still occupies its slot until then — then the deferred dispatch
+        list (drained by the scheduler's next turn), then the worker's
+        fetch/insert queue."""
+        if self.prefix_store is None:
+            return
+        while True:
+            with self._cond:
+                busy = (bool(self._pending) or bool(self._admitting)
+                        or any(self._slots) or bool(self._inflight)
+                        or self._snap_backlog)
+            if busy:
+                time.sleep(0.002)
+                continue
+            self._snap_queue.join()
+            with self._cond:
+                if not self._snap_backlog:
+                    return
+
+    def _store_lookup(
+        self, prompt: list[int], slot_reuse: int
+    ) -> tuple[int, object] | None:
+        """``(restore_len, host_kv_pytree)`` when the store's longest match
+        beats the slot-resident reuse, else None. The restore length obeys
+        the same invariants as ``_reuse_len``: capped at len(prompt)−1
+        (the final token must prefill so its logits exist to sample from),
+        aligned DOWN to a prefill_chunk multiple (segment offsets must stay
+        aligned), floored at MIN_PREFIX_REUSE."""
+        if self.prefix_store is None:
+            return None
+        cap = len(prompt) - 1
+        matched, payloads = self.prefix_store.longest_match(prompt[:cap])
+        r = min(matched, cap)
+        if self.prefill_chunk:
+            r -= r % self.prefill_chunk
+        if r < MIN_PREFIX_REUSE or r <= slot_reuse:
+            return None
+        # Only the tail past the slot-resident reuse crosses host→device:
+        # rows [0, slot_reuse) already hold identical KV in the claimed
+        # slot (both lengths are prefill_chunk-aligned), so transferring
+        # them again would just stretch the blocking restore. Concatenate
+        # only the chunks that intersect [slot_reuse, r) — this runs on the
+        # scheduler thread, and copying overlap/tail chunk bytes just to
+        # slice them away would stall every active decode stream.
+        c = self.prefix_store.chunk_tokens
+        lo = slot_reuse // c
+        hi = -(-r // c)
+        n_leaves = len(payloads[0])
+        cat = [
+            np.concatenate([chunk[j] for chunk in payloads[lo:hi]],
+                           axis=2)[:, :, slot_reuse - lo * c: r - lo * c]
+            for j in range(n_leaves)
+        ]
+        host = jax.tree.unflatten(
+            jax.tree.structure((self._ck, self._cv)), cat)
+        return r, host
+
+    def _restore_into(self, slot: int, start: int, n: int, host,
+                      req: _Request) -> None:
+        """Write ``n`` matched host prefix tokens into the claimed slot's
+        cache rows [start, start+n) (scheduler thread) — ``start`` is the
+        slot-resident reuse the transfer skips. Blocks until the transfer
+        lands — the honest restore latency, observed on the restore
+        histogram and recorded as a ``prefix-restore`` span on the
+        request's trace."""
+        t0 = time.perf_counter()
+        self._ck, self._cv = self._restore_fn(n)(
+            self._ck, self._cv, np.int32(slot), np.int32(start), host)
+        jax.block_until_ready((self._ck, self._cv))
+        t1 = time.perf_counter()
+        obs.PREFIX_STORE_RESTORE.observe(t1 - t0)
+        obs.PREFIX_STORE_HITS.inc()
+        obs.PREFIX_STORE_RESTORED_TOKENS.inc(n)
+        self.prefix_store_hits += 1
+        self.prefix_store_tokens_restored += n
+        self.prefix_store_restore_s += t1 - t0
+        if req.trace is not None:
+            req.trace.add_span_abs("prefix-restore", t0, t1,
+                                   tokens=n, slot=slot)
+
     def _decode_fn(self, n_steps: int, want_lp: bool, history: int):
         """Jitted: ``n_steps`` batched decode+sample steps over all slots.
 
@@ -1411,6 +1721,20 @@ class InferenceEngine:
                 "decode_busy_rows_total": self.n_decode_rows,
                 "prefix_hits_total": self.prefix_hits,
                 "prefix_tokens_saved_total": self.prefix_tokens_saved,
+                "prefix_store_hits_total": self.prefix_store_hits,
+                "prefix_store_restored_tokens_total":
+                    self.prefix_store_tokens_restored,
+                "prefix_store_snapshots_dropped_total":
+                    self.prefix_store_snapshots_dropped,
+                "prefix_store_evictions_total": (
+                    self.prefix_store.n_evictions
+                    if self.prefix_store is not None else 0),
+                "prefix_store_bytes": (
+                    self.prefix_store.bytes_held
+                    if self.prefix_store is not None else 0),
+                "prefix_store_entries": (
+                    self.prefix_store.n_entries
+                    if self.prefix_store is not None else 0),
                 "overlapped_chunks_total": self.n_overlapped,
                 "overrun_tokens_total": self.n_overrun,
                 "decode_pipeline": self.decode_pipeline,
@@ -1439,6 +1763,12 @@ class InferenceEngine:
                 r.cancel.set()
             self._cond.notify_all()
         self._thread.join(timeout=timeout)
+        if self.prefix_store is not None:
+            # Stop the snapshot worker (sentinel after any queued fetches)
+            # and release the host copies with the device state below.
+            self._snap_queue.put(None)
+            self._snap_thread.join(timeout=timeout)
+            self.prefix_store.clear()
         if self._thread.is_alive():
             # A dispatch (e.g. a long XLA compile) is still in flight: do
             # NOT null the state under it — the thread exits at its next
@@ -1455,12 +1785,16 @@ class InferenceEngine:
         while True:
             with self._cond:
                 while not (self._stop or self._pending or self._admitting
-                           or any(self._slots) or self._inflight):
+                           or any(self._slots) or self._inflight
+                           or self._pending_snaps):
                     self._cond.wait()
                 if self._stop and not (
                     self._pending or self._admitting or any(self._slots)
-                    or self._inflight
+                    or self._inflight or self._pending_snaps
                 ):
+                    # _pending_snaps blocks the exit: leaving deferred
+                    # snapshots undispatched would strand _snap_backlog > 0
+                    # and hang any concurrent drain_prefix_store() forever.
                     return
             try:
                 self._start_admissions()
@@ -1552,7 +1886,12 @@ class InferenceEngine:
         prompts become chunked :class:`_Admission`s advanced one segment per
         scheduler iteration so active decodes interleave. A prompt whose
         prefix is already resident in a free slot (prefix caching) admits
-        into THAT slot and prefills only the suffix — zero K/V copies."""
+        into THAT slot and prefills only the suffix — zero K/V copies. When
+        the HOST prefix store holds a longer match than any slot (the slot
+        that held this conversation was reclaimed under churn), the match
+        is restored host→device into the claimed slot first and the
+        admission starts past it."""
+        self._dispatch_snapshots()
         if self.members > 1:
             self._start_admissions_members()
             return
@@ -1578,7 +1917,25 @@ class InferenceEngine:
             # max_seq, where the clamped start silently corrupts valid
             # cache rows (see __init__'s chunk-alignment invariant).
             reuse = self._reuse_len(lcp, len(req.prompt_ids))
-            if reuse or (
+            restore = self._store_lookup(req.prompt_ids, reuse)
+            if restore is not None:
+                n_restore, host = restore
+                if reuse:
+                    # The slot-resident overlap [0, reuse) is a tier-0 hit
+                    # even on the store path — only the tail past it is
+                    # transferred and counted as restored.
+                    self.prefix_hits += 1
+                    self.prefix_tokens_saved += reuse
+                with self._cond:
+                    self._claimed.add(slot)
+                    # Rows [0, n_restore) hold the restored prefix once the
+                    # dispatch below lands; beyond it the slot is in flux.
+                    self._resident[slot] = req.prompt_ids[:n_restore]
+                    self._admitting.append(_Admission(
+                        req, slot, offset=n_restore,
+                        restored=n_restore - reuse))
+                self._restore_into(slot, reuse, n_restore - reuse, host, req)
+            elif reuse or (
                 self.prefill_chunk and len(req.prompt_ids) > self.prefill_chunk
             ):
                 if reuse:
@@ -1758,9 +2115,14 @@ class InferenceEngine:
         obs.PREFILL.observe(t1 - t0)
         for m, req in live.items():
             if req.trace is not None:
+                # reused/restored are structurally 0 here like the
+                # single-engine single-shot path (member reuse routes
+                # through a chunked admission); recorded so every
+                # admission span carries the cache-effectiveness attrs.
                 req.trace.add_span_abs(
                     "prefill", t0, t1, tokens=len(req.prompt_ids),
-                    bucket=bucket, slot=row, coalesced=len(live))
+                    bucket=bucket, slot=row, coalesced=len(live),
+                    reused=0, restored=0)
         for m, req in live.items():
             flat = m * n_s + row
             self._resident[flat] = list(req.prompt_ids)
@@ -1884,9 +2246,14 @@ class InferenceEngine:
         # the latency the admitted request experienced.
         obs.PREFILL.observe(t1 - adm.t_start)
         if req.trace is not None:
+            # Per-request cache effectiveness on the admission span:
+            # ``reused`` is the total prefix the admission skipped
+            # (offset0), ``restored`` the portion that came host→device
+            # from the prefix store rather than sitting slot-resident.
             req.trace.add_span_abs(
                 "prefill", adm.t_start, t1, tokens=len(prompt),
-                slot=adm.slot, chunked=True, reused=adm.offset0)
+                slot=adm.slot, chunked=True, reused=adm.offset0,
+                restored=adm.restored)
         with self._cond:
             self._slots[adm.slot] = req
         self._release_admission(adm)
@@ -1963,8 +2330,12 @@ class InferenceEngine:
         t1 = time.perf_counter()
         obs.PREFILL.observe(t1 - t0)
         if req.trace is not None:
+            # reused/restored are structurally 0 on the single-shot path
+            # (reuse routes through a chunked admission); recorded anyway so
+            # every admission span carries the cache-effectiveness attrs.
             req.trace.add_span_abs("prefill", t0, t1,
-                                   tokens=n_prompt, bucket=bucket, slot=slot)
+                                   tokens=n_prompt, bucket=bucket, slot=slot,
+                                   reused=0, restored=0)
         if req.want_lp >= 0:
             req.lp.append((float(s_lp),
                            np.asarray(top_ix), np.asarray(top_lp)))
@@ -2138,9 +2509,12 @@ class InferenceEngine:
     def _release_slot(self, i: int, req: _Request) -> None:
         """Free a slot whose request finished/cancelled. Caller holds _cond.
         The cache rows hold K/V for everything but the request's last
-        sampled token (never fed back) — that prefix stays reusable."""
+        sampled token (never fed back) — that prefix stays reusable; with a
+        host prefix store the prefix is additionally queued for a
+        device→host snapshot, so it survives the slot being reclaimed."""
         self._slots[i] = None
         self._resident[i] = req.hist[:-1]
+        self._queue_snapshot(i)
 
     def _dispatch_chunk(self, mask, n_steps: int, want_lp: bool, history: int):
         """Enqueue one decode chunk (non-blocking — jax arrays are futures);
@@ -2297,6 +2671,12 @@ class InferenceEngine:
             self._claimed = set()
             self._pending = []
             self._resident = [[] for _ in range(self._rows)]
+            # Deferred snapshots reference pre-failure cache rows — drop
+            # them (already-dispatched slices fail harmlessly in the
+            # worker). The store's existing host copies stay valid.
+            self._snap_backlog = max(
+                0, self._snap_backlog - len(self._pending_snaps))
+            self._pending_snaps = []
         # In-flight chunk payloads reference (possibly poisoned) device
         # arrays from before the failure — drop them unread.
         self._inflight.clear()
@@ -2381,6 +2761,9 @@ def get_engine(
     spec_decode: int = 0,
     quant: str | None = None,
     prefix_cache: bool = True,
+    prefix_store: str | None = None,
+    prefix_store_bytes: int = DEFAULT_PREFIX_STORE_BYTES,
+    prefix_store_chunk: int = 0,
     ensemble: int = 1,
     members: int = 1,
     kv_quant: str | None = None,
@@ -2393,9 +2776,10 @@ def get_engine(
     ensemble, members, draft model) plus the cache representation (kv_quant) —
     dispatch knobs like decode_chunk are per-call, so two backends that differ
     only in chunking share one set of weights on device. ``n_slots``/
-    ``prefill_chunk``/``max_pending``/``decode_pipeline`` (structural
-    properties of the preallocated cache and the scheduler) apply at first
-    construction; later callers share the existing engine as-is. ``spec_decode`` and
+    ``prefill_chunk``/``max_pending``/``decode_pipeline``/``prefix_store*``
+    (structural properties of the preallocated cache and the scheduler)
+    apply at first construction; later callers share the existing engine
+    as-is. ``spec_decode`` and
     ``prefix_cache`` are NOT structural: a shared engine runs with the
     maximum draft length any of its backends requested, and a
     ``prefix_cache=0`` from ANY backend disables reuse on the shared engine
@@ -2428,7 +2812,10 @@ def get_engine(
                 decode_pipeline=decode_pipeline,
                 prefill_chunk=prefill_chunk, max_pending=max_pending,
                 spec_decode=spec_decode, quant=quant,
-                prefix_cache=prefix_cache, ensemble=ensemble,
+                prefix_cache=prefix_cache, prefix_store=prefix_store,
+                prefix_store_bytes=prefix_store_bytes,
+                prefix_store_chunk=prefix_store_chunk,
+                ensemble=ensemble,
                 members=members, kv_quant=kv_quant,
                 draft_spec=draft_spec, draft_seed=draft_seed,
                 draft_params=draft_params, sp_impl=sp_impl,
@@ -2453,6 +2840,9 @@ def get_engine_from_ckpt(
     spec_decode: int = 0,
     quant: str | None = None,
     prefix_cache: bool = True,
+    prefix_store: str | None = None,
+    prefix_store_bytes: int = DEFAULT_PREFIX_STORE_BYTES,
+    prefix_store_chunk: int = 0,
     ensemble: int = 1,
     kv_quant: str | None = None,
     draft_ckpt: str | None = None,
@@ -2503,7 +2893,10 @@ def get_engine_from_ckpt(
                 decode_pipeline=decode_pipeline,
                 prefill_chunk=prefill_chunk, max_pending=max_pending,
                 spec_decode=spec_decode, quant=quant,
-                prefix_cache=prefix_cache, ensemble=ensemble,
+                prefix_cache=prefix_cache, prefix_store=prefix_store,
+                prefix_store_bytes=prefix_store_bytes,
+                prefix_store_chunk=prefix_store_chunk,
+                ensemble=ensemble,
                 kv_quant=kv_quant,
                 draft_spec=draft_spec, draft_params=draft_params,
                 sp_impl=sp_impl,

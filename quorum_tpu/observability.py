@@ -164,6 +164,36 @@ class Histogram:
         return lines
 
 
+class Counter:
+    """One Prometheus counter: thread-safe monotonic ``inc`` plus exposition.
+
+    Process-wide like the registry's other families — engines sharing the
+    process accumulate into one series (the per-engine breakdown lives in
+    the ``quorum_tpu_engine_*`` block each engine's ``metrics()`` feeds)."""
+
+    def __init__(self, name: str, help_text: str):
+        self.name = name
+        self.help = help_text
+        self._lock = threading.Lock()
+        self._value = 0.0
+
+    def inc(self, amount: float = 1.0) -> None:
+        if amount < 0:
+            raise ValueError(f"counter {self.name} cannot decrease")
+        with self._lock:
+            self._value += float(amount)
+
+    @property
+    def value(self) -> float:
+        with self._lock:
+            return self._value
+
+    def expose(self) -> list[str]:
+        return [f"# HELP {self.name} {self.help}",
+                f"# TYPE {self.name} counter",
+                f"{self.name} {_fmt_float(self.value)}"]
+
+
 class Gauge:
     """One Prometheus gauge: thread-safe ``set`` plus exposition.
 
@@ -199,6 +229,7 @@ class MetricsRegistry:
         self._lock = threading.Lock()
         self._hists: dict[str, Histogram] = {}
         self._gauges: dict[str, Gauge] = {}
+        self._counters: dict[str, Counter] = {}
 
     def histogram(self, name: str, help_text: str,
                   buckets: tuple[float, ...] = DEFAULT_BUCKETS) -> Histogram:
@@ -217,9 +248,19 @@ class MetricsRegistry:
                 self._gauges[name] = g
             return g
 
+    def counter(self, name: str, help_text: str) -> Counter:
+        with self._lock:
+            c = self._counters.get(name)
+            if c is None:
+                c = Counter(name, help_text)
+                self._counters[name] = c
+            return c
+
     def expose(self) -> list[str]:
         with self._lock:
-            families = list(self._hists.values()) + list(self._gauges.values())
+            families = (list(self._hists.values())
+                        + list(self._counters.values())
+                        + list(self._gauges.values()))
         lines: list[str] = []
         for fam in families:
             lines.extend(fam.expose())
@@ -233,6 +274,9 @@ class MetricsRegistry:
                     h._series.clear()
             for g in self._gauges.values():
                 g.set(0.0)
+            for c in self._counters.values():
+                with c._lock:
+                    c._value = 0.0
 
 
 METRICS = MetricsRegistry()
@@ -269,6 +313,31 @@ DECODE_CHUNK = METRICS.histogram(
 PIPELINE_DEPTH = METRICS.gauge(
     "quorum_tpu_decode_pipeline_inflight",
     "Decode chunks currently in flight on the device (dispatch ring depth).")
+
+# Tiered KV prefix store (quorum_tpu/cache/prefix_store.py + the engine's
+# snapshot/restore hooks, docs/prefix_cache.md): host-RAM retention of
+# decoded KV prefixes beyond the resident slots. Process-wide families —
+# the per-engine split is in the quorum_tpu_engine_prefix_store_* block.
+PREFIX_STORE_HITS = METRICS.counter(
+    "quorum_tpu_prefix_store_hits_total",
+    "Admissions whose prompt prefix was restored from the host prefix "
+    "store (the store's match beat the slot-resident LCP).")
+PREFIX_STORE_RESTORED_TOKENS = METRICS.counter(
+    "quorum_tpu_prefix_store_restored_tokens_total",
+    "Prompt tokens restored host->device instead of being re-prefilled.")
+PREFIX_STORE_EVICTIONS = METRICS.counter(
+    "quorum_tpu_prefix_store_evictions_total",
+    "KV chunks evicted from the host prefix store (byte-budget LRU).")
+PREFIX_STORE_BYTES = METRICS.gauge(
+    "quorum_tpu_prefix_store_bytes",
+    "Bytes of KV prefix data held in the host store right now "
+    "(last-writer-wins across engines sharing the process).")
+PREFIX_STORE_RESTORE = METRICS.histogram(
+    "quorum_tpu_prefix_store_restore_seconds",
+    "Host->device restore of a matched KV prefix into a claimed slot "
+    "(transfer + cache write, blocking on the scheduler thread).",
+    buckets=(0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+             1.0, 2.5, 5.0, 10.0))
 
 
 # ---- request-scoped tracing ------------------------------------------------

@@ -234,7 +234,8 @@ def create_app(
             f"quorum_tpu_uptime_seconds {time.monotonic() - started:.3f}",
         ]
         gauges = ("slots", "members", "busy_slots", "admitting", "pending",
-                  "queue_limit", "decode_pipeline", "inflight_chunks")
+                  "queue_limit", "decode_pipeline", "inflight_chunks",
+                  "prefix_store_bytes", "prefix_store_entries")
         # One snapshot per distinct engine: backends sharing one cached
         # engine (get_engine) must not double-count its load. Each family's
         # TYPE line appears exactly once, with all its samples grouped —

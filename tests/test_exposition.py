@@ -99,7 +99,11 @@ def _config():
     return {
         "settings": {"timeout": 60},
         "primary_backends": [
-            {"name": "LLM1", "url": "tpu://llama-tiny?seed=3&slots=2",
+            # prefix_store=host so the quorum_tpu_prefix_store_* families
+            # (and the engine-block store gauges/counters) are live on the
+            # exposition this test validates.
+            {"name": "LLM1",
+             "url": "tpu://llama-tiny?seed=3&slots=2&prefix_store=host",
              "model": "t"},
         ],
     }
@@ -147,6 +151,24 @@ async def test_live_metrics_exposition_validates():
     assert ('quorum_tpu_request_duration_seconds_bucket'
             '{status="2xx",le="+Inf"}') in text
     assert 'quorum_tpu_request_duration_seconds_count{status="2xx"}' in text
+
+    # the tiered-prefix-store families (ISSUE 3): the restore histogram
+    # exposes its full _bucket/_sum/_count triplet even before any hit,
+    # and the counter/gauge families carry the counter/gauge TYPEs
+    fam = "quorum_tpu_prefix_store_restore_seconds"
+    assert f"# TYPE {fam} histogram" in text
+    assert f'{fam}_bucket{{le="+Inf"}}' in text
+    assert f"{fam}_sum" in text and f"{fam}_count" in text
+    for counter in ("quorum_tpu_prefix_store_hits_total",
+                    "quorum_tpu_prefix_store_restored_tokens_total",
+                    "quorum_tpu_prefix_store_evictions_total"):
+        assert f"# TYPE {counter} counter" in text, counter
+    assert "# TYPE quorum_tpu_prefix_store_bytes gauge" in text
+    # per-engine split: the store keys ride the engine block with the
+    # right kinds (bytes/entries are gauges, the rest counters)
+    assert ("# TYPE quorum_tpu_engine_prefix_store_bytes gauge") in text
+    assert ("# TYPE quorum_tpu_engine_prefix_store_hits_total counter"
+            ) in text
 
     # _count == +Inf bucket and bucket monotonicity for one family, by hand
     # (belt to the validator's braces)

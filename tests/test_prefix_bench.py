@@ -1,0 +1,30 @@
+"""Smoke over the prefix-store microbench (``make prefix-bench``).
+
+Runs the same entry point the Makefile target runs, at a budget small
+enough for the fast tier, and pins the ISSUE-3 acceptance behavior: under
+slot churn (more conversations than slots) follow-up turns hit the host
+store, prefill only the tail, and the sampled output is byte-identical to
+the store-less engine's cold full prefill.
+"""
+
+from scripts.prefix_bench import run
+
+import pytest
+
+
+def test_prefix_bench_counters():
+    m = run(conversations=3, slots=1, turns=2, new_tokens=5, chunk=16)
+    # Every turn-2 conversation finds its slot reclaimed; each must have
+    # restored its history from the host store instead of re-prefilling.
+    assert m["on_store_hits"] >= m["conversations"]
+    assert m["on_store_restored_tokens"] >= 16 * m["conversations"]
+    assert m["prefill_tokens_saved_by_store"] > 0
+    assert m["on_prefill_tokens"] < m["off_prefill_tokens"]
+    assert m["on_restore_ms_mean"] > 0.0
+    # reuse is a scheduling optimization, never a semantic change
+    assert m["tokens_match"] is True
+
+
+def test_prefix_bench_rejects_churnless_shape():
+    with pytest.raises(ValueError, match="exceed"):
+        run(conversations=2, slots=2, turns=1)
