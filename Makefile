@@ -2,7 +2,7 @@
 # clean — /root/reference/Makefile:1-25), adapted to this environment: no uv,
 # no uvicorn — the bundled h11 ASGI server serves the app.
 
-.PHONY: install run dev test test-all coverage bench hostpath-bench prefix-bench dryrun metrics-check clean
+.PHONY: install run dev test test-all coverage bench hostpath-bench prefix-bench dryrun metrics-check chaos-check verify clean
 
 install:
 	pip install -e .
@@ -62,6 +62,20 @@ prefix-bench:
 # buckets, or _sum/_count inconsistencies. See docs/observability.md.
 metrics-check:
 	python -m pytest tests/test_exposition.py -x -q $(PYTEST_EXTRA)
+
+# Fault-injection chaos sweep (scripts/chaos_check.py, docs/robustness.md):
+# injects each named fault site (quorum_tpu/faults.py) under concurrent
+# load on a tiny CPU engine and asserts containment — only the affected
+# requests error, the next request succeeds, deadlines answer within
+# slack, the breaker opens under a failure storm and /health reflects it,
+# and fault-free output stays pinned token-for-token. Exit 2 = hung
+# (the script carries its own watchdog). The suite's slow-tier smoke over
+# the same entry point is tests/test_robustness.py (chaos quick subset).
+chaos-check:
+	JAX_PLATFORMS=cpu python scripts/chaos_check.py
+
+# The local verify path: fast tier + exposition lint + chaos containment.
+verify: test metrics-check chaos-check
 
 # Multi-chip sharding validation on a virtual 8-device CPU mesh.
 # dryrun_multichip re-execs itself with a clean env (JAX_PLATFORMS=cpu,

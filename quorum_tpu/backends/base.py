@@ -19,12 +19,17 @@ from quorum_tpu import oai
 
 
 class BackendError(Exception):
-    """A backend call failed. Carries the normalized OpenAI-style error body."""
+    """A backend call failed. Carries the normalized OpenAI-style error body
+    plus any response headers the relay must preserve (``Retry-After`` on
+    503 overload/breaker-open and 504 deadline responses)."""
 
-    def __init__(self, message: str, *, status_code: int = 500, body: dict | None = None):
+    def __init__(self, message: str, *, status_code: int = 500,
+                 body: dict | None = None,
+                 headers: dict[str, str] | None = None):
         super().__init__(message)
         self.status_code = status_code
         self.body = body or oai.error_body(message, code=status_code)
+        self.headers = dict(headers or {})
 
 
 @dataclass

@@ -11,23 +11,46 @@ Fixes two structural problems of the reference dispatcher:
 Error normalization parity: any transport exception becomes a 500
 ``proxy_error`` body (oai_proxy.py:252-259); non-2xx upstream statuses pass
 their status and parsed body through (oai_proxy.py:216-248).
+
+Retry (opt-in, docs/robustness.md): a ``retries: N`` key on the backend's
+``primary_backends`` entry retries *non-streaming* calls up to N extra
+attempts on connect errors and upstream 5xx, with capped exponential
+backoff + full jitter, never past the request's deadline. Streaming is
+never retried — bytes may already be on the client's wire. Each retried
+attempt counts into ``quorum_tpu_backend_retries_total{backend=...}``.
 """
 
 from __future__ import annotations
 
+import asyncio
 import json
 import logging
+import random
+import time
 from typing import Any, AsyncIterator
 
 import httpx
 
-from quorum_tpu import oai, sse
+from quorum_tpu import faults, oai, sse
 from quorum_tpu.backends.base import BackendError, CompletionResult, prepare_body
+from quorum_tpu.observability import BACKEND_RETRIES
 
 logger = logging.getLogger(__name__)
 
 # Hop-by-hop / recomputed headers never forwarded upstream.
 _SKIP_HEADERS = {"host", "content-length", "transfer-encoding", "connection"}
+
+# Retry pacing: attempt k sleeps min(CAP, BASE * 2^k) scaled by a full
+# jitter factor in [0.5, 1.5) — retry storms from co-failing replicas must
+# not re-synchronize on the upstream.
+RETRY_BASE_S = 0.05
+RETRY_CAP_S = 2.0
+# Exceptions worth a retry: the connection never carried the request, so a
+# second attempt cannot duplicate upstream work. Read-side failures
+# (ReadError/ReadTimeout mid-body) are NOT retried — the upstream may have
+# processed the completion already.
+_RETRYABLE_EXC = (httpx.ConnectError, httpx.ConnectTimeout,
+                  faults.FaultInjected)
 
 
 def _clean_headers(headers: dict[str, str]) -> dict[str, str]:
@@ -39,15 +62,44 @@ class HttpBackend:
     # (oai_proxy.py:446-466); local tpu:// backends set this False.
     requires_auth = True
 
-    def __init__(self, name: str, url: str, model: str = "", client: httpx.AsyncClient | None = None):
+    def __init__(self, name: str, url: str, model: str = "",
+                 client: httpx.AsyncClient | None = None, retries: int = 0):
         self.name = name
         self.url = url.rstrip("/")
         self.model = model
+        self.retries = max(0, int(retries))
         self._client = client or httpx.AsyncClient()
 
     @property
     def _endpoint(self) -> str:
         return f"{self.url}/chat/completions"
+
+    async def _backoff(self, attempt: int, deadline: float,
+                       floor: float = 0.0) -> bool:
+        """Sleep one capped-exponential + jitter step before retry
+        ``attempt + 1``; False when the budget (count or deadline) is
+        spent and the current failure must surface instead. ``floor`` is
+        the upstream's own Retry-After ask — an overloaded replica that
+        named its recovery window must not be hammered inside it."""
+        if attempt >= self.retries:
+            return False
+        delay = min(RETRY_CAP_S, RETRY_BASE_S * (2 ** attempt))
+        delay *= 0.5 + random.random()  # full jitter: [0.5x, 1.5x)
+        delay = max(delay, floor)
+        if time.monotonic() + delay >= deadline:
+            return False  # a retry past the deadline helps nobody
+        BACKEND_RETRIES.inc(backend=self.name)
+        await asyncio.sleep(delay)
+        return True
+
+    @staticmethod
+    def _retry_after(resp: "httpx.Response") -> float:
+        """The upstream's Retry-After in seconds (0.0 when absent or in
+        the HTTP-date form — close enough to 'no ask' for a retry floor)."""
+        try:
+            return max(0.0, float(resp.headers.get("Retry-After", 0)))
+        except ValueError:
+            return 0.0
 
     async def _post_json(
         self, endpoint: str, req_body: dict[str, Any],
@@ -56,19 +108,35 @@ class HttpBackend:
         """POST + the shared error-normalization/tagging contract: transport
         failures → 500 proxy_error, invalid/non-object JSON → error body
         with the upstream status, successful JSON tagged with the backend
-        name (oai_proxy.py:212)."""
-        try:
-            resp = await self._client.post(
-                endpoint,
-                json=req_body,
-                headers=_clean_headers(headers),
-                timeout=timeout,
-            )
-        except Exception as e:
-            logger.warning("Backend %s transport failure: %s", self.name, e)
-            raise BackendError(
-                f"Backend {self.name} error: {e}", status_code=500
-            ) from e
+        name (oai_proxy.py:212). With ``retries`` configured, connect
+        errors and upstream 5xx retry inside the request's deadline."""
+        deadline = time.monotonic() + timeout
+        attempt = 0
+        while True:
+            try:
+                faults.fire("http.request")
+                resp = await self._client.post(
+                    endpoint,
+                    json=req_body,
+                    headers=_clean_headers(headers),
+                    timeout=max(0.001, deadline - time.monotonic()),
+                )
+            except Exception as e:
+                if (isinstance(e, _RETRYABLE_EXC)
+                        and await self._backoff(attempt, deadline)):
+                    attempt += 1
+                    continue
+                logger.warning(
+                    "Backend %s transport failure: %s", self.name, e)
+                raise BackendError(
+                    f"Backend {self.name} error: {e}", status_code=500
+                ) from e
+            if (resp.status_code >= 500
+                    and await self._backoff(attempt, deadline,
+                                            floor=self._retry_after(resp))):
+                attempt += 1
+                continue
+            break
         try:
             parsed = resp.json()
         except (json.JSONDecodeError, ValueError):
@@ -121,6 +189,7 @@ class HttpBackend:
         req_body["stream"] = True
         parser = sse.SSEParser()
         try:
+            faults.fire("http.stream")
             async with self._client.stream(
                 "POST",
                 self._endpoint,

@@ -89,8 +89,8 @@ def _build_tpu_backend(spec: BackendSpec) -> Backend:
 
 
 SCHEME_FACTORIES: dict[str, Callable[[BackendSpec], Backend]] = {
-    "http": lambda s: HttpBackend(s.name, s.url, s.model),
-    "https": lambda s: HttpBackend(s.name, s.url, s.model),
+    "http": lambda s: HttpBackend(s.name, s.url, s.model, retries=s.retries),
+    "https": lambda s: HttpBackend(s.name, s.url, s.model, retries=s.retries),
     "tpu": _build_tpu_backend,
 }
 
@@ -146,7 +146,8 @@ def rebuild_registry(
         prev = old.get(spec.name)
         if (prev is not None and prev_spec is not None
                 and prev_spec.url == spec.url
-                and prev_spec.model == spec.model):
+                and prev_spec.model == spec.model
+                and prev_spec.retries == spec.retries):
             reg.add(prev, spec=spec)
             continue
         factory = SCHEME_FACTORIES.get(spec.scheme)

@@ -119,6 +119,7 @@ import asyncio
 import logging
 import math
 import threading
+import time
 from typing import Any, AsyncIterator
 
 import numpy as np
@@ -132,6 +133,8 @@ from quorum_tpu.engine.engine import (
     DEFAULT_PREFILL_CHUNK,
     DEFAULT_SLOTS,
     _CKPT_MEMBERS_ERROR,
+    DeadlineExceeded,
+    EngineBreakerOpen,
     GenerationResult,
     InferenceEngine,
     QueueFullError,
@@ -236,13 +239,56 @@ def _invalid_request(message: str) -> BackendError:
     )
 
 
-def _overloaded(name: str, why: str = "admission queue full") -> BackendError:
+def _overloaded(name: str, why: str = "admission queue full",
+                retry_after: float = 1.0) -> BackendError:
     """503 with the actual saturated resource named — an operator debugging
-    the error must not tune the chat queue when the scoring gate tripped."""
+    the error must not tune the chat queue when the scoring gate tripped.
+    Every overload response carries ``Retry-After`` (docs/robustness.md):
+    load balancers and SDK retry loops key their backoff on it."""
     msg = f"Backend {name} is overloaded: {why}; retry later"
     return BackendError(
         msg, status_code=503,
         body=oai.error_body(msg, type_="overloaded_error", code=503),
+        headers={"Retry-After": str(max(1, math.ceil(retry_after)))},
+    )
+
+
+def _breaker_open(name: str, e: EngineBreakerOpen) -> BackendError:
+    """503 + Retry-After while the engine's failure breaker rejects new
+    admissions (repeated device-state rebuilds — docs/robustness.md)."""
+    return _overloaded(
+        name, f"engine circuit breaker is open ({e})",
+        retry_after=e.retry_after)
+
+
+def _deadline_error(name: str, e: DeadlineExceeded) -> BackendError:
+    """Map an engine deadline miss onto the HTTP contract: shed from the
+    queue (the engine never served it) → 503 + Retry-After, safe to retry
+    elsewhere; cancelled after admission → 504, the work is lost."""
+    if e.stage == "queue":
+        return _overloaded(
+            name, "request deadline expired before admission (shed)")
+    msg = (f"Backend {name} deadline exceeded during {e.stage}; "
+           "partial work discarded")
+    return BackendError(
+        msg, status_code=504,
+        body=oai.error_body(msg, type_="timeout_error", code=504),
+        headers={"Retry-After": "1"},
+    )
+
+
+def _timeout_error(name: str, timeout: float) -> BackendError:
+    """The asyncio-side wait outlived the backend timeout (the backstop
+    behind the engine-enforced deadline): 504, counted as a backend-stage
+    deadline miss."""
+    from quorum_tpu.observability import DEADLINE_EXCEEDED
+
+    DEADLINE_EXCEEDED.inc(stage="backend")
+    msg = f"Backend {name} timed out after {timeout}s"
+    return BackendError(
+        msg, status_code=504,
+        body=oai.error_body(msg, type_="timeout_error", code=504),
+        headers={"Retry-After": "1"},
     )
 
 
@@ -475,6 +521,11 @@ class TpuBackend:
     # dropped these, VERDICT r2 missing item 1).
     _UNSUPPORTED = ("tools", "tool_choice", "functions", "function_call")
     MAX_N = 8
+    # Slack the asyncio-side wait keeps beyond the engine-enforced deadline:
+    # the scheduler's sweep is the real enforcement (one decode chunk of
+    # latency); the wait only backstops a wedged scheduler, so a deadline
+    # miss still answers within deadline + this slack.
+    DEADLINE_SLACK_S = 2.0
 
     def _acquire_score_slot(self) -> None:
         """Admit one scoring/embedding device forward or raise 503.
@@ -651,7 +702,8 @@ class TpuBackend:
     CHOICE_SEED_STRIDE = 7919
 
     def _submit_choice(self, plan: dict[str, Any], idx: int,
-                       cancel: threading.Event):
+                       cancel: threading.Event,
+                       deadline: float | None = None):
         return self.engine.submit(
             plan["prompt_ids"],
             max_new_tokens=plan["max_new"],
@@ -665,6 +717,7 @@ class TpuBackend:
             logit_bias=plan["logit_bias"],
             logprobs=plan["logprobs"],
             member=self.member,
+            deadline=deadline,
         )
 
     def _lp_entry(self, tid: int, record, top_n: int) -> dict[str, Any]:
@@ -769,12 +822,23 @@ class TpuBackend:
             for c in cancels:
                 c.set()
 
+        # The engine-enforced deadline: queue-wait sheds before admission
+        # (503), scheduler turns cancel admitted rows past it (504). The
+        # asyncio wait below keeps a slack backstop in case the scheduler
+        # itself is wedged.
+        deadline = time.monotonic() + timeout
         try:
-            reqs = [self._submit_choice(plan, i, cancels[i])
+            reqs = [self._submit_choice(plan, i, cancels[i], deadline)
                     for i in range(plan["n"])]
         except QueueFullError:
             cancel_all()  # release any choices already admitted
             raise _overloaded(self.name) from None
+        except EngineBreakerOpen as e:
+            cancel_all()
+            raise _breaker_open(self.name, e) from None
+        except DeadlineExceeded as e:
+            cancel_all()
+            raise _deadline_error(self.name, e) from None
 
         def run():
             return [self._consume(plan, r) for r in reqs]
@@ -786,12 +850,16 @@ class TpuBackend:
             with trace_span(current_trace(), "backend-generate",
                             backend=self.name, choices=plan["n"],
                             prompt_tokens=len(plan["prompt_ids"])):
-                outs = await self._shielded_to_thread(run, timeout)
+                outs = await self._shielded_to_thread(
+                    run, timeout + self.DEADLINE_SLACK_S)
         except asyncio.TimeoutError:
             # Abort the on-device loop at the next chunk boundary; don't hold
             # the request open waiting for the full generation.
             cancel_all()
-            raise BackendError(f"Backend {self.name} timed out after {timeout}s")
+            raise _timeout_error(self.name, timeout) from None
+        except DeadlineExceeded as e:
+            cancel_all()
+            raise _deadline_error(self.name, e) from None
         except BackendError:
             raise
         except Exception as e:
@@ -895,8 +963,7 @@ class TpuBackend:
         try:
             vectors = await self._gated_to_thread(run, timeout)
         except asyncio.TimeoutError:
-            raise BackendError(
-                f"Backend {self.name} timed out after {timeout}s") from None
+            raise _timeout_error(self.name, timeout) from None
         except BackendError:
             raise
         except Exception as e:
@@ -1092,9 +1159,7 @@ class TpuBackend:
                 scores = await self._gated_to_thread(
                     run_score, max(0.0, deadline - _time.monotonic()))
             except asyncio.TimeoutError:
-                raise BackendError(
-                    f"Backend {self.name} timed out after {timeout}s"
-                ) from None
+                raise _timeout_error(self.name, timeout) from None
             except BackendError:
                 raise
             except Exception as e:
@@ -1124,11 +1189,17 @@ class TpuBackend:
                     c.set()
 
             try:
-                reqs = [self._submit_choice(plans[i], 0, cancels[i])
+                reqs = [self._submit_choice(plans[i], 0, cancels[i], deadline)
                         for i in range(len(plans))]
             except QueueFullError:
                 cancel_all()
                 raise _overloaded(self.name) from None
+            except EngineBreakerOpen as e:
+                cancel_all()
+                raise _breaker_open(self.name, e) from None
+            except DeadlineExceeded as e:
+                cancel_all()
+                raise _deadline_error(self.name, e) from None
 
             def run():
                 return [self._consume(plans[i], r)
@@ -1136,12 +1207,14 @@ class TpuBackend:
 
             try:
                 outs = await self._shielded_to_thread(
-                    run, max(0.0, deadline - _time.monotonic()))
+                    run, max(0.0, deadline - _time.monotonic())
+                    + self.DEADLINE_SLACK_S)
             except asyncio.TimeoutError:
                 cancel_all()
-                raise BackendError(
-                    f"Backend {self.name} timed out after {timeout}s"
-                ) from None
+                raise _timeout_error(self.name, timeout) from None
+            except DeadlineExceeded as e:
+                cancel_all()
+                raise _deadline_error(self.name, e) from None
             except BackendError:
                 raise
             except Exception as e:
@@ -1254,13 +1327,22 @@ class TpuBackend:
                 c.set()
 
         # Submit every choice BEFORE the first yield: a full admission queue
-        # must surface as a 503 response, not as an error chunk inside an
+        # (or an open breaker, or an already-expired deadline) must surface
+        # as a 503 response, not as an error chunk inside an
         # already-started 200 stream.
+        engine_deadline = time.monotonic() + timeout
         try:
-            reqs = [self._submit_choice(plan, i, cancels[i]) for i in range(n)]
+            reqs = [self._submit_choice(plan, i, cancels[i], engine_deadline)
+                    for i in range(n)]
         except QueueFullError:
             cancel_all()  # release any choices already admitted
             raise _overloaded(self.name) from None
+        except EngineBreakerOpen as e:
+            cancel_all()
+            raise _breaker_open(self.name, e) from None
+        except DeadlineExceeded as e:
+            cancel_all()
+            raise _deadline_error(self.name, e) from None
 
         def produce(idx: int, req):
             """Drain one choice; events are (kind, choice_index, payload)."""
@@ -1317,10 +1399,11 @@ class TpuBackend:
 
         producers = [loop.run_in_executor(None, produce, i, r)
                      for i, r in enumerate(reqs)]
-        # End-to-end deadline, matching complete()'s semantics: each queue
-        # wait gets the *remaining* time, so a generation that keeps emitting
-        # deltas still can't outlive the configured backend timeout.
-        deadline = loop.time() + timeout
+        # End-to-end deadline, matching complete()'s semantics: the engine
+        # sweep is the enforcement (it delivers the DeadlineExceeded error
+        # event within one decode chunk); each queue wait keeps a slack
+        # backstop for a wedged scheduler.
+        deadline = loop.time() + timeout + self.DEADLINE_SLACK_S
         ended = 0
         try:
             # inside the try: a disconnect at this first yield must still
@@ -1360,11 +1443,13 @@ class TpuBackend:
                                         finish_reason=finishes[idx], index=idx)
                         yield oai.more(out) if more else out
                     else:
+                        if isinstance(val, DeadlineExceeded):
+                            raise _deadline_error(self.name, val) from val
                         raise BackendError(
                             f"Backend {self.name} failed: {val}") from val
         except asyncio.TimeoutError:
             cancel_all()  # abort the device loops at the next chunk boundary
-            raise BackendError(f"Backend {self.name} timed out after {timeout}s")
+            raise _timeout_error(self.name, timeout) from None
         except BaseException:
             # Client disconnect (GeneratorExit) or cancellation: release the
             # engine within one decode chunk; the producer threads exit on

@@ -66,11 +66,17 @@ DEFAULT_AGGREGATE_PROMPT = (
 
 @dataclass
 class BackendSpec:
-    """One entry of ``primary_backends``."""
+    """One entry of ``primary_backends``.
+
+    ``retries`` (opt-in, default 0) applies to ``http(s)://`` backends
+    only: non-streaming calls retry up to that many extra attempts on
+    connect errors / upstream 5xx with capped exponential backoff + jitter,
+    never past the request deadline (docs/robustness.md)."""
 
     name: str
     url: str
     model: str = ""
+    retries: int = 0
 
     @property
     def is_valid(self) -> bool:
@@ -98,10 +104,17 @@ class BackendSpec:
 
     @classmethod
     def from_dict(cls, d: dict[str, Any]) -> "BackendSpec":
+        try:
+            retries = int(d.get("retries", 0) or 0)
+        except (TypeError, ValueError):
+            logger.warning("backend %r: invalid retries=%r ignored",
+                           d.get("name"), d.get("retries"))
+            retries = 0
         return cls(
             name=str(d.get("name", "")),
             url=str(d.get("url", "") or ""),
             model=str(d.get("model", "") or ""),
+            retries=max(0, retries),
         )
 
 

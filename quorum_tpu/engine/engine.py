@@ -76,6 +76,7 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from quorum_tpu import faults
 from quorum_tpu import observability as obs
 from quorum_tpu.cache.prefix_store import (
     DEFAULT_PREFIX_STORE_BYTES,
@@ -134,6 +135,109 @@ _CKPT_MEMBERS_ERROR = ("stacked members are seeded random inits; a "
 
 class QueueFullError(Exception):
     """The engine's admission queue is at capacity (surface as HTTP 503)."""
+
+
+class DeadlineExceeded(Exception):
+    """A request ran past its deadline. ``stage`` names where the scheduler
+    caught it: ``"queue"`` — shed while still pending, the engine never
+    started serving it (surface as 503 + Retry-After, safe to retry
+    elsewhere); ``"prefill"``/``"decode"`` — cancelled after admission
+    (surface as 504, work was lost)."""
+
+    def __init__(self, stage: str):
+        super().__init__(f"request deadline exceeded ({stage})")
+        self.stage = stage
+
+
+class EngineBreakerOpen(Exception):
+    """The engine's failure breaker is open: repeated device-state rebuilds
+    inside the sliding window mean new admissions would likely hit the same
+    fault. Surface as 503 with ``Retry-After: ceil(retry_after)``."""
+
+    def __init__(self, retry_after: float):
+        super().__init__(
+            f"engine circuit breaker is open; retry in {retry_after:.1f}s")
+        self.retry_after = retry_after
+
+
+# Failure-breaker defaults: >= BREAKER_THRESHOLD device-state rebuilds inside
+# BREAKER_WINDOW_S seconds open the breaker for BREAKER_COOLDOWN_S, after
+# which ONE probe admission is let through per cooldown interval; a probe
+# that admits cleanly closes the breaker, a rebuild while probing reopens it.
+BREAKER_THRESHOLD = 3
+BREAKER_WINDOW_S = 30.0
+BREAKER_COOLDOWN_S = 5.0
+
+
+class _Breaker:
+    """Sliding-window circuit breaker over engine device-state rebuilds.
+
+    Rebuilds — not request failures — are the signal: a request rejected at
+    validation costs nothing shared, but a poison-pill whose dispatch
+    consumes the donated cache forces a full KV-cache reallocation and dooms
+    every co-batched stream. A client retry loop on such a request would
+    re-brick the shared engine forever; the breaker converts that storm into
+    fast 503s until a probe admission proves the engine serves again.
+    Thread-safe (``submit`` callers and the scheduler both touch it)."""
+
+    _CODES = {"closed": 0, "open": 1, "half_open": 2}
+
+    def __init__(self, threshold: int = BREAKER_THRESHOLD,
+                 window: float = BREAKER_WINDOW_S,
+                 cooldown: float = BREAKER_COOLDOWN_S):
+        self.threshold = max(1, int(threshold))
+        self.window = float(window)
+        self.cooldown = float(cooldown)
+        self._lock = threading.Lock()
+        self._failures: deque[float] = deque()
+        self._open_until = 0.0
+        self._last_probe = 0.0
+        self.state = "closed"
+
+    def record_failure(self, now: float | None = None) -> None:
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            self._failures.append(now)
+            while self._failures and self._failures[0] < now - self.window:
+                self._failures.popleft()
+            if (self.state != "closed"
+                    or len(self._failures) >= self.threshold):
+                self.state = "open"
+                self._open_until = now + self.cooldown
+
+    def record_success(self) -> None:
+        with self._lock:
+            if self.state != "closed":
+                self.state = "closed"
+                self._failures.clear()
+
+    def allow(self, now: float | None = None) -> bool:
+        """May a new admission proceed right now? Open → no until the
+        cooldown elapses; then half-open, letting one probe through per
+        cooldown interval (a stamp, not a flag — a probe whose client
+        vanished must not wedge the breaker half-open forever)."""
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            if self.state == "closed":
+                return True
+            if self.state == "open":
+                if now < self._open_until:
+                    return False
+                self.state = "half_open"
+            if now - self._last_probe < self.cooldown and self._last_probe:
+                return False
+            self._last_probe = now
+            return True
+
+    def retry_after(self, now: float | None = None) -> float:
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            return max(self._open_until - now, 0.0) or self.cooldown
+
+    @property
+    def state_code(self) -> int:
+        """0 = closed, 1 = open, 2 = half-open (the breaker_state gauge)."""
+        return self._CODES[self.state]
 
 
 def _host_fetch(*arrays):
@@ -217,12 +321,12 @@ class _Request:
         "prompt_ids", "budget", "temperature", "top_p", "top_k", "seed",
         "eos_id", "cancel", "chunk_hint", "out", "emitted",
         "pp", "fp", "bias_row", "want_lp", "lp", "hist", "ngram", "member",
-        "trace", "t_submit", "tspans",
+        "trace", "t_submit", "tspans", "deadline",
     )
 
     def __init__(self, prompt_ids, budget, sampler: SamplerConfig, seed, eos_id,
                  cancel, chunk_hint, pp=0.0, fp=0.0, bias_row=None, want_lp=-1,
-                 member=0):
+                 member=0, deadline=None):
         self.prompt_ids = prompt_ids
         self.budget = budget
         self.temperature = sampler.temperature
@@ -239,6 +343,10 @@ class _Request:
         self.bias_row = bias_row      # np [V] f32 logit_bias, or None
         self.want_lp = want_lp        # -1 = no logprobs; else #top alternatives
         self.member = member          # stacked-members engine: weight set index
+        # Absolute time.monotonic() deadline (None = no deadline). Enforced
+        # by the scheduler's per-turn sweep: pending requests are shed
+        # (stage "queue"), admitted ones cancelled (stage "prefill"/"decode").
+        self.deadline = deadline
         self.lp: list = []
         # Request-scoped tracing: the server's trace (when this submission
         # happens inside a traced request context) rides along so the
@@ -768,6 +876,12 @@ class InferenceEngine:
         self.n_tokens = 0
         self.n_failures = 0
         self.n_cancelled = 0   # requests retired because cancel was set
+        # Fault containment (docs/robustness.md): device-state rebuilds
+        # after failed dispatches, deadline sheds/cancels by the per-turn
+        # sweep, and the rebuild-storm circuit breaker gating admissions.
+        self.n_rebuilds = 0
+        self.n_deadline_exceeded = 0
+        self.breaker = _Breaker()
         self.n_overlapped = 0  # decode chunks dispatched ahead of the read
         # Tokens the device produced that never reached a consumer. With
         # on-device finish accounting this stays 0 for EOS/budget finishes
@@ -1215,6 +1329,7 @@ class InferenceEngine:
                 if item is None:
                     return
                 tokens, have, payload = item
+                faults.fire("engine.snapshot")
                 leaves = [np.asarray(x)
                           for x in jax.device_get(jax.tree.leaves(payload))]
                 c = self.prefix_store.chunk_tokens
@@ -1597,11 +1712,17 @@ class InferenceEngine:
         logit_bias: "np.ndarray | None" = None,  # [vocab] f32 additive bias
         logprobs: int = -1,  # ≥ 0 → record per-token logprobs + that many tops
         member: int = 0,  # stacked-members engine: which weight set serves this
+        deadline: float | None = None,  # absolute time.monotonic() deadline
     ) -> _Request | None:
         """Enqueue a generation and return its handle (``None`` when there is
         nothing to generate). Raises :class:`QueueFullError` *synchronously*
-        when the admission queue is at capacity — callers can reject the
+        when the admission queue is at capacity, and
+        :class:`EngineBreakerOpen` while the failure breaker rejects new
+        admissions — callers can reject the
         request (e.g. with a 503) before committing to a response stream.
+        ``deadline`` bounds the request's whole life: pending past it is
+        shed (stage ``queue``), admitted past it is cancelled with a
+        :class:`DeadlineExceeded` error frame (stage ``prefill``/``decode``).
         Consume tokens with :meth:`stream_results`; when ``logprobs`` ≥ 0 the
         handle's ``lp`` list carries one ``(logprob, top_ids, top_lps)``
         record per yielded token. Penalties follow the OpenAI contract
@@ -1620,6 +1741,7 @@ class InferenceEngine:
             bias_row=logit_bias,
             want_lp=logprobs,
             member=member,
+            deadline=deadline,
         )
 
     def stream_results(self, req: _Request | None) -> Iterator[int]:
@@ -1668,7 +1790,7 @@ class InferenceEngine:
 
     def _submit(self, prompt_ids, *, max_new_tokens, sampler, seed, eos_id,
                 cancel, decode_chunk, pp=0.0, fp=0.0, bias_row=None,
-                want_lp=-1, member=0) -> _Request | None:
+                want_lp=-1, member=0, deadline=None) -> _Request | None:
         spec = self.spec
         if not 0 <= member < self.members:
             raise ValueError(
@@ -1687,7 +1809,20 @@ class InferenceEngine:
             cancel if cancel is not None else threading.Event(),
             decode_chunk,
             pp=pp, fp=fp, bias_row=bias_row, want_lp=want_lp, member=member,
+            deadline=deadline,
         )
+        now = time.monotonic()
+        if deadline is not None and now >= deadline:
+            # Already expired at submission: shed synchronously — queueing
+            # it would only burn a scheduler sweep to reach the same 503.
+            # The counter bump takes _cond: this path runs on arbitrary
+            # caller threads, racing the scheduler's own increments.
+            with self._cond:
+                self.n_deadline_exceeded += 1
+            obs.DEADLINE_EXCEEDED.inc(stage="queue")
+            raise DeadlineExceeded("queue")
+        if not self.breaker.allow(now):
+            raise EngineBreakerOpen(self.breaker.retry_after(now))
         with self._cond:
             if self._stop:
                 raise RuntimeError("engine has been shut down")
@@ -1739,7 +1874,28 @@ class InferenceEngine:
                 "overrun_tokens_total": self.n_overrun,
                 "decode_pipeline": self.decode_pipeline,
                 "inflight_chunks": len(self._inflight),
+                "rebuilds_total": self.n_rebuilds,
+                "deadline_exceeded_total": self.n_deadline_exceeded,
+                "breaker_state": self.breaker.state_code,
             }
+
+    def health(self) -> dict:
+        """Liveness/capacity signals for the server's /health and /ready:
+        every field is a real observation (thread liveness, breaker state,
+        queue depth), never a hardcoded OK — a load balancer must be able to
+        rotate a process whose scheduler died out of service."""
+        with self._cond:
+            pending = len(self._pending)
+            stopped = self._stop
+        return {
+            "scheduler_alive": self._thread.is_alive() and not stopped,
+            "snapshot_worker_alive": (
+                self.prefix_store is None or self._snap_thread.is_alive()),
+            "breaker": self.breaker.state,
+            "pending": pending,
+            "queue_limit": self.max_pending,
+            "rebuilds_total": self.n_rebuilds,
+        }
 
     def shutdown(self, timeout: float = 30.0) -> None:
         """Stop the scheduler thread and release device state.
@@ -1797,6 +1953,7 @@ class InferenceEngine:
                     # and hang any concurrent drain_prefix_store() forever.
                     return
             try:
+                self._sweep_deadlines()
                 self._start_admissions()
                 self._step_admissions()
                 if any(self._slots) or self._inflight:
@@ -1950,7 +2107,13 @@ class InferenceEngine:
             else:
                 with self._cond:
                     self._resident[slot] = []
-                self._admit(req, slot)
+                try:
+                    self._admit(req, slot)
+                except Exception as e:
+                    # This request's own prefill failed: doom it alone
+                    # (escalating only if the shared device state went with
+                    # it) and keep admitting the rest of the queue.
+                    self._contain_admission_failure([req], e)
 
     def _common_free_row(self, members) -> int | None:
         """The slot row that is free for EVERY given member, preferring the
@@ -2047,7 +2210,13 @@ class InferenceEngine:
                     for r in group.values():
                         self._pending.remove(r)
             if admit_chunked is None:
-                self._admit_members(group, row, bucket)
+                try:
+                    self._admit_members(group, row, bucket)
+                except Exception as e:
+                    # The coalesced group's own prefill failed: doom only
+                    # its members (other members' active streams continue
+                    # unless the shared state was consumed).
+                    self._contain_admission_failure(list(group.values()), e)
             # chunked admissions advance in _step_admissions_members; loop
             # to route any further heads
 
@@ -2095,6 +2264,7 @@ class InferenceEngine:
             live[m] = req
         if not live:
             return
+        faults.fire("engine.admit")
         t0 = time.perf_counter()
         (firsts, s_lp, top_ix, top_lp,
          self._ck, self._cv, self._token, self._lengths, self._keys,
@@ -2113,6 +2283,7 @@ class InferenceEngine:
             firsts, s_lp, top_ix, top_lp)
         t1 = time.perf_counter()
         obs.PREFILL.observe(t1 - t0)
+        self.breaker.record_success()
         for m, req in live.items():
             if req.trace is not None:
                 # reused/restored are structurally 0 here like the
@@ -2184,7 +2355,12 @@ class InferenceEngine:
                     else:
                         batch[m] = adm
                 adms = rest
-                self._run_member_segments(batch, bucket, history)
+                try:
+                    self._run_member_segments(batch, bucket, history)
+                except Exception as e:
+                    self._contain_admission_failure(
+                        [adm.req for adm in batch.values()], e,
+                        admissions=list(batch.values()))
 
     def _run_member_segments(
         self, batch: dict[int, _Admission], bucket: int, history: int
@@ -2257,6 +2433,7 @@ class InferenceEngine:
         with self._cond:
             self._slots[adm.slot] = req
         self._release_admission(adm)
+        self.breaker.record_success()
 
     def _step_admissions(self) -> None:
         """Advance every in-progress chunked admission by ONE prompt segment.
@@ -2280,15 +2457,23 @@ class InferenceEngine:
             history = prefill_bucket(adm.offset + len(seg), self.spec.max_seq)
             tokens = np.zeros((1, bucket), np.int32)
             tokens[0, : len(seg)] = seg
-            self._ck, self._cv = self._seg_fn(bucket, history)(
-                self.params, tokens, np.int32(adm.offset), np.int32(len(seg)),
-                np.int32(adm.slot), self._ck, self._cv,
-            )
-            adm.offset += len(seg)
-            # keep the prefix-cache view in sync with what the cache rows hold
-            self._resident[adm.slot] = prompt[: adm.offset]
-            if adm.offset >= len(prompt):
-                self._finish_admission(adm)
+            try:
+                faults.fire("engine.prefill_segment")
+                self._ck, self._cv = self._seg_fn(bucket, history)(
+                    self.params, tokens, np.int32(adm.offset),
+                    np.int32(len(seg)),
+                    np.int32(adm.slot), self._ck, self._cv,
+                )
+                adm.offset += len(seg)
+                # keep the prefix-cache view in sync with the cache rows
+                self._resident[adm.slot] = prompt[: adm.offset]
+                if adm.offset >= len(prompt):
+                    self._finish_admission(adm)
+            except Exception as e:
+                # One admission's segment failed: doom it alone; active
+                # decodes and other admissions continue (escalation only
+                # when the shared cache's donated buffers were consumed).
+                self._contain_admission_failure([req], e, admissions=[adm])
 
     def _release_admission(self, adm: _Admission) -> None:
         with self._cond:
@@ -2297,6 +2482,7 @@ class InferenceEngine:
             self._claimed.discard(adm.slot)
 
     def _admit(self, req: _Request, slot: int) -> None:
+        faults.fire("engine.admit")
         t0 = time.perf_counter()
         n_prompt = len(req.prompt_ids)
         bucket = prefill_bucket(n_prompt, self.spec.max_seq)
@@ -2329,6 +2515,7 @@ class InferenceEngine:
         first, s_lp, top_ix, top_lp = _host_fetch(first, s_lp, top_ix, top_lp)
         t1 = time.perf_counter()
         obs.PREFILL.observe(t1 - t0)
+        self.breaker.record_success()  # a half-open probe admitted cleanly
         if req.trace is not None:
             # reused/restored are structurally 0 on the single-shot path
             # (reuse routes through a chunked admission); recorded anyway so
@@ -2362,6 +2549,93 @@ class InferenceEngine:
     def _active_rows(self) -> list:
         with self._cond:
             return [(i, r) for i, r in enumerate(self._slots) if r is not None]
+
+    # ---- deadlines & failure containment ----------------------------------
+
+    def _expire(self, req: _Request, stage: str) -> None:
+        """Retire one request past its deadline: error frame first (the
+        consumer must see DeadlineExceeded, not a clean end), cancel set so
+        in-flight device work masks the row out at the next boundary."""
+        self.n_deadline_exceeded += 1
+        obs.DEADLINE_EXCEEDED.inc(stage=stage)
+        if req.trace is not None:
+            now = time.perf_counter()
+            req.trace.add_span_abs("deadline-exceeded", now, now, stage=stage)
+        req.out.put(("err", DeadlineExceeded(stage)))
+        req.cancel.set()
+
+    def _sweep_deadlines(self) -> None:
+        """Once per scheduler turn: shed pending requests past their deadline
+        (stage ``queue`` — the engine never served them, a 503 the client can
+        retry elsewhere) and cancel admitted ones (stage ``prefill`` /
+        ``decode`` — a 504, the work is lost). Runs on the scheduler thread,
+        so it cannot race the cancel sweep's own releases."""
+        now = time.monotonic()
+
+        def expired(r: _Request) -> bool:
+            return (r.deadline is not None and now > r.deadline
+                    and not r.cancel.is_set())
+
+        with self._cond:
+            shed = [r for r in self._pending if expired(r)]
+            for r in shed:
+                self._pending.remove(r)
+            late_adm = [a for a in self._admitting if expired(a.req)]
+            late_active = [(i, r) for i, r in enumerate(self._slots)
+                           if r is not None and expired(r)]
+        for r in shed:
+            self._expire(r, "queue")
+        for a in late_adm:
+            self._expire(a.req, "prefill")
+            self._release_admission(a)
+        for i, r in late_active:
+            self._expire(r, "decode")
+            with self._cond:
+                if self._slots[i] is r:
+                    self._release_slot(i, r)
+
+    def _device_state_ok(self) -> bool:
+        """Whether the donated per-slot device state survived the last
+        failed call. A jitted call that died mid-execution may have consumed
+        its donated buffers — detectable as deleted arrays — in which case
+        only a full rebuild (and dooming the streams whose KV lived there)
+        recovers the engine."""
+        try:
+            leaves = jax.tree.leaves(
+                (self._ck, self._cv, self._token, self._lengths, self._keys,
+                 self._temp, self._topp, self._topk, self._pp, self._fp,
+                 self._counts, self._bias, self._live, self._budget,
+                 self._eos))
+            return not any(x.is_deleted() for x in leaves
+                           if isinstance(x, jax.Array))
+        except Exception:
+            return False
+
+    def _contain_admission_failure(
+        self, reqs: list[_Request], exc: Exception,
+        admissions: "list[_Admission] | None" = None,
+    ) -> None:
+        """One admission's own dispatch failed: doom only its request(s).
+
+        When the failed call left the shared device state intact (fault
+        before dispatch, host-side error), nothing else is touched — active
+        streams keep decoding and pending requests keep their place. When
+        donated buffers were consumed, escalate to :meth:`_fail_all` (the
+        co-batched KV went with them) — which still keeps pending requests
+        queued."""
+        for adm in admissions or ():
+            self._release_admission(adm)
+        if self._device_state_ok():
+            self.n_failures += len(reqs)
+            for r in reqs:
+                if r.trace is not None:
+                    now = time.perf_counter()
+                    r.trace.add_span_abs("engine-failure", now, now,
+                                         error=type(exc).__name__,
+                                         contained=True)
+                r.out.put(("err", exc))
+        else:
+            self._fail_all(exc, doomed=reqs)
 
     def _run_chunk(self) -> None:
         self._sweep_cancelled()
@@ -2520,6 +2794,7 @@ class InferenceEngine:
         """Enqueue one decode chunk (non-blocking — jax arrays are futures);
         chains the per-slot device state so further dispatches can follow
         before this one is read. Returns the chunk's output arrays."""
+        faults.fire("engine.decode")
         out = self._decode_fn(n_steps, want_lp, history)(
             self.params, mask, self._eos, self._ck, self._cv, self._token,
             self._lengths, self._keys, self._temp, self._topp, self._topk,
@@ -2659,17 +2934,24 @@ class InferenceEngine:
             return True
         return False
 
-    def _fail_all(self, exc: Exception) -> None:
+    def _fail_all(self, exc: Exception,
+                  doomed: "list[_Request] | None" = None) -> None:
+        """Recover from a scheduler-turn failure with a bounded blast radius:
+        only requests whose device state was entangled with the failed
+        dispatch — active slots, in-flight admissions, plus any ``doomed``
+        extras the caller names — fail. Requests still in ``_pending`` were
+        never dispatched: they STAY queued (bounded by their deadlines) and
+        admit normally once the device state is rebuilt. Each call counts
+        one engine rebuild and feeds the failure breaker — a poison-pill
+        retry storm trips it and new admissions shed with 503 until a
+        cooldown probe admission succeeds."""
         with self._cond:
-            doomed = (
-                [r for r in self._slots if r is not None]
-                + [a.req for a in self._admitting]
-                + self._pending
-            )
+            doomed = list(doomed or [])
+            doomed += [r for r in self._slots if r is not None]
+            doomed += [a.req for a in self._admitting]
             self._slots = [None] * self._rows
             self._admitting = []
             self._claimed = set()
-            self._pending = []
             self._resident = [[] for _ in range(self._rows)]
             # Deferred snapshots reference pre-failure cache rows — drop
             # them (already-dispatched slices fail harmlessly in the
@@ -2681,10 +2963,17 @@ class InferenceEngine:
         # arrays from before the failure — drop them unread.
         self._inflight.clear()
         obs.PIPELINE_DEPTH.set(0)
+        self.n_rebuilds += 1
+        self.breaker.record_failure()
         # Wake consumers first — the state rebuild below can itself fail, and
         # doomed requests must never hang on their queues.
         self.n_failures += len(doomed)
         for r in doomed:
+            if r.trace is not None:
+                now = time.perf_counter()
+                r.trace.add_span_abs("engine-failure", now, now,
+                                     error=type(exc).__name__,
+                                     contained=False)
             r.out.put(("err", exc))
         # The failed call may have consumed its donated buffers; rebuild the
         # device state so the engine survives for subsequent requests — but

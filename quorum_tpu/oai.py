@@ -236,6 +236,15 @@ def validate_request_body(body: dict[str, Any]) -> str | None:
     stop = body.get("stop")
     if stop is not None and not isinstance(stop, (str, list)):
         return f"Invalid value for 'stop': {stop!r}"
+    # Per-request deadline override (seconds) — replaces settings.timeout
+    # for this request's whole life, engine deadline and HTTP hops alike
+    # (docs/robustness.md). Consumed by the server, never forwarded.
+    t = body.get("timeout")
+    if t is not None:
+        if isinstance(t, bool) or not isinstance(t, (int, float)):
+            return f"Invalid value for 'timeout': {t!r} (seconds, a number)"
+        if not math.isfinite(float(t)) or float(t) <= 0:
+            return f"Invalid value for 'timeout': {t!r} (must be > 0)"
     if "messages" in body and not isinstance(body["messages"], list):
         return "Invalid value for 'messages': must be an array"
     return None

@@ -165,7 +165,10 @@ class Histogram:
 
 
 class Counter:
-    """One Prometheus counter: thread-safe monotonic ``inc`` plus exposition.
+    """One Prometheus counter family: thread-safe monotonic ``inc`` plus
+    exposition. ``inc`` accepts labels (``inc(stage="queue")``) — each
+    distinct label set is its own series under the family's one ``# TYPE``
+    line; label-less families expose a single bare sample.
 
     Process-wide like the registry's other families — engines sharing the
     process accumulate into one series (the per-engine breakdown lives in
@@ -175,23 +178,35 @@ class Counter:
         self.name = name
         self.help = help_text
         self._lock = threading.Lock()
-        self._value = 0.0
+        self._series: dict[tuple[tuple[str, str], ...], float] = {}
 
-    def inc(self, amount: float = 1.0) -> None:
+    def inc(self, amount: float = 1.0, **labels: str) -> None:
         if amount < 0:
             raise ValueError(f"counter {self.name} cannot decrease")
+        key = tuple(sorted((k, str(v)) for k, v in labels.items()))
         with self._lock:
-            self._value += float(amount)
+            self._series[key] = self._series.get(key, 0.0) + float(amount)
 
     @property
     def value(self) -> float:
+        """Total across every labeled series (the label-less reading)."""
         with self._lock:
-            return self._value
+            return sum(self._series.values())
+
+    def value_of(self, **labels: str) -> float:
+        key = tuple(sorted((k, str(v)) for k, v in labels.items()))
+        with self._lock:
+            return self._series.get(key, 0.0)
 
     def expose(self) -> list[str]:
-        return [f"# HELP {self.name} {self.help}",
-                f"# TYPE {self.name} counter",
-                f"{self.name} {_fmt_float(self.value)}"]
+        lines = [f"# HELP {self.name} {self.help}",
+                 f"# TYPE {self.name} counter"]
+        with self._lock:
+            snap = dict(self._series) or {(): 0.0}
+        for key in sorted(snap):
+            lines.append(f"{self.name}{_fmt_labels(key)} "
+                         f"{_fmt_float(snap[key])}")
+        return lines
 
 
 class Gauge:
@@ -276,7 +291,7 @@ class MetricsRegistry:
                 g.set(0.0)
             for c in self._counters.values():
                 with c._lock:
-                    c._value = 0.0
+                    c._series.clear()
 
 
 METRICS = MetricsRegistry()
@@ -338,6 +353,20 @@ PREFIX_STORE_RESTORE = METRICS.histogram(
     "(transfer + cache write, blocking on the scheduler thread).",
     buckets=(0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
              1.0, 2.5, 5.0, 10.0))
+
+# Fault-contained serving (docs/robustness.md): request deadlines, HTTP
+# backend retry, and the engine failure breaker. Per-engine breakdowns
+# (rebuilds_total, breaker_state, deadline_exceeded_total) live in the
+# quorum_tpu_engine_* block each engine's metrics() feeds.
+DEADLINE_EXCEEDED = METRICS.counter(
+    "quorum_tpu_deadline_exceeded_total",
+    "Requests that ran past their deadline, by stage: queue = shed before "
+    "admission (503 + Retry-After), prefill/decode = cancelled after "
+    "admission (504), backend = an HTTP/device hop outlived its wait.")
+BACKEND_RETRIES = METRICS.counter(
+    "quorum_tpu_backend_retries_total",
+    "HTTP backend attempts retried after a connect error or 5xx "
+    "(opt-in per-backend retries= config knob), by backend.")
 
 
 # ---- request-scoped tracing ------------------------------------------------

@@ -197,9 +197,73 @@ def create_app(
             app.state["config"], app.state["registry"] = rt.cfg, rt.reg
         return rt.cfg, rt.reg
 
+    def _distinct_engines(reg: BackendRegistry, need: str):
+        """(backend name, engine) per DISTINCT engine exposing ``need`` —
+        backends sharing a cached engine must not double-count it. The one
+        iteration /metrics and /health both build on (HTTP relay backends
+        hold no local state and contribute nothing)."""
+        seen: set[int] = set()
+        for backend in reg.backends:
+            engine = getattr(backend, "engine", None)
+            if engine is None or not hasattr(engine, need):
+                continue
+            if id(engine) in seen:
+                continue
+            seen.add(id(engine))
+            yield backend.name, engine
+
+    def _engine_health() -> tuple[str, list[dict]]:
+        """Aggregate health from real signals (docs/robustness.md): one
+        check row per distinct tpu:// engine — scheduler / snapshot-worker
+        thread liveness, breaker state, queue depth vs capacity.
+        ``unhealthy``: a serving thread is dead (only a restart recovers).
+        ``degraded``: the failure breaker is open/half-open or the
+        admission queue is saturated — alive, but shedding."""
+        checks: list[dict] = []
+        for name, engine in _distinct_engines(rt.reg, "health"):
+            row = engine.health()
+            row["backend"] = name
+            checks.append(row)
+        status = "healthy"
+        for row in checks:
+            if (not row["scheduler_alive"]
+                    or not row["snapshot_worker_alive"]):
+                return "unhealthy", checks
+            if (row["breaker"] != "closed"
+                    or row["pending"] >= row["queue_limit"]):
+                status = "degraded"
+        return status, checks
+
     @app.route("GET", "/health", "/v1/health")
     async def health(request: Request) -> Response:
-        return JSONResponse({"status": "healthy"})
+        """Truthful liveness: ``healthy`` / ``degraded`` (200 — the process
+        still serves, possibly shedding) / ``unhealthy`` (503 — rotate it
+        out). With no engine-backed backends the body stays the reference's
+        exact ``{"status": "healthy"}``."""
+        await current()
+        status, checks = _engine_health()
+        body: dict = {"status": status}
+        if checks:
+            body["checks"] = checks
+        if status == "unhealthy":
+            return JSONResponse(body, status_code=503,
+                                headers={"Retry-After": "5"})
+        return JSONResponse(body)
+
+    @app.route("GET", "/ready", "/v1/ready")
+    async def ready(request: Request) -> Response:
+        """Readiness: 200 only while NEW work would be admitted — a dead
+        serving thread, an open/half-open breaker, or a saturated queue all
+        503 so load balancers stop routing here before clients eat the
+        rejections."""
+        await current()
+        status, checks = _engine_health()
+        if status == "healthy":
+            return JSONResponse({"status": "ready"})
+        return JSONResponse(
+            {"status": "unready", "reason": status,
+             **({"checks": checks} if checks else {})},
+            status_code=503, headers={"Retry-After": "5"})
 
     started = time.monotonic()
 
@@ -235,21 +299,13 @@ def create_app(
         ]
         gauges = ("slots", "members", "busy_slots", "admitting", "pending",
                   "queue_limit", "decode_pipeline", "inflight_chunks",
-                  "prefix_store_bytes", "prefix_store_entries")
-        # One snapshot per distinct engine: backends sharing one cached
-        # engine (get_engine) must not double-count its load. Each family's
-        # TYPE line appears exactly once, with all its samples grouped —
-        # the Prometheus text format rejects repeated TYPE lines.
-        seen: set[int] = set()
-        snapshots: list[tuple[str, dict]] = []
-        for backend in reg.backends:
-            engine = getattr(backend, "engine", None)
-            if engine is None or not hasattr(engine, "metrics"):
-                continue
-            if id(engine) in seen:
-                continue
-            seen.add(id(engine))
-            snapshots.append((backend.name, engine.metrics()))
+                  "prefix_store_bytes", "prefix_store_entries",
+                  "breaker_state")
+        # One snapshot per distinct engine (_distinct_engines). Each
+        # family's TYPE line appears exactly once, with all its samples
+        # grouped — the Prometheus text format rejects repeated TYPE lines.
+        snapshots = [(name, engine.metrics())
+                     for name, engine in _distinct_engines(reg, "metrics")]
         if snapshots:
             for key in snapshots[0][1]:
                 kind = "gauge" if key in gauges else "counter"
@@ -379,7 +435,14 @@ def create_app(
 
         is_streaming = bool(body.get("stream", False))
         is_parallel = cfg.parallel_enabled(len(reg))
-        timeout = cfg.timeout
+        # Per-request deadline override (validated above): a client that
+        # knows its own budget caps the whole request — engine deadline AND
+        # every HTTP backend hop inherit it; the knob is consumed here, not
+        # forwarded (upstreams would reject an unknown field). ``deadline``
+        # anchors the budget so SEQUENTIAL hops (fan-out then aggregator)
+        # split one allowance instead of each getting a fresh full one.
+        timeout = float(body.pop("timeout", None) or cfg.timeout)
+        deadline = time.monotonic() + timeout
 
         # Resolve the actual fan-out targets first: in aggregate strategy only
         # the configured source_backends are called (fix of quirk 4), and both
@@ -442,13 +505,14 @@ def create_app(
             def relayable(o):
                 return o.error is not None and (
                     400 <= o.error.status_code < 500
-                    or o.error.status_code == 503
+                    or o.error.status_code in (503, 504)
                 )
 
             if all(relayable(o) for o in outcomes):
                 first_err = outcomes[0].error
                 return JSONResponse(first_err.body,
-                                    status_code=first_err.status_code)
+                                    status_code=first_err.status_code,
+                                    headers=first_err.headers)
             return JSONResponse(
                 {
                     "error": {
@@ -462,7 +526,12 @@ def create_app(
         if is_parallel:
             with trace.span("aggregate", strategy=cfg.strategy_name):
                 combined = await combine_outcomes(
-                    cfg, reg, outcomes, body, headers, aggregator_timeout=timeout
+                    cfg, reg, outcomes, body, headers,
+                    # The aggregator hop runs AFTER the fan-out: it gets the
+                    # remaining budget, not a second full one, so the
+                    # request's declared deadline bounds the whole chain.
+                    aggregator_timeout=max(
+                        0.001, deadline - time.monotonic()),
                 )
             return JSONResponse(combined)
 
@@ -477,15 +546,19 @@ def create_app(
 
     def _relay_backend_error(e: BackendError) -> Response:
         """Typed client errors keep their body verbatim; everything else
-        normalizes to proxy_error (the chat error contract — docs/api.md)."""
+        normalizes to proxy_error (the chat error contract — docs/api.md).
+        Either way the error's response headers ride along — 503/504s carry
+        Retry-After (docs/robustness.md)."""
         err = e.body.get("error")
         if isinstance(err, dict) and err.get("type") not in (None, "proxy_error"):
-            return JSONResponse(e.body, status_code=e.status_code)
+            return JSONResponse(e.body, status_code=e.status_code,
+                                headers=e.headers)
         msg = err.get("message", str(e)) if isinstance(err, dict) else str(e)
         return JSONResponse(
             {"error": {"message": f"Backend failed: {msg}",
                        "type": "proxy_error"}},
             status_code=e.status_code,
+            headers=e.headers,
         )
 
     async def _single_backend_request(

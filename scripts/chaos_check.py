@@ -1,0 +1,308 @@
+"""Chaos harness: inject faults at every named site and assert containment.
+
+``make chaos-check`` runs the full sweep on a tiny CPU engine behind the real
+ASGI app (no network, httpx ASGITransport). For each injection site
+(quorum_tpu/faults.py) it drives concurrent load, arms the fault, and
+asserts the containment contract of docs/robustness.md:
+
+  - only the affected request(s) error; a co-batched or queued bystander
+    either completes or is requeued and completes;
+  - the immediately following request succeeds (the engine rebuilt);
+  - deadline-exceeded requests get their timeout response within
+    deadline + slack and release their slots;
+  - a failure storm opens the engine breaker (503 + Retry-After) and
+    /health reports it; a cooldown probe closes it again;
+  - with faults disarmed, greedy AND sampled outputs are pinned
+    token-for-token against the pre-chaos baseline (fault machinery is
+    inert when disarmed);
+  - the HTTP backend retry ladder recovers from transient connect
+    errors / 5xx within its budget.
+
+Exit codes: 0 = all checks passed, 1 = at least one failed, 2 = the harness
+itself hung (watchdog). ``tests/test_robustness.py`` runs the quick subset
+as a suite smoke; the full sweep is wired into ``make chaos-check``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+os.environ.setdefault("QUORUM_TPU_COMPILE_CACHE", "0")
+
+SCRIPT_TIMEOUT_S = 600.0   # watchdog over the whole sweep
+DEADLINE_SLACK_S = 2.0     # acceptance: timeout response within deadline + 2s
+
+_CHECKS: list[tuple[str, bool, str]] = []
+
+
+def check(name: str, ok: bool, detail: str = "") -> None:
+    _CHECKS.append((name, bool(ok), detail))
+    print(f"  [{'ok' if ok else 'FAIL'}] {name}" + (f" — {detail}" if detail and not ok else ""), flush=True)
+
+
+def _config() -> dict:
+    return {
+        "settings": {"timeout": 30},
+        "primary_backends": [{
+            "name": "T",
+            # prefill_chunk=32: the templated short prompt (~19 tokens)
+            # single-shot admits (the engine.admit site), the 30-word one
+            # (~170 tokens) rides chunked prefill (engine.prefill_segment).
+            # d_model=128 keeps warm decode measurably slow (~tens of ms
+            # per token on CPU) so the deadline scenarios actually catch
+            # requests mid-flight instead of racing a finished generation.
+            "url": ("tpu://llama-tiny?d_model=128&max_seq=256"
+                    "&slots=2&queue=8&decode_chunk=4"
+                    "&prefill_chunk=32&prefix_store=host"
+                    "&prefix_store_chunk=32&max_tokens=8"),
+            "model": "chaos",
+        }],
+    }
+
+
+async def _run(quick: bool) -> None:
+    import httpx
+
+    from quorum_tpu import faults
+    from quorum_tpu.config import Config
+    from quorum_tpu.server.app import create_app
+
+    app = create_app(Config(raw=_config()), watch_config=False)
+    backend = app.state["registry"].get("T")
+    engine = backend.engine
+    transport = httpx.ASGITransport(app=app)
+    auth = {"Authorization": "Bearer chaos"}
+
+    async with httpx.AsyncClient(transport=transport,
+                                 base_url="http://chaos") as client:
+
+        # The long-running deadline scenarios must actually run long: a
+        # random-init model's greedy stream can sample EOS at any step, so
+        # they bias it out (an ordinary OpenAI logit_bias knob).
+        no_eos = {str(backend.tokenizer.eos_id): -100}
+
+        async def chat(content: str = "hello", *, max_tokens: int = 8,
+                       temperature: float = 0.0, seed: int = 0,
+                       timeout: float | None = None,
+                       ban_eos: bool = False) -> httpx.Response:
+            body: dict = {
+                "model": "chaos", "max_tokens": max_tokens,
+                "temperature": temperature, "seed": seed,
+                "messages": [{"role": "user", "content": content}],
+            }
+            if timeout is not None:
+                body["timeout"] = timeout
+            if ban_eos:
+                body["logit_bias"] = no_eos
+            return await client.post("/v1/chat/completions", json=body,
+                                     headers=auth)
+
+        def text(r: httpx.Response) -> str:
+            return r.json()["choices"][0]["message"]["content"]
+
+        # ---- phase 0: baseline (compiles programs, pins outputs) ---------
+        print("phase 0: baseline", flush=True)
+        greedy0 = text(await chat(seed=1))
+        sampled0 = text(await chat(temperature=0.9, seed=7))
+        check("baseline greedy nonempty", isinstance(greedy0, str))
+        # Warm every decode history bucket (one full-budget generation):
+        # first-use XLA compiles block the scheduler for seconds, and the
+        # deadline phases below assert ~sub-second sweep latencies.
+        await chat("warmup", max_tokens=235, ban_eos=True)
+
+        # ---- phase 1: one fault per engine site under concurrent load ----
+        long_prompt = "word " * 30  # > prefill_chunk tokens: chunked path
+        sites = [("engine.admit", "hi"),
+                 ("engine.prefill_segment", long_prompt),
+                 ("engine.decode", "hi")]
+        if quick:
+            sites = sites[:1]
+        for site, prompt in sites:
+            print(f"phase 1: inject {site}", flush=True)
+            faults.reset_counts()
+            faults.arm(site, times=1)
+            burst = await asyncio.gather(
+                *(chat(prompt if i == 0 else "bystander", seed=i)
+                  for i in range(4)))
+            faults.disarm()
+            statuses = [r.status_code for r in burst]
+            check(f"{site}: fault fired", faults.fired(site) >= 1)
+            check(f"{site}: at least one request failed",
+                  any(s >= 500 for s in statuses), f"statuses={statuses}")
+            check(f"{site}: not every request failed (bounded blast radius)",
+                  any(s == 200 for s in statuses), f"statuses={statuses}")
+            follow = await chat(seed=1)
+            check(f"{site}: next request succeeds",
+                  follow.status_code == 200 and text(follow) == greedy0,
+                  f"status={follow.status_code}")
+
+        # snapshot worker: a fault there may cost one snapshot, never a
+        # request or the worker thread.
+        print("phase 1: inject engine.snapshot", flush=True)
+        faults.arm("engine.snapshot", times=1)
+        r = await chat("snapshot me " * 8, seed=3)
+        engine.drain_prefix_store()
+        faults.disarm()
+        check("engine.snapshot: request unaffected", r.status_code == 200)
+        check("engine.snapshot: worker survives",
+              engine.health()["snapshot_worker_alive"])
+
+        # ---- phase 2: deadlines ------------------------------------------
+        # Latency injection (faults delay mode) makes each decode dispatch
+        # stall 50ms: generation speed becomes a harness constant instead
+        # of a property of the box, so the deadline windows are exact.
+        print("phase 2: deadlines", flush=True)
+        faults.arm("engine.decode", times=100000, delay=0.05)
+        try:
+            # Queue-stage shed: both slots blocked by slow generations
+            # (~48 tokens x 12.5ms/token), the late request's 0.3s deadline
+            # expires while it is still pending.
+            blockers = [asyncio.create_task(
+                chat("blocker", max_tokens=48, seed=10 + i, ban_eos=True))
+                for i in range(2)]
+            await asyncio.sleep(0.1)
+            t0 = time.monotonic()
+            shed = await chat("late", timeout=0.3, max_tokens=4)
+            waited = time.monotonic() - t0
+            await asyncio.gather(*blockers)
+            check("deadline(queue): shed with 503",
+                  shed.status_code == 503, f"status={shed.status_code}")
+            check("deadline(queue): Retry-After present",
+                  "retry-after" in {k.lower() for k in shed.headers})
+            check("deadline(queue): answered within deadline + slack",
+                  waited <= 0.3 + DEADLINE_SLACK_S, f"waited={waited:.2f}s")
+            if not quick:
+                # Decode-stage: admitted, then cancelled mid-generation ->
+                # 504, and the slot is free for the follow-up.
+                t0 = time.monotonic()
+                late = await chat("slow", timeout=0.3, max_tokens=100,
+                                  ban_eos=True)
+                waited = time.monotonic() - t0
+                check("deadline(decode): 504", late.status_code == 504,
+                      f"status={late.status_code}")
+                check("deadline(decode): within deadline + slack",
+                      waited <= 0.3 + DEADLINE_SLACK_S,
+                      f"waited={waited:.2f}s")
+        finally:
+            faults.disarm("engine.decode")
+        if not quick:
+            follow = await chat(seed=1)
+            check("deadline(decode): slot released, next request ok",
+                  follow.status_code == 200 and text(follow) == greedy0)
+
+        # ---- phase 3: breaker under a failure storm ----------------------
+        print("phase 3: breaker", flush=True)
+        engine.breaker.threshold = 2
+        engine.breaker.window = 60.0
+        engine.breaker.cooldown = 0.5
+        for i in range(2):
+            faults.arm("engine.decode", times=1)
+            await chat("poison", seed=20 + i)
+            faults.disarm()
+        check("breaker: open after failure storm",
+              engine.breaker.state == "open", engine.breaker.state)
+        rejected = await chat("during-open")
+        check("breaker: rejects with 503", rejected.status_code == 503,
+              f"status={rejected.status_code}")
+        check("breaker: 503 carries Retry-After",
+              "retry-after" in {k.lower() for k in rejected.headers})
+        health = (await client.get("/health")).json()
+        check("health: degraded while breaker open",
+              health["status"] == "degraded", health["status"])
+        ready = await client.get("/ready")
+        check("ready: 503 while breaker open", ready.status_code == 503)
+        await asyncio.sleep(0.6)
+        probe = await chat(seed=1)
+        check("breaker: cooldown probe succeeds and closes it",
+              probe.status_code == 200 and engine.breaker.state == "closed",
+              f"status={probe.status_code} state={engine.breaker.state}")
+        health = (await client.get("/health")).json()
+        check("health: healthy after recovery",
+              health["status"] == "healthy", health["status"])
+
+        # ---- phase 4: fault-free path is untouched -----------------------
+        print("phase 4: disarmed pinning", flush=True)
+        faults.disarm()
+        check("no site left armed", not faults.armed())
+        greedy1 = text(await chat(seed=1))
+        sampled1 = text(await chat(temperature=0.9, seed=7))
+        check("greedy output pinned across chaos", greedy1 == greedy0)
+        check("sampled output pinned across chaos", sampled1 == sampled0)
+
+        # ---- phase 5: HTTP backend retry ladder --------------------------
+        print("phase 5: http retry", flush=True)
+        from quorum_tpu.backends.http_backend import HttpBackend
+        from quorum_tpu.observability import BACKEND_RETRIES
+
+        calls = {"n": 0}
+
+        def flaky(req: httpx.Request) -> httpx.Response:
+            calls["n"] += 1
+            if calls["n"] <= 2:
+                return httpx.Response(500, json={"error": {
+                    "message": "transient", "type": "server_error"}})
+            return httpx.Response(200, json={
+                "choices": [{"message": {"role": "assistant",
+                                         "content": "ok"}}]})
+
+        hb = HttpBackend(
+            "flaky", "http://upstream.test/v1", "m", retries=3,
+            client=httpx.AsyncClient(transport=httpx.MockTransport(flaky)))
+        before = BACKEND_RETRIES.value_of(backend="flaky")
+        result = await hb.complete({"messages": []}, auth, 10.0)
+        check("http retry: transient 5xx recovered",
+              result.status_code == 200 and calls["n"] == 3,
+              f"status={result.status_code} calls={calls['n']}")
+        check("http retry: backend_retries_total advanced",
+              BACKEND_RETRIES.value_of(backend="flaky") == before + 2)
+        # Injected connect-level fault at the http.request site retries too.
+        faults.arm("http.request", times=1)
+        result = await hb.complete({"messages": []}, auth, 10.0)
+        faults.disarm()
+        check("http retry: injected transport fault recovered",
+              result.status_code == 200)
+        await hb.aclose()
+
+    from quorum_tpu.engine.engine import shutdown_all_engines
+
+    shutdown_all_engines()
+
+
+def run(quick: bool = False) -> dict:
+    """Entry point shared with the tests/test_robustness.py smoke: run the
+    sweep, return {"passed": n, "failed": n, "failures": [names]}."""
+    _CHECKS.clear()
+    asyncio.run(asyncio.wait_for(_run(quick), SCRIPT_TIMEOUT_S))
+    failures = [name for name, ok, _ in _CHECKS if not ok]
+    return {"passed": sum(1 for _, ok, _ in _CHECKS if ok),
+            "failed": len(failures), "failures": failures}
+
+
+def main() -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--quick", action="store_true",
+                   help="reduced sweep (one site, queue deadline only)")
+    args = p.parse_args()
+    t0 = time.time()
+    try:
+        out = run(quick=args.quick)
+    except asyncio.TimeoutError:
+        print(json.dumps({"error": "chaos sweep hung past watchdog"}),
+              flush=True)
+        return 2
+    out["seconds"] = round(time.time() - t0, 1)
+    print(json.dumps(out), flush=True)
+    return 0 if out["failed"] == 0 else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

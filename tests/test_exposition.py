@@ -170,6 +170,15 @@ async def test_live_metrics_exposition_validates():
     assert ("# TYPE quorum_tpu_engine_prefix_store_hits_total counter"
             ) in text
 
+    # robustness families (docs/robustness.md): deadline sheds by stage,
+    # HTTP retry attempts, and the per-engine rebuild/breaker block
+    assert "# TYPE quorum_tpu_deadline_exceeded_total counter" in text
+    assert "# TYPE quorum_tpu_backend_retries_total counter" in text
+    assert "# TYPE quorum_tpu_engine_rebuilds_total counter" in text
+    assert ("# TYPE quorum_tpu_engine_deadline_exceeded_total counter"
+            in text)
+    assert "# TYPE quorum_tpu_engine_breaker_state gauge" in text
+
     # _count == +Inf bucket and bucket monotonicity for one family, by hand
     # (belt to the validator's braces)
     inf = count = None

@@ -56,10 +56,16 @@ def test_document_paths_match_served_routes():
     and "/v1" servers — app.py registers both prefixes)."""
     assert set(DOC["paths"]) == {
         "/chat/completions", "/completions", "/embeddings", "/health",
-        "/models", "/metrics", "/debug/traces", "/debug/traces/{request_id}"}
+        "/ready", "/models", "/metrics", "/debug/traces",
+        "/debug/traces/{request_id}"}
     assert [s["url"] for s in DOC["servers"]] == ["/", "/v1"]
     post = DOC["paths"]["/chat/completions"]["post"]
-    assert set(post["responses"]) == {"200", "400", "401", "500", "503"}
+    assert set(post["responses"]) == {
+        "200", "400", "401", "500", "503", "504"}
+    # The 503/504 shapes carry Retry-After (docs/robustness.md).
+    for ref, resp in (("Overloaded", "503"), ("GatewayTimeout", "504")):
+        assert post["responses"][resp]["$ref"].endswith(ref)
+        assert "Retry-After" in DOC["components"]["responses"][ref]["headers"]
     # Streaming and JSON bodies both documented on the 200.
     assert set(post["responses"]["200"]["content"]) == {
         "application/json", "text/event-stream"}
@@ -163,6 +169,8 @@ async def test_live_aux_endpoints_conform():
     async with make_client(single_backend_config()) as client:
         health = await client.get("/health")
         check("HealthResponse", health.json())
+        ready = await client.get("/ready")
+        check("ReadyResponse", ready.json())
         models = await client.get("/v1/models")
         check("ModelList", models.json())
         metrics = await client.get("/metrics")

@@ -1,0 +1,543 @@
+"""Fault-contained serving (docs/robustness.md): the fault-injection
+registry, request deadlines, engine failure containment + breaker,
+truthful health, Retry-After contracts, and the HTTP retry ladder.
+
+Fast tier: registry/breaker/retry/config units plus app-level health and
+contract checks over a tiny llama engine (the test_metrics pattern).
+Engine-heavy scenarios (dispatch-failure containment, deadline sweeps,
+mid-stream disconnect, the chaos smoke) are slow-tier."""
+
+import asyncio
+import threading
+import time
+
+import httpx
+import pytest
+
+from quorum_tpu import faults
+from tests.conftest import make_client
+
+AUTH = {"Authorization": "Bearer t"}
+
+
+def teardown_function(_fn):
+    faults.disarm()  # no test may leak an armed site into the next
+
+
+# ---- fault registry (no jax, no server) ------------------------------------
+
+
+def test_faults_arm_fire_autodisarm():
+    faults.reset_counts()
+    assert faults.fire is faults._noop  # disarmed = literal no-op binding
+    faults.arm("engine.decode", times=2)
+    assert faults.armed("engine.decode")
+    for _ in range(2):
+        with pytest.raises(faults.FaultInjected) as ei:
+            faults.fire("engine.decode")
+        assert ei.value.site == "engine.decode"
+    # auto-disarmed after `times` fires; the binding reverts to the no-op
+    faults.fire("engine.decode")
+    assert not faults.armed()
+    assert faults.fire is faults._noop
+    assert faults.fired("engine.decode") == 2
+
+
+def test_faults_reject_unknown_site_and_bad_times():
+    with pytest.raises(ValueError):
+        faults.arm("engine.nonexistent")
+    with pytest.raises(ValueError):
+        faults.arm("engine.decode", times=0)
+
+
+def test_faults_delay_mode_sleeps_instead_of_raising():
+    faults.arm("engine.decode", times=1, delay=0.05)
+    t0 = time.perf_counter()
+    faults.fire("engine.decode")  # must NOT raise
+    assert time.perf_counter() - t0 >= 0.04
+    assert not faults.armed()
+
+
+def test_faults_custom_exception():
+    faults.arm("http.request", exc=lambda site: RuntimeError(site))
+    with pytest.raises(RuntimeError):
+        faults.fire("http.request")
+
+
+# ---- breaker unit ----------------------------------------------------------
+
+
+def test_breaker_opens_after_threshold_and_probes():
+    from quorum_tpu.engine.engine import _Breaker
+
+    b = _Breaker(threshold=2, window=10.0, cooldown=1.0)
+    assert b.state == "closed" and b.allow(now=0.0)
+    b.record_failure(now=0.0)
+    assert b.state == "closed" and b.allow(now=0.1)
+    b.record_failure(now=0.2)
+    assert b.state == "open"
+    assert not b.allow(now=0.5)
+    assert b.retry_after(now=0.5) == pytest.approx(0.7)
+    # cooldown elapsed: exactly one probe per cooldown interval
+    assert b.allow(now=1.5)
+    assert b.state == "half_open"
+    assert not b.allow(now=1.6)        # probe outstanding
+    assert b.allow(now=2.6)            # probe stamp expired: a new probe
+    b.record_success()
+    assert b.state == "closed" and b.allow(now=2.7)
+
+
+def test_breaker_failure_while_half_open_reopens():
+    from quorum_tpu.engine.engine import _Breaker
+
+    b = _Breaker(threshold=1, window=10.0, cooldown=1.0)
+    b.record_failure(now=0.0)
+    assert b.state == "open"
+    assert b.allow(now=1.5)            # half-open probe
+    b.record_failure(now=1.6)          # probe's admission failed
+    assert b.state == "open"
+    assert not b.allow(now=1.7)
+
+
+def test_breaker_window_prunes_stale_failures():
+    from quorum_tpu.engine.engine import _Breaker
+
+    b = _Breaker(threshold=2, window=1.0, cooldown=1.0)
+    b.record_failure(now=0.0)
+    b.record_failure(now=5.0)          # first failure long out of window
+    assert b.state == "closed"
+
+
+# ---- HTTP retry ladder -----------------------------------------------------
+
+
+def _flaky_backend(fails: int, *, status: int = 500, retries: int,
+                   exc: Exception | None = None):
+    from quorum_tpu.backends.http_backend import HttpBackend
+
+    calls = {"n": 0}
+
+    def handler(req: httpx.Request) -> httpx.Response:
+        calls["n"] += 1
+        if calls["n"] <= fails:
+            if exc is not None:
+                raise exc
+            return httpx.Response(status, json={"error": {
+                "message": "transient", "type": "server_error"}})
+        return httpx.Response(200, json={
+            "choices": [{"message": {"role": "assistant", "content": "ok"}}]})
+
+    hb = HttpBackend(
+        "flaky", "http://u.test/v1", "m", retries=retries,
+        client=httpx.AsyncClient(transport=httpx.MockTransport(handler)))
+    return hb, calls
+
+
+async def test_http_retry_recovers_from_5xx():
+    from quorum_tpu.observability import BACKEND_RETRIES
+
+    hb, calls = _flaky_backend(2, retries=2)
+    before = BACKEND_RETRIES.value_of(backend="flaky")
+    result = await hb.complete({"messages": []}, AUTH, 10.0)
+    assert result.status_code == 200 and calls["n"] == 3
+    assert BACKEND_RETRIES.value_of(backend="flaky") == before + 2
+
+
+async def test_http_retry_recovers_from_connect_error():
+    hb, calls = _flaky_backend(
+        1, retries=1, exc=httpx.ConnectError("refused"))
+    result = await hb.complete({"messages": []}, AUTH, 10.0)
+    assert result.status_code == 200 and calls["n"] == 2
+
+
+async def test_http_retry_honors_upstream_retry_after():
+    """A 503 upstream that names its recovery window (Retry-After) is not
+    re-POSTed inside it — the header floors the backoff delay."""
+    from quorum_tpu.backends.http_backend import HttpBackend
+
+    calls = {"n": 0}
+
+    def handler(req: httpx.Request) -> httpx.Response:
+        calls["n"] += 1
+        if calls["n"] == 1:
+            return httpx.Response(
+                503, headers={"Retry-After": "0.3"},
+                json={"error": {"message": "shedding",
+                                "type": "overloaded_error"}})
+        return httpx.Response(200, json={
+            "choices": [{"message": {"role": "assistant", "content": "ok"}}]})
+
+    hb = HttpBackend(
+        "polite", "http://u.test/v1", "m", retries=2,
+        client=httpx.AsyncClient(transport=httpx.MockTransport(handler)))
+    t0 = time.perf_counter()
+    result = await hb.complete({"messages": []}, AUTH, 10.0)
+    assert result.status_code == 200 and calls["n"] == 2
+    assert time.perf_counter() - t0 >= 0.3  # waited out the upstream's ask
+
+
+async def test_http_no_retry_by_default():
+    hb, calls = _flaky_backend(1, retries=0)
+    result = await hb.complete({"messages": []}, AUTH, 10.0)
+    assert result.status_code == 500 and calls["n"] == 1
+
+
+async def test_http_retry_budget_exhausts_to_upstream_error():
+    hb, calls = _flaky_backend(99, retries=2)
+    result = await hb.complete({"messages": []}, AUTH, 10.0)
+    assert result.status_code == 500 and calls["n"] == 3
+
+
+async def test_http_retry_never_sleeps_past_deadline():
+    from quorum_tpu.backends.base import BackendError
+
+    hb, calls = _flaky_backend(
+        99, retries=50, exc=httpx.ConnectError("refused"))
+    t0 = time.perf_counter()
+    with pytest.raises(BackendError):
+        await hb.complete({"messages": []}, AUTH, 0.05)
+    assert time.perf_counter() - t0 < 2.0  # not 50 backoff sleeps
+
+
+async def test_http_stream_is_never_retried():
+    from quorum_tpu.backends.base import BackendError
+    from quorum_tpu.backends.http_backend import HttpBackend
+
+    calls = {"n": 0}
+
+    def handler(req):
+        calls["n"] += 1
+        raise httpx.ConnectError("refused")
+
+    hb = HttpBackend(
+        "s", "http://u.test/v1", "m", retries=3,
+        client=httpx.AsyncClient(transport=httpx.MockTransport(handler)))
+    with pytest.raises(BackendError):
+        async for _ in hb.stream({"messages": []}, AUTH, 5.0):
+            pass
+    assert calls["n"] == 1
+
+
+def test_config_parses_retries():
+    from quorum_tpu.config import BackendSpec
+
+    assert BackendSpec.from_dict({"name": "a", "url": "http://x"}).retries == 0
+    assert BackendSpec.from_dict(
+        {"name": "a", "url": "http://x", "retries": 3}).retries == 3
+    assert BackendSpec.from_dict(
+        {"name": "a", "url": "http://x", "retries": "junk"}).retries == 0
+    assert BackendSpec.from_dict(
+        {"name": "a", "url": "http://x", "retries": -2}).retries == 0
+
+
+# ---- request-level contracts ----------------------------------------------
+
+
+def test_timeout_body_knob_validation():
+    from quorum_tpu.oai import validate_request_body
+
+    ok = {"messages": [], "timeout": 1.5}
+    assert validate_request_body(ok) is None
+    for bad in (0, -1, "fast", True, float("inf")):
+        msg = validate_request_body({"messages": [], "timeout": bad})
+        assert msg is not None and "timeout" in msg
+
+
+def test_overload_errors_carry_retry_after():
+    from quorum_tpu.backends.tpu_backend import (
+        _breaker_open, _deadline_error, _overloaded, _timeout_error)
+    from quorum_tpu.engine.engine import DeadlineExceeded, EngineBreakerOpen
+
+    assert _overloaded("x").headers["Retry-After"] == "1"
+    assert _overloaded("x", retry_after=4.2).headers["Retry-After"] == "5"
+    e = _breaker_open("x", EngineBreakerOpen(3.0))
+    assert e.status_code == 503 and e.headers["Retry-After"] == "3"
+    shed = _deadline_error("x", DeadlineExceeded("queue"))
+    assert shed.status_code == 503 and "Retry-After" in shed.headers
+    late = _deadline_error("x", DeadlineExceeded("decode"))
+    assert late.status_code == 504
+    assert late.body["error"]["type"] == "timeout_error"
+    assert _timeout_error("x", 1.0).status_code == 504
+
+
+async def test_relayed_503_keeps_retry_after_header():
+    """The server relays a backend's typed 503 verbatim INCLUDING its
+    Retry-After header (the contract load balancers key on)."""
+    from quorum_tpu.backends.base import BackendError
+    from quorum_tpu.oai import error_body
+
+    class Overloaded:
+        name = "O"
+        model = "m"
+        requires_auth = False
+
+        async def complete(self, body, headers, timeout):
+            raise BackendError(
+                "overloaded", status_code=503,
+                body=error_body("overloaded", type_="overloaded_error",
+                                code=503),
+                headers={"Retry-After": "7"})
+
+        async def aclose(self):
+            return None
+
+    cfg = {"settings": {"timeout": 5},
+           "primary_backends": [{"name": "O", "url": "http://o.test/v1",
+                                 "model": "m"}]}
+    async with make_client(cfg, O=Overloaded()) as client:
+        r = await client.post(
+            "/v1/chat/completions",
+            json={"messages": [{"role": "user", "content": "hi"}]},
+            headers=AUTH)
+        assert r.status_code == 503
+        assert r.headers["Retry-After"] == "7"
+        assert r.json()["error"]["type"] == "overloaded_error"
+
+
+# ---- app-level health over a real tiny engine ------------------------------
+
+
+def _tpu_config(seed: int = 0):
+    return {
+        "settings": {"timeout": 30},
+        "primary_backends": [
+            {"name": "T",
+             "url": f"tpu://llama-tiny?seed={9200 + seed}&slots=2",
+             "model": "t"},
+        ],
+    }
+
+
+async def test_health_truthful_and_ready():
+    async with make_client(_tpu_config(0)) as client:
+        engine = None
+        r = await client.get("/health")
+        body = r.json()
+        assert r.status_code == 200 and body["status"] == "healthy"
+        row = body["checks"][0]
+        assert row["scheduler_alive"] and row["breaker"] == "closed"
+        assert (await client.get("/ready")).status_code == 200
+
+        # Reach the engine through the live registry to flip real signals.
+        from quorum_tpu.server.app import create_app  # noqa: F401
+        transport = client._transport
+        engine = transport.app.state["registry"].get("T").engine
+        for _ in range(3):
+            engine.breaker.record_failure()
+        assert engine.breaker.state == "open"
+        body = (await client.get("/health")).json()
+        assert body["status"] == "degraded"
+        ready = await client.get("/ready")
+        assert ready.status_code == 503
+        assert ready.json()["reason"] == "degraded"
+        assert "retry-after" in {k.lower() for k in ready.headers}
+        engine.breaker.record_success()
+        assert (await client.get("/health")).json()["status"] == "healthy"
+
+
+async def test_health_unhealthy_when_scheduler_dead():
+    async with make_client(_tpu_config(1)) as client:
+        engine = client._transport.app.state["registry"].get("T").engine
+        engine.shutdown()
+        r = await client.get("/health")
+        assert r.status_code == 503
+        assert r.json()["status"] == "unhealthy"
+        assert (await client.get("/ready")).status_code == 503
+
+
+async def test_metrics_expose_robustness_families():
+    async with make_client(_tpu_config(2)) as client:
+        text = (await client.get("/metrics")).text
+        assert "# TYPE quorum_tpu_engine_rebuilds_total counter" in text
+        assert ("# TYPE quorum_tpu_engine_deadline_exceeded_total counter"
+                in text)
+        assert "# TYPE quorum_tpu_engine_breaker_state gauge" in text
+        assert 'quorum_tpu_engine_breaker_state{backend="T"} 0' in text
+        assert "# TYPE quorum_tpu_deadline_exceeded_total counter" in text
+        assert "# TYPE quorum_tpu_backend_retries_total counter" in text
+
+
+# ---- engine-level containment & deadlines (slow tier) ----------------------
+
+
+def _engine(**kw):
+    from quorum_tpu.engine.engine import InferenceEngine
+    from quorum_tpu.models.model_config import MODEL_PRESETS
+
+    kw.setdefault("decode_chunk", 4)
+    kw.setdefault("n_slots", 2)
+    return InferenceEngine(MODEL_PRESETS["llama-tiny"], **kw)
+
+
+def _greedy(eng, prompt, n=6, **kw):
+    from quorum_tpu.ops.sampling import SamplerConfig
+
+    return eng.generate(prompt, max_new_tokens=n,
+                        sampler=SamplerConfig(temperature=0.0), **kw)
+
+
+@pytest.mark.slow
+def test_queued_request_survives_anothers_dispatch_failure():
+    """The _fail_all blast-radius regression: a decode-dispatch failure
+    dooms the admitted request but a never-dispatched pending request is
+    requeued — it completes with exactly the tokens of an undisturbed
+    run."""
+    from quorum_tpu.ops.sampling import SamplerConfig
+
+    eng = _engine(n_slots=1)
+    baseline = _greedy(eng, [7, 8, 9], n=6).token_ids
+    faults.arm("engine.decode", times=1)
+    victim = eng.submit([3, 4, 5], max_new_tokens=8,
+                        sampler=SamplerConfig(temperature=0.0))
+    survivor = eng.submit([7, 8, 9], max_new_tokens=6,
+                          sampler=SamplerConfig(temperature=0.0))
+    with pytest.raises(faults.FaultInjected):
+        list(eng.stream_results(victim))
+    out = list(eng.stream_results(survivor))
+    assert out == baseline
+    assert eng.n_rebuilds == 1
+    eng.shutdown()
+
+
+@pytest.mark.slow
+def test_admission_failure_spares_active_and_pending():
+    """A poisoned request's own admission dispatch (state intact) dooms
+    only that request: no rebuild, and the engine keeps serving."""
+    eng = _engine(n_slots=2)
+    baseline = _greedy(eng, [5, 6], n=5).token_ids
+    faults.arm("engine.admit", times=1)
+    with pytest.raises(faults.FaultInjected):
+        _greedy(eng, [1, 2, 3], n=4)
+    assert eng.n_rebuilds == 0  # contained without touching shared state
+    assert _greedy(eng, [5, 6], n=5).token_ids == baseline
+    assert eng.breaker.state == "closed"
+    eng.shutdown()
+
+
+@pytest.mark.slow
+def test_deadline_queue_shed_and_decode_cancel():
+    from quorum_tpu.engine.engine import DeadlineExceeded
+    from quorum_tpu.ops.sampling import SamplerConfig
+
+    eng = _engine(n_slots=1)
+    _greedy(eng, [1, 2], n=4)  # warm programs so sweep cadence is real
+    # Latency injection makes the blocker slow deterministically.
+    faults.arm("engine.decode", times=100000, delay=0.02)
+    try:
+        blocker = eng.submit([1, 2, 3], max_new_tokens=64,
+                             sampler=SamplerConfig(temperature=0.0))
+        late = eng.submit([9, 9], max_new_tokens=4,
+                          sampler=SamplerConfig(temperature=0.0),
+                          deadline=time.monotonic() + 0.15)
+        with pytest.raises(DeadlineExceeded) as ei:
+            list(eng.stream_results(late))
+        assert ei.value.stage == "queue"
+        blocker.cancel.set()
+        # Admitted request whose deadline passes mid-decode: stage decode,
+        # and the slot frees for the follow-up.
+        slow = eng.submit([4, 5, 6], max_new_tokens=64,
+                          sampler=SamplerConfig(temperature=0.0),
+                          deadline=time.monotonic() + 0.2)
+        with pytest.raises(DeadlineExceeded) as ei:
+            list(eng.stream_results(slow))
+        assert ei.value.stage in ("prefill", "decode")
+    finally:
+        faults.disarm()
+    assert len(_greedy(eng, [5, 5], n=3).token_ids) == 3  # slot released
+    assert eng.n_deadline_exceeded == 2
+    eng.shutdown()
+
+
+@pytest.mark.slow
+def test_expired_deadline_sheds_at_submit():
+    from quorum_tpu.engine.engine import DeadlineExceeded
+    from quorum_tpu.ops.sampling import SamplerConfig
+
+    eng = _engine()
+    with pytest.raises(DeadlineExceeded):
+        eng.submit([1, 2], max_new_tokens=4,
+                   sampler=SamplerConfig(temperature=0.0),
+                   deadline=time.monotonic() - 1.0)
+    eng.shutdown()
+
+
+@pytest.mark.slow
+async def test_client_disconnect_mid_sse_frees_slot():
+    """GeneratorExit during SSE (client gone) cancels the engine request
+    within one decode chunk: the slot frees and cancellations_total
+    counts it."""
+    async with make_client(_tpu_config(3)) as client:
+        backend = client._transport.app.state["registry"].get("T")
+        engine = backend.engine
+        body = {"model": "t", "stream": True, "max_tokens": 512,
+                "logit_bias": {str(backend.tokenizer.eos_id): -100},
+                "messages": [{"role": "user", "content": "go"}]}
+        cancelled_before = engine.n_cancelled
+        agen = backend.stream(body, AUTH, 30.0)
+        got = await agen.__anext__()           # role chunk: stream is live
+        assert got["choices"][0]["delta"].get("role") == "assistant"
+        while engine.metrics()["busy_slots"] == 0:
+            await asyncio.sleep(0.01)
+        await agen.aclose()                    # GeneratorExit into the gen
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            m = engine.metrics()
+            if (m["busy_slots"] == 0 and m["admitting"] == 0
+                    and m["cancellations_total"] > cancelled_before):
+                break
+            await asyncio.sleep(0.02)
+        m = engine.metrics()
+        assert m["busy_slots"] == 0 and m["admitting"] == 0
+        assert m["cancellations_total"] > cancelled_before
+
+
+@pytest.mark.slow
+def test_breaker_storm_opens_and_probe_recovers():
+    eng = _engine()
+    eng.breaker.threshold = 2
+    eng.breaker.cooldown = 0.3
+    baseline = _greedy(eng, [3, 4, 5], n=6).token_ids
+    for _ in range(2):
+        faults.arm("engine.decode", times=1)
+        with pytest.raises(Exception):
+            _greedy(eng, [6, 7], n=8)
+        faults.disarm()
+    assert eng.breaker.state == "open"
+    from quorum_tpu.engine.engine import EngineBreakerOpen
+    from quorum_tpu.ops.sampling import SamplerConfig
+
+    with pytest.raises(EngineBreakerOpen):
+        eng.submit([1, 1], max_new_tokens=2,
+                   sampler=SamplerConfig(temperature=0.0))
+    time.sleep(0.35)
+    assert _greedy(eng, [3, 4, 5], n=6).token_ids == baseline  # the probe
+    assert eng.breaker.state == "closed"
+    eng.shutdown()
+
+
+# ---- chaos harness smoke ---------------------------------------------------
+
+
+@pytest.mark.slow
+def test_chaos_check_quick_subset():
+    """The suite's smoke over the same entry point `make chaos-check`
+    runs (reduced sweep: one injection site, queue deadline, breaker,
+    pinning, http retry)."""
+    import importlib
+
+    mod = importlib.import_module("chaos_check")
+    out = mod.run(quick=True)
+    assert out["failed"] == 0, out["failures"]
+
+
+def _import_scripts_path():
+    import os
+    import sys
+
+    scripts = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "scripts")
+    if scripts not in sys.path:
+        sys.path.insert(0, scripts)
+
+
+_import_scripts_path()
