@@ -59,7 +59,9 @@ prefix-bench:
 # Promtool-style exposition lint (pure Python, no extra deps): spins the
 # app over a tiny tpu:// backend, pulls the FULL /metrics output, and
 # fails on malformed lines, duplicated TYPE lines, non-monotonic histogram
-# buckets, or _sum/_count inconsistencies. See docs/observability.md.
+# buckets, or _sum/_count inconsistencies — covering every family incl.
+# the constrained-decoding quorum_tpu_constrain_* set
+# (docs/structured_output.md). See docs/observability.md.
 metrics-check:
 	python -m pytest tests/test_exposition.py -x -q $(PYTEST_EXTRA)
 

@@ -111,6 +111,14 @@ URL grammar:  ``tpu://<model-id>?<spec overrides>&<engine options>``
 Contract parity with the dispatcher: configured model overrides the request
 model (oai_proxy.py:161-176 via prepare_body); responses are tagged with
 ``"backend"`` (:212); failures normalize to BackendError (:231-259).
+
+Structured output: ``response_format`` of type ``json_object`` /
+``json_schema`` / ``regex`` (extension) compiles to a token-level DFA
+(quorum_tpu/constrain/, cached per grammar+tokenizer) that the engine
+threads through the decode chunk ON DEVICE — guaranteed-valid output with
+zero extra host syncs at any decode_pipeline depth
+(docs/structured_output.md). Unsupported schemas are 400s; a grammar no
+token sequence can satisfy under this tokenizer is a 422 grammar_error.
 """
 
 from __future__ import annotations
@@ -136,6 +144,7 @@ from quorum_tpu.engine.engine import (
     DeadlineExceeded,
     EngineBreakerOpen,
     GenerationResult,
+    GrammarArenaFull,
     InferenceEngine,
     QueueFullError,
     get_engine,
@@ -289,6 +298,19 @@ def _timeout_error(name: str, timeout: float) -> BackendError:
         msg, status_code=504,
         body=oai.error_body(msg, type_="timeout_error", code=504),
         headers={"Retry-After": "1"},
+    )
+
+
+def _grammar_unsatisfiable(name: str, e: Exception) -> BackendError:
+    """422 grammar_error: the response_format grammar compiled but admits
+    no completion under this backend's tokenizer — every path dead-ends
+    before an accept state (e.g. a required character has no producing
+    token). Distinct from a 400: the request was well-formed; the
+    (grammar, tokenizer) pair cannot be served (docs/structured_output.md)."""
+    msg = (f"Backend {name} cannot satisfy response_format: {e}")
+    return BackendError(
+        msg, status_code=422,
+        body=oai.error_body(msg, type_="grammar_error", code=422),
     )
 
 
@@ -576,12 +598,7 @@ class TpuBackend:
                 raise _invalid_request(
                     f"{key!r} is not supported by tpu:// backends"
                 )
-        rf = body.get("response_format")
-        if isinstance(rf, dict) and rf.get("type") not in (None, "text"):
-            raise _invalid_request(
-                f"response_format type {rf.get('type')!r} is not supported "
-                "by tpu:// backends (only 'text')"
-            )
+        grammar = self._plan_grammar(body.get("response_format"))
         # Explicit JSON null means "unset" for every optional knob (OpenAI
         # SDKs serialize unset optionals as null).
         n = body.get("n")
@@ -655,7 +672,48 @@ class TpuBackend:
             "presence_penalty": pp,
             "frequency_penalty": fp,
             "logit_bias": self._bias_row(body.get("logit_bias")),
+            "grammar": grammar,
         }
+
+    def _plan_grammar(self, rf: Any):
+        """``response_format`` → a compiled token-DFA grammar (or None for
+        text). On-device constrained decoding, docs/structured_output.md:
+        json_object / json_schema / regex compile once per (grammar,
+        tokenizer) — cached — and the engine masks every sampled token by
+        the grammar's allow-set on device. Malformed or unsupported
+        grammars are 400s; a grammar no token sequence can satisfy under
+        this tokenizer is a 422 ``grammar_error`` (the dead-end path)."""
+        if rf is None:
+            return None
+        if not isinstance(rf, dict):
+            raise _invalid_request(
+                f"Invalid value for 'response_format': {rf!r}")
+        if rf.get("type") in (None, "text"):
+            return None
+        from quorum_tpu.constrain import (
+            GrammarError,
+            GrammarUnsatisfiable,
+            compile_response_format,
+        )
+
+        if self.engine.prefill_chunk <= 0:
+            raise _invalid_request(
+                "response_format constrained decoding requires chunked "
+                "prefill (prefill_chunk >= 16), which this backend's "
+                "engine disables (sp>1 or prefill_chunk=0)")
+        try:
+            grammar = compile_response_format(
+                rf, self.tokenizer, self.engine.spec.vocab_size)
+        except GrammarUnsatisfiable as e:
+            raise _grammar_unsatisfiable(self.name, e) from None
+        except GrammarError as e:
+            raise _invalid_request(
+                f"Invalid 'response_format': {e}") from None
+        if grammar is not None:
+            from quorum_tpu.observability import CONSTRAINED_REQUESTS
+
+            CONSTRAINED_REQUESTS.inc()
+        return grammar
 
     def _bias_row(self, logit_bias: Any):
         """OpenAI ``logit_bias`` ({token-id: -100..100}) → dense [V] f32 row."""
@@ -718,15 +776,25 @@ class TpuBackend:
             logprobs=plan["logprobs"],
             member=self.member,
             deadline=deadline,
+            grammar=plan["grammar"],
         )
 
     def _lp_entry(self, tid: int, record, top_n: int) -> dict[str, Any]:
-        """One OpenAI ``logprobs.content[]`` element from an engine record."""
+        """One OpenAI ``logprobs.content[]`` element from an engine record.
+
+        Non-finite alternatives are dropped: under constrained decoding
+        (docs/structured_output.md) the grammar masks disallowed tokens to
+        −inf BEFORE the log_softmax, so a state allowing fewer tokens than
+        ``top_n`` would otherwise surface ``-Infinity`` samples —
+        ``json.dumps`` renders those as the non-RFC-8259 ``-Infinity``
+        literal and strict clients reject the whole body. The sampled
+        token itself is always allowed (finite); the clamp is belt to
+        that invariant's braces."""
         def tok_obj(t, lp):
             text = self.tokenizer.decode([int(t)])
             return {
                 "token": text,
-                "logprob": float(lp),
+                "logprob": float(lp) if math.isfinite(float(lp)) else -9999.0,
                 "bytes": list(text.encode("utf-8")),
             }
 
@@ -735,6 +803,7 @@ class TpuBackend:
         entry["top_logprobs"] = [
             tok_obj(int(t), float(l))
             for t, l in zip(top_ids[:top_n], top_lps[:top_n])
+            if math.isfinite(float(l))
         ]
         return entry
 
@@ -860,6 +929,11 @@ class TpuBackend:
         except DeadlineExceeded as e:
             cancel_all()
             raise _deadline_error(self.name, e) from None
+        except GrammarArenaFull as e:
+            # Device grammar arena at capacity: retryable overload, not a
+            # server fault (docs/structured_output.md).
+            cancel_all()
+            raise _overloaded(self.name, str(e)) from None
         except BackendError:
             raise
         except Exception as e:
@@ -1215,6 +1289,9 @@ class TpuBackend:
             except DeadlineExceeded as e:
                 cancel_all()
                 raise _deadline_error(self.name, e) from None
+            except GrammarArenaFull as e:
+                cancel_all()
+                raise _overloaded(self.name, str(e)) from None
             except BackendError:
                 raise
             except Exception as e:
@@ -1445,6 +1522,8 @@ class TpuBackend:
                     else:
                         if isinstance(val, DeadlineExceeded):
                             raise _deadline_error(self.name, val) from val
+                        if isinstance(val, GrammarArenaFull):
+                            raise _overloaded(self.name, str(val)) from val
                         raise BackendError(
                             f"Backend {self.name} failed: {val}") from val
         except asyncio.TimeoutError:

@@ -1,0 +1,45 @@
+"""On-device constrained decoding: ``response_format`` grammars compiled to
+token-level DFA masks (docs/structured_output.md).
+
+Host half: :func:`compile_response_format` lowers JSON mode / a JSON Schema
+subset / a regex into a dense ``[n_states, vocab]`` token-transition table
+plus per-state accept flags, cached per (grammar, tokenizer). Device half:
+the engine uploads the tables and threads a per-row DFA state through every
+decode chunk — each sampled token is masked by its state's allow-set and
+advances the state on device, with zero host round-trips at any
+``decode_pipeline`` depth (quorum_tpu/engine/engine.py).
+"""
+
+from quorum_tpu.constrain.grammar import (
+    CompiledGrammar,
+    GrammarError,
+    GrammarUnsatisfiable,
+    clear_compile_cache,
+    compile_cache_info,
+    compile_response_format,
+    json_value_ast,
+    lift_to_tokens,
+    schema_ast,
+)
+from quorum_tpu.constrain.regex_dfa import (
+    ByteDFA,
+    compile_ast,
+    compile_pattern,
+    parse,
+)
+
+__all__ = [
+    "ByteDFA",
+    "CompiledGrammar",
+    "GrammarError",
+    "GrammarUnsatisfiable",
+    "clear_compile_cache",
+    "compile_ast",
+    "compile_cache_info",
+    "compile_pattern",
+    "compile_response_format",
+    "json_value_ast",
+    "lift_to_tokens",
+    "parse",
+    "schema_ast",
+]

@@ -49,6 +49,15 @@ concurrent requests):
     the longest match host→device at admission when it beats the
     slot-resident LCP — multi-turn conversations survive slot eviction
     under churn (docs/prefix_cache.md).
+  - **On-device constrained decoding**: a request with a compiled grammar
+    (``response_format`` JSON mode / JSON Schema / regex —
+    quorum_tpu/constrain/, docs/structured_output.md) threads a per-row
+    token-DFA state through every decode chunk: logits are masked by the
+    state's allow-set before sampling and the state advances on the
+    sampled token, all inside the chunk program — grammar-valid output
+    with zero extra host round-trips at any ``decode_pipeline`` depth.
+    Unconstrained batches compile and run the exact unconstrained program
+    variant (the logprobs-gating pattern).
   - **Quantized representations**: ``quant=int8`` stores weights int8 with
     per-channel scales (native int8 MXU matmuls); ``kv_quant=int8`` stores
     the KV cache as (int8, per-token scale) pairs with native int8 decode
@@ -93,7 +102,11 @@ from quorum_tpu.models.transformer import (
     prefill,
     prefill_segment,
 )
-from quorum_tpu.ops.sampling import SamplerConfig, sample_token_rows
+from quorum_tpu.ops.sampling import (
+    SamplerConfig,
+    apply_token_mask,
+    sample_token_rows,
+)
 from quorum_tpu.parallel.mesh import single_device_mesh
 from quorum_tpu.parallel.sharding import kv_cache_sharding, shard_pytree
 
@@ -127,6 +140,21 @@ MIN_PREFIX_REUSE = 16
 # snapshot device memory finite under churn faster than one worker drains
 # (past it, releases simply go unsnapshotted — a future store miss).
 SNAP_QUEUE_MAX = 8
+# Constrained decoding (docs/structured_output.md): the device-side grammar
+# arena keeps every grammar's token-DFA rows at a STABLE offset while any
+# request might reference them, so per-row DFA states never need remapping.
+# Offsets only ever grow; when no constrained request is pending/active the
+# arena may reset — but only once it exceeds this many states, so a steady
+# one-grammar workload keeps its uploaded table (and its offset) warm
+# across requests instead of re-uploading per admission.
+CONSTRAIN_ARENA_KEEP = 4096
+# Hard ceiling on arena growth: the table is [states, vocab] int32, so
+# client-driven distinct-schema traffic on a server that never fully
+# quiesces would otherwise grow device memory without bound (at a 128k
+# vocab, 8192 states ≈ 4 GB). Past the cap a NEW grammar's admission
+# fails alone (503-style GrammarArenaFull, retry after quiescence or with
+# an already-resident grammar) — never the co-batched streams.
+CONSTRAIN_ARENA_MAX = 8192
 _CKPT_ENSEMBLE_ERROR = ("ensemble members are seeded random inits; a "
                         "checkpoint provides only one weight set")
 _CKPT_MEMBERS_ERROR = ("stacked members are seeded random inits; a "
@@ -147,6 +175,13 @@ class DeadlineExceeded(Exception):
     def __init__(self, stage: str):
         super().__init__(f"request deadline exceeded ({stage})")
         self.stage = stage
+
+
+class GrammarArenaFull(RuntimeError):
+    """The device grammar arena is at capacity (CONSTRAIN_ARENA_MAX) and
+    cannot place another distinct grammar until constrained traffic
+    quiesces and the arena resets. Surfaced per-request (503-style —
+    retryable; resident grammars keep serving)."""
 
 
 class EngineBreakerOpen(Exception):
@@ -321,12 +356,12 @@ class _Request:
         "prompt_ids", "budget", "temperature", "top_p", "top_k", "seed",
         "eos_id", "cancel", "chunk_hint", "out", "emitted",
         "pp", "fp", "bias_row", "want_lp", "lp", "hist", "ngram", "member",
-        "trace", "t_submit", "tspans", "deadline",
+        "trace", "t_submit", "tspans", "deadline", "grammar", "g_start",
     )
 
     def __init__(self, prompt_ids, budget, sampler: SamplerConfig, seed, eos_id,
                  cancel, chunk_hint, pp=0.0, fp=0.0, bias_row=None, want_lp=-1,
-                 member=0, deadline=None):
+                 member=0, deadline=None, grammar=None):
         self.prompt_ids = prompt_ids
         self.budget = budget
         self.temperature = sampler.temperature
@@ -347,6 +382,11 @@ class _Request:
         # by the scheduler's per-turn sweep: pending requests are shed
         # (stage "queue"), admitted ones cancelled (stage "prefill"/"decode").
         self.deadline = deadline
+        # Constrained decoding: the compiled token-DFA this request decodes
+        # under (None = unconstrained) and its GLOBAL start state in the
+        # engine's device arena — assigned at admission by _ensure_grammar.
+        self.grammar = grammar
+        self.g_start = 0
         self.lp: list = []
         # Request-scoped tracing: the server's trace (when this submission
         # happens inside a traced request context) rides along so the
@@ -372,9 +412,18 @@ class _Request:
         with the row's own RNG chain (one key split per emitted token,
         exactly the decode path's discipline), so the emitted tokens equal
         the non-speculative path's bit for bit; a draft token is accepted
-        iff it equals the token the model itself SAMPLES there."""
+        iff it equals the token the model itself SAMPLES there.
+
+        Constrained requests are excluded: the verify program samples all
+        g+1 positions in PARALLEL, while the DFA mask at position i depends
+        on the model's own token at i−1 — serializing the samples would
+        cost g+1 dependent top-p sorts per turn. They fall back to the
+        plain chunked path instead (exactly as penalties do), which the
+        spec-compose test pins token-for-token against the non-speculative
+        constrained stream (docs/structured_output.md fallback matrix)."""
         return (self.pp == 0.0 and self.fp == 0.0
-                and self.bias_row is None and self.want_lp < 0)
+                and self.bias_row is None and self.want_lp < 0
+                and self.grammar is None)
 
 
 class _InflightChunk:
@@ -386,15 +435,21 @@ class _InflightChunk:
     released (or re-admitted) in the meantime. ``depth`` is the ring depth
     at dispatch (0 = the blocking chunk), recorded on the decode span."""
 
-    __slots__ = ("payload", "active", "n_steps", "t0", "history", "depth")
+    __slots__ = ("payload", "active", "n_steps", "t0", "history", "depth",
+                 "constrained")
 
-    def __init__(self, payload, active, n_steps, t0, history, depth):
+    def __init__(self, payload, active, n_steps, t0, history, depth,
+                 constrained=False):
         self.payload = payload
         self.active = active
         self.n_steps = n_steps
         self.t0 = t0
         self.history = history
         self.depth = depth
+        # Dispatched through the grammar-constrained program variant: the
+        # payload carries a trailing per-step masked-entry count and the
+        # reap attributes a constrained= attr to the decode span.
+        self.constrained = constrained
 
 
 class _Admission:
@@ -896,6 +951,29 @@ class InferenceEngine:
         self.n_spec_turns = 0      # speculative verify dispatches
         self.n_spec_accepted = 0   # draft tokens accepted across them
         self.n_decode_chunks = 0   # plain batched decode dispatch turns
+        # Constrained decoding (docs/structured_output.md): the device-side
+        # grammar arena — every admitted grammar's token-DFA rows
+        # concatenated at stable offsets behind the reserved FREE row 0
+        # (all-allowed self-loop, accepting: the state unconstrained rows
+        # sit in). Host mirrors grow; the padded [bucket, V] device pair
+        # re-uploads (async) when a new grammar lands. n_constrained /
+        # n_constrain_masked feed the engine /metrics block.
+        self._g_offsets: dict = {}
+        self._g_grammars: dict = {}
+        self._g_states = 1
+        self._g_trans_np = np.zeros((1, self.spec.vocab_size), np.int32)
+        self._g_accept_np = np.ones((1,), bool)
+        self._g_trans = None   # device [bucket, V] int32 (None until used)
+        self._g_accept = None  # device [bucket] bool
+        self._g_bucket = 0
+        # Rows whose constrained request was released: their device DFA
+        # state must return to FREE before the row can serve an
+        # unconstrained request again (processed at the top of
+        # _start_admissions — release sites hold _cond, and a first-use
+        # XLA compile must never run under the lock).
+        self._pending_dfa_resets: list[int] = []
+        self.n_constrained = 0
+        self.n_constrain_masked = 0
         # Occupancy accounting: active rows summed over every scheduler turn
         # (decode chunks AND verify turns) — average batch occupancy is
         # decode_busy_rows_total / (decode_chunks_total + spec_turns_total).
@@ -966,6 +1044,11 @@ class InferenceEngine:
         self._live = jax.device_put(np.zeros((s,), bool), rep)
         self._budget = jax.device_put(np.zeros((s,), np.int32), rep)
         self._eos = jax.device_put(np.full((s,), -1, np.int32), rep)
+        # Per-row grammar-DFA state (GLOBAL arena index; 0 = FREE, the
+        # all-allowed state unconstrained rows stay in). Threaded through
+        # the CONSTRAINED decode variant only — the plain variant's
+        # signature carries no trace of it (the gating contract).
+        self._dfa = jax.device_put(np.zeros((s,), np.int32), rep)
         self._temp = jax.device_put(np.ones((s,), np.float32), rep)
         self._topp = jax.device_put(np.ones((s,), np.float32), rep)
         self._topk = jax.device_put(np.zeros((s,), np.int32), rep)
@@ -1183,9 +1266,10 @@ class InferenceEngine:
         vocab = self.spec.vocab_size
 
         def register(slot, last_tok, n_minus1, seed, temp1, topp1, topk1,
-                     pp1, fp1, bias_row, budget1, eos1,
+                     pp1, fp1, bias_row, budget1, eos1, dfa1,
                      token_s, lengths_s, keys_s, temp_s, topp_s, topk_s,
-                     pp_s, fp_s, counts_s, bias_s, live_s, budget_s, eos_s):
+                     pp_s, fp_s, counts_s, bias_s, live_s, budget_s, eos_s,
+                     dfa_s):
             return (
                 token_s.at[slot].set(last_tok),
                 lengths_s.at[slot].set(n_minus1),
@@ -1202,6 +1286,12 @@ class InferenceEngine:
                 live_s.at[slot].set(budget1 > 0),
                 budget_s.at[slot].set(budget1),
                 eos_s.at[slot].set(eos1),
+                # Grammar-DFA start state (0 = FREE for unconstrained).
+                # Constrained admissions always register through here —
+                # the single-shot admit path samples its first token
+                # INSIDE the prefill program, before any mask could apply,
+                # so _start_admissions routes them chunked instead.
+                dfa_s.at[slot].set(dfa1),
             )
 
         fn = jax.jit(
@@ -1209,7 +1299,7 @@ class InferenceEngine:
             donate_argnames=(
                 "token_s", "lengths_s", "keys_s", "temp_s", "topp_s", "topk_s",
                 "pp_s", "fp_s", "counts_s", "bias_s",
-                "live_s", "budget_s", "eos_s",
+                "live_s", "budget_s", "eos_s", "dfa_s",
             ),
         )
         self._admit_cache["register"] = fn
@@ -1437,7 +1527,120 @@ class InferenceEngine:
             req.trace.add_span_abs("prefix-restore", t0, t1,
                                    tokens=n, slot=slot)
 
-    def _decode_fn(self, n_steps: int, want_lp: bool, history: int):
+    # ---- constrained decoding: grammar arena + per-row DFA state -----------
+
+    def _ensure_grammar(self, grammar) -> int:
+        """Place a compiled grammar's token-DFA rows in the device arena
+        (scheduler thread, outside ``_cond``) and return the GLOBAL start
+        state a request decoding under it begins in. Idempotent per
+        grammar: the offset is stable for the arena's lifetime, so rows'
+        device-resident DFA states stay valid as other grammars come and
+        go. A new grammar re-uploads the (padded, bucketed) table pair —
+        an async admission-time transfer, never a per-chunk cost."""
+        key = grammar.key or ("anon", id(grammar))
+        off = self._g_offsets.get(key)
+        if off is None:
+            if grammar.vocab_size != self.spec.vocab_size:
+                raise ValueError(
+                    f"grammar compiled for vocab {grammar.vocab_size} "
+                    f"cannot constrain a vocab-{self.spec.vocab_size} model")
+            if self._g_states + grammar.n_states > CONSTRAIN_ARENA_MAX:
+                # Bounded device memory beats serving one more schema: the
+                # caller contains this to the one request (active streams
+                # and already-resident grammars are untouched).
+                raise GrammarArenaFull(
+                    f"grammar arena at capacity ({self._g_states} of "
+                    f"{CONSTRAIN_ARENA_MAX} states; this grammar needs "
+                    f"{grammar.n_states} more) — retry after constrained "
+                    "traffic quiesces")
+            off = self._g_states
+            shifted = np.where(grammar.trans >= 0, grammar.trans + off,
+                               -1).astype(np.int32)
+            self._g_trans_np = np.concatenate(
+                [self._g_trans_np, shifted], axis=0)
+            self._g_accept_np = np.concatenate(
+                [self._g_accept_np, grammar.accept.astype(bool)])
+            self._g_offsets[key] = off
+            self._g_grammars[key] = grammar
+            self._g_states += grammar.n_states
+            self._upload_arena()
+        return off + grammar.start
+
+    def _upload_arena(self) -> None:
+        """(Re)upload the arena tables padded to a power-of-two state
+        bucket — the bucket is part of the constrained program variant's
+        cache key, so log-many program shapes cover any arena size.
+        Padding rows allow nothing and accept nothing."""
+        b = 1
+        while b < self._g_states:
+            b <<= 1
+        trans = self._g_trans_np
+        accept = self._g_accept_np
+        if b > self._g_states:
+            pad = b - self._g_states
+            trans = np.concatenate(
+                [trans, np.full((pad, trans.shape[1]), -1, np.int32)], axis=0)
+            accept = np.concatenate([accept, np.zeros((pad,), bool)])
+        self._g_bucket = b
+        self._g_trans = jax.device_put(trans, self._rep)
+        self._g_accept = jax.device_put(accept, self._rep)
+
+    def _maybe_reset_arena(self) -> None:
+        """Drop the arena once it has grown past CONSTRAIN_ARENA_KEEP
+        states AND no request anywhere (active, admitting, pending) still
+        references a grammar — the only moment offsets may move, because
+        no device-resident row state points into the arena. Below the
+        threshold the arena is kept as a warm cache: a steady
+        same-grammar workload never re-uploads."""
+        if self._g_states <= 1 or self._g_states <= CONSTRAIN_ARENA_KEEP:
+            return
+        with self._cond:
+            busy = (
+                any(r is not None and r.grammar is not None
+                    for r in self._slots)
+                or any(a.req.grammar is not None for a in self._admitting)
+                or any(r.grammar is not None for r in self._pending))
+        if busy:
+            return
+        self._g_offsets = {}
+        self._g_grammars = {}
+        self._g_states = 1
+        self._g_trans_np = np.zeros((1, self.spec.vocab_size), np.int32)
+        self._g_accept_np = np.ones((1,), bool)
+        self._g_trans = self._g_accept = None
+        self._g_bucket = 0
+
+    def _dfa_reset_fn(self):
+        fn = self._admit_cache.get("dfa_reset")
+        if fn is None:
+            fn = jax.jit(lambda dfa, row: dfa.at[row].set(0),
+                         donate_argnums=(0,))
+            self._admit_cache["dfa_reset"] = fn
+        return fn
+
+    def _flush_dfa_resets(self) -> None:
+        """Return released constrained rows' device DFA state to FREE
+        (scheduler thread, lock not held). Runs at the top of
+        _start_admissions, i.e. BEFORE any admission this turn can
+        activate one of those rows for an unconstrained request — the
+        only reader that would mis-mask on a stale state."""
+        with self._cond:
+            rows, self._pending_dfa_resets = self._pending_dfa_resets, []
+        for r in rows:
+            self._dfa = self._dfa_reset_fn()(self._dfa, np.int32(r))
+
+    def _decode_key(self, n_steps: int, want_lp: bool, history: int,
+                    constrained: bool):
+        """The decode-program cache key. The UNCONSTRAINED key is the
+        pre-constrain 3-tuple — pinned by tests: batches with no grammar
+        row compile and dispatch the exact program variant they always
+        did, with no mask/table operands (the logprobs-gating contract)."""
+        if constrained:
+            return ("dfa", n_steps, want_lp, history, self._g_bucket)
+        return (n_steps, want_lp, history)
+
+    def _decode_fn(self, n_steps: int, want_lp: bool, history: int,
+                   tstates: int = 0):
         """Jitted: ``n_steps`` batched decode+sample steps over all slots.
 
         Variants per (chunk size, want_lp, history bucket): the ``want_lp``
@@ -1448,13 +1651,30 @@ class InferenceEngine:
         cache prefix instead of the full padded max_seq row (decode is
         HBM-bound — this is the decode-side bandwidth fix).
 
+        ``tstates`` > 0 selects the CONSTRAINED variant (same gating
+        pattern as want_lp — unconstrained batches never compile or pay
+        it): the program takes the grammar arena's [tstates, V] transition
+        table and [tstates] accept flags plus the per-row DFA state, masks
+        each step's logits by the row's state's allow-set (EOS allowed
+        exactly in accepting states), and advances the state on the
+        sampled token — all inside the chunk's on-device scan, zero host
+        round-trips at any pipeline depth. Unconstrained rows ride along
+        in state 0 (FREE: everything allowed, self-loop). The variant
+        additionally returns per-step masked-entry counts.
+
         The per-step model/cache/finish machinery lives in
         :func:`transformer.decode_chunk`: rows finish ON DEVICE (EOS or
         budget), so the chunk returns per-row ``n_valid`` and updated
         ``live``/``budget`` state — what lets the scheduler keep
         ``decode_pipeline`` chunks in flight without producing overrun
-        tokens for rows that finish mid-window."""
-        fn = self._decode_cache.get((n_steps, want_lp, history))
+        tokens for rows that finish mid-window. (A constrained row that
+        completes its grammar enters an accept-sink whose only allowed
+        token is EOS — the existing on-device EOS finish then retires it,
+        so grammar completion maps to finish_reason "stop" with no new
+        host logic.)"""
+        constrained = tstates > 0
+        key = self._decode_key(n_steps, want_lp, history, constrained)
+        fn = self._decode_cache.get(key)
         if fn is not None:
             return fn
         spec = self.spec
@@ -1462,12 +1682,14 @@ class InferenceEngine:
         n_top = min(TOP_LOGPROBS, spec.vocab_size)
         n_rows = self._rows
         n_s = self.n_slots
+        vocab = spec.vocab_size
         ens = self.ensemble
         mem = self.members
 
-        def chunk(params, active, eos_s, ck, cv, token_s, lengths_s, keys_s,
-                  temp_s, topp_s, topk_s, pp_s, fp_s, counts_s, bias_s,
-                  live_s, budget_s):
+        def chunk_core(params, active, eos_s, ck, cv, token_s, lengths_s,
+                       keys_s, temp_s, topp_s, topk_s, pp_s, fp_s, counts_s,
+                       bias_s, live_s, budget_s,
+                       trans_t=None, accept_t=None, dfa_s=None):
             # Inactive slots run the forward (batch is static) but their
             # K/V write is masked off — a slot mid-chunked-admission must
             # not have its freshly prefilled cache clobbered by the dummy
@@ -1496,13 +1718,35 @@ class InferenceEngine:
                         params, ck, cv)
 
             def sample_fn(logits, live, carry):
-                keys, counts = carry
+                if constrained:
+                    keys, counts, dfa = carry
+                else:
+                    keys, counts = carry
+                    dfa = None
                 # OpenAI sampling knobs, applied per row on the f32 logits:
                 # logit_bias adds; presence/frequency penalties subtract
                 # based on the slot's generated-token counts.
                 adj = (logits + bias_s
                        - fp_s[:, None] * counts
                        - pp_s[:, None] * (counts > 0))
+                if constrained:
+                    # Grammar mask: the row's current state's allow-set
+                    # ([S, V] gather), with the EOS column rewritten to
+                    # "allowed iff the state accepts" — EOS is the only
+                    # legal move out of a completed grammar, and illegal
+                    # everywhere else. Masking happens BEFORE the sampler,
+                    # so temperature/top-k/top-p compose unchanged
+                    # (ops/sampling.apply_token_mask) and per-row states
+                    # advance on the sampled token — token after token,
+                    # inside the scan, no host round-trip.
+                    rowt = trans_t[dfa]                      # [S, V]
+                    allow = rowt >= 0
+                    eos_col = (jnp.arange(vocab)[None, :]
+                               == eos_s[:, None])
+                    allow = jnp.where(
+                        eos_col,
+                        (accept_t[dfa] & (eos_s >= 0))[:, None], allow)
+                    adj = apply_token_mask(adj, allow)
                 split = jax.vmap(jax.random.split)(keys)  # [S, 2, 2]
                 nxt = sample_token_rows(
                     adj, split[:, 1], temp_s, topp_s, topk_s
@@ -1517,33 +1761,81 @@ class InferenceEngine:
                     aux = (s_lp, top_ix, top_lp)
                 else:
                     aux = ()
+                if constrained:
+                    # Count masked vocab entries for live CONSTRAINED rows
+                    # (dfa > 0 — grammar states start past FREE) and
+                    # advance the DFA: the sampled token's transition, or
+                    # stay put on EOS (the row dies via the chunk's own
+                    # finish check) and for dead rows.
+                    con = live & (dfa > 0)
+                    masked = jnp.sum((~allow) & con[:, None],
+                                     dtype=jnp.int32)
+                    ndfa = jnp.take_along_axis(
+                        rowt, nxt[:, None], axis=1)[:, 0]
+                    dfa = jnp.where(live & (nxt != eos_s) & (ndfa >= 0),
+                                    ndfa, dfa)
+                    return nxt, (split[:, 0], counts, dfa), aux + (masked,)
                 return nxt, (split[:, 0], counts), aux
 
+            carry0 = ((keys_s, counts_s, dfa_s) if constrained
+                      else (keys_s, counts_s))
             (toks, _valid, n_valid, live_end, budget_s, ck, cv, lengths_s,
-             (keys_s, counts_s), aux) = decode_chunk(
+             carry_out, aux) = decode_chunk(
                 params, spec, n_steps, token_s, lengths_s, live0, budget_s,
-                eos_s, ck, cv, sample_fn, (keys_s, counts_s),
+                eos_s, ck, cv, sample_fn, carry0,
                 history=history, model_call=model_call)
+            if constrained:
+                keys_s, counts_s, dfa_s = carry_out
+            else:
+                keys_s, counts_s = carry_out
             if want_lp:
-                s_lp, top_ix, top_lp = aux
+                s_lp, top_ix, top_lp = aux[:3]
                 lp_out = (s_lp.T, top_ix.transpose(1, 0, 2),
                           top_lp.transpose(1, 0, 2))
             else:
                 lp_out = ()
+            mask_out = (aux[-1],) if constrained else ()  # [n_steps] int32
             # Rows outside this chunk's active set keep their liveness (a
             # slot mid-admission must not be marked dead under the ring).
             live_s = jnp.where(active > 0, live_end, live_s)
             token_s = jnp.where(active > 0, toks[:, -1], token_s)
-            return ((toks, n_valid) + lp_out
-                    + (ck, cv, token_s, lengths_s, keys_s, counts_s,
-                       live_s, budget_s))
+            tail = (ck, cv, token_s, lengths_s, keys_s, counts_s,
+                    live_s, budget_s)
+            if constrained:
+                tail = tail + (dfa_s,)
+            return (toks, n_valid) + lp_out + mask_out + tail
 
-        fn = jax.jit(
-            chunk,
-            donate_argnames=("ck", "cv", "token_s", "lengths_s", "keys_s",
-                             "counts_s", "live_s", "budget_s"),
-        )
-        self._decode_cache[(n_steps, want_lp, history)] = fn
+        if constrained:
+            def chunk(params, active, eos_s, trans_t, accept_t, ck, cv,
+                      token_s, lengths_s, keys_s, temp_s, topp_s, topk_s,
+                      pp_s, fp_s, counts_s, bias_s, live_s, budget_s, dfa_s):
+                return chunk_core(
+                    params, active, eos_s, ck, cv, token_s, lengths_s,
+                    keys_s, temp_s, topp_s, topk_s, pp_s, fp_s, counts_s,
+                    bias_s, live_s, budget_s,
+                    trans_t=trans_t, accept_t=accept_t, dfa_s=dfa_s)
+
+            fn = jax.jit(
+                chunk,
+                donate_argnames=("ck", "cv", "token_s", "lengths_s",
+                                 "keys_s", "counts_s", "live_s", "budget_s",
+                                 "dfa_s"),
+            )
+        else:
+            def chunk(params, active, eos_s, ck, cv, token_s, lengths_s,
+                      keys_s, temp_s, topp_s, topk_s, pp_s, fp_s, counts_s,
+                      bias_s, live_s, budget_s):
+                return chunk_core(
+                    params, active, eos_s, ck, cv, token_s, lengths_s,
+                    keys_s, temp_s, topp_s, topk_s, pp_s, fp_s, counts_s,
+                    bias_s, live_s, budget_s)
+
+            fn = jax.jit(
+                chunk,
+                donate_argnames=("ck", "cv", "token_s", "lengths_s",
+                                 "keys_s", "counts_s", "live_s", "budget_s"),
+            )
+        self._decode_cache[key] = fn
         return fn
 
     def _verify_fn(self, g: int, history: int):
@@ -1713,6 +2005,7 @@ class InferenceEngine:
         logprobs: int = -1,  # ≥ 0 → record per-token logprobs + that many tops
         member: int = 0,  # stacked-members engine: which weight set serves this
         deadline: float | None = None,  # absolute time.monotonic() deadline
+        grammar=None,  # CompiledGrammar: constrained decoding (structured output)
     ) -> _Request | None:
         """Enqueue a generation and return its handle (``None`` when there is
         nothing to generate). Raises :class:`QueueFullError` *synchronously*
@@ -1742,6 +2035,7 @@ class InferenceEngine:
             want_lp=logprobs,
             member=member,
             deadline=deadline,
+            grammar=grammar,
         )
 
     def stream_results(self, req: _Request | None) -> Iterator[int]:
@@ -1790,12 +2084,34 @@ class InferenceEngine:
 
     def _submit(self, prompt_ids, *, max_new_tokens, sampler, seed, eos_id,
                 cancel, decode_chunk, pp=0.0, fp=0.0, bias_row=None,
-                want_lp=-1, member=0, deadline=None) -> _Request | None:
+                want_lp=-1, member=0, deadline=None,
+                grammar=None) -> _Request | None:
         spec = self.spec
         if not 0 <= member < self.members:
             raise ValueError(
                 f"member {member} out of range for a {self.members}-member "
                 "engine")
+        if grammar is not None:
+            # Constrained decoding preconditions, checked synchronously so a
+            # misconfiguration is a clean rejection, not a wedged stream:
+            # the grammar's terminal states emit by forcing EOS, and the
+            # first token must be sampled by a masked decode chunk — which
+            # means the admission rides the chunked-prefill register path.
+            if eos_id is None:
+                raise ValueError(
+                    "constrained decoding requires an EOS id: grammar "
+                    "completion finishes the row by forcing EOS on device")
+            if self.prefill_chunk <= 0:
+                raise ValueError(
+                    "constrained decoding requires chunked prefill "
+                    "(prefill_chunk >= 16 after power-of-two alignment): "
+                    "the first constrained token is sampled by a masked "
+                    "decode chunk, not inside the single-shot admit "
+                    "program — unavailable with sp>1 or prefill_chunk=0")
+            if grammar.vocab_size != spec.vocab_size:
+                raise ValueError(
+                    f"grammar compiled for vocab {grammar.vocab_size} does "
+                    f"not match the model vocab {spec.vocab_size}")
         # Keep the most recent context if the prompt exceeds the window,
         # reserving at least one position to generate into.
         prompt = list(prompt_ids)[-(spec.max_seq - 1):]
@@ -1809,7 +2125,7 @@ class InferenceEngine:
             cancel if cancel is not None else threading.Event(),
             decode_chunk,
             pp=pp, fp=fp, bias_row=bias_row, want_lp=want_lp, member=member,
-            deadline=deadline,
+            deadline=deadline, grammar=grammar,
         )
         now = time.monotonic()
         if deadline is not None and now >= deadline:
@@ -1872,6 +2188,8 @@ class InferenceEngine:
                     if self.prefix_store is not None else 0),
                 "overlapped_chunks_total": self.n_overlapped,
                 "overrun_tokens_total": self.n_overrun,
+                "constrained_requests_total": self.n_constrained,
+                "constrain_masked_tokens_total": self.n_constrain_masked,
                 "decode_pipeline": self.decode_pipeline,
                 "inflight_chunks": len(self._inflight),
                 "rebuilds_total": self.n_rebuilds,
@@ -2047,7 +2365,16 @@ class InferenceEngine:
         the HOST prefix store holds a longer match than any slot (the slot
         that held this conversation was reclaimed under churn), the match
         is restored host→device into the claimed slot first and the
-        admission starts past it."""
+        admission starts past it.
+
+        Constrained requests (``req.grammar``) ALWAYS route through the
+        chunked path regardless of prompt length: the single-shot admit
+        program samples the first token inside the prefill, before any
+        grammar mask could apply; the register path leaves the first
+        sample to the next (masked) decode chunk. Their grammar tables are
+        placed in the device arena here, before the admission starts."""
+        self._flush_dfa_resets()
+        self._maybe_reset_arena()
         self._dispatch_snapshots()
         if self.members > 1:
             self._start_admissions_members()
@@ -2065,6 +2392,15 @@ class InferenceEngine:
                 req.out.put(("end", None))
                 continue
             self._note_admitted(req)
+            if req.grammar is not None:
+                try:
+                    req.g_start = self._ensure_grammar(req.grammar)
+                except Exception as e:
+                    # Arena at capacity (or a poisoned table): doom this
+                    # request alone; the slot was never claimed.
+                    self._contain_admission_failure([req], e)
+                    continue
+                self.n_constrained += 1
             # Reuse caps at len(prompt)-1 (the final prompt token must run
             # through a segment so the register path's first decode step has
             # its position's logits to sample from) and is aligned DOWN to a
@@ -2092,7 +2428,7 @@ class InferenceEngine:
                         req, slot, offset=n_restore,
                         restored=n_restore - reuse))
                 self._restore_into(slot, reuse, n_restore - reuse, host, req)
-            elif reuse or (
+            elif reuse or req.grammar is not None or (
                 self.prefill_chunk and len(req.prompt_ids) > self.prefill_chunk
             ):
                 if reuse:
@@ -2177,8 +2513,9 @@ class InferenceEngine:
                     if slot is None:
                         continue
                     reuse = self._reuse_len(lcp, len(r.prompt_ids))
-                    if reuse or (self.prefill_chunk
-                                 and len(r.prompt_ids) > self.prefill_chunk):
+                    if reuse or r.grammar is not None or (
+                            self.prefill_chunk
+                            and len(r.prompt_ids) > self.prefill_chunk):
                         if reuse:
                             self.prefix_hits += 1
                             self.prefix_tokens_saved += reuse
@@ -2209,6 +2546,20 @@ class InferenceEngine:
                         return  # no head has a usable row this iteration
                     for r in group.values():
                         self._pending.remove(r)
+            if (admit_chunked is not None
+                    and admit_chunked.req.grammar is not None):
+                # Arena placement outside _cond (a grammar's first table
+                # upload must not run under the scheduler lock); the
+                # admission's register turn — the only reader of g_start —
+                # happens strictly after this point in the turn order.
+                try:
+                    admit_chunked.req.g_start = self._ensure_grammar(
+                        admit_chunked.req.grammar)
+                except Exception as e:
+                    self._contain_admission_failure(
+                        [admit_chunked.req], e, admissions=[admit_chunked])
+                    continue
+                self.n_constrained += 1
             if admit_chunked is None:
                 try:
                     self._admit_members(group, row, bucket)
@@ -2398,7 +2749,8 @@ class InferenceEngine:
         (self._token, self._lengths, self._keys, self._temp,
          self._topp, self._topk, self._pp, self._fp,
          self._counts, self._bias,
-         self._live, self._budget, self._eos) = self._register_fn()(
+         self._live, self._budget, self._eos,
+         self._dfa) = self._register_fn()(
             np.int32(adm.slot),
             np.int32(prompt[-1]),
             np.int32(len(prompt) - 1),
@@ -2411,10 +2763,11 @@ class InferenceEngine:
             bias,
             np.int32(req.budget),
             np.int32(req.eos_id if req.eos_id is not None else -1),
+            np.int32(req.g_start if req.grammar is not None else 0),
             self._token, self._lengths, self._keys,
             self._temp, self._topp, self._topk,
             self._pp, self._fp, self._counts, self._bias,
-            self._live, self._budget, self._eos,
+            self._live, self._budget, self._eos, self._dfa,
         )
         t1 = time.perf_counter()
         # Wall time from slot claim to cache-complete: chunked admissions
@@ -2714,6 +3067,11 @@ class InferenceEngine:
             n_steps = max(
                 1, min(r.chunk_hint or self.decode_chunk for _, r in active))
             want_lp = any(r.want_lp >= 0 for _, r in active)
+            # Program-variant gating (the logprobs pattern): only a batch
+            # that actually contains a grammar row pays the constrained
+            # variant — its table gathers AND its operand shapes. A batch
+            # with none dispatches the exact pre-constrain program.
+            constrained = any(r.grammar is not None for _, r in active)
             # Planned lengths: host-known emitted counts plus every step
             # already in flight — an upper bound on where rows can be when
             # this chunk runs (rows that finish on device stop short of it).
@@ -2731,15 +3089,18 @@ class InferenceEngine:
                 # compile.
                 if not any(r.budget - r.emitted > ahead for _, r in active):
                     return
-                if (n_steps, want_lp, history) not in self._decode_cache:
+                if self._decode_key(n_steps, want_lp, history,
+                                    constrained) not in self._decode_cache:
                     return
             mask = np.zeros((self._rows,), np.int32)
             for i, _ in active:
                 mask[i] = 1
             t0 = time.perf_counter()
-            payload = self._dispatch_chunk(mask, n_steps, want_lp, history)
+            payload = self._dispatch_chunk(mask, n_steps, want_lp, history,
+                                           constrained)
             self._inflight.append(
-                _InflightChunk(payload, active, n_steps, t0, history, depth))
+                _InflightChunk(payload, active, n_steps, t0, history, depth,
+                               constrained))
             if depth > 0:
                 self.n_overlapped += 1
             obs.PIPELINE_DEPTH.set(len(self._inflight))
@@ -2756,18 +3117,22 @@ class InferenceEngine:
         dispatch-to-reap latency is kept as the span's ``inflight`` attr."""
         c = self._inflight.popleft()
         t0 = time.perf_counter()
-        done = self._emit_chunk(c.active, c.payload)
+        done = self._emit_chunk(c)
         t1 = time.perf_counter()
         obs.DECODE_CHUNK.observe(t1 - t0)
         obs.PIPELINE_DEPTH.set(len(self._inflight))
         self.n_decode_chunks += 1
         self.n_decode_rows += len(c.active)
+        meta = {}
+        if c.constrained:
+            meta["constrained"] = sum(
+                1 for _, r in c.active if r.grammar is not None)
         for i, req in c.active:
             if self._slots[i] is req or i in done:
                 self._turn_span(req, "decode", t0, t1, steps=c.n_steps,
                                 occupancy=len(c.active), history=c.history,
                                 depth=c.depth,
-                                inflight=round(t0 - c.t0, 6))
+                                inflight=round(t0 - c.t0, 6), **meta)
         if done:
             with self._cond:
                 for i, req in c.active:
@@ -2788,13 +3153,45 @@ class InferenceEngine:
         device→host snapshot, so it survives the slot being reclaimed."""
         self._slots[i] = None
         self._resident[i] = req.hist[:-1]
+        if req.grammar is not None:
+            # The row's device DFA state must return to FREE before an
+            # unconstrained request can activate it (a stale grammar state
+            # would wrongly mask that request in a mixed constrained
+            # batch). Deferred like snapshots: the caller holds _cond, and
+            # the reset's first-use compile must not run under the lock.
+            self._pending_dfa_resets.append(i)
         self._queue_snapshot(i)
 
-    def _dispatch_chunk(self, mask, n_steps: int, want_lp: bool, history: int):
+    def _dispatch_chunk(self, mask, n_steps: int, want_lp: bool, history: int,
+                        constrained: bool = False):
         """Enqueue one decode chunk (non-blocking — jax arrays are futures);
         chains the per-slot device state so further dispatches can follow
-        before this one is read. Returns the chunk's output arrays."""
+        before this one is read. Returns the chunk's output arrays.
+
+        The constrained variant threads the grammar arena tables (read-only
+        operands — never donated, shared by every in-flight chunk) and the
+        per-row DFA state (donated and chained like the rest of the slot
+        state, so a chunk dispatched before its predecessor is read still
+        masks from the right states)."""
         faults.fire("engine.decode")
+        if constrained:
+            out = self._decode_fn(n_steps, want_lp, history,
+                                  tstates=self._g_bucket)(
+                self.params, mask, self._eos, self._g_trans, self._g_accept,
+                self._ck, self._cv, self._token,
+                self._lengths, self._keys, self._temp, self._topp, self._topk,
+                self._pp, self._fp, self._counts, self._bias,
+                self._live, self._budget, self._dfa,
+            )
+            if want_lp:
+                (toks, n_valid, s_lp, top_ix, top_lp, masked, self._ck,
+                 self._cv, self._token, self._lengths, self._keys,
+                 self._counts, self._live, self._budget, self._dfa) = out
+                return (toks, n_valid, s_lp, top_ix, top_lp, masked)
+            (toks, n_valid, masked, self._ck, self._cv, self._token,
+             self._lengths, self._keys, self._counts, self._live,
+             self._budget, self._dfa) = out
+            return (toks, n_valid, masked)
         out = self._decode_fn(n_steps, want_lp, history)(
             self.params, mask, self._eos, self._ck, self._cv, self._token,
             self._lengths, self._keys, self._temp, self._topp, self._topk,
@@ -2810,7 +3207,7 @@ class InferenceEngine:
          self._keys, self._counts, self._live, self._budget) = out
         return (toks, n_valid)
 
-    def _emit_chunk(self, active, payload) -> set[int]:
+    def _emit_chunk(self, c: "_InflightChunk") -> set[int]:
         """Block on one dispatched chunk's outputs and deliver its tokens.
 
         ``n_valid[i]`` (computed ON DEVICE) bounds row i's delivery: a row
@@ -2819,10 +3216,20 @@ class InferenceEngine:
         the host has since released (cancellation, stop strings — finishes
         the device cannot see) count into ``overrun_tokens_total``.
         Returns the slots that finished in THIS chunk."""
-        if len(payload) == 5:
-            toks, n_valid, s_lp, top_ix, top_lp = _host_fetch(*payload)
+        active, payload = c.active, c.payload
+        fetched = _host_fetch(*payload)
+        if c.constrained:
+            # The grammar variant's trailing per-step masked-entry counts
+            # ride the fetch the tokens already require — no extra sync.
+            *fetched, masked = fetched
+            n_masked = int(np.asarray(masked).sum())
+            if n_masked:
+                self.n_constrain_masked += n_masked
+                obs.CONSTRAIN_MASKED_TOKENS.inc(n_masked)
+        if len(fetched) == 5:
+            toks, n_valid, s_lp, top_ix, top_lp = fetched
         else:
-            toks, n_valid = _host_fetch(*payload)
+            toks, n_valid = fetched
             s_lp = top_ix = top_lp = None
         done: set[int] = set()
         for i, req in active:
@@ -2959,6 +3366,9 @@ class InferenceEngine:
             self._snap_backlog = max(
                 0, self._snap_backlog - len(self._pending_snaps))
             self._pending_snaps = []
+            # The rebuild below re-zeroes the per-row DFA state wholesale;
+            # row-level resets queued before the failure are moot.
+            self._pending_dfa_resets = []
         # In-flight chunk payloads reference (possibly poisoned) device
         # arrays from before the failure — drop them unread.
         self._inflight.clear()

@@ -236,6 +236,29 @@ def validate_request_body(body: dict[str, Any]) -> str | None:
     stop = body.get("stop")
     if stop is not None and not isinstance(stop, (str, list)):
         return f"Invalid value for 'stop': {stop!r}"
+    # Structured output (docs/structured_output.md): shape-level validation
+    # before fan-out — a malformed response_format must be ONE 400, not N
+    # backend failures. Grammar compilation (and its 422 dead-end path)
+    # stays in the tpu backend, which owns the tokenizer.
+    rf = body.get("response_format")
+    if rf is not None:
+        if not isinstance(rf, dict) or not isinstance(rf.get("type"), str):
+            return (f"Invalid value for 'response_format': {rf!r} (an "
+                    "object with a string 'type')")
+        rft = rf["type"]
+        if rft not in ("text", "json_object", "json_schema", "regex"):
+            return (f"Invalid response_format type {rft!r} (text, "
+                    "json_object, json_schema, or regex)")
+        if rft == "json_schema":
+            js = rf.get("json_schema")
+            if not isinstance(js, dict) or not isinstance(
+                    js.get("schema"), (dict, bool)):
+                return ("response_format type 'json_schema' requires "
+                        "json_schema.schema (an object)")
+        if rft == "regex":
+            if not isinstance(rf.get("pattern"), str) or not rf["pattern"]:
+                return ("response_format type 'regex' requires a non-empty "
+                        "'pattern' string")
     # Per-request deadline override (seconds) — replaces settings.timeout
     # for this request's whole life, engine deadline and HTTP hops alike
     # (docs/robustness.md). Consumed by the server, never forwarded.

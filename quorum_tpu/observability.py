@@ -358,6 +358,29 @@ PREFIX_STORE_RESTORE = METRICS.histogram(
 # backend retry, and the engine failure breaker. Per-engine breakdowns
 # (rebuilds_total, breaker_state, deadline_exceeded_total) live in the
 # quorum_tpu_engine_* block each engine's metrics() feeds.
+# Constrained decoding (quorum_tpu/constrain/ + the engine's on-device
+# DFA threading — docs/structured_output.md).
+CONSTRAINED_REQUESTS = METRICS.counter(
+    "quorum_tpu_constrained_requests_total",
+    "Requests served under a response_format grammar (json_object / "
+    "json_schema / regex).")
+CONSTRAIN_MASKED_TOKENS = METRICS.counter(
+    "quorum_tpu_constrain_masked_tokens_total",
+    "Vocabulary entries masked to -inf by the on-device grammar DFA, "
+    "summed over every decode step of every constrained row.")
+CONSTRAIN_CACHE_HITS = METRICS.counter(
+    "quorum_tpu_constrain_cache_hits_total",
+    "Grammar compilations served from the (grammar, tokenizer) cache.")
+CONSTRAIN_CACHE_MISSES = METRICS.counter(
+    "quorum_tpu_constrain_cache_misses_total",
+    "Grammar compilations that had to run (cache miss).")
+CONSTRAIN_COMPILE = METRICS.histogram(
+    "quorum_tpu_constrain_compile_seconds",
+    "Grammar -> token-DFA compile time (regex/schema lowering, byte-DFA "
+    "construction, token lifting) on a cache miss.",
+    buckets=(0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+             1.0, 2.5, 5.0, 10.0))
+
 DEADLINE_EXCEEDED = METRICS.counter(
     "quorum_tpu_deadline_exceeded_total",
     "Requests that ran past their deadline, by stage: queue = shed before "

@@ -60,6 +60,22 @@ def sample_token(
     return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
 
 
+def apply_token_mask(logits: jnp.ndarray, allow: jnp.ndarray) -> jnp.ndarray:
+    """Grammar/constraint masking: disallowed entries drop to −inf BEFORE
+    the sampler, so the existing cutoff machinery (temperature, top-k,
+    top-p — all downstream of the mask) composes unchanged. −inf survives
+    ``jax.random.categorical``'s Gumbel-argmax, which is what makes masking
+    a would-be-sampled-anyway token a strict no-op under greedy and pure
+    temperature sampling: the restricted argmax equals the unrestricted
+    one whenever the unrestricted winner is allowed (the
+    constrained-vs-unconstrained determinism pin in
+    tests/test_constrained_decoding.py). With top-k/top-p active the
+    cutoffs are computed over the MASKED distribution, so near-threshold
+    samples can differ from the unconstrained run even when the winner
+    itself was never masked."""
+    return jnp.where(allow, logits, -jnp.inf)
+
+
 def sample_token_rows(
     logits: jnp.ndarray,       # [S, V] float
     keys: jnp.ndarray,         # [S, 2] uint32 — one PRNG key per row

@@ -1,0 +1,331 @@
+"""Grammar-compilation unit tests (fast tier — no engine, no jax device
+work): the regex→byte-DFA pipeline, the JSON Schema lowering, token-table
+construction over real tokenizers, dead-end trimming, and the compile
+cache. The device half (on-device masking inside decode chunks) is pinned
+by tests/test_constrained_decoding.py."""
+
+import json
+
+import numpy as np
+import pytest
+
+from quorum_tpu.constrain import (
+    CompiledGrammar,
+    GrammarError,
+    GrammarUnsatisfiable,
+    clear_compile_cache,
+    compile_ast,
+    compile_pattern,
+    compile_response_format,
+    json_value_ast,
+    lift_to_tokens,
+    schema_ast,
+)
+from quorum_tpu.constrain.grammar import json_object_ast
+from quorum_tpu.engine.tokenizer import ByteTokenizer
+from quorum_tpu.observability import (
+    CONSTRAIN_CACHE_HITS,
+    CONSTRAIN_CACHE_MISSES,
+    CONSTRAIN_COMPILE,
+)
+
+
+# ---- byte-level regex → DFA ------------------------------------------------
+
+
+def test_alternation_and_literals():
+    d = compile_pattern("ab|ac")
+    assert d.matches(b"ab") and d.matches(b"ac")
+    assert not d.matches(b"a") and not d.matches(b"abc") \
+        and not d.matches(b"bc")
+
+
+def test_classes_ranges_and_bounded_repetition():
+    d = compile_pattern("[a-c]{2,4}")
+    assert d.matches(b"ab") and d.matches(b"abca")
+    assert not d.matches(b"a") and not d.matches(b"abcab") \
+        and not d.matches(b"ad")
+
+
+def test_negated_class_and_escapes():
+    d = compile_pattern(r'"[^"\\]*"')
+    assert d.matches(b'""') and d.matches(b'"hi there"')
+    assert not d.matches(b'"a"b"')
+    hexd = compile_pattern(r"\x41+")
+    assert hexd.matches(b"AAA") and not hexd.matches(b"B")
+
+
+def test_json_integer_pattern():
+    d = compile_pattern(r"-?(0|[1-9]\d*)")
+    for ok in (b"0", b"7", b"-123", b"90210"):
+        assert d.matches(ok), ok
+    for bad in (b"01", b"-", b"", b"1.5"):
+        assert not d.matches(bad), bad
+
+
+def test_unsupported_syntax_is_a_grammar_error():
+    for pattern in ("a(?=b)", "(", "a{5,2}", "[z-a]", "", "a\\q"):
+        with pytest.raises(GrammarError):
+            compile_pattern(pattern)
+
+
+def test_dfa_is_trimmed_every_state_reaches_accept():
+    d = compile_pattern("abc|abd")
+    # From every state, some byte path must reach an accept state — the
+    # property that makes runtime dead-ends impossible.
+    n = d.n_states
+    live = d.accept.copy()
+    for _ in range(n):
+        tgt = np.where(d.trans >= 0, live[np.clip(d.trans, 0, n - 1)], False)
+        live = live | tgt.any(axis=1)
+    assert live.all()
+
+
+# ---- JSON Schema lowering --------------------------------------------------
+
+
+def _accepts(schema, value) -> bool:
+    dfa = compile_ast(schema_ast(schema))
+    return dfa.matches(
+        json.dumps(value, separators=(",", ":"),
+                   ensure_ascii=True).encode())
+
+
+def test_schema_object_properties_in_order():
+    schema = {"type": "object", "properties": {
+        "ok": {"type": "boolean"},
+        "dir": {"enum": ["N", "S"]},
+        "n": {"type": "integer"}}}
+    assert _accepts(schema, {"ok": True, "dir": "N", "n": -42})
+    assert not _accepts(schema, {"ok": True})          # all props required
+    assert not _accepts(schema, {"ok": "yes", "dir": "N", "n": 1})
+    # canonical form: whitespace is NOT accepted
+    dfa = compile_ast(schema_ast(schema))
+    assert not dfa.matches(b'{"ok": true,"dir":"N","n":1}')
+
+
+def test_schema_scalars_arrays_bounds():
+    assert _accepts({"type": "number"}, -2.5e3)
+    assert _accepts({"type": "null"}, None)
+    assert _accepts({"type": ["integer", "null"]}, None)
+    arr = {"type": "array", "items": {"type": "integer"},
+           "minItems": 1, "maxItems": 3}
+    assert _accepts(arr, [1]) and _accepts(arr, [1, 2, 3])
+    assert not _accepts(arr, []) and not _accepts(arr, [1, 2, 3, 4])
+    s = {"type": "string", "minLength": 2, "maxLength": 4}
+    assert _accepts(s, "ab") and _accepts(s, "abcd")
+    assert not _accepts(s, "a") and not _accepts(s, "abcde")
+
+
+def test_schema_enum_const_oneof():
+    assert _accepts({"enum": ["N", "S", 3, None]}, 3)
+    assert _accepts({"const": "fixed"}, "fixed")
+    assert not _accepts({"const": "fixed"}, "other")
+    assert _accepts({"oneOf": [{"type": "integer"}, {"type": "boolean"}]},
+                    True)
+
+
+def test_schema_unsupported_keywords_rejected():
+    for schema in ({"$ref": "#/x"}, {"allOf": []},
+                   {"type": "string", "pattern": "a+"},
+                   {"type": "object", "patternProperties": {}},
+                   # validating keywords the automaton cannot enforce must
+                   # 400, never silently loosen (a 200 whose content fails
+                   # jsonschema would break the guaranteed-valid contract)
+                   {"type": "integer", "minimum": 0},
+                   {"type": "number", "multipleOf": 2},
+                   {"type": "integer", "exclusiveMaximum": 10},
+                   {"type": "object", "minProperties": 1},
+                   {"type": "array", "items": {"type": "integer"},
+                    "uniqueItems": True}):
+        with pytest.raises(GrammarError):
+            schema_ast(schema)
+
+
+def test_schema_required_subset_honored():
+    props = {"a": {"type": "boolean"}, "b": {"type": "null"}}
+    # required ⊆ properties: satisfied by construction (all emitted)
+    assert _accepts({"type": "object", "properties": props,
+                     "required": ["a"]}, {"a": True, "b": None})
+    # required naming an undeclared property cannot be honored
+    with pytest.raises(GrammarError):
+        schema_ast({"type": "object", "properties": props,
+                    "required": ["c"]})
+
+
+def test_json_value_depth_bound():
+    dfa = compile_ast(json_value_ast(1))
+    assert dfa.matches(b'[1,"a",null]')
+    assert not dfa.matches(b"[[1]]")  # nesting beyond the depth budget
+    top = compile_ast(json_object_ast(1))
+    assert top.matches(b'{"a":1}') and not top.matches(b"3")
+
+
+# ---- token lifting over real tokenizers ------------------------------------
+
+
+def _grammar(schema, vocab=512):
+    tok = ByteTokenizer(vocab)
+    return tok, compile_response_format(
+        {"type": "json_schema", "json_schema": {"schema": schema}},
+        tok, vocab)
+
+
+def test_token_dfa_walks_conforming_document():
+    schema = {"type": "object", "properties": {
+        "ok": {"type": "boolean"}, "n": {"type": "integer"}}}
+    tok, g = _grammar(schema)
+    doc = '{"ok":false,"n":12}'
+    end = g.advance_tokens(g.start, tok.encode(doc))
+    assert end >= 0 and g.accept[end]
+    # a wrong token dead-ends immediately
+    assert g.advance_tokens(g.start, tok.encode("[")) == -1
+    # partial documents are non-accepting but alive
+    mid = g.advance_tokens(g.start, tok.encode('{"ok":'))
+    assert mid >= 0 and not g.accept[mid]
+
+
+def test_specials_and_zero_text_tokens_disallowed():
+    _, g = _grammar({"type": "boolean"})
+    # pad/bos/eos produce no text: allowing them would let the model stall
+    # the grammar forever. EOS is handled separately via accept states.
+    assert (g.trans[:, :3] == -1).all()
+
+
+def test_folding_vocab_aliases_share_transitions():
+    # vocab 512 folds ids ≥ 259 back onto bytes: every alias of an allowed
+    # byte must be allowed and transition identically.
+    tok, g = _grammar({"type": "boolean"})
+    t_id = tok.encode("t")[0]
+    alias = t_id + 256  # same byte under the fold
+    assert tok.token_byte(alias) == tok.token_byte(t_id)
+    assert g.trans[g.start, t_id] == g.trans[g.start, alias] >= 0
+
+
+def test_accept_sink_allows_nothing():
+    # After a complete fixed-shape document the state must allow NO token
+    # (EOS only, via accept) — that forced EOS is what maps grammar
+    # completion onto finish_reason "stop" on device.
+    tok, g = _grammar({"const": "x"})
+    end = g.advance_tokens(g.start, tok.encode('"x"'))
+    assert g.accept[end]
+    assert not g.allowed(end).any()
+
+
+def test_unsatisfiable_vocab_raises():
+    # vocab 20 → byte_slots 17: '{' (0x7b) has no producing token.
+    tok = ByteTokenizer(20)
+    with pytest.raises(GrammarUnsatisfiable):
+        compile_response_format({"type": "json_object"}, tok, 20)
+
+
+def test_malformed_response_format_raises_grammar_error():
+    tok = ByteTokenizer(512)
+    for rf in ({"type": "json_schema"},
+               {"type": "json_schema", "json_schema": {}},
+               {"type": "regex"},
+               {"type": "regex", "pattern": ""},
+               {"type": "xml"},
+               "json"):
+        with pytest.raises(GrammarError):
+            compile_response_format(rf, tok, 512)
+
+
+def test_response_format_text_is_none():
+    tok = ByteTokenizer(512)
+    assert compile_response_format({"type": "text"}, tok, 512) is None
+
+
+# ---- compile cache + metrics -----------------------------------------------
+
+
+def test_compile_cache_hits_and_metrics():
+    clear_compile_cache()
+    tok = ByteTokenizer(512)
+    rf = {"type": "regex", "pattern": "ab+c"}
+    h0 = CONSTRAIN_CACHE_HITS.value
+    m0 = CONSTRAIN_CACHE_MISSES.value
+    c0 = CONSTRAIN_COMPILE.snapshot().get((), {}).get("count", 0)
+    g1 = compile_response_format(rf, tok, 512)
+    g2 = compile_response_format(rf, tok, 512)
+    assert g2 is g1  # cached per (grammar, tokenizer)
+    assert CONSTRAIN_CACHE_MISSES.value == m0 + 1
+    assert CONSTRAIN_CACHE_HITS.value == h0 + 1
+    assert CONSTRAIN_COMPILE.snapshot()[()]["count"] == c0 + 1
+    # a different vocab is a different tokenizer key
+    g3 = compile_response_format(rf, ByteTokenizer(300), 300)
+    assert g3 is not g1 and g3.vocab_size == 300
+
+
+def test_lift_preserves_grammar_against_random_walks():
+    """Property check: any token path the lifted DFA allows must decode to
+    a byte string the byte DFA accepts once an accept state is reached."""
+    tok = ByteTokenizer(512)
+    schema = {"type": "object", "properties": {
+        "a": {"enum": ["x", "yy"]},
+        "b": {"type": "integer"}}}
+    dfa = compile_ast(schema_ast(schema))
+    g = compile_response_format(
+        {"type": "json_schema", "json_schema": {"schema": schema}},
+        tok, 512)
+    rng = np.random.default_rng(0)
+    for _ in range(20):
+        state, ids = g.start, []
+        for _ in range(400):
+            if g.accept[state]:
+                break
+            allowed = np.flatnonzero(g.allowed(state))
+            assert allowed.size, "non-accept state with nothing allowed"
+            t = int(rng.choice(allowed))
+            ids.append(t)
+            state = int(g.trans[state, t])
+        assert g.accept[state]
+        text = tok.decode(ids)
+        assert dfa.matches(text.encode()), text
+        json.loads(text)
+
+
+class _FakeHF:
+    """Minimal HF-tokenizer stand-in for the byte-table unit tests."""
+
+    def __init__(self, tokens, specials=()):
+        self._tokens = tokens
+        self.all_special_ids = list(specials)
+
+    def convert_ids_to_tokens(self, ids):
+        return [self._tokens[i] for i in ids]
+
+
+def test_hf_byte_table_sentencepiece_convention():
+    """Sentencepiece vocabularies: '▁'→space, <0xHH> byte-fallback tokens
+    are single raw bytes (NOT their 6-char ASCII spelling — that would
+    let a raw control byte through a JSON string mask), and accented
+    tokens encode UTF-8 (NOT the GPT-2 byte map)."""
+    from quorum_tpu.constrain.grammar import _hf_token_bytes
+
+    hf = _FakeHF(["<s>", "▁hi", "<0x0A>", "ü", "abc"], specials=[0])
+    table = _hf_token_bytes(hf, 5)
+    assert table[0] is None            # special
+    assert table[1] == b" hi"
+    assert table[2] == b"\n"           # byte fallback, not b"<0x0A>"
+    assert table[3] == "ü".encode()    # UTF-8 pair, not GPT-2-mapped 0xFC
+    assert table[4] == b"abc"
+
+
+def test_hf_byte_table_gpt2_bytelevel_convention():
+    """Byte-level vocabularies (detected by the 'Ġ' marker): every char
+    maps through bytes_to_unicode; tokens outside the alphabet are
+    disallowed rather than mis-encoded."""
+    from quorum_tpu.constrain.grammar import _hf_token_bytes
+
+    hf = _FakeHF(["Ġhi", "ab", "<|end|>☃"])  # snowman: outside map
+    table = _hf_token_bytes(hf, 3)
+    assert table[0] == b" hi"          # Ġ is the byte-level space
+    assert table[1] == b"ab"
+    assert table[2] is None
+
+
+def test_table_bytes_reported():
+    _, g = _grammar({"type": "boolean"})
+    assert g.table_bytes == g.trans.nbytes + g.accept.nbytes
+    assert isinstance(g, CompiledGrammar)

@@ -170,6 +170,24 @@ async def test_live_metrics_exposition_validates():
     assert ("# TYPE quorum_tpu_engine_prefix_store_hits_total counter"
             ) in text
 
+    # constrained-decoding families (ISSUE 5, docs/structured_output.md):
+    # the compile histogram exposes its full triplet even before any
+    # constrained traffic, the counters carry counter TYPEs, and the
+    # per-engine split rides the engine block
+    fam = "quorum_tpu_constrain_compile_seconds"
+    assert f"# TYPE {fam} histogram" in text
+    assert f'{fam}_bucket{{le="+Inf"}}' in text
+    assert f"{fam}_sum" in text and f"{fam}_count" in text
+    for counter in ("quorum_tpu_constrained_requests_total",
+                    "quorum_tpu_constrain_masked_tokens_total",
+                    "quorum_tpu_constrain_cache_hits_total",
+                    "quorum_tpu_constrain_cache_misses_total"):
+        assert f"# TYPE {counter} counter" in text, counter
+    assert ("# TYPE quorum_tpu_engine_constrained_requests_total counter"
+            in text)
+    assert ("# TYPE quorum_tpu_engine_constrain_masked_tokens_total "
+            "counter" in text)
+
     # robustness families (docs/robustness.md): deadline sheds by stage,
     # HTTP retry attempts, and the per-engine rebuild/breaker block
     assert "# TYPE quorum_tpu_deadline_exceeded_total counter" in text

@@ -201,14 +201,33 @@ def test_logit_bias_rejects_bad_values(backend, bad):
     ("tool_choice", "auto"),
     ("functions", [{"name": "f"}]),
     ("function_call", "auto"),
-    ("response_format", {"type": "json_object"}),
+    # response_format types are now IMPLEMENTED (docs/structured_output.md,
+    # tests/test_constrained_decoding.py); malformed shapes and schemas
+    # outside the supported subset stay 400s:
     ("response_format", {"type": "json_schema", "json_schema": {}}),
+    ("response_format", {"type": "json_schema",
+                         "json_schema": {"schema": {"$ref": "#/x"}}}),
+    ("response_format", {"type": "regex", "pattern": "("}),
+    ("response_format", {"type": "xml"}),
 ])
 def test_unsupported_fields_rejected(backend, field, value):
     with pytest.raises(BackendError) as e:
         run(backend.complete({**BASE, field: value}, {}, 60))
     assert e.value.status_code == 400
     assert e.value.body["error"]["type"] == "invalid_request_error"
+
+
+def test_response_format_regex_constrains_output(backend):
+    """Structured output's fast-tier smoke: a regex response_format is
+    enforced on device (the full json_schema/pipeline matrix lives in
+    tests/test_constrained_decoding.py)."""
+    res = run(backend.complete(
+        {**BASE, "max_tokens": 8, "temperature": 0.9, "seed": 2,
+         "response_format": {"type": "regex", "pattern": "yes|no|maybe"}},
+        {}, 60))
+    choice = res.body["choices"][0]
+    assert choice["message"]["content"] in ("yes", "no", "maybe")
+    assert choice["finish_reason"] == "stop"
 
 
 def test_response_format_text_accepted(backend):

@@ -61,7 +61,7 @@ def test_document_paths_match_served_routes():
     assert [s["url"] for s in DOC["servers"]] == ["/", "/v1"]
     post = DOC["paths"]["/chat/completions"]["post"]
     assert set(post["responses"]) == {
-        "200", "400", "401", "500", "503", "504"}
+        "200", "400", "401", "422", "500", "503", "504"}
     # The 503/504 shapes carry Retry-After (docs/robustness.md).
     for ref, resp in (("Overloaded", "503"), ("GatewayTimeout", "504")):
         assert post["responses"][resp]["$ref"].endswith(ref)
@@ -84,7 +84,26 @@ def test_error_type_enum_matches_docs_table():
         "properties"]["error"]["properties"]["type"]["enum"]
     assert set(enum) == {"invalid_request_error", "auth_error",
                         "configuration_error", "proxy_error",
-                        "overloaded_error"}
+                        "overloaded_error", "timeout_error",
+                        "grammar_error"}
+
+
+def test_response_format_schema_accepts_documented_variants():
+    """The structured-output request surface (docs/structured_output.md):
+    every documented variant validates; junk shapes don't."""
+    for rf in ({"type": "text"},
+               {"type": "json_object"},
+               {"type": "json_schema",
+                "json_schema": {"name": "t", "schema": {"type": "object"}}},
+               {"type": "regex", "pattern": "yes|no"}):
+        check("ResponseFormat", rf)
+        check("CreateChatCompletionRequest",
+              {"messages": [{"role": "user", "content": "x"}],
+               "response_format": rf})
+    import jsonschema as _js
+    for bad in ({"type": "xml"}, {"type": 3}, {}):
+        with pytest.raises(_js.ValidationError):
+            check("ResponseFormat", bad)
 
 
 def test_fixture_requests_validate_against_request_schema():
@@ -187,6 +206,14 @@ async def test_live_aux_endpoints_conform():
     # out-of-range n
     ({**BODY, "n": 99}, {"Authorization": "Bearer t"}, 400,
      "invalid_request_error"),
+    # malformed response_format: caught pre-fan-out by validate_request_body
+    ({**BODY, "response_format": {"type": "json_schema"}},
+     {"Authorization": "Bearer t"}, 400, "invalid_request_error"),
+    # schema outside the constrained-decoding subset: the backend's 400
+    ({**BODY, "response_format": {
+        "type": "json_schema",
+        "json_schema": {"schema": {"$ref": "#/nope"}}}},
+     {"Authorization": "Bearer t"}, 400, "invalid_request_error"),
 ])
 async def test_live_errors_conform(req, headers, status, err_type,
                                    monkeypatch):
@@ -207,6 +234,50 @@ def test_no_fanout_routes_document_model_not_found():
         post = DOC["paths"][route]["post"]
         assert {"200", "400", "401", "404", "500", "503"} <= set(
             post["responses"]), route
+
+
+async def test_live_constrained_response_and_dead_end_conform():
+    """Structured output on the wire: a json_schema request returns a
+    conforming 200 whose content parses; a grammar no token can satisfy
+    (vocab too small to spell '{') returns the documented 422
+    grammar_error shape."""
+    cfg = {
+        "settings": {"timeout": 300},
+        "primary_backends": [
+            {"name": "LLM1", "url": "tpu://llama-tiny?seed=1",
+             "model": "tiny"},
+        ],
+    }
+    rf = {"type": "json_schema", "json_schema": {"schema": {
+        "type": "object", "properties": {"ok": {"type": "boolean"}}}}}
+    async with make_client(cfg) as client:
+        resp = await client.post(
+            "/v1/chat/completions",
+            json={**BODY, "max_tokens": 32, "response_format": rf},
+            headers={"Authorization": "Bearer t"})
+        assert resp.status_code == 200, resp.text
+        body = resp.json()
+        check("CreateChatCompletionResponse", body)
+        content = body["choices"][0]["message"]["content"]
+        assert isinstance(json.loads(content).get("ok"), bool)
+        assert body["choices"][0]["finish_reason"] == "stop"
+
+    tiny = {
+        "settings": {"timeout": 300},
+        "primary_backends": [
+            {"name": "LLM1", "url": "tpu://llama-tiny?vocab_size=20&seed=1",
+             "model": "tiny"},
+        ],
+    }
+    async with make_client(tiny) as client:
+        resp = await client.post(
+            "/v1/chat/completions",
+            json={**BODY, "response_format": rf},
+            headers={"Authorization": "Bearer t"})
+        assert resp.status_code == 422, resp.text
+        body = resp.json()
+        check("ErrorResponse", body)
+        assert body["error"]["type"] == "grammar_error"
 
 
 async def test_live_model_not_found_conforms():
