@@ -56,7 +56,10 @@ Prints ONE JSON line:
    "main_*"/"b7_*"/"b7q_*" dispatch accounting: *_dispatches_per_req (device
    dispatches per request), *_sync_dispatches_per_req (the subset the host
    BLOCKED on — the decode_pipeline ring hides the rest), *_pipeline_depth,
-   *_overrun_tokens (0 when rows finish on device — PERF.md §2)}
+   *_overrun_tokens (0 when rows finish on device — PERF.md §2),
+   *_decode_loop / *_loop_chunks_per_dispatch / *_drain_gap_ms_per_dispatch
+   (megachunk decode: chunks one dispatch covered and the host-drain tax it
+   amortizes — decode_loop=C drops dispatches/req ~C×)}
 
 The ``*_prefix_*`` keys measure automatic prefix caching where it matters —
 7B prefill dominates TTFT there: a long shared system preamble is sent
@@ -282,7 +285,8 @@ async def _engine_counters(client) -> dict:
     out: dict = {}
     for name in ("requests_total", "decode_chunks_total",
                  "overlapped_chunks_total", "overrun_tokens_total",
-                 "spec_turns_total", "decode_pipeline"):
+                 "spec_turns_total", "decode_pipeline", "decode_loop",
+                 "decode_loop_chunks_total", "drain_gap_seconds_total"):
         m = re.search(rf"^quorum_tpu_engine_{name}\{{[^}}]*\}} (\S+)$",
                       resp.text, re.M)
         if m:
@@ -293,20 +297,32 @@ async def _engine_counters(client) -> dict:
 def _dispatch_report(prefix: str, counters: dict) -> dict:
     """Per-phase dispatch accounting: device dispatches per request, how
     many of them the host actually BLOCKED on (total − overlapped — the
-    pipeline hides the rest), and the configured ring depth (PERF.md §2)."""
+    pipeline hides the rest), the configured ring depth (PERF.md §2), and
+    the megachunk numbers — chunk segments per dispatch (→ decode_loop=C
+    when the fusion engages) and the host-drain gap per dispatch (payload
+    on host → tokens in consumer queues), so the decode_loop win is a
+    printed number, not an inference."""
     reqs = counters.get("requests_total") or 0
     if not reqs:
         return {}
     chunks = counters.get("decode_chunks_total", 0)
     chunks += counters.get("spec_turns_total", 0)
     synced = chunks - counters.get("overlapped_chunks_total", 0)
-    return {
+    out = {
         f"{prefix}_dispatches_per_req": round(chunks / reqs, 2),
         f"{prefix}_sync_dispatches_per_req": round(synced / reqs, 2),
         f"{prefix}_pipeline_depth": int(counters.get("decode_pipeline", 1)),
         f"{prefix}_overrun_tokens": int(
             counters.get("overrun_tokens_total", 0)),
+        f"{prefix}_decode_loop": int(counters.get("decode_loop", 1)),
     }
+    plain = counters.get("decode_chunks_total", 0)
+    if plain:
+        out[f"{prefix}_loop_chunks_per_dispatch"] = round(
+            counters.get("decode_loop_chunks_total", 0) / plain, 2)
+        out[f"{prefix}_drain_gap_ms_per_dispatch"] = round(
+            counters.get("drain_gap_seconds_total", 0.0) / plain * 1e3, 3)
+    return out
 
 
 async def bench_7b(model: str, url: str, prefix: str, quant: bool,

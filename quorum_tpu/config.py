@@ -14,7 +14,9 @@ quorum_tpu extends ``primary_backends[].url`` with a ``tpu://`` scheme:
   tpu://<model-id>?family=llama&layers=4&d_model=256&...   in-process JAX model
 
 Query parameters configure the model (see :mod:`quorum_tpu.models.registry`)
-and the serving engine (``decode_chunk=``, ``decode_pipeline=``, ``slots=``,
+and the serving engine (``decode_chunk=``, ``decode_pipeline=``,
+``decode_loop=`` for megachunk decode, ``flash_decode=`` for the Pallas
+decode kernel, ``slots=``,
 ``quant=``, ``prefix_store=host``/``prefix_store_bytes=``/
 ``prefix_store_chunk=`` for the tiered host KV prefix store, … — the full
 grammar is the docstring of

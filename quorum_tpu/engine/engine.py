@@ -96,12 +96,14 @@ from quorum_tpu.models.init import init_params, init_params_sharded
 from quorum_tpu.models.model_config import ModelSpec
 from quorum_tpu.models.transformer import (
     decode_chunk,
+    decode_loop,
     decode_multi,
     decode_step,
     init_cache,
     prefill,
     prefill_segment,
 )
+from quorum_tpu.ops.flash_decode import resolve_flash_decode
 from quorum_tpu.ops.sampling import (
     SamplerConfig,
     apply_token_mask,
@@ -127,6 +129,19 @@ DEFAULT_MAX_PENDING = 128
 # sampling/writing inside the program, so in-flight chunks never produce
 # overrun tokens for it.
 DEFAULT_DECODE_PIPELINE = 2
+# Megachunk decode ("Kernel Looping", PAPERS.md): how many decode chunks ONE
+# dispatch may cover on device (decode_loop=C; 1 = today's one-chunk
+# programs, byte-for-byte — the cache-key pin in tests/test_decode_loop.py).
+# C>1 fuses the chunk-dispatch boundary itself: the device rolls chunk to
+# chunk inside one program (with an all-rows-finished early exit) while the
+# host only drains the token ring buffer. Bounded so a pathological config
+# can't pin the device for seconds per dispatch (the deadline clamp in
+# _effective_loop halves it further per dispatch as needed).
+DEFAULT_DECODE_LOOP = 1
+MAX_DECODE_LOOP = 64
+# EWMA weight for the per-chunk device-latency estimate feeding the
+# deadline clamp on the effective megachunk length.
+CHUNK_EWMA_ALPHA = 0.3
 # Concurrent scoring/embedding device forwards per engine (see
 # ``score_gate`` in InferenceEngine.__init__); excess requests 503.
 SCORE_GATE_SLOTS = 2
@@ -436,10 +451,10 @@ class _InflightChunk:
     at dispatch (0 = the blocking chunk), recorded on the decode span."""
 
     __slots__ = ("payload", "active", "n_steps", "t0", "history", "depth",
-                 "constrained")
+                 "constrained", "n_chunks")
 
     def __init__(self, payload, active, n_steps, t0, history, depth,
-                 constrained=False):
+                 constrained=False, n_chunks=1):
         self.payload = payload
         self.active = active
         self.n_steps = n_steps
@@ -450,6 +465,26 @@ class _InflightChunk:
         # payload carries a trailing per-step masked-entry count and the
         # reap attributes a constrained= attr to the decode span.
         self.constrained = constrained
+        # Megachunk dispatch: decode chunks this ONE dispatch covers on
+        # device (decode_loop). 1 = a plain decode_chunk payload; >1 = the
+        # fused variant whose token/valid/aux arrays carry a leading
+        # per-chunk axis the reap drains segment by segment.
+        self.n_chunks = n_chunks
+
+    @property
+    def tokens_ahead(self) -> int:
+        """Upper bound on tokens this dispatch can still produce per row."""
+        return self.n_steps * self.n_chunks
+
+    def ready(self) -> bool:
+        """True when every payload array has landed (non-blocking probe) —
+        the incremental-drain check: a completed dispatch behind the
+        blocking oldest can be reaped without pacing the device."""
+        try:
+            return all(x.is_ready() for x in jax.tree.leaves(self.payload)
+                       if isinstance(x, jax.Array))
+        except Exception:
+            return False
 
 
 class _Admission:
@@ -503,7 +538,12 @@ class _DraftRuntime:
     BITE = 16  # max tokens per advance program (T buckets: powers of two ≤ 16)
 
     def __init__(self, spec: ModelSpec, target_spec: ModelSpec, rows: int,
-                 seed: int = 0, params=None):
+                 seed: int = 0, params=None, flash: str | None = None):
+        # The owning engine's resolved flash-decode gate: the draft's own
+        # decode steps must run the same attention kernel as the target's
+        # (a flash_decode=1 backend with speculation on would otherwise
+        # silently measure a mixed-kernel arm in the PERF.md §5 A/B).
+        self.flash = flash
         if spec.vocab_size != target_spec.vocab_size:
             raise ValueError(
                 f"draft model vocab {spec.vocab_size} != target vocab "
@@ -547,7 +587,8 @@ class _DraftRuntime:
                     tok, lens, ck, cv = carry
                     logits, ck, cv = decode_step(
                         params, self.spec, tok, lens, ck, cv,
-                        write_mask=wmask, history=history)
+                        write_mask=wmask, history=history,
+                        flash=self.flash)
                     nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
                     return (nxt, lens + 1, ck, cv), nxt
 
@@ -648,6 +689,8 @@ class InferenceEngine:
         seed: int = 0,
         decode_chunk: int = 8,
         decode_pipeline: int = DEFAULT_DECODE_PIPELINE,
+        decode_loop: int = DEFAULT_DECODE_LOOP,
+        flash_decode: str | None = None,
         params=None,
         n_slots: int = DEFAULT_SLOTS,
         prefill_chunk: int = DEFAULT_PREFILL_CHUNK,
@@ -705,6 +748,22 @@ class InferenceEngine:
         # Depth of the decode-dispatch ring (see DEFAULT_DECODE_PIPELINE):
         # up to this many chunks in flight; the host blocks on the oldest.
         self.decode_pipeline = max(1, int(decode_pipeline))
+        # Megachunk decode (see DEFAULT_DECODE_LOOP): up to this many chunks
+        # fused into ONE dispatch. _effective_loop clamps it per dispatch
+        # (admission pressure, remaining budgets, in-flight deadlines).
+        if not 1 <= int(decode_loop) <= MAX_DECODE_LOOP:
+            raise ValueError(
+                f"decode_loop={decode_loop} out of range [1, "
+                f"{MAX_DECODE_LOOP}]")
+        # Floored to a power of two: every per-dispatch clamp halves, so a
+        # non-pow2 C would spawn a SECOND family of fused program shapes
+        # (48, 24, 12, 6, 3 beside the budget cap's 2..32), each a full
+        # XLA compile at 7B scale.
+        self.decode_loop = 1 << (int(decode_loop).bit_length() - 1)
+        # Per-backend flash-decode gate, resolved ONCE (programs are cached
+        # per engine; QUORUM_TPU_FLASH_DECODE stays a process override —
+        # ops/flash_decode.resolve_flash_decode). "" = masked-dense.
+        self._flash = resolve_flash_decode(flash_decode)
         self.n_slots = max(1, n_slots)
         # Admission gate for the direct device forwards (embeddings,
         # teacher-forced scoring): chat decode is slot-queue-gated, but
@@ -951,6 +1010,20 @@ class InferenceEngine:
         self.n_spec_turns = 0      # speculative verify dispatches
         self.n_spec_accepted = 0   # draft tokens accepted across them
         self.n_decode_chunks = 0   # plain batched decode dispatch turns
+        # Megachunk accounting: device-side chunk segments that produced at
+        # least one delivered/overrun token, summed over megachunk (and
+        # plain — they count 1) dispatches. decode_chunks_total keeps
+        # counting DISPATCHES, so dispatches-per-request drops ~C× under
+        # decode_loop=C while this stays ~constant.
+        self.n_loop_chunks = 0
+        # Host-drain gap: time between a dispatch's payload landing on host
+        # (fetch complete) and its last token handed to the consumer
+        # queues, summed in seconds — the per-dispatch host tax the bench
+        # divides out (scripts/hostpath_bench.py).
+        self.drain_gap_s = 0.0
+        # EWMA of per-chunk dispatch-to-reap latency (seconds) feeding the
+        # deadline clamp in _effective_loop. 0 until the first reap.
+        self._chunk_ewma_s = 0.0
         # Constrained decoding (docs/structured_output.md): the device-side
         # grammar arena — every admitted grammar's token-DFA rows
         # concatenated at stable offsets behind the reserved FREE row 0
@@ -996,7 +1069,7 @@ class InferenceEngine:
                     "off — drop the draft knob instead)")
             self._draft_rt = _DraftRuntime(
                 draft_spec, self.spec, self._rows, seed=draft_seed,
-                params=draft_params)
+                params=draft_params, flash=self._flash)
         else:
             self._draft_rt = None
         self._stop = False
@@ -1630,18 +1703,31 @@ class InferenceEngine:
             self._dfa = self._dfa_reset_fn()(self._dfa, np.int32(r))
 
     def _decode_key(self, n_steps: int, want_lp: bool, history: int,
-                    constrained: bool):
-        """The decode-program cache key. The UNCONSTRAINED key is the
-        pre-constrain 3-tuple — pinned by tests: batches with no grammar
-        row compile and dispatch the exact program variant they always
-        did, with no mask/table operands (the logprobs-gating contract)."""
+                    constrained: bool, n_chunks: int = 1):
+        """The decode-program cache key. The UNCONSTRAINED single-chunk key
+        is the pre-constrain 3-tuple — pinned by tests: batches with no
+        grammar row compile and dispatch the exact program variant they
+        always did, with no mask/table operands (the logprobs-gating
+        contract). Megachunk variants (``n_chunks`` > 1) live under their
+        own "loop"-tagged keys, so a ``decode_loop=1`` engine can never
+        compile one (the decode_loop=1 cache-key pin — same gating pattern
+        again)."""
         if constrained:
-            return ("dfa", n_steps, want_lp, history, self._g_bucket)
-        return (n_steps, want_lp, history)
+            base = ("dfa", n_steps, want_lp, history, self._g_bucket)
+        else:
+            base = (n_steps, want_lp, history)
+        if n_chunks > 1:
+            return ("loop", n_chunks) + base
+        return base
 
     def _decode_fn(self, n_steps: int, want_lp: bool, history: int,
-                   tstates: int = 0):
-        """Jitted: ``n_steps`` batched decode+sample steps over all slots.
+                   tstates: int = 0, n_chunks: int = 1):
+        """Jitted: ``n_steps`` batched decode+sample steps over all slots —
+        times ``n_chunks`` when megachunked (decode_loop=C > 1): the chunk
+        body runs inside a device-resident outer loop with an
+        all-rows-finished early exit (transformer.decode_loop), the token/
+        valid/aux outputs gain a leading per-chunk axis, and one dispatch
+        covers what used to be C dispatches' worth of host turnaround.
 
         Variants per (chunk size, want_lp, history bucket): the ``want_lp``
         one additionally emits per-step logprobs (log_softmax over [S, V] +
@@ -1673,11 +1759,13 @@ class InferenceEngine:
         so grammar completion maps to finish_reason "stop" with no new
         host logic.)"""
         constrained = tstates > 0
-        key = self._decode_key(n_steps, want_lp, history, constrained)
+        key = self._decode_key(n_steps, want_lp, history, constrained,
+                               n_chunks)
         fn = self._decode_cache.get(key)
         if fn is not None:
             return fn
         spec = self.spec
+        flash = self._flash
 
         n_top = min(TOP_LOGPROBS, spec.vocab_size)
         n_rows = self._rows
@@ -1706,7 +1794,7 @@ class InferenceEngine:
                         mem, n_s,
                         lambda p, k, v, t, ps, w: decode_step(
                             p, spec, t, ps, k, v, write_mask=w,
-                            history=history),
+                            history=history, flash=flash),
                         params, ck, cv, tok, pos, wm)
             else:
                 def model_call(ck, cv, tok, pos, wm):
@@ -1714,7 +1802,7 @@ class InferenceEngine:
                         ens,
                         lambda p, k, v: decode_step(
                             p, spec, tok, pos, k, v, write_mask=wm,
-                            history=history),
+                            history=history, flash=flash),
                         params, ck, cv)
 
             def sample_fn(logits, live, carry):
@@ -1779,26 +1867,46 @@ class InferenceEngine:
 
             carry0 = ((keys_s, counts_s, dfa_s) if constrained
                       else (keys_s, counts_s))
-            (toks, _valid, n_valid, live_end, budget_s, ck, cv, lengths_s,
-             carry_out, aux) = decode_chunk(
-                params, spec, n_steps, token_s, lengths_s, live0, budget_s,
-                eos_s, ck, cv, sample_fn, carry0,
-                history=history, model_call=model_call)
+            if n_chunks > 1:
+                # Megachunk: C chunk bodies fused in one program with an
+                # all-dead early exit; toks [C, B, n_steps], n_valid
+                # [C, B], aux leaves [C, n_steps, ...] — the reap drains
+                # the per-chunk segments in order.
+                (toks, n_valid, tok_end, live_end, budget_s, ck, cv,
+                 lengths_s, carry_out, aux) = decode_loop(
+                    params, spec, n_steps, n_chunks, token_s, lengths_s,
+                    live0, budget_s, eos_s, ck, cv, sample_fn, carry0,
+                    history=history, model_call=model_call)
+            else:
+                (toks, _valid, n_valid, live_end, budget_s, ck, cv,
+                 lengths_s, carry_out, aux) = decode_chunk(
+                    params, spec, n_steps, token_s, lengths_s, live0,
+                    budget_s, eos_s, ck, cv, sample_fn, carry0,
+                    history=history, model_call=model_call)
+                tok_end = toks[:, -1]
             if constrained:
                 keys_s, counts_s, dfa_s = carry_out
             else:
                 keys_s, counts_s = carry_out
             if want_lp:
                 s_lp, top_ix, top_lp = aux[:3]
-                lp_out = (s_lp.T, top_ix.transpose(1, 0, 2),
-                          top_lp.transpose(1, 0, 2))
+                if n_chunks > 1:
+                    # step-major → row-major per chunk segment:
+                    # [C, steps, S(, top)] → [C, S, steps(, top)]
+                    lp_out = (s_lp.transpose(0, 2, 1),
+                              top_ix.transpose(0, 2, 1, 3),
+                              top_lp.transpose(0, 2, 1, 3))
+                else:
+                    lp_out = (s_lp.T, top_ix.transpose(1, 0, 2),
+                              top_lp.transpose(1, 0, 2))
             else:
                 lp_out = ()
-            mask_out = (aux[-1],) if constrained else ()  # [n_steps] int32
+            # [n_steps] int32 ([C, n_steps] megachunked — the reap sums)
+            mask_out = (aux[-1],) if constrained else ()
             # Rows outside this chunk's active set keep their liveness (a
             # slot mid-admission must not be marked dead under the ring).
             live_s = jnp.where(active > 0, live_end, live_s)
-            token_s = jnp.where(active > 0, toks[:, -1], token_s)
+            token_s = jnp.where(active > 0, tok_end, token_s)
             tail = (ck, cv, token_s, lengths_s, keys_s, counts_s,
                     live_s, budget_s)
             if constrained:
@@ -2191,6 +2299,9 @@ class InferenceEngine:
                 "constrained_requests_total": self.n_constrained,
                 "constrain_masked_tokens_total": self.n_constrain_masked,
                 "decode_pipeline": self.decode_pipeline,
+                "decode_loop": self.decode_loop,
+                "decode_loop_chunks_total": self.n_loop_chunks,
+                "drain_gap_seconds_total": round(self.drain_gap_s, 6),
                 "inflight_chunks": len(self._inflight),
                 "rebuilds_total": self.n_rebuilds,
                 "deadline_exceeded_total": self.n_deadline_exceeded,
@@ -3029,28 +3140,90 @@ class InferenceEngine:
         self._fill_inflight()
         if self._inflight:
             self._reap_oldest()
+            # Incremental drain: dispatches behind the (blocking) oldest
+            # whose payloads already landed are reaped without pacing the
+            # device — under megachunks a long dispatch can complete
+            # several successors' worth of host work, and tokens must not
+            # sit in finished device buffers while the host waits on a
+            # future turn's blocking reap.
+            while self._inflight and self._inflight[0].ready():
+                self._reap_oldest()
+
+    def _admission_pressure(self) -> bool:
+        """A chunked admission is mid-prefill, or a pending request could
+        actually claim a slot right now. Pending requests with NO free
+        slot are NOT pressure — they cannot admit until a row finishes
+        anyway, and deep/fused dispatch is exactly what finishes rows
+        sooner. Caller holds ``_cond``."""
+        if self._admitting:
+            return True
+        if not self._pending:
+            return False
+        members = {r.member for r in self._pending}
+        for m in members:
+            lo = m * self.n_slots
+            for i in range(lo, lo + self.n_slots):
+                if self._slots[i] is None and i not in self._claimed:
+                    return True
+        return False
 
     def _target_depth(self) -> int:
         """How deep the ring may run right now. Admission pressure caps it
-        at 1 (dispatch-then-drain): when a pending request could actually
-        claim a slot, or a chunked admission is mid-prefill, every extra
-        in-flight chunk would delay the admission by a whole chunk on
-        device (its programs chain behind the ring). Pending requests with
-        NO free slot do not cap the depth — they cannot admit until a row
-        finishes anyway, and deep dispatch is exactly what finishes rows
-        sooner."""
+        at 1 (dispatch-then-drain): every extra in-flight chunk would
+        delay the admission by a whole chunk on device (its programs
+        chain behind the ring)."""
         with self._cond:
-            if self._stop or self._admitting:
+            if self._stop or self._admission_pressure():
                 return 1
-            if not self._pending:
-                return self.decode_pipeline
-            members = {r.member for r in self._pending}
-            for m in members:
-                lo = m * self.n_slots
-                for i in range(lo, lo + self.n_slots):
-                    if self._slots[i] is None and i not in self._claimed:
-                        return 1
             return self.decode_pipeline
+
+    def _effective_loop(self, active, n_steps: int, ahead: int) -> int:
+        """Chunks THIS dispatch may fuse (1..decode_loop), clamped so the
+        fusion never costs what it saves:
+
+        - **admission pressure** → 1: an admission waits for the ring to
+          drain, and a C-chunk program in it would stretch that wait C×
+          (the same rule that caps the ring depth);
+        - **remaining budgets**: fuse no more chunks than the longest
+          still-live row can fill (rounded up to a power of two so the
+          clamp adds log-many program shapes, not one per tail length) —
+          the on-device early exit makes over-dispatch cheap, not free;
+        - **deadlines** (the PR-4 backstop interaction): one dispatch must
+          not outlive the tightest deadline among active OR queued
+          requests — the per-turn sweep only runs between dispatches, and
+          a C-chunk program that blows through a deadline would push the
+          shed/cancel past the server's 2 s DEADLINE_SLACK_S backstop. Estimated from the per-chunk
+          dispatch-to-reap EWMA; halved (staying a power of two) until it
+          fits.
+        """
+        c = self.decode_loop
+        if c <= 1 or not active:
+            return 1
+        with self._cond:
+            if self._admission_pressure():
+                return 1
+            # Queued requests with no free slot exert no admission
+            # pressure, but their deadline SWEEP runs only between
+            # dispatches — a C-chunk dispatch delays their shed by its
+            # whole length, so their deadlines clamp C exactly like an
+            # active row's would.
+            waiting = [r.deadline for r in self._pending
+                       if r.deadline is not None]
+        rem = max(r.budget - r.emitted - ahead for _, r in active)
+        if rem <= 0:
+            return 1
+        need = -(-rem // n_steps)
+        cap = 1
+        while cap < need:
+            cap <<= 1
+        c = min(c, cap)
+        deadlines = waiting + [r.deadline for _, r in active
+                               if r.deadline is not None]
+        if deadlines and self._chunk_ewma_s > 0.0:
+            slack = min(deadlines) - time.monotonic()
+            while c > 1 and c * self._chunk_ewma_s > max(slack, 0.0):
+                c //= 2
+        return max(1, c)
 
     def _fill_inflight(self) -> None:
         target = self._target_depth()
@@ -3075,11 +3248,13 @@ class InferenceEngine:
             # Planned lengths: host-known emitted counts plus every step
             # already in flight — an upper bound on where rows can be when
             # this chunk runs (rows that finish on device stop short of it).
-            ahead = sum(c.n_steps for c in self._inflight)
+            ahead = sum(c.tokens_ahead for c in self._inflight)
+            n_chunks = self._effective_loop(active, n_steps, ahead)
             planned = max(len(r.prompt_ids) + r.emitted for _, r in active)
             planned += ahead
             history = prefill_bucket(
-                min(planned + n_steps, self.spec.max_seq), self.spec.max_seq)
+                min(planned + n_steps * n_chunks, self.spec.max_seq),
+                self.spec.max_seq)
             if depth > 0:
                 # Dispatching AHEAD of the read is worth it only when some
                 # row can still be decoding in this chunk (the device budget
@@ -3089,18 +3264,18 @@ class InferenceEngine:
                 # compile.
                 if not any(r.budget - r.emitted > ahead for _, r in active):
                     return
-                if self._decode_key(n_steps, want_lp, history,
-                                    constrained) not in self._decode_cache:
+                if self._decode_key(n_steps, want_lp, history, constrained,
+                                    n_chunks) not in self._decode_cache:
                     return
             mask = np.zeros((self._rows,), np.int32)
             for i, _ in active:
                 mask[i] = 1
             t0 = time.perf_counter()
             payload = self._dispatch_chunk(mask, n_steps, want_lp, history,
-                                           constrained)
+                                           constrained, n_chunks)
             self._inflight.append(
                 _InflightChunk(payload, active, n_steps, t0, history, depth,
-                               constrained))
+                               constrained, n_chunks))
             if depth > 0:
                 self.n_overlapped += 1
             obs.PIPELINE_DEPTH.set(len(self._inflight))
@@ -3117,16 +3292,34 @@ class InferenceEngine:
         dispatch-to-reap latency is kept as the span's ``inflight`` attr."""
         c = self._inflight.popleft()
         t0 = time.perf_counter()
-        done = self._emit_chunk(c)
+        done, n_exec = self._emit_chunk(c)
         t1 = time.perf_counter()
         obs.DECODE_CHUNK.observe(t1 - t0)
         obs.PIPELINE_DEPTH.set(len(self._inflight))
         self.n_decode_chunks += 1
         self.n_decode_rows += len(c.active)
+        # Megachunk accounting: chunk segments this dispatch actually
+        # produced tokens for (the early exit skips the all-dead tail),
+        # plus the per-chunk latency EWMA the deadline clamp estimates
+        # from. The divisor is the EXECUTED segment count, not the
+        # dispatched C — early-exited dispatches ran only n_exec chunks,
+        # and dividing by C would bias the estimate low by up to C×,
+        # letting a later fused dispatch outlive a deadline the clamp
+        # exists to protect. (Dispatch-to-reap still overestimates for
+        # overlapped dispatches — conservative, the right direction.)
+        self.n_loop_chunks += n_exec
+        obs.DECODE_LOOP_CHUNKS.observe(n_exec)
+        per_chunk = (t1 - c.t0) / max(1, n_exec)
+        self._chunk_ewma_s = (
+            per_chunk if self._chunk_ewma_s == 0.0
+            else (1 - CHUNK_EWMA_ALPHA) * self._chunk_ewma_s
+            + CHUNK_EWMA_ALPHA * per_chunk)
         meta = {}
         if c.constrained:
             meta["constrained"] = sum(
                 1 for _, r in c.active if r.grammar is not None)
+        if c.n_chunks > 1:
+            meta["chunks"] = c.n_chunks
         for i, req in c.active:
             if self._slots[i] is req or i in done:
                 self._turn_span(req, "decode", t0, t1, steps=c.n_steps,
@@ -3163,10 +3356,11 @@ class InferenceEngine:
         self._queue_snapshot(i)
 
     def _dispatch_chunk(self, mask, n_steps: int, want_lp: bool, history: int,
-                        constrained: bool = False):
+                        constrained: bool = False, n_chunks: int = 1):
         """Enqueue one decode chunk (non-blocking — jax arrays are futures);
         chains the per-slot device state so further dispatches can follow
-        before this one is read. Returns the chunk's output arrays.
+        before this one is read. Returns the chunk's output arrays — with
+        a leading per-chunk axis when ``n_chunks`` > 1 (megachunk).
 
         The constrained variant threads the grammar arena tables (read-only
         operands — never donated, shared by every in-flight chunk) and the
@@ -3176,7 +3370,8 @@ class InferenceEngine:
         faults.fire("engine.decode")
         if constrained:
             out = self._decode_fn(n_steps, want_lp, history,
-                                  tstates=self._g_bucket)(
+                                  tstates=self._g_bucket,
+                                  n_chunks=n_chunks)(
                 self.params, mask, self._eos, self._g_trans, self._g_accept,
                 self._ck, self._cv, self._token,
                 self._lengths, self._keys, self._temp, self._topp, self._topk,
@@ -3192,7 +3387,7 @@ class InferenceEngine:
              self._lengths, self._keys, self._counts, self._live,
              self._budget, self._dfa) = out
             return (toks, n_valid, masked)
-        out = self._decode_fn(n_steps, want_lp, history)(
+        out = self._decode_fn(n_steps, want_lp, history, n_chunks=n_chunks)(
             self.params, mask, self._eos, self._ck, self._cv, self._token,
             self._lengths, self._keys, self._temp, self._topp, self._topk,
             self._pp, self._fp, self._counts, self._bias,
@@ -3207,7 +3402,7 @@ class InferenceEngine:
          self._keys, self._counts, self._live, self._budget) = out
         return (toks, n_valid)
 
-    def _emit_chunk(self, c: "_InflightChunk") -> set[int]:
+    def _emit_chunk(self, c: "_InflightChunk") -> tuple[set[int], int]:
         """Block on one dispatched chunk's outputs and deliver its tokens.
 
         ``n_valid[i]`` (computed ON DEVICE) bounds row i's delivery: a row
@@ -3215,9 +3410,21 @@ class InferenceEngine:
         nothing here, so nothing is discarded. Tokens produced for a row
         the host has since released (cancellation, stop strings — finishes
         the device cannot see) count into ``overrun_tokens_total``.
-        Returns the slots that finished in THIS chunk."""
+
+        A megachunk dispatch (``c.n_chunks`` > 1) arrives with a leading
+        per-chunk axis; its segments drain in chunk order — per-chunk
+        ``n_valid`` keeps delivery exact (a row that finished in segment 0
+        produced nothing in segment 1), and a host-side finish inside
+        segment j counts the later segments' tokens for that row as
+        overrun (the documented ≤ C−1-chunk waste for cancel/stop-string
+        finishes). Plain dispatches are normalized to a 1-segment view of
+        the same loop.
+
+        Returns ``(slots that finished in THIS dispatch, segments that
+        produced any token)``."""
         active, payload = c.active, c.payload
         fetched = _host_fetch(*payload)
+        t_fetch = time.perf_counter()
         if c.constrained:
             # The grammar variant's trailing per-step masked-entry counts
             # ride the fetch the tokens already require — no extra sync.
@@ -3231,25 +3438,42 @@ class InferenceEngine:
         else:
             toks, n_valid = fetched
             s_lp = top_ix = top_lp = None
+        toks, n_valid = np.asarray(toks), np.asarray(n_valid)
+        if c.n_chunks == 1:
+            toks, n_valid = toks[None], n_valid[None]
+            if s_lp is not None:
+                s_lp, top_ix, top_lp = (
+                    np.asarray(s_lp)[None], np.asarray(top_ix)[None],
+                    np.asarray(top_lp)[None])
         done: set[int] = set()
-        for i, req in active:
-            k = int(n_valid[i])
-            if self._slots[i] is not req:
-                # Released (or re-admitted) while this chunk was in flight:
-                # every token the device still produced for the row is
-                # overrun.
-                self.n_overrun += k
-                continue
-            before = req.emitted
-            for j in range(k):
-                if req.want_lp >= 0 and s_lp is not None:
-                    req.lp.append(
-                        (float(s_lp[i, j]), top_ix[i, j], top_lp[i, j]))
-                if self._emit(req, int(toks[i, j])):
-                    done.add(i)
-                    break
-            self.n_overrun += k - (req.emitted - before)
-        return done
+        n_exec = 0
+        for ci in range(toks.shape[0]):
+            nv = n_valid[ci]
+            if not int(nv.sum()):
+                continue  # all-dead segment (on-device early exit)
+            n_exec += 1
+            for i, req in active:
+                k = int(nv[i])
+                if not k:
+                    continue
+                if self._slots[i] is not req or i in done:
+                    # Released/re-admitted while in flight, or finished
+                    # host-side in an earlier segment of this dispatch:
+                    # every token the device still produced is overrun.
+                    self.n_overrun += k
+                    continue
+                before = req.emitted
+                for j in range(k):
+                    if req.want_lp >= 0 and s_lp is not None:
+                        req.lp.append((float(s_lp[ci, i, j]),
+                                       top_ix[ci, i, j], top_lp[ci, i, j]))
+                    if self._emit(req, int(toks[ci, i, j])):
+                        done.add(i)
+                        break
+                self.n_overrun += k - (req.emitted - before)
+        # Host-drain gap: payload-on-host to last token in consumer queues.
+        self.drain_gap_s += time.perf_counter() - t_fetch
+        return done, n_exec
 
     @staticmethod
     def _draft(req: _Request, g: int) -> list[int] | None:
@@ -3454,6 +3678,8 @@ def get_engine(
     *,
     seed: int = 0,
     decode_pipeline: int = DEFAULT_DECODE_PIPELINE,
+    decode_loop: int = DEFAULT_DECODE_LOOP,
+    flash_decode: str | None = None,
     n_slots: int = DEFAULT_SLOTS,
     prefill_chunk: int = DEFAULT_PREFILL_CHUNK,
     max_pending: int = DEFAULT_MAX_PENDING,
@@ -3472,10 +3698,14 @@ def get_engine(
     sp_impl: str = "ring",
 ) -> InferenceEngine:
     """Engines are keyed by weight identity (spec, seed, mesh, quant,
-    ensemble, members, draft model) plus the cache representation (kv_quant) —
-    dispatch knobs like decode_chunk are per-call, so two backends that differ
+    ensemble, members, draft model) plus the cache representation (kv_quant)
+    and the flash-decode gate (flash_decode — it selects which attention
+    programs compile, and the PERF.md §5 A/B needs two backends in one
+    process to genuinely run different kernels) — dispatch knobs like
+    decode_chunk are per-call, so two backends that differ
     only in chunking share one set of weights on device. ``n_slots``/
-    ``prefill_chunk``/``max_pending``/``decode_pipeline``/``prefix_store*``
+    ``prefill_chunk``/``max_pending``/``decode_pipeline``/``decode_loop``/
+    ``prefix_store*``
     (structural properties of the preallocated cache and the scheduler)
     apply at first construction; later callers share the existing engine
     as-is. ``spec_decode`` and
@@ -3497,6 +3727,7 @@ def get_engine(
     key = (spec, seed, quant or None, max(1, int(ensemble)),
            max(1, int(members)), kv_quant or None,
            draft_spec, draft_seed, draft_ckpt, sp_key,
+           resolve_flash_decode(flash_decode),
            tuple(sorted(mesh.shape.items())),
            tuple(map(str, mesh.devices.flat)))
     with _ENGINES_LOCK:
@@ -3509,6 +3740,7 @@ def get_engine(
             eng = InferenceEngine(
                 spec, mesh, seed=seed, n_slots=n_slots,
                 decode_pipeline=decode_pipeline,
+                decode_loop=decode_loop, flash_decode=flash_decode,
                 prefill_chunk=prefill_chunk, max_pending=max_pending,
                 spec_decode=spec_decode, quant=quant,
                 prefix_cache=prefix_cache, prefix_store=prefix_store,
@@ -3533,6 +3765,8 @@ def get_engine_from_ckpt(
     *,
     dtype: str | None = None,
     decode_pipeline: int = DEFAULT_DECODE_PIPELINE,
+    decode_loop: int = DEFAULT_DECODE_LOOP,
+    flash_decode: str | None = None,
     n_slots: int = DEFAULT_SLOTS,
     prefill_chunk: int = DEFAULT_PREFILL_CHUNK,
     max_pending: int = DEFAULT_MAX_PENDING,
@@ -3573,7 +3807,7 @@ def get_engine_from_ckpt(
 
     sp_key = sp_impl if dict(mesh.shape).get(_SP, 1) > 1 else None
     key = ("ckpt", resolved, eff_dtype, quant or None, kv_quant or None,
-           draft_resolved, sp_key,
+           draft_resolved, sp_key, resolve_flash_decode(flash_decode),
            tuple(sorted(mesh.shape.items())),
            tuple(map(str, mesh.devices.flat)))
     with _ENGINES_LOCK:
@@ -3590,6 +3824,7 @@ def get_engine_from_ckpt(
             eng = InferenceEngine(
                 spec, mesh, params=params, n_slots=n_slots,
                 decode_pipeline=decode_pipeline,
+                decode_loop=decode_loop, flash_decode=flash_decode,
                 prefill_chunk=prefill_chunk, max_pending=max_pending,
                 spec_decode=spec_decode, quant=quant,
                 prefix_cache=prefix_cache, prefix_store=prefix_store,

@@ -500,6 +500,7 @@ def decode_step(
     cache_v: jnp.ndarray,
     write_mask: jnp.ndarray | None = None,  # [B] bool: rows allowed to write
     history: int | None = None,  # static: attend over cache[:history] only
+    flash: str | None = None,  # "" off / "tpu" / "interpret"; None = env gate
 ):
     """One autoregressive step. Returns (logits [B,V], cache_k, cache_v).
 
@@ -514,8 +515,14 @@ def decode_step(
     HBM-bandwidth-bound; without the bound every step streams the full
     padded ``max_seq`` K/V (VERDICT r2 weakness 5) — at 8B/8k that is ~16×
     the needed bytes for a 512-token conversation. The engine picks a
-    power-of-two bucket per chunk, so log-many programs cover every length."""
+    power-of-two bucket per chunk, so log-many programs cover every length.
+
+    ``flash`` selects the Pallas flash-decode kernel per CALL (the engine
+    resolves its backend's ``flash_decode=`` knob once and threads it
+    through every decode program); ``None`` keeps the process-env gate
+    (``flash_decode_mode()``) for direct callers and tests."""
     b = token.shape[0]
+    flash_mode = flash_decode_mode() if flash is None else flash
     x = _emb_rows(params["tok_emb"], token, jnp.dtype(spec.dtype))[:, None, :]  # [B,1,D]
     if spec.emb_scale != 1.0:  # gemma scales embeddings by sqrt(d_model)
         x = x * jnp.asarray(spec.emb_scale, x.dtype)
@@ -572,15 +579,15 @@ def decode_step(
             attn = decode_attention_q8(
                 q, read_k[0], read_k[1], read_v[0], read_v[1], lengths + 1,
                 window=spec.sliding_window)
-        elif flash_decode_mode():
-            # Opt-in Pallas kernel (QUORUM_TPU_FLASH_DECODE=1): per-ROW
-            # exact cache reads — a short row co-batched with a long one
-            # stops streaming K/V near its own length, not at the shared
+        elif flash_mode:
+            # Opt-in Pallas kernel (flash_decode=1 / QUORUM_TPU_FLASH_DECODE):
+            # per-ROW exact cache reads — a short row co-batched with a long
+            # one stops streaming K/V near its own length, not at the shared
             # history bucket. The wrapper re-checks shape support and falls
             # back to decode_attention itself (ops/flash_decode.py).
             attn = flash_decode_attention(
                 q, read_k, read_v, lengths + 1,
-                interpret=flash_decode_mode() == "interpret",
+                interpret=flash_mode == "interpret",
                 window=spec.sliding_window)
         else:
             attn = decode_attention(q, read_k, read_v, lengths + 1,
@@ -611,6 +618,7 @@ def decode_chunk(
     sample_carry,
     history: int | None = None,
     model_call=None,
+    flash: str | None = None,
 ):
     """``n_steps`` decode steps with **on-device finish accounting**.
 
@@ -640,7 +648,7 @@ def decode_chunk(
     if model_call is None:
         def model_call(ck, cv, tok, pos, wm):
             return decode_step(params, spec, tok, pos, ck, cv,
-                               write_mask=wm, history=history)
+                               write_mask=wm, history=history, flash=flash)
 
     def step(carry, _):
         tok, lens, lv, bud, ck, cv, s_carry = carry
@@ -663,6 +671,82 @@ def decode_chunk(
     n_valid = jnp.sum(valid.astype(jnp.int32), axis=1)
     return (toks, valid, n_valid, live, budget, cache_k, cache_v, lengths,
             sample_carry, ys[2:])
+
+
+def decode_loop(
+    params: Params,
+    spec: ModelSpec,
+    n_steps: int,
+    n_chunks: int,
+    token: jnp.ndarray,    # [B] current token ids
+    lengths: jnp.ndarray,  # [B] #tokens already in cache per row
+    live: jnp.ndarray,     # [B] bool: rows decoding in this dispatch
+    budget: jnp.ndarray,   # [B] int32: tokens each row may still produce
+    eos: jnp.ndarray,      # [B] int32: per-row EOS id (-1 = none)
+    cache_k: jnp.ndarray,
+    cache_v: jnp.ndarray,
+    sample_fn,
+    sample_carry,
+    history: int | None = None,
+    model_call=None,
+    flash: str | None = None,
+):
+    """Megachunk decode: up to ``n_chunks`` :func:`decode_chunk` bodies in
+    ONE device-resident program ("Kernel Looping", PAPERS.md — after
+    per-step syncs are gone, the chunk-dispatch boundary itself is the
+    next tax on the token critical path).
+
+    The outer ``lax.scan`` replays the exact per-chunk body back to back
+    with no host dispatch in between; an **all-rows-finished early exit**
+    (``lax.cond`` on ``any(live)``) skips the remaining chunk bodies'
+    forwards once every row has finished on device, so a batch that
+    completes in chunk 1 does not burn ``n_chunks`` chunks of compute —
+    the skipped iterations pass the carry through untouched. Sampled
+    tokens land in a device-resident ``[n_chunks, B, n_steps]`` ring
+    buffer with per-chunk ``n_valid`` counts, which is what lets the host
+    drain completed chunk segments incrementally instead of pacing every
+    chunk boundary.
+
+    ``n_chunks == 1`` is NOT special-cased here on purpose: the engine
+    dispatches plain :func:`decode_chunk` for ``decode_loop=1`` so unfused
+    users compile the exact pre-existing program (the cache-key pin in
+    tests/test_decode_loop.py).
+
+    Returns ``(toks [n_chunks, B, n_steps], n_valid [n_chunks, B],
+    token [B], live, budget, cache_k, cache_v, lengths, sample_carry,
+    aux)`` — ``token`` is the final carried token per row (frozen at each
+    row's last real token), and every ``aux`` leaf gains a leading
+    ``n_chunks`` axis over its per-chunk ``[n_steps, ...]`` shape.
+    """
+    def run_chunk(op):
+        tok, lens, lv, bud, ck, cv, s_carry = op
+        (toks, _valid, n_valid, lv, bud, ck, cv, lens, s_carry, aux) = \
+            decode_chunk(params, spec, n_steps, tok, lens, lv, bud, eos,
+                         ck, cv, sample_fn, s_carry, history=history,
+                         model_call=model_call, flash=flash)
+        # toks[:, -1] IS the carried token (dead rows freeze theirs).
+        return (toks[:, -1], lens, lv, bud, ck, cv, s_carry), \
+            (toks, n_valid, aux)
+
+    carry0 = (token, lengths, live, budget, cache_k, cache_v, sample_carry)
+    # The dead branch must emit the same output pytree as a real chunk;
+    # eval_shape is trace-free, so tracing decode_loop inside jit costs
+    # one abstract pass, never a second compile of the chunk body.
+    out_shapes = jax.eval_shape(lambda op: run_chunk(op)[1], carry0)
+
+    def skip_chunk(op):
+        zeros = jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype),
+                             out_shapes)
+        return op, zeros
+
+    def body(carry, _):
+        return lax.cond(jnp.any(carry[2]), run_chunk, skip_chunk, carry)
+
+    carry, (toks, n_valid, aux) = lax.scan(body, carry0, None,
+                                           length=n_chunks)
+    token, lengths, live, budget, cache_k, cache_v, sample_carry = carry
+    return (toks, n_valid, token, live, budget, cache_k, cache_v, lengths,
+            sample_carry, aux)
 
 
 def decode_multi(

@@ -328,6 +328,17 @@ DECODE_CHUNK = METRICS.histogram(
 PIPELINE_DEPTH = METRICS.gauge(
     "quorum_tpu_decode_pipeline_inflight",
     "Decode chunks currently in flight on the device (dispatch ring depth).")
+# Megachunk decode (decode_loop=C, engine/engine.py): chunk segments ONE
+# dispatch actually produced tokens for — 1 per dispatch when unfused, up
+# to C when the device rolled chunk-to-chunk inside one program, 0 when a
+# dispatch's rows had all finished on device before it ran. The C× win is
+# this histogram's mean against decode_chunks_total staying ~flat.
+DECODE_LOOP_CHUNKS = METRICS.histogram(
+    "quorum_tpu_decode_loop_chunks",
+    "Decode chunk segments covered by one device dispatch (decode_loop "
+    "megachunk fusion; per-chunk n_valid counts the segments that "
+    "produced tokens).",
+    buckets=(1, 2, 4, 8, 16, 32, 64))
 
 # Tiered KV prefix store (quorum_tpu/cache/prefix_store.py + the engine's
 # snapshot/restore hooks, docs/prefix_cache.md): host-RAM retention of

@@ -207,6 +207,62 @@ def flash_decode_enabled() -> bool:
     return bool(flash_decode_mode())
 
 
+def parse_flash_decode(raw: str) -> str:
+    """Validate a ``flash_decode=`` config value → "0" | "1" | "interpret".
+
+    Strict at config time (a typo must not silently mean "off"): accepts
+    the boolean spellings plus the Pallas-interpreter mode used by CPU
+    engine tests."""
+    val = str(raw).strip().lower()
+    if val in ("0", "false", "no", "off", ""):
+        return "0"
+    if val in ("1", "true", "yes", "on"):
+        return "1"
+    if val == "interpret":
+        return "interpret"
+    raise ValueError(
+        f"invalid flash_decode={raw!r} (use 0/1, true/false, yes/no, or "
+        "interpret)")
+
+
+def resolve_flash_decode(knob: str | None) -> str:
+    """Effective flash-decode mode for ONE engine: '' (masked-dense),
+    'tpu', or 'interpret'.
+
+    Precedence: the ``QUORUM_TPU_FLASH_DECODE`` env var, when set, wins
+    over the per-backend ``flash_decode=`` URL knob — the process-wide
+    override the on-chip A/B scripts flip (they must beat a config file
+    they don't control); otherwise the knob drives it, so two backends in
+    one process can run the §5 flash A/B against each other. ``knob`` is
+    None/'' when the URL never set it (falls back to the env gate's
+    default-off). Resolved ONCE at engine construction — programs are
+    cached per engine, so a mid-life flip could never take effect anyway
+    (the same trace-time caveat as :func:`flash_decode_mode`)."""
+    env = os.environ.get("QUORUM_TPU_FLASH_DECODE")
+    if env is not None:
+        # The env value takes the same spellings the URL knob does — an
+        # operator's FLASH_DECODE=on must not silently measure the
+        # masked-dense path in the kernel arm of an A/B. Unparseable
+        # values are a LOUD off (never a crash at engine construction:
+        # one typo'd env var must not brick every engine in the process).
+        try:
+            val = parse_flash_decode(env)
+        except ValueError:
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "ignoring invalid QUORUM_TPU_FLASH_DECODE=%r "
+                "(use 0/1 or interpret) — flash decode forced OFF", env)
+            val = "0"
+    else:
+        val = knob or "0"
+    if val == "1":
+        return "tpu" if jax.default_backend() == "tpu" else ""
+    if val == "interpret":
+        return "interpret"
+    return ""
+
+
 def flash_decode_attention(
     q: jnp.ndarray,        # [B, H, 1, hd]
     k_cache: jnp.ndarray,  # [B, K, T, hd]

@@ -298,7 +298,8 @@ def create_app(
             f"quorum_tpu_uptime_seconds {time.monotonic() - started:.3f}",
         ]
         gauges = ("slots", "members", "busy_slots", "admitting", "pending",
-                  "queue_limit", "decode_pipeline", "inflight_chunks",
+                  "queue_limit", "decode_pipeline", "decode_loop",
+                  "inflight_chunks",
                   "prefix_store_bytes", "prefix_store_entries",
                   "breaker_state")
         # One snapshot per distinct engine (_distinct_engines). Each

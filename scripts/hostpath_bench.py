@@ -1,20 +1,29 @@
-"""Host-path microbench: what the decode-dispatch pipeline buys on CPU.
+"""Host-path microbench: what the decode-dispatch pipeline and the
+megachunk decode loop buy on CPU.
 
 Runs a tiny random-init engine (no checkpoint, no TPU) through the same
-compiled serving programs the real chip runs, once per pipeline depth, and
-reports the dispatch accounting the PR-1 counters expose:
+compiled serving programs the real chip runs — once per pipeline depth and
+once with ``decode_loop=C`` megachunk fusion — and reports the dispatch
+accounting the PR-1 counters expose:
 
-  - ``dispatches_per_request``  decode chunks the generation cost
+  - ``dispatches_per_request``  decode dispatches the generation cost
+                                (under decode_loop=C one dispatch covers up
+                                to C chunks, so this drops ~C×)
   - ``syncs_per_request``       dispatches the host BLOCKED on (chunk
                                 dispatched with an empty ring); the pipelined
                                 remainder overlapped the host turnaround
   - ``overrun_tokens``          tokens produced but discarded (0 when rows
                                 finish on device — EOS/budget at any depth)
+  - ``drain_gap_ms_per_dispatch`` host time between a dispatch's payload
+                                landing on host and its last token handed to
+                                the consumer queues — the per-dispatch host
+                                tax megachunking amortizes over C chunks
   - ``host_turnaround_share``   fraction of the K=1 wall time the deeper
                                 pipeline hid (≈ turnaround/(turnaround +
                                 chunk time) when fully hidden — PERF.md §2)
 
-Usage:  python scripts/hostpath_bench.py [--tokens N] [--chunk C] [--depth K]
+Usage:  python scripts/hostpath_bench.py [--tokens N] [--chunk C]
+        [--depth K] [--loop C]
 Prints one human-readable block and one machine-parsable JSON line.
 ``make hostpath-bench`` runs it; tests/test_hostpath_bench.py is the suite's
 smoke over the same entry point.
@@ -35,14 +44,17 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def run(tokens: int = 64, chunk: int = 4, depth: int = 4,
-        repeats: int = 3) -> dict:
-    """Generate ``tokens`` greedily at decode_pipeline=1 and =``depth`` on
-    fresh tiny engines; return the dispatch/sync/overrun accounting plus
+        repeats: int = 3, loop: int = 4) -> dict:
+    """Generate ``tokens`` greedily at decode_pipeline=1 and =``depth``
+    (both unfused) plus decode_loop=``loop`` megachunks on fresh tiny
+    engines; return the dispatch/sync/overrun/drain-gap accounting plus
     wall times (median of ``repeats`` after a compile warm-up)."""
     if depth < 2:
         # depth 1 IS the K=1 baseline leg — comparing it against itself
         # would report run-to-run noise as a pipeline win.
         raise ValueError("depth must be >= 2 (1 is the baseline leg)")
+    if loop < 2:
+        raise ValueError("loop must be >= 2 (1 is the unfused baseline)")
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     os.environ.pop("PALLAS_AXON_POOL_IPS", None)
 
@@ -53,26 +65,36 @@ def run(tokens: int = 64, chunk: int = 4, depth: int = 4,
     spec = MODEL_PRESETS["llama-tiny"]
     greedy = SamplerConfig(temperature=0.0)
     prompt = [5, 6, 7]
-    out: dict = {"tokens": tokens, "decode_chunk": chunk, "depth": depth}
-    streams: dict[int, list[int]] = {}
+    out: dict = {"tokens": tokens, "decode_chunk": chunk, "depth": depth,
+                 "loop": loop}
+    streams: dict[str, list[int]] = {}
 
-    for k in (1, depth):
-        eng = InferenceEngine(spec, decode_chunk=chunk, decode_pipeline=k)
+    # Legs: (tag, pipeline depth, decode_loop). The loop leg keeps the deep
+    # ring — megachunks compose with pipelining (C chunks per in-flight
+    # entry), and the acceptance number is dispatches/request at loop=C.
+    legs = [("k1", 1, 1), (f"k{depth}", depth, 1),
+            (f"loop{loop}", depth, loop)]
+    for tag, k, c in legs:
+        eng = InferenceEngine(spec, decode_chunk=chunk, decode_pipeline=k,
+                              decode_loop=c)
         eng.generate(prompt, max_new_tokens=tokens, sampler=greedy)  # warm-up
         c0, o0, v0 = eng.n_decode_chunks, eng.n_overlapped, eng.n_overrun
+        g0 = eng.drain_gap_s
         walls = []
         for _ in range(repeats):
             t0 = time.perf_counter()
             res = eng.generate(prompt, max_new_tokens=tokens, sampler=greedy)
             walls.append(time.perf_counter() - t0)
-        streams[k] = res.token_ids
+        streams[tag] = res.token_ids
         dispatches = (eng.n_decode_chunks - c0) / repeats
         overlapped = (eng.n_overlapped - o0) / repeats
-        out[f"k{k}_dispatches_per_request"] = dispatches
-        out[f"k{k}_syncs_per_request"] = dispatches - overlapped
-        out[f"k{k}_overrun_tokens"] = eng.n_overrun - v0
-        out[f"k{k}_wall_s"] = round(statistics.median(walls), 4)
-        out[f"k{k}_tok_s"] = round(tokens / statistics.median(walls), 1)
+        out[f"{tag}_dispatches_per_request"] = dispatches
+        out[f"{tag}_syncs_per_request"] = dispatches - overlapped
+        out[f"{tag}_overrun_tokens"] = eng.n_overrun - v0
+        out[f"{tag}_drain_gap_ms_per_dispatch"] = round(
+            (eng.drain_gap_s - g0) / max(1.0, dispatches * repeats) * 1e3, 3)
+        out[f"{tag}_wall_s"] = round(statistics.median(walls), 4)
+        out[f"{tag}_tok_s"] = round(tokens / statistics.median(walls), 1)
         eng.shutdown()
 
     t1, tk = out["k1_wall_s"], out[f"k{depth}_wall_s"]
@@ -80,7 +102,11 @@ def run(tokens: int = 64, chunk: int = 4, depth: int = 4,
     # synchronized: its share of the K=1 request is the measured stand-in
     # for turnaround/(turnaround + chunk time).
     out["host_turnaround_share"] = round(max(0.0, t1 - tk) / t1, 3) if t1 else 0.0
-    out["tokens_match"] = streams[1] == streams[depth]
+    out["loop_dispatch_reduction"] = round(
+        out["k1_dispatches_per_request"]
+        / max(1e-9, out[f"loop{loop}_dispatches_per_request"]), 2)
+    out["tokens_match"] = (streams["k1"] == streams[f"k{depth}"]
+                           == streams[f"loop{loop}"])
     return out
 
 
@@ -89,24 +115,32 @@ def main() -> int:
     ap.add_argument("--tokens", type=int, default=64)
     ap.add_argument("--chunk", type=int, default=4)
     ap.add_argument("--depth", type=int, default=4)
+    ap.add_argument("--loop", type=int, default=4,
+                    help="decode_loop=C for the megachunk leg (>= 2)")
     ap.add_argument("--repeats", type=int, default=3)
     args = ap.parse_args()
     if args.depth < 2:
         ap.error("--depth must be >= 2 (1 is the K=1 baseline both legs run)")
-    m = run(args.tokens, args.chunk, args.depth, args.repeats)
-    k = args.depth
+    if args.loop < 2:
+        ap.error("--loop must be >= 2 (1 is the unfused baseline)")
+    m = run(args.tokens, args.chunk, args.depth, args.repeats, args.loop)
+    k, c = args.depth, args.loop
     print(f"host-path microbench (llama-tiny, {m['tokens']} tokens, "
           f"decode_chunk={m['decode_chunk']}):")
-    print(f"  K=1 : {m['k1_dispatches_per_request']:.1f} dispatches/req, "
-          f"{m['k1_syncs_per_request']:.1f} blocking syncs/req, "
-          f"{m['k1_tok_s']} tok/s")
-    print(f"  K={k} : {m[f'k{k}_dispatches_per_request']:.1f} dispatches/req, "
-          f"{m[f'k{k}_syncs_per_request']:.1f} blocking syncs/req, "
-          f"{m[f'k{k}_tok_s']} tok/s")
+    for tag, label in (("k1", "K=1      "), (f"k{k}", f"K={k}      "),
+                       (f"loop{c}", f"K={k} C={c}")):
+        print(f"  {label}: {m[f'{tag}_dispatches_per_request']:.1f} "
+              f"dispatches/req, {m[f'{tag}_syncs_per_request']:.1f} blocking "
+              f"syncs/req, {m[f'{tag}_tok_s']} tok/s, "
+              f"{m[f'{tag}_drain_gap_ms_per_dispatch']:.2f} ms drain "
+              "gap/dispatch")
     print(f"  overrun tokens: K=1 {m['k1_overrun_tokens']}, "
-          f"K={k} {m[f'k{k}_overrun_tokens']} (on-device finish)")
+          f"K={k} {m[f'k{k}_overrun_tokens']}, "
+          f"C={c} {m[f'loop{c}_overrun_tokens']} (on-device finish)")
     print(f"  host-turnaround share hidden by K={k}: "
           f"{m['host_turnaround_share']:.1%}")
+    print(f"  dispatch reduction at decode_loop={c}: "
+          f"{m['loop_dispatch_reduction']:.1f}x")
     print(f"  token-for-token identical: {m['tokens_match']}")
     print(json.dumps(m), flush=True)
     return 0

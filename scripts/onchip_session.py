@@ -15,15 +15,22 @@ list; whatever the tunnel survives is kept:
   4. Pallas decode-kernel A/B (``QUORUM_TPU_FLASH_DECODE=1``) on a skewed
      co-batch at 7B — separate processes per arm (the flag is read at
      trace time).
-  5. One ``QUORUM_TPU_PROFILE_DIR`` trace of steady-state 7B decode, to
+  5. Megachunk decode A/B (``decode_loop=4`` vs unfused, ISSUE 6): the
+     fused on-device chunk loop vs one-dispatch-per-chunk at 7B, separate
+     processes per arm (decode_loop is structural on the engine). CPU
+     already pins token equality and the ~C× dispatch reduction
+     (make hostpath-bench); this arm measures what the killed dispatch
+     boundary is worth in decode tok/s on real silicon.
+  6. One ``QUORUM_TPU_PROFILE_DIR`` trace of steady-state 7B decode, to
      attribute the ~38% HBM-roofline gap (PERF §4).
-  6. int8 QUALITY at 7B scale: teacher-forced scoring (engine/score.py)
+  7. int8 QUALITY at 7B scale: teacher-forced scoring (engine/score.py)
      of one fixed prompt under bf16 and under quant=int8 of the SAME
      seed-0 mistral-7b weights — mean |Δlogprob| and the ppl ratio. The
      CPU suite pins quantization error only on tiny models; this is the
      number that says int8 serving is quality-safe at the scale we ship.
 
-Usage: ``python scripts/onchip_session.py [--skip bench,ab,kvq,flash,profile,qq]``
+Usage: ``python scripts/onchip_session.py
+[--skip bench,ab,kvq,flash,megachunk,profile,qq]``
 Each step is a subprocess with its own budget; a wedged step is recorded
 and skipped, never fatal. Results: ``ONCHIP.json`` (merged dict, one key
 prefix per step) + profile trace under ``profiles/``.
@@ -390,6 +397,20 @@ def main() -> None:
                 bank(run_step(
                     arm, [sys.executable, "-c", _SERVE_ONE, B7_URL, "2",
                           arm, "1000", "skew"], budget=b, env_extra=env))
+    if "megachunk" not in skip:
+        # decode_loop=4 vs unfused at 7B: SEPARATE processes per arm —
+        # decode_loop is structural on the engine, and the unfused arm
+        # must compile the exact pre-existing programs (the cache-key pin
+        # the CPU suite enforces). Steady-state decode tok/s is the
+        # number: the fused arm's only difference is the killed
+        # chunk-dispatch boundary between chunks.
+        for arm, arm_url in (("loop_off", B7_URL),
+                             ("loop_on", B7_URL + "&decode_loop=4")):
+            b = fits(arm, 1500)
+            if b:
+                bank(run_step(
+                    arm, [sys.executable, "-c", _SERVE_ONE, arm_url, "2",
+                          arm, "600"], budget=b))
     if "qq" not in skip:
         b = fits("qq", 3100, n_children=2)  # two ~1500s precision arms
         if b:

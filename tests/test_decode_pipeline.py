@@ -159,3 +159,162 @@ def test_dispatch_accounting_counters():
     assert syncs4 < syncs1
     assert m4["overrun_tokens_total"] == 0
     assert m4["decode_pipeline"] == 4 and m1["decode_pipeline"] == 1
+
+
+# ---- megachunk decode loop (decode_loop=C, ISSUE 6) ------------------------
+#
+# decode_loop=C fuses up to C chunk bodies into ONE dispatch
+# (transformer.decode_loop). The C=1 engine is the oracle at BOTH ring
+# depths: the fused program replays the identical per-chunk body, so every
+# leg must be token-for-token.
+
+def _loop_pair(k: int, **kw):
+    """(decode_loop=1 oracle, decode_loop=4 megachunk) engines at ring
+    depth ``k`` over identical weights."""
+    return (InferenceEngine(TINY, decode_chunk=4, decode_pipeline=k,
+                            decode_loop=1, **kw),
+            InferenceEngine(TINY, decode_chunk=4, decode_pipeline=k,
+                            decode_loop=4, **kw))
+
+
+@pytest.mark.parametrize("k", [1, 4])
+def test_loop_greedy_and_sampled_token_for_token(k):
+    e1, e4 = _loop_pair(k)
+    a = e1.generate([5, 6, 7], max_new_tokens=32, sampler=GREEDY)
+    b = e4.generate([5, 6, 7], max_new_tokens=32, sampler=GREEDY)
+    assert a.token_ids == b.token_ids and len(b.token_ids) == 32
+    s = SamplerConfig(temperature=0.9, top_p=0.95)
+    for seed in (7, 42):
+        a = e1.generate([5, 6, 7], max_new_tokens=24, sampler=s, seed=seed)
+        b = e4.generate([5, 6, 7], max_new_tokens=24, sampler=s, seed=seed)
+        assert a.token_ids == b.token_ids, f"seed {seed} diverged at K={k}"
+    assert e4.n_overrun == 0  # budget finishes stay on device under fusion
+
+
+@pytest.mark.parametrize("k", [1, 4])
+def test_loop_eos_mid_chunk_token_for_token(k):
+    """EOS landing mid-chunk inside a megachunk: the on-device early exit
+    must skip the remaining fused chunks — identical output, zero overrun,
+    no C extra chunks of garbage."""
+    e1, e4 = _loop_pair(k)
+    probe = e1.generate([9, 8], max_new_tokens=32, sampler=GREEDY)
+    eos = probe.token_ids[9]  # a position inside fused chunk 3
+    a = e1.generate([9, 8], max_new_tokens=32, sampler=GREEDY, eos_id=eos)
+    b = e4.generate([9, 8], max_new_tokens=32, sampler=GREEDY, eos_id=eos)
+    assert a.token_ids == b.token_ids
+    assert a.finish_reason == b.finish_reason == "stop"
+    assert e4.n_overrun == 0
+
+
+def test_loop_stop_sequence_parity_via_backend():
+    """Host-side stop-string finishes under megachunks: the delivered text
+    must match decode_loop=1 exactly; the already-dispatched fused tail is
+    bounded overrun (≤ C−1 chunks), never output."""
+    import asyncio
+
+    from quorum_tpu.backends.tpu_backend import TpuBackend
+    from quorum_tpu.config import BackendSpec
+    from quorum_tpu.engine.engine import release_engine
+
+    def backend(c):
+        return TpuBackend.from_spec(BackendSpec(
+            name=f"l{c}",
+            url=f"tpu://llama-tiny?seed=5&decode_pipeline=4&decode_loop={c}",
+            model="m"))
+
+    b1 = backend(1)
+    base = {"model": "m", "messages": [{"role": "user", "content": "hi"}],
+            "max_tokens": 24, "temperature": 0.0}
+    probe = asyncio.run(b1.complete(base, {}, 60))
+    text = probe.body["choices"][0]["message"]["content"]
+    stop = text[3:5] if len(text) >= 5 else text[-1]
+    r1 = asyncio.run(b1.complete({**base, "stop": [stop]}, {}, 60))
+    # decode_loop is structural (first-construction-wins on the shared
+    # engine): evict the C=1 engine so the C=4 URL really builds one.
+    release_engine(b1.engine)
+    b4 = backend(4)
+    assert b4.engine.decode_loop == 4
+    r4 = asyncio.run(b4.complete({**base, "stop": [stop]}, {}, 60))
+    c1, c4 = r1.body["choices"][0], r4.body["choices"][0]
+    assert c4["message"]["content"] == c1["message"]["content"]
+    assert c4["finish_reason"] == c1["finish_reason"]
+
+
+def test_loop_cancel_does_not_corrupt_later_requests():
+    """Abandoning a stream mid-megachunk: the wasted fused tail is
+    bounded (counted as overrun), and the engine must produce exactly the
+    decode_loop=1 stream for the next request."""
+    e1, e4 = _loop_pair(4)
+    cancel = threading.Event()
+    it = e4.generate_stream([5, 6, 7], max_new_tokens=40, sampler=GREEDY,
+                            cancel=cancel)
+    for _, tok in zip(range(5), it):
+        pass
+    it.close()  # abandons the iterator -> cancel fires, slot drains
+    after1 = e1.generate([3, 4], max_new_tokens=16, sampler=GREEDY)
+    after4 = e4.generate([3, 4], max_new_tokens=16, sampler=GREEDY)
+    assert after4.token_ids == after1.token_ids
+
+
+@pytest.mark.parametrize("k", [1, 4])
+def test_loop_constrained_token_for_token(k):
+    """A schema-constrained stream under megachunks: the DFA state rides
+    the fused carry (zero extra host syncs), and the stream equals the
+    decode_loop=1 constrained stream token for token."""
+    import json as _json
+
+    from quorum_tpu.constrain import compile_response_format
+    from quorum_tpu.engine.tokenizer import ByteTokenizer
+
+    tok = ByteTokenizer(TINY.vocab_size)
+    schema = {"type": "object", "properties": {
+        "ok": {"type": "boolean"}, "n": {"type": "integer"}}}
+    rf = {"type": "json_schema", "json_schema": {"schema": schema}}
+    e1, e4 = _loop_pair(k)
+
+    def run(eng):
+        g = compile_response_format(rf, tok, TINY.vocab_size)
+        req = eng.submit(tok.encode("go"), max_new_tokens=64,
+                         sampler=SamplerConfig(temperature=0.8), seed=11,
+                         eos_id=tok.eos_id, grammar=g)
+        return list(eng.stream_results(req))
+
+    a, b = run(e1), run(e4)
+    assert a == b
+    body = tok.decode([t for t in b if t != tok.eos_id])
+    obj = _json.loads(body)
+    assert isinstance(obj, dict)
+    assert e4.n_overrun == 0
+
+
+def test_loop_members_token_for_token():
+    """Stacked members under megachunks: every member's stream equals its
+    decode_loop=1 self (the fused loop advances all members per chunk
+    body, exactly as the unfused dispatch did)."""
+    e1 = InferenceEngine(TINY, decode_chunk=4, decode_pipeline=1,
+                         decode_loop=1, members=2)
+    e4 = InferenceEngine(TINY, decode_chunk=4, decode_pipeline=1,
+                         decode_loop=4, members=2)
+    for m in (0, 1):
+        a = e1.generate([5, 6, 7], max_new_tokens=16, sampler=GREEDY,
+                        member=m)
+        b = e4.generate([5, 6, 7], max_new_tokens=16, sampler=GREEDY,
+                        member=m)
+        assert a.token_ids == b.token_ids, f"member {m} diverged"
+
+
+def test_loop_dispatch_counter_acceptance():
+    """The ISSUE acceptance: dispatches per 64-token request drop ~C× at
+    decode_loop=C (64 tokens / chunk 4 = 16 chunks → ≤ 5 dispatches at
+    C=4 vs 16 unfused), chunk-segment accounting stays exact, and blocking
+    syncs stay ≤ the unfused count."""
+    e1, e4 = _loop_pair(2)
+    e1.generate([5, 6, 7], max_new_tokens=64, sampler=GREEDY)
+    e4.generate([5, 6, 7], max_new_tokens=64, sampler=GREEDY)
+    m1, m4 = e1.metrics(), e4.metrics()
+    assert m1["decode_chunks_total"] >= 16
+    assert m4["decode_chunks_total"] <= m1["decode_chunks_total"] // 3
+    # every fused dispatch's segments are accounted: 16 chunks either way
+    assert m4["decode_loop_chunks_total"] == m1["decode_loop_chunks_total"]
+    assert m4["decode_loop"] == 4 and m1["decode_loop"] == 1
+    assert m4["overrun_tokens_total"] == 0

@@ -102,8 +102,11 @@ def _config():
             # prefix_store=host so the quorum_tpu_prefix_store_* families
             # (and the engine-block store gauges/counters) are live on the
             # exposition this test validates.
+            # decode_loop=2 so the megachunk knob rides the config path
+            # the exposition's engine block reports.
             {"name": "LLM1",
-             "url": "tpu://llama-tiny?seed=3&slots=2&prefix_store=host",
+             "url": "tpu://llama-tiny?seed=3&slots=2&prefix_store=host"
+                    "&decode_loop=2",
              "model": "t"},
         ],
     }
@@ -187,6 +190,21 @@ async def test_live_metrics_exposition_validates():
             in text)
     assert ("# TYPE quorum_tpu_engine_constrain_masked_tokens_total "
             "counter" in text)
+
+    # megachunk-decode families (ISSUE 6): chunk segments per dispatch as
+    # a histogram (samples after any decode traffic — unfused dispatches
+    # observe 1), the configured decode_loop as an engine gauge, and the
+    # executed-segment/drain-gap accounting as engine counters
+    fam = "quorum_tpu_decode_loop_chunks"
+    assert f"# TYPE {fam} histogram" in text
+    assert f'{fam}_bucket{{le="+Inf"}}' in text
+    assert f"{fam}_sum" in text and f"{fam}_count" in text
+    assert "# TYPE quorum_tpu_engine_decode_loop gauge" in text
+    assert ("# TYPE quorum_tpu_engine_decode_loop_chunks_total counter"
+            in text)
+    assert ("# TYPE quorum_tpu_engine_drain_gap_seconds_total counter"
+            in text)
+    assert 'quorum_tpu_engine_decode_loop{backend="LLM1"} 2' in text
 
     # robustness families (docs/robustness.md): deadline sheds by stage,
     # HTTP retry attempts, and the per-engine rebuild/breaker block

@@ -121,3 +121,54 @@ def test_engine_serves_identically_with_kernel(monkeypatch):
     monkeypatch.setenv("QUORUM_TPU_FLASH_DECODE", "interpret")
     got = serve()
     assert got == ref
+
+
+def test_flash_decode_url_knob(monkeypatch):
+    """The per-backend flash_decode= knob (first-class since ISSUE 6):
+    resolves per engine without the env var, is validated at config time,
+    and serves token-identically to the masked-dense path; the env var
+    stays a process override that beats the knob."""
+    from quorum_tpu.engine.engine import InferenceEngine
+    from quorum_tpu.models.model_config import resolve_spec
+    from quorum_tpu.ops.flash_decode import (
+        parse_flash_decode,
+        resolve_flash_decode,
+    )
+    from quorum_tpu.ops.sampling import SamplerConfig
+
+    monkeypatch.delenv("QUORUM_TPU_FLASH_DECODE", raising=False)
+    assert parse_flash_decode("1") == "1"
+    assert parse_flash_decode("off") == "0"
+    assert parse_flash_decode("interpret") == "interpret"
+    with pytest.raises(ValueError):
+        parse_flash_decode("maybe")
+    # knob drives resolution when the env var is unset...
+    assert resolve_flash_decode("interpret") == "interpret"
+    assert resolve_flash_decode(None) == ""
+    # ...and the env override wins over the knob (A/B scripts flip it)
+    monkeypatch.setenv("QUORUM_TPU_FLASH_DECODE", "0")
+    assert resolve_flash_decode("interpret") == ""
+    monkeypatch.setenv("QUORUM_TPU_FLASH_DECODE", "interpret")
+    assert resolve_flash_decode("0") == "interpret"
+    # env takes the URL knob's spellings ("off" parses, wins over the knob)
+    monkeypatch.setenv("QUORUM_TPU_FLASH_DECODE", "off")
+    assert resolve_flash_decode("interpret") == ""
+    # unparseable env is a LOUD off (logged), never a crash — one typo'd
+    # var must not brick every engine construction in the process
+    monkeypatch.setenv("QUORUM_TPU_FLASH_DECODE", "garbage")
+    assert resolve_flash_decode("interpret") == ""
+    monkeypatch.delenv("QUORUM_TPU_FLASH_DECODE", raising=False)
+
+    spec = resolve_spec("llama-tiny", {"n_kv_heads": "4", "max_seq": "256"})
+    sampler = SamplerConfig(temperature=0.8, top_p=0.9)
+
+    def serve(flash):
+        eng = InferenceEngine(spec, decode_chunk=4, n_slots=2,
+                              flash_decode=flash)
+        assert eng._flash == ("interpret" if flash == "interpret" else "")
+        out = eng.generate([3, 4, 5], max_new_tokens=8, sampler=sampler,
+                           seed=5).token_ids
+        eng.shutdown()
+        return out
+
+    assert serve(None) == serve("interpret")

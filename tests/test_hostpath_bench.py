@@ -2,23 +2,33 @@
 
 Runs the same entry point the Makefile target runs, at a budget small
 enough for the fast tier (NOT slow-marked — this is the CPU-measurable
-proof of the decode-dispatch pipeline, wired into every suite run), and
-pins the dispatch accounting the bench reports:
+proof of the decode-dispatch pipeline and the megachunk decode loop, wired
+into every suite run), and pins the dispatch accounting the bench reports:
 
   - strictly fewer blocking host syncs per request at K=4 than K=1 for a
     >=8-chunk generation (the ISSUE acceptance counter check)
+  - dispatches/request reduced ~C× at decode_loop=C with blocking
+    syncs/request still <= 1 (the megachunk acceptance)
   - zero overrun tokens when rows finish on device
-  - token-for-token identical output across depths
+  - token-for-token identical output across depths AND fusion
 """
 
 from scripts.hostpath_bench import run
 
 
 def test_hostpath_bench_counters():
-    m = run(tokens=32, chunk=4, depth=4, repeats=1)
+    m = run(tokens=32, chunk=4, depth=4, repeats=1, loop=4)
     assert m["k1_dispatches_per_request"] >= 8
     assert m["k4_syncs_per_request"] < m["k1_syncs_per_request"]
     assert m["k1_overrun_tokens"] == 0
     assert m["k4_overrun_tokens"] == 0
+    assert m["loop4_overrun_tokens"] == 0
+    # Megachunk acceptance: one dispatch covers ~C chunks (8 chunks at
+    # C=4 → 2-3 dispatches), and the host still blocks at most about once
+    # per request (the first dispatch of each generation).
+    assert m["loop4_dispatches_per_request"] <= m["k1_dispatches_per_request"] / 2
+    assert m["loop4_syncs_per_request"] <= 1.5
+    assert m["loop_dispatch_reduction"] >= 2.0
     assert m["tokens_match"] is True
     assert 0.0 <= m["host_turnaround_share"] < 1.0
+    assert m["loop4_drain_gap_ms_per_dispatch"] >= 0.0

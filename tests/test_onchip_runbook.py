@@ -75,8 +75,8 @@ def test_session_budget_exhaustion_skips_cleanly(tmp_path, monkeypatch):
     mod.main()
     assert calls == [], "no step may launch with an exhausted budget"
     banked = json.loads(out.read_text())
-    for step in ("bench", "ab", "kvq", "flash_off", "flash_on", "qq",
-                 "profile"):
+    for step in ("bench", "ab", "kvq", "flash_off", "flash_on",
+                 "loop_off", "loop_on", "qq", "profile"):
         assert banked.get(f"{step}_error") == (
             "skipped: session budget exhausted"), (step, banked)
 
@@ -172,6 +172,9 @@ def test_full_session_rehearsal_on_cpu(tmp_path, monkeypatch):
     assert banked["kvq_decode_tok_s"] > 0
     assert banked["flash_off_agg_decode_tok_s"] > 0
     assert banked["flash_on_agg_decode_tok_s"] > 0
+    # megachunk A/B (decode_loop=4 vs unfused) banked both arms
+    assert banked["loop_off_decode_tok_s"] > 0
+    assert banked["loop_on_decode_tok_s"] > 0
     assert banked["qq_model"] == "llama-tiny"
     assert 0.5 < banked["qq_ppl_ratio"] < 2.0
     assert banked["profile_ttft_ms"] > 0
