@@ -42,9 +42,12 @@ bench:
 	python bench.py
 
 # Tiny-model CPU microbench of the decode-dispatch host path: prints
-# dispatches/request, blocking syncs/request, overrun tokens, and the
-# host-turnaround share the depth-K pipeline hides (PERF.md §2).
-# tests/test_hostpath_bench.py runs the same entry point as a fast smoke.
+# dispatches/request, blocking syncs/request, overrun tokens, the
+# host-turnaround share the depth-K pipeline hides (PERF.md §2), and the
+# prefill-interference A/B — streaming inter-token p50/p95/p99 under
+# admission churn, colocated vs disagg=1+1 device groups with the
+# device->device KV handoff live (docs/tpu_backends.md).
+# tests/test_hostpath_bench.py runs the same entry points as fast smokes.
 hostpath-bench:
 	JAX_PLATFORMS=cpu python scripts/hostpath_bench.py
 

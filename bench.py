@@ -59,7 +59,12 @@ Prints ONE JSON line:
    *_overrun_tokens (0 when rows finish on device — PERF.md §2),
    *_decode_loop / *_loop_chunks_per_dispatch / *_drain_gap_ms_per_dispatch
    (megachunk decode: chunks one dispatch covered and the host-drain tax it
-   amortizes — decode_loop=C drops dispatches/req ~C×)}
+   amortizes — decode_loop=C drops dispatches/req ~C×),
+   "colocated_intertoken_p{50,95,99}_ms" / "disagg_intertoken_p{50,95,99}_ms"
+   / "interference_p99_ratio" / "disagg_kv_handoff_bytes": the prefill-
+   interference A/B (disagg=P+D, docs/tpu_backends.md) — streaming
+   inter-token gap under concurrent admission churn, colocated vs
+   disaggregated device groups (QUORUM_TPU_BENCH_DISAGG=0 skips)}
 
 The ``*_prefix_*`` keys measure automatic prefix caching where it matters —
 7B prefill dominates TTFT there: a long shared system preamble is sent
@@ -665,23 +670,30 @@ def run_child_phase(flag: str, prefix: str, budget: int,
     their scheduler threads hold them — while the 7B weights alone need
     ~14.5 GB of the v5e's 16 GB HBM; and only one process can hold the TPU
     client at a time, so each child must finish before the next starts."""
-    import subprocess
-
     env = None
     if env_extra:
         env = dict(os.environ)
         env.update(env_extra)
+    return _run_json_subprocess(
+        [sys.executable, os.path.abspath(__file__), flag],
+        prefix, budget, env)
+
+
+def _run_json_subprocess(argv: list, prefix: str, budget: int,
+                         env: "dict | None" = None) -> dict:
+    """One JSON-emitting bench subprocess: run it, parse its last JSON
+    line, and shape timeouts/failures into ``{prefix}_error`` keys. A hung
+    child (e.g. a wedged TPU tunnel) must not take down the whole bench —
+    salvage any checkpointed metrics line it printed before stalling (the
+    long-ctx phase checkpoints its core metrics first)."""
+    import subprocess
+
     try:
         proc = subprocess.run(
-            [sys.executable, os.path.abspath(__file__), flag],
-            capture_output=True, text=True, timeout=budget,
+            argv, capture_output=True, text=True, timeout=budget,
             cwd=os.path.dirname(os.path.abspath(__file__)), env=env,
         )
     except subprocess.TimeoutExpired as e:
-        # A hung child (e.g. a wedged TPU tunnel) must not take down the
-        # whole bench — salvage any checkpointed metrics line the child
-        # printed before stalling (the long-ctx phase checkpoints its
-        # core metrics first), then report the timeout and move on.
         stdout = e.stdout
         if isinstance(stdout, bytes):
             stdout = stdout.decode(errors="replace")
@@ -694,6 +706,39 @@ def run_child_phase(flag: str, prefix: str, budget: int,
                f"subprocess rc={proc.returncode}: "
                f"{(proc.stderr or '')[-300:]}"}
     return got
+
+
+def run_interference_phase(budget: int = 900) -> dict:
+    """Prefill-interference A/B (tpu://…&disagg=P+D, docs/tpu_backends.md):
+    the streaming inter-token gap percentiles under concurrent admission
+    churn, colocated vs disaggregated — scripts/hostpath_bench.py's
+    measurement, run in a SUBPROCESS (the legs need a 2-virtual-device CPU
+    mesh, and XLA's device count is fixed at first jax import). Gate with
+    ``QUORUM_TPU_BENCH_DISAGG=0``."""
+    if os.environ.get("QUORUM_TPU_BENCH_DISAGG", "1") == "0":
+        return {}
+    import re as _re
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    flags = _re.sub(r"--xla_force_host_platform_device_count=\d+", "",
+                    env.get("XLA_FLAGS", ""))
+    env["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=2").strip()
+    script = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "scripts", "hostpath_bench.py")
+    got = _run_json_subprocess(
+        [sys.executable, script, "--tokens", "48", "--repeats", "1",
+         "--only-interference"],
+        "interference", budget, env)
+    keep = ("colocated_intertoken_p50_ms", "colocated_intertoken_p95_ms",
+            "colocated_intertoken_p99_ms", "disagg_intertoken_p50_ms",
+            "disagg_intertoken_p95_ms", "disagg_intertoken_p99_ms",
+            "interference_p99_ratio", "interference_tokens_match",
+            "disagg_kv_handoffs", "disagg_kv_handoff_bytes",
+            "interference_error")
+    return {k: got[k] for k in keep if k in got}
 
 
 def _last_json_line(stdout: "str | None") -> "dict | None":
@@ -1102,6 +1147,9 @@ async def main() -> None:
         b7: dict = run_7b_phase() if (BENCH_7B != "0" or BENCH_7BQ != "0") else {}
         if BENCH_CKPT != "0":
             b7.update(run_child_phase("--ckpt", "ckpt", _CKPT_BUDGET))
+        # Prefill-interference A/B (disagg=P+D): streaming inter-token gap
+        # percentiles under admission churn, colocated vs disaggregated.
+        b7.update(run_interference_phase())
         await phase12_main(b7)
         return
 

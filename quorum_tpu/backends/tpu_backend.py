@@ -10,6 +10,22 @@ the device per decode-chunk), fixing the reference's pseudo-streaming
 
 URL grammar:  ``tpu://<model-id>?<spec overrides>&<engine options>``
   spec overrides   any ModelSpec field (n_layers=2, d_model=64, ...)
+  disagg=P+D       disaggregated prefill/decode serving (default off): the
+                   first P local devices become the PREFILL group (second
+                   weight copy + staging KV cache; every admission rides
+                   chunked prefill there) and the next D the DECODE group
+                   (slot cache + the decode_pipeline/decode_loop ring); a
+                   completed admission's KV prefix hands off device→device
+                   chunk-by-chunk into its claimed decode slot
+                   (quorum_tpu/cache/kv_transfer.py), overlapping the next
+                   chunk's prefill. Admission bursts stop stretching
+                   streaming inter-token gaps: the decode ring keeps full
+                   depth under any admission pressure. Structural; builds
+                   its own per-group tp meshes, so tp=/dp=/sp= do not
+                   compose (neither do spec_model=/spec_ckpt= — the draft
+                   runtime is not group-placed); requires chunked prefill
+                   (prefill_chunk >= 16). See docs/tpu_backends.md for the
+                   interaction matrix
   tp=, dp=, sp=    mesh shape (default: single device); sp>1 runs admission
   sp_impl=         sp>1 attention strategy: "ring" (default — O(S/sp)
                    memory, KV blocks ppermute the ICI ring) or "ulysses"
@@ -428,7 +444,20 @@ class TpuBackend:
         tp = int(opts.get("tp", 1))
         dp = int(opts.get("dp", 1))
         sp = int(opts.get("sp", 1))
-        if tp * dp * sp > 1:
+        prefill_mesh = None
+        if opts.get("disagg"):
+            from quorum_tpu.parallel.mesh import disagg_meshes, parse_disagg
+
+            # Structural split into two disjoint device groups; the knob
+            # owns the mesh layout, so an explicit tp/dp/sp beside it is a
+            # contradiction (fail at config, never silently pick one).
+            n_p, n_d = parse_disagg(opts["disagg"])
+            if tp * dp * sp > 1:
+                raise ValueError(
+                    "disagg= builds its own per-group device meshes; "
+                    "tp=/dp=/sp= do not compose with it")
+            prefill_mesh, mesh = disagg_meshes(n_p, n_d)
+        elif tp * dp * sp > 1:
             mesh = make_mesh(MeshConfig(dp=dp, sp=sp, tp=tp))
         else:
             mesh = single_device_mesh()
@@ -443,6 +472,7 @@ class TpuBackend:
                 f"member={member} out of range for members={members}")
         eng_kw = dict(
             n_slots=n_slots,
+            prefill_mesh=prefill_mesh,
             decode_pipeline=int(
                 opts.get("decode_pipeline", DEFAULT_DECODE_PIPELINE)),
             decode_loop=int(opts.get("decode_loop", DEFAULT_DECODE_LOOP)),

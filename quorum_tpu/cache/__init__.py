@@ -7,8 +7,14 @@ tier 1: :class:`~quorum_tpu.cache.prefix_store.PrefixStore` keeps
 chunk-granular KV prefixes in host RAM (byte-budget LRU), so a multi-turn
 conversation whose slot was reclaimed under load restores its history
 host→device and prefills only the tail. See docs/prefix_cache.md.
+
+:mod:`~quorum_tpu.cache.kv_transfer` is the shared chunk-granular movement
+layer both tiers and the disaggregated prefill→decode handoff build on:
+generic cache-row slice/write bodies plus a direct device→device transfer
+route (host-bounce fallback) with bytes/seconds accounting.
 """
 
+from quorum_tpu.cache import kv_transfer  # noqa: F401
 from quorum_tpu.cache.prefix_store import (  # noqa: F401
     DEFAULT_PREFIX_STORE_BYTES,
     PrefixStore,

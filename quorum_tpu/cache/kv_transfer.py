@@ -1,0 +1,124 @@
+"""Shared chunk-granular KV transfer: device↔host and device↔device.
+
+The PR-3 prefix store moved KV in exactly one direction pair — slot cache
+device→host on release, host→device on restore — with the slice/write
+programs living inline in the engine. Disaggregated serving (``disagg=P+D``,
+docs/tpu_backends.md) needs the same chunk-granular movement between TWO
+device groups: a completed admission's staged KV prefix on the prefill mesh
+hands off into the claimed slot of the decode mesh's cache. This module is
+the generalization both paths share:
+
+  - :func:`slice_rows` / :func:`write_rows` — the pure (jit-able) cache
+    slice/update bodies, generic over the cache pytree (bf16 arrays or int8
+    ``(values, scales)`` pairs) and over member-stacked caches (``[M, …]``
+    leaves addressed by flat row ``m·n_slots + s``);
+  - :func:`fetch_to_host` — the blocking device→host fetch the prefix-store
+    snapshot worker runs (host arrays in the cache's native representation);
+  - :func:`transfer` — move a sliced chunk pytree onto a target sharding:
+    the DIRECT device→device route (``jax.device_put`` onto the target
+    mesh — ICI/DCN where the runtime supports it) with a host-bounce
+    fallback when the direct put is rejected, recording bytes and seconds
+    on the ``quorum_tpu_kv_handoff_*`` families either way.
+
+Layout convention (matches the engine's slot cache): non-stacked leaves are
+``[L, S, K, T, …]`` (slot axis 1, position axis 3); stacked leaves carry a
+leading member axis ``[M, L, S, K, T, …]``. Sliced chunks drop the slot (and
+member) axis: ``[L, K, n, …]`` — the one wire format snapshot, restore, and
+handoff all speak.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+
+import jax
+import numpy as np
+from jax import lax
+
+from quorum_tpu import observability as obs
+
+logger = logging.getLogger(__name__)
+
+
+def slice_rows(cache, row, start, n: int, *, stacked: bool, n_slots: int):
+    """Slice ``n`` cache positions of flat row ``row`` starting at ``start``
+    out of a cache pytree (pure; call under jit). Returns the chunk pytree
+    in the ``[L, K, n, …]`` wire layout. Non-donating by design — snapshot
+    and handoff both READ a live cache."""
+
+    def take(a):
+        if stacked:
+            m, s = row // n_slots, row % n_slots
+            starts = (m, 0, s, 0, start) + (0,) * (a.ndim - 5)
+            sizes = ((1, a.shape[1], 1, a.shape[3], n) + tuple(a.shape[5:]))
+            return lax.dynamic_slice(a, starts, sizes)[0][:, 0]
+        starts = (0, row, 0, start) + (0,) * (a.ndim - 4)
+        sizes = (a.shape[0], 1, a.shape[2], n) + tuple(a.shape[4:])
+        return lax.dynamic_slice(a, starts, sizes)[:, 0]
+
+    return jax.tree.map(take, cache)
+
+
+def write_rows(cache, chunk, row, start, *, stacked: bool, n_slots: int):
+    """Write a ``[L, K, n, …]`` chunk pytree into positions
+    [start, start+n) of flat row ``row`` (pure; call under jit with the
+    cache donated — the restore/handoff write is a cache mutation like any
+    other)."""
+
+    def put(a, h):
+        if stacked:
+            m, s = row // n_slots, row % n_slots
+            starts = (m, 0, s, 0, start) + (0,) * (a.ndim - 5)
+            return lax.dynamic_update_slice(
+                a, h[None, :, None].astype(a.dtype), starts)
+        starts = (0, row, 0, start) + (0,) * (a.ndim - 4)
+        return lax.dynamic_update_slice(a, h[:, None].astype(a.dtype), starts)
+
+    return jax.tree.map(put, cache, chunk)
+
+
+def fetch_to_host(payload) -> list[np.ndarray]:
+    """Blocking device→host fetch of a sliced chunk pytree's leaves, in
+    ``jax.tree.leaves`` order — the prefix-store snapshot worker's half of
+    the device↔host route (host arrays stay in the cache's NATIVE
+    representation, so ``kv_quant=int8`` halves host bytes)."""
+    return [np.asarray(x)
+            for x in jax.device_get(jax.tree.leaves(payload))]
+
+
+def transfer(chunk, sharding, *, record: bool = True):
+    """Move a sliced chunk pytree onto ``sharding`` (typically the target
+    group's replicated sharding) and block until it lands.
+
+    The direct device→device route first: ``jax.device_put`` of the
+    committed source arrays onto the target mesh — no host copy in the
+    dataflow the runtime has to honor. When the runtime rejects the direct
+    put (platforms without a cross-group transfer path), fall back to an
+    explicit host bounce — same bytes, one extra hop, never a failure mode.
+    Returns ``(moved_pytree, n_bytes, seconds, route)`` with ``route`` one
+    of ``"device"`` / ``"host"``; bytes/seconds also land on the
+    ``quorum_tpu_kv_handoff_{bytes,seconds}`` families when ``record``.
+    """
+    leaves, treedef = jax.tree.flatten(chunk)
+    n_bytes = int(sum(x.nbytes for x in leaves))
+    t0 = time.perf_counter()
+    route = "device"
+    try:
+        moved = [jax.device_put(x, sharding) for x in leaves]
+        jax.block_until_ready(moved)
+    except Exception:
+        # Host bounce: fetch then re-place. Logged once per call — a
+        # deployment silently bouncing every handoff through host RAM is a
+        # perf bug someone must be able to see.
+        logger.warning(
+            "direct device->device KV transfer rejected; bouncing %d bytes "
+            "via host", n_bytes, exc_info=True)
+        route = "host"
+        moved = [jax.device_put(np.asarray(x), sharding) for x in leaves]
+        jax.block_until_ready(moved)
+    dt = time.perf_counter() - t0
+    if record:
+        obs.KV_HANDOFF_BYTES.inc(n_bytes)
+        obs.KV_HANDOFF_SECONDS.observe(dt)
+    return jax.tree.unflatten(treedef, moved), n_bytes, dt, route

@@ -18,8 +18,9 @@ and the serving engine (``decode_chunk=``, ``decode_pipeline=``,
 ``decode_loop=`` for megachunk decode, ``flash_decode=`` for the Pallas
 decode kernel, ``slots=``,
 ``quant=``, ``prefix_store=host``/``prefix_store_bytes=``/
-``prefix_store_chunk=`` for the tiered host KV prefix store, … — the full
-grammar is the docstring of
+``prefix_store_chunk=`` for the tiered host KV prefix store,
+``disagg=P+D`` for disaggregated prefill/decode device groups with
+device→device KV handoff, … — the full grammar is the docstring of
 :mod:`quorum_tpu.backends.tpu_backend`); anything absent falls back to the
 named preset for ``<model-id>`` and the engine defaults.
 

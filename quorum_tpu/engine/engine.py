@@ -62,6 +62,18 @@ concurrent requests):
     per-channel scales (native int8 MXU matmuls); ``kv_quant=int8`` stores
     the KV cache as (int8, per-token scale) pairs with native int8 decode
     attention. Both halve their side's HBM bytes; they compose.
+  - **Disaggregated prefill/decode** (``disagg=P+D``): admission prefill
+    programs compile and run on their own device group (a second weight
+    copy + a staging KV cache on the prefill mesh), the decode ring owns
+    the decode group, and a completed admission's KV prefix hands off
+    device→device chunk-by-chunk into the claimed decode slot
+    (quorum_tpu/cache/kv_transfer.py) — handoff of chunk i overlaps
+    prefill of chunk i+1. The scheduler becomes two cooperating loops
+    (``_prefill_scheduler`` admits/prefills/hands off; ``_scheduler``
+    registers/decodes) with ``_handoffs`` as the queue between them, so
+    admission bursts never stretch streaming inter-token gaps: the decode
+    ring keeps its full depth regardless of admission pressure
+    (docs/tpu_backends.md).
 
 The reference has no analog — its "backends" are HTTP calls
 (/root/reference/src/quorum/oai_proxy.py:182-192). This module is what makes a
@@ -87,6 +99,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from quorum_tpu import faults
 from quorum_tpu import observability as obs
+from quorum_tpu.cache import kv_transfer
 from quorum_tpu.cache.prefix_store import (
     DEFAULT_PREFIX_STORE_BYTES,
     PrefixStore,
@@ -371,7 +384,8 @@ class _Request:
         "prompt_ids", "budget", "temperature", "top_p", "top_k", "seed",
         "eos_id", "cancel", "chunk_hint", "out", "emitted",
         "pp", "fp", "bias_row", "want_lp", "lp", "hist", "ngram", "member",
-        "trace", "t_submit", "tspans", "deadline", "grammar", "g_start",
+        "trace", "t_submit", "tspans", "deadline", "expired", "grammar",
+        "g_start",
     )
 
     def __init__(self, prompt_ids, budget, sampler: SamplerConfig, seed, eos_id,
@@ -396,7 +410,11 @@ class _Request:
         # Absolute time.monotonic() deadline (None = no deadline). Enforced
         # by the scheduler's per-turn sweep: pending requests are shed
         # (stage "queue"), admitted ones cancelled (stage "prefill"/"decode").
+        # ``expired`` marks a deadline retirement already delivered (err
+        # frame sent by _expire): retirement paths that see only the
+        # cancel event must not re-count it as a client cancellation.
         self.deadline = deadline
+        self.expired = False
         # Constrained decoding: the compiled token-DFA this request decodes
         # under (None = unconstrained) and its GLOBAL start state in the
         # engine's device arena — assigned at admission by _ensure_grammar.
@@ -498,7 +516,8 @@ class _Admission:
     prefix store (0 = pure slot-resident reuse); kept separate so the
     admission span can attribute cache effectiveness per tier."""
 
-    __slots__ = ("req", "slot", "offset", "offset0", "restored", "t_start")
+    __slots__ = ("req", "slot", "offset", "offset0", "restored", "t_start",
+                 "handed", "final_sent", "dead")
 
     def __init__(self, req: _Request, slot: int, offset: int = 0,
                  restored: int = 0):
@@ -508,6 +527,15 @@ class _Admission:
         self.offset0 = offset            # reused-prefix length (tracing)
         self.restored = restored         # of which: host-store restore
         self.t_start = time.perf_counter()
+        # Disaggregated serving only: staging-cache rows [0, handed) have
+        # been handed off to the claimed decode-group slot; ``final_sent``
+        # marks the whole prompt staged+queued (awaiting decode-group
+        # register); ``dead`` tells the decode loop to drop this
+        # admission's queued handoff pieces (cancelled/expired/failed —
+        # its slot claim may have been re-issued).
+        self.handed = 0
+        self.final_sent = False
+        self.dead = False
 
 
 class _DraftRuntime:
@@ -708,9 +736,29 @@ class InferenceEngine:
         draft_seed: int = 0,
         draft_params=None,
         sp_impl: str = "ring",
+        prefill_mesh: Mesh | None = None,
     ):
         self.spec = spec.validate()
         self.mesh = mesh or single_device_mesh()
+        # Disaggregated prefill/decode (tpu://…&disagg=P+D): ``mesh`` is the
+        # DECODE group (cache, slot state, decode ring); ``prefill_mesh``
+        # the disjoint prefill group (second weight copy, staging cache,
+        # admission segment programs). None = colocated, byte-for-byte the
+        # pre-disagg engine.
+        self.prefill_mesh = prefill_mesh
+        self.disagg = prefill_mesh is not None
+        if self.disagg:
+            overlap = (set(map(str, self.mesh.devices.flat))
+                       & set(map(str, prefill_mesh.devices.flat)))
+            if overlap:
+                raise ValueError(
+                    f"disagg device groups must be disjoint; {len(overlap)} "
+                    "device(s) appear in both the prefill and decode mesh")
+            if draft_spec is not None:
+                raise ValueError(
+                    "draft-model speculation (spec_model=/spec_ckpt=) does "
+                    "not compose with disagg: the draft runtime is not "
+                    "group-placed (prompt-lookup spec_decode composes)")
         if quant not in (None, "", "int8"):
             raise ValueError(f"unsupported quant mode {quant!r} (int8 or none)")
         self.quant = quant or None
@@ -798,6 +846,22 @@ class InferenceEngine:
         from quorum_tpu.parallel.mesh import AXIS_SP
 
         self._use_sp = dict(self.mesh.shape).get(AXIS_SP, 1) > 1
+        if self.disagg:
+            if (self._use_sp
+                    or dict(self.prefill_mesh.shape).get(AXIS_SP, 1) > 1):
+                raise ValueError(
+                    "disagg does not compose with sp>1: sequence-parallel "
+                    "serving disables chunked prefill, which every "
+                    "disaggregated admission rides (the staged KV hands "
+                    "off segment by segment)")
+            if self.prefill_chunk <= 0:
+                raise ValueError(
+                    "disagg requires chunked prefill (prefill_chunk >= 16 "
+                    "after power-of-two alignment): admissions prefill "
+                    "into the prefill group's staging cache segment by "
+                    "segment and register on the decode group — the "
+                    "single-shot admit program samples its first token "
+                    "inside prefill, on the wrong device group")
         if sp_impl not in ("ring", "ulysses"):
             raise ValueError(
                 f"unknown sp_impl {sp_impl!r} (ring or ulysses)")
@@ -848,8 +912,15 @@ class InferenceEngine:
         # the suffix (the admission rides the chunked-prefill machinery with
         # a nonzero start offset — so it needs prefill_chunk > 0). Multi-turn
         # conversations re-send their whole history; the repeated prefix
-        # costs nothing on device.
-        self.prefix_cache = bool(prefix_cache) and self.prefill_chunk > 0
+        # costs nothing on device. Disabled under disagg: the resident KV
+        # lives on the DECODE group, where the prefill group's segment
+        # programs cannot attend over it — reuse would need a decode→
+        # prefill back-transfer per admission; the prefix-store restore
+        # (host→prefill staging) is the cross-admission tier instead, and
+        # outputs stay token-for-token identical either way (reuse only
+        # skips recompute of identical KV).
+        self.prefix_cache = (bool(prefix_cache) and self.prefill_chunk > 0
+                             and not self.disagg)
         # Tiered KV prefix store (quorum_tpu/cache/prefix_store.py,
         # docs/prefix_cache.md): a host-RAM cache tier behind the
         # slot-resident prefix cache. On slot release the valid KV prefix is
@@ -918,62 +989,29 @@ class InferenceEngine:
         self._resident: list[list[int]] = [[] for _ in range(self._rows)]
         self.prefix_hits = 0
         self.prefix_tokens_saved = 0
-        if self.members > 1:
-            from quorum_tpu.models.init import init_params_ensemble_sharded
-
-            # Same stacked-init program as ensembles ([M, …] leaves, one
-            # seed per member, quant applied per member inside the init);
-            # only the *decode semantics* differ (separate streams, no mean).
-            self.params = init_params_ensemble_sharded(
-                spec, self.mesh, [seed + i for i in range(self.members)],
-                quant=self.quant)
-        elif self.ensemble > 1:
-            from quorum_tpu.models.init import init_params_ensemble_sharded
-
-            # quant composes: the stacked tree quantizes per member inside
-            # the init program (models/init.py) and qeinsum sees each
-            # member's own int8 leaves under the vmap.
-            self.params = init_params_ensemble_sharded(
-                spec, self.mesh, [seed + i for i in range(self.ensemble)],
-                quant=self.quant)
-        elif params is not None:
-            self.params = shard_pytree(self.mesh, params)
-            if self.quant == "int8":
-                # Requantize in place: inputs donated, each bf16 leaf's
-                # buffer dies at its quantize op (models/quant.py).
-                from quorum_tpu.models.quant import quantize_params_sharded
-
-                self.params = quantize_params_sharded(self.params, self.mesh)
-        elif self.quant == "int8":
-            # Init + quantize fused in one program: the bf16 weights are
-            # per-leaf intermediates, so llama-3-8b (16.1 GB bf16 / 8.1 GB
-            # int8) comes up on a single 16 GB chip. (On XLA:CPU the
-            # helper splits into two programs — see its docstring.)
-            from quorum_tpu.models.quant import init_params_quantized_sharded
-
-            self.params = init_params_quantized_sharded(spec, self.mesh, seed)
-        else:
-            # One compiled program materializes the weights sharded in place —
-            # no eager per-leaf dispatch, no replicated copy (critical at 7B:
-            # bf16 weights alone are ~14 GB of a v5e's 16 GB HBM).
-            self.params = init_params_sharded(spec, self.mesh, seed)
-        self._cache_sh = kv_cache_sharding(self.mesh, spec.n_kv_heads, batch=self.n_slots)
-        if self.kv_quant:
-            # (values, scales): the scale array drops the head_dim axis.
-            self._cache_sh = (
-                self._cache_sh,
-                NamedSharding(self.mesh, P(*tuple(self._cache_sh.spec)[:4])),
-            )
-        if self.ensemble > 1 or self.members > 1:
-            # member-stacked cache [M, L, S, K, T, hd]: member axis vmapped,
-            # never sharded
-            self._cache_sh = jax.tree.map(
-                lambda sh: NamedSharding(
-                    self.mesh, P(*((None,) + tuple(sh.spec)))),
-                self._cache_sh,
-                is_leaf=lambda x: isinstance(x, NamedSharding))
+        self.params = self._build_params(self.mesh, params, seed)
+        # Disaggregated serving: the prefill group needs its own weight copy
+        # (its programs cannot read across the group boundary — GSPMD never
+        # spans both meshes) and a staging KV cache the admission segments
+        # write into before the handoff. Same seeds, same init programs →
+        # identical weights on both groups.
+        self.prefill_params = (
+            self._build_params(self.prefill_mesh, params, seed)
+            if self.disagg else None)
+        self._cache_sh = self._cache_sharding(self.mesh)
         self._rep = NamedSharding(self.mesh, P())
         self._init_device_state()
+        if self.disagg:
+            self._stage_sh = self._cache_sharding(self.prefill_mesh)
+            self._init_stage_state()
+        # Handoff queue between the two scheduler loops (disagg): the
+        # prefill loop appends transferred KV pieces (already resident on
+        # the decode mesh) + per-admission "final" markers; the decode loop
+        # drains them — writes into the claimed slot, then registers.
+        self._handoffs: deque = deque()
+        self.n_kv_handoffs = 0
+        self.kv_handoff_bytes = 0
+        self.kv_handoff_s = 0.0
 
         self._admit_cache: dict[int, object] = {}   # bucket → compiled admit
         self._decode_cache: dict[int, object] = {}  # n_steps → compiled chunk
@@ -1077,7 +1115,72 @@ class InferenceEngine:
             target=self._scheduler, name=f"engine-{id(self):x}", daemon=True
         )
         self._thread.start()
+        if self.disagg:
+            # The second cooperating loop: admissions prefill on their own
+            # device group and hand off KV; the decode loop above never
+            # runs a prefill program again.
+            self._prefill_thread = threading.Thread(
+                target=self._prefill_scheduler,
+                name=f"engine-prefill-{id(self):x}", daemon=True)
+            self._prefill_thread.start()
+        else:
+            self._prefill_thread = None
         _ALL_ENGINES.add(self)
+
+    def _build_params(self, mesh: Mesh, params, seed: int):
+        """One device group's weight tree: shared by the decode mesh and
+        (under disagg) the prefill mesh — both groups must hold identical
+        weights, so both run the same deterministic init/shard programs."""
+        spec = self.spec
+        if self.members > 1 or self.ensemble > 1:
+            from quorum_tpu.models.init import init_params_ensemble_sharded
+
+            # Same stacked-init program for members and ensembles ([M, …]
+            # leaves, one seed per member, quant applied per member inside
+            # the init); only the *decode semantics* differ.
+            stacked = max(self.members, self.ensemble)
+            return init_params_ensemble_sharded(
+                spec, mesh, [seed + i for i in range(stacked)],
+                quant=self.quant)
+        if params is not None:
+            out = shard_pytree(mesh, params)
+            if self.quant == "int8":
+                # Requantize in place: inputs donated, each bf16 leaf's
+                # buffer dies at its quantize op (models/quant.py).
+                from quorum_tpu.models.quant import quantize_params_sharded
+
+                out = quantize_params_sharded(out, mesh)
+            return out
+        if self.quant == "int8":
+            # Init + quantize fused in one program: the bf16 weights are
+            # per-leaf intermediates, so llama-3-8b (16.1 GB bf16 / 8.1 GB
+            # int8) comes up on a single 16 GB chip. (On XLA:CPU the
+            # helper splits into two programs — see its docstring.)
+            from quorum_tpu.models.quant import init_params_quantized_sharded
+
+            return init_params_quantized_sharded(spec, mesh, seed)
+        # One compiled program materializes the weights sharded in place —
+        # no eager per-leaf dispatch, no replicated copy (critical at 7B:
+        # bf16 weights alone are ~14 GB of a v5e's 16 GB HBM).
+        return init_params_sharded(spec, mesh, seed)
+
+    def _cache_sharding(self, mesh: Mesh):
+        """Slot-cache sharding for one device group — the decode mesh's
+        slot cache and the prefill mesh's staging cache share one layout
+        (that equality is what lets the handoff slice/write programs speak
+        a single chunk wire format)."""
+        sh = kv_cache_sharding(mesh, self.spec.n_kv_heads,
+                               batch=self.n_slots)
+        if self.kv_quant:
+            # (values, scales): the scale array drops the head_dim axis.
+            sh = (sh, NamedSharding(mesh, P(*tuple(sh.spec)[:4])))
+        if self.ensemble > 1 or self.members > 1:
+            # member-stacked cache [M, L, S, K, T, hd]: member axis
+            # vmapped, never sharded
+            sh = jax.tree.map(
+                lambda s: NamedSharding(mesh, P(*((None,) + tuple(s.spec)))),
+                sh, is_leaf=lambda x: isinstance(x, NamedSharding))
+        return sh
 
     def _init_device_state(self) -> None:
         """(Re)allocate the slot-batched cache and per-slot state on device.
@@ -1089,21 +1192,7 @@ class InferenceEngine:
         The cache is allocated by a compiled zero-fill — no host-side
         materialization or transfer of the multi-GB buffer.
         """
-        stacked = max(self.ensemble, self.members)
-
-        def zero_cache():
-            ck, cv = init_cache(self.spec, batch=self.n_slots,
-                                kv_quant=self.kv_quant)
-            if stacked > 1:
-                stack = lambda x: jnp.zeros(  # noqa: E731
-                    (stacked,) + x.shape, x.dtype)
-                ck = jax.tree.map(stack, ck)
-                cv = jax.tree.map(stack, cv)
-            return ck, cv
-
-        self._ck, self._cv = jax.jit(
-            zero_cache, out_shardings=(self._cache_sh, self._cache_sh),
-        )()
+        self._ck, self._cv = self._zero_cache(self._cache_sh)
         s = self._rows
         rep = self._rep
         self._token = jax.device_put(np.zeros((s,), np.int32), rep)
@@ -1142,6 +1231,35 @@ class InferenceEngine:
             # admissions — copied only when a request actually sets
             # logit_bias (the _zero_bias copy-on-write convention).
             self._zero_bias_mem = np.zeros((self.members, v), np.float32)
+
+    def _zero_cache(self, shardings):
+        """Compiled zero-fill of one slot-batched cache onto ``shardings``
+        — no host-side materialization or transfer of the multi-GB buffer.
+        Used for the decode cache and (under disagg) the staging cache."""
+        stacked = max(self.ensemble, self.members)
+
+        def zero_cache():
+            ck, cv = init_cache(self.spec, batch=self.n_slots,
+                                kv_quant=self.kv_quant)
+            if stacked > 1:
+                stack = lambda x: jnp.zeros(  # noqa: E731
+                    (stacked,) + x.shape, x.dtype)
+                ck = jax.tree.map(stack, ck)
+                cv = jax.tree.map(stack, cv)
+            return ck, cv
+
+        return jax.jit(zero_cache, out_shardings=(shardings, shardings))()
+
+    def _init_stage_state(self) -> None:
+        """(Re)allocate the prefill group's staging KV cache (disagg only):
+        the decode cache's exact slot-batched shape, placed on the prefill
+        mesh. Admission segments write prompt KV here; the handoff slices
+        it chunk-granular into the claimed decode-group slot (staging row i
+        mirrors decode slot row i, so one flat-row convention addresses
+        both). Rebuilt after a prefill-group failure consumed the donated
+        staging buffers (:meth:`_contain_prefill_failure`) — decode-group
+        state is never touched on that path."""
+        self._sck, self._scv = self._zero_cache(self._stage_sh)
 
     # ---- compiled programs ------------------------------------------------
 
@@ -1380,44 +1498,35 @@ class InferenceEngine:
 
     def _snapshot_fn(self, n: int):
         """Jitted: slice ``n`` cache positions of one slot starting at a
-        dynamic offset — the device→host snapshot's device half. Non-
+        dynamic offset — the device→host snapshot's device half
+        (kv_transfer.slice_rows, the shared chunk wire format). Non-
         donating (it READS the live cache); one program per chunk-aligned
         length, generic over the cache pytree (bf16 arrays or int8
         (values, scales) pairs — the host store receives the native
-        representation either way)."""
+        representation either way). Always unstacked: the prefix store
+        rejects members/ensemble engines at config time."""
         fn = self._admit_cache.get(("snap", n))
         if fn is None:
-            def snap(ck, cv, slot, offset):
-                def take(a):
-                    # values [L, S, K, T, hd] / scales [L, S, K, T]
-                    starts = (0, slot, 0, offset) + (0,) * (a.ndim - 4)
-                    sizes = ((a.shape[0], 1, a.shape[2], n)
-                             + tuple(a.shape[4:]))
-                    return lax.dynamic_slice(a, starts, sizes)[:, 0]
-
-                return jax.tree.map(take, (ck, cv))
-
-            fn = jax.jit(snap)
+            fn = jax.jit(lambda ck, cv, slot, offset: kv_transfer.slice_rows(
+                (ck, cv), slot, offset, n,
+                stacked=False, n_slots=self.n_slots))
             self._admit_cache[("snap", n)] = fn
         return fn
 
     def _restore_fn(self, n: int):
         """Jitted: write an ``n``-token host KV slice into positions
-        [start, start+n) of one slot (host→device restore) — ``start`` is
-        traced, so skipping a slot-resident overlap costs no extra
-        compile. Donates the cache like every other cache-writing program;
-        ``n`` is always a prefill_chunk multiple, so the program count is
-        bounded by max_seq/prefill_chunk."""
+        [start, start+n) of one slot (host→device restore,
+        kv_transfer.write_rows) — ``start`` is traced, so skipping a
+        slot-resident overlap costs no extra compile. Donates the cache
+        like every other cache-writing program; ``n`` is always a
+        prefill_chunk multiple, so the program count is bounded by
+        max_seq/prefill_chunk."""
         fn = self._admit_cache.get(("restore", n))
         if fn is None:
             def restore(ck, cv, slot, start, host):
-                def put(a, h):
-                    # values [L, S, K, T, hd] / scales [L, S, K, T] — the
-                    # position axis is 3, same layout as ``_snapshot_fn``.
-                    starts = (0, slot, 0, start) + (0,) * (a.ndim - 4)
-                    return lax.dynamic_update_slice(a, h[:, None], starts)
-
-                return jax.tree.map(put, (ck, cv), host)
+                return kv_transfer.write_rows(
+                    (ck, cv), host, slot, start,
+                    stacked=False, n_slots=self.n_slots)
 
             fn = jax.jit(restore, donate_argnames=("ck", "cv"))
             self._admit_cache[("restore", n)] = fn
@@ -1493,8 +1602,7 @@ class InferenceEngine:
                     return
                 tokens, have, payload = item
                 faults.fire("engine.snapshot")
-                leaves = [np.asarray(x)
-                          for x in jax.device_get(jax.tree.leaves(payload))]
+                leaves = kv_transfer.fetch_to_host(payload)
                 c = self.prefix_store.chunk_tokens
                 n_chunks = (len(tokens) - have) // c
                 # Contiguous copies per chunk: a view would pin the whole
@@ -1530,7 +1638,7 @@ class InferenceEngine:
             with self._cond:
                 busy = (bool(self._pending) or bool(self._admitting)
                         or any(self._slots) or bool(self._inflight)
-                        or self._snap_backlog)
+                        or bool(self._handoffs) or self._snap_backlog)
             if busy:
                 time.sleep(0.002)
                 continue
@@ -1578,17 +1686,25 @@ class InferenceEngine:
         return r, host
 
     def _restore_into(self, slot: int, start: int, n: int, host,
-                      req: _Request) -> None:
+                      req: _Request, stage: bool = False) -> None:
         """Write ``n`` matched host prefix tokens into the claimed slot's
         cache rows [start, start+n) (scheduler thread) — ``start`` is the
         slot-resident reuse the transfer skips. Blocks until the transfer
         lands — the honest restore latency, observed on the restore
         histogram and recorded as a ``prefix-restore`` span on the
-        request's trace."""
+        request's trace. Under disagg (``stage``) the restore targets the
+        PREFILL group's staging cache instead: the tail segments must
+        attend over the restored history, and the whole prefix then rides
+        the ordinary chunk-granular handoff into the decode slot."""
         t0 = time.perf_counter()
-        self._ck, self._cv = self._restore_fn(n)(
-            self._ck, self._cv, np.int32(slot), np.int32(start), host)
-        jax.block_until_ready((self._ck, self._cv))
+        if stage:
+            self._sck, self._scv = self._restore_fn(n)(
+                self._sck, self._scv, np.int32(slot), np.int32(start), host)
+            jax.block_until_ready((self._sck, self._scv))
+        else:
+            self._ck, self._cv = self._restore_fn(n)(
+                self._ck, self._cv, np.int32(slot), np.int32(start), host)
+            jax.block_until_ready((self._ck, self._cv))
         t1 = time.perf_counter()
         obs.PREFIX_STORE_RESTORE.observe(t1 - t0)
         obs.PREFIX_STORE_HITS.inc()
@@ -1599,6 +1715,283 @@ class InferenceEngine:
         if req.trace is not None:
             req.trace.add_span_abs("prefix-restore", t0, t1,
                                    tokens=n, slot=slot)
+
+    # ---- disaggregated serving: prefill loop + device↔device KV handoff ----
+
+    def _handoff_slice_fn(self, n: int):
+        """Jitted: slice ``n`` staging-cache positions of one flat row into
+        the chunk wire layout (kv_transfer.slice_rows) — the prefill-mesh
+        half of the handoff. Non-donating: it READS the live staging cache,
+        and is dispatched BEFORE the next segment donates those buffers
+        (enqueue order is execution order, so the read completes first —
+        the same discipline the decode ring's payload chains rely on)."""
+        fn = self._admit_cache.get(("hslice", n))
+        if fn is None:
+            stacked = self.ensemble > 1 or self.members > 1
+            n_s = self.n_slots
+
+            fn = jax.jit(lambda ck, cv, row, start: kv_transfer.slice_rows(
+                (ck, cv), row, start, n, stacked=stacked, n_slots=n_s))
+            self._admit_cache[("hslice", n)] = fn
+        return fn
+
+    def _handoff_write_fn(self, n: int):
+        """Jitted: write a transferred ``n``-position chunk into the decode
+        cache's claimed slot (kv_transfer.write_rows) — the decode-mesh
+        half, run by the DECODE loop only (all decode-cache mutation stays
+        on one thread) and donating the cache like every other writer."""
+        fn = self._admit_cache.get(("hput", n))
+        if fn is None:
+            stacked = self.ensemble > 1 or self.members > 1
+            n_s = self.n_slots
+
+            def put(ck, cv, chunk, row, start):
+                return kv_transfer.write_rows(
+                    (ck, cv), chunk, row, start,
+                    stacked=stacked, n_slots=n_s)
+
+            fn = jax.jit(put, donate_argnames=("ck", "cv"))
+            self._admit_cache[("hput", n)] = fn
+        return fn
+
+    def _handoff_dispatch(self, adm: _Admission, upto: int):
+        """Dispatch (async) the staging slice covering rows
+        [adm.handed, upto) — widened to a power-of-two window ENDING at
+        ``upto`` (re-sending already-handed rows is an idempotent
+        overwrite; exact tail lengths would compile one slice/write pair
+        per length). Returns None when nothing new is staged."""
+        if upto <= adm.handed:
+            return None
+        b = 1 << (upto - adm.handed - 1).bit_length()
+        b = min(b, self.spec.max_seq)
+        start = max(0, upto - b)
+        payload = self._handoff_slice_fn(b)(
+            self._sck, self._scv, np.int32(adm.slot), np.int32(start))
+        return (payload, start, b, upto)
+
+    def _handoff_commit(self, adm: _Admission, disp, final: bool = False):
+        """Transfer a dispatched slice device→device onto the decode mesh
+        (blocking the PREFILL thread only — the decode ring keeps rolling)
+        and queue it for the decode loop; ``final`` additionally queues the
+        register marker. The overlap contract: the slice for chunk i was
+        dispatched before segment i+1, so this transfer proceeds while the
+        prefill group computes the next segment."""
+        if disp is not None:
+            payload, start, b, upto = disp
+            faults.fire("engine.kv_handoff")
+            t0 = time.perf_counter()
+            moved, n_bytes, dt, route = kv_transfer.transfer(
+                payload, self._rep)
+            self.n_kv_handoffs += 1
+            self.kv_handoff_bytes += n_bytes
+            self.kv_handoff_s += dt
+            if adm.req.trace is not None:
+                adm.req.trace.add_span_abs(
+                    "kv-handoff", t0, time.perf_counter(), tokens=b,
+                    slot=adm.slot, bytes=n_bytes, route=route)
+            adm.handed = upto
+            with self._cond:
+                self._handoffs.append(("kv", adm, moved, start, b))
+                self._cond.notify_all()
+        if final:
+            adm.final_sent = True
+            with self._cond:
+                self._handoffs.append(("final", adm, None, 0, 0))
+                self._cond.notify_all()
+
+    def _drain_handoffs(self) -> None:
+        """Decode loop: write queued handoff pieces into their claimed
+        slots and register admissions whose final marker arrived. Pieces of
+        a ``dead`` admission are dropped — its claim may already have been
+        re-issued, and a stale write would corrupt the new tenant."""
+        while True:
+            with self._cond:
+                if not self._handoffs:
+                    return
+                kind, adm, chunk, start, n = self._handoffs.popleft()
+            if adm.dead:
+                continue
+            if kind == "kv":
+                try:
+                    self._ck, self._cv = self._handoff_write_fn(n)(
+                        self._ck, self._cv, chunk,
+                        np.int32(adm.slot), np.int32(start))
+                except Exception as e:
+                    # Same containment contract as the register branch: a
+                    # failed slot write dooms only this admission when the
+                    # donated decode cache survived (checked inside);
+                    # escalation to _fail_all only when it was consumed.
+                    adm.dead = True
+                    self._contain_admission_failure([adm.req], e,
+                                                    admissions=[adm])
+                continue
+            req = adm.req
+            if req.cancel.is_set():
+                with self._cond:
+                    if adm.dead:
+                        continue
+                    adm.dead = True
+                if not req.expired:  # deadline expiry already delivered err
+                    self.n_cancelled += 1
+                    req.out.put(("end", None))
+                self._release_admission(adm)
+                continue
+            try:
+                if req.grammar is not None:
+                    # Arena placement is decode-group state (the DFA masks
+                    # apply inside decode chunks), so it happens HERE, on
+                    # the decode loop — never from the prefill thread.
+                    req.g_start = self._ensure_grammar(req.grammar)
+                    self.n_constrained += 1
+                with self._cond:
+                    self._resident[adm.slot] = list(req.prompt_ids)
+                self._finish_admission(adm)
+            except Exception as e:
+                adm.dead = True
+                self._contain_admission_failure([req], e, admissions=[adm])
+
+    def _admit_disagg(self, req: _Request, slot: int) -> None:
+        """Claim the decode-group slot and start the admission on the
+        prefill group. Every disagg admission rides the chunked path; a
+        host prefix-store match restores into the STAGING slot first (the
+        tail segments attend over it there) and reaches the decode slot
+        through the ordinary handoff."""
+        offset = 0
+        try:
+            # Inside containment: the request is already popped from
+            # _pending but not yet in _admitting — an uncaught failure
+            # here (host-RAM pressure in the store concatenate, say) would
+            # slip past the outer catch's admitting sweep and leave the
+            # consumer blocked forever.
+            restore = self._store_lookup(req.prompt_ids, 0)
+        except Exception as e:
+            self._contain_prefill_failure([req], e)
+            return
+        if restore is not None:
+            offset = restore[0]
+        adm = _Admission(req, slot, offset=offset, restored=offset)
+        with self._cond:
+            self._claimed.add(slot)
+            self._resident[slot] = []
+            self._admitting.append(adm)
+        if restore is not None:
+            try:
+                self._restore_into(slot, 0, offset, restore[1], req,
+                                   stage=True)
+            except Exception as e:
+                self._contain_prefill_failure([req], e, admissions=[adm])
+
+    def _stage_state_ok(self) -> bool:
+        """Whether the donated staging cache survived the last failed
+        prefill-group call (the prefill-side twin of _device_state_ok)."""
+        try:
+            leaves = jax.tree.leaves((self._sck, self._scv))
+            return not any(x.is_deleted() for x in leaves
+                           if isinstance(x, jax.Array))
+        except Exception:
+            return False
+
+    def _contain_prefill_failure(
+        self, reqs: list[_Request], exc: Exception,
+        admissions: "list[_Admission] | None" = None,
+    ) -> None:
+        """A prefill-group dispatch failed: the group boundary IS the blast-
+        radius boundary. With the staging cache intact only the named
+        request(s) die; when the donated staging buffers were consumed,
+        every in-flight admission's staged KV went with them — doom the
+        admitting set and rebuild the STAGING cache, leaving pending
+        requests queued and active decode streams completely untouched
+        (the insulation disagg exists for)."""
+        for adm in admissions or ():
+            adm.dead = True
+            self._release_admission(adm)
+        if self._stage_state_ok():
+            self.n_failures += len(reqs)
+            for r in reqs:
+                if r.trace is not None:
+                    now = time.perf_counter()
+                    r.trace.add_span_abs("engine-failure", now, now,
+                                         error=type(exc).__name__,
+                                         contained=True)
+                r.out.put(("err", exc))
+            return
+        with self._cond:
+            doomed_adms = list(self._admitting)
+        doomed = list(reqs)
+        for a in doomed_adms:
+            a.dead = True
+            if a.req not in doomed:
+                doomed.append(a.req)
+            self._release_admission(a)
+        self.n_rebuilds += 1
+        self.breaker.record_failure()
+        self.n_failures += len(doomed)
+        for r in doomed:
+            if r.trace is not None:
+                now = time.perf_counter()
+                r.trace.add_span_abs("engine-failure", now, now,
+                                     error=type(exc).__name__,
+                                     contained=True, group="prefill")
+            r.out.put(("err", exc))
+        if not self._stop:
+            self._init_stage_state()
+
+    def _prefill_work(self) -> bool:
+        """Does the prefill loop have anything to do right now? Caller
+        holds ``_cond``. An admission awaiting its decode-group register
+        (final_sent, not cancelled) is NOT work — the decode loop owns it;
+        pending requests count only when one could actually claim a slot."""
+        for a in self._admitting:
+            if not a.final_sent or a.req.cancel.is_set():
+                return True
+        if not self._pending:
+            return False
+        members = {r.member for r in self._pending}
+        for m in members:
+            lo = m * self.n_slots
+            for i in range(lo, lo + self.n_slots):
+                if self._slots[i] is None and i not in self._claimed:
+                    return True
+        return False
+
+    def _prefill_scheduler(self) -> None:
+        """The prefill group's cooperating loop (disagg only): admit
+        pending requests into staging, advance segments, hand off KV. The
+        decode loop (:meth:`_scheduler`) never blocks on any of it."""
+        while True:
+            with self._cond:
+                while not (self._stop or self._prefill_work()):
+                    # Going idle: refresh the occupancy gauge so a
+                    # drained prefill group reads 0, not the last burst.
+                    obs.PREFILL_GROUP_ACTIVE.set(len(self._admitting))
+                    self._cond.wait()
+                stopping = self._stop
+                if stopping:
+                    pending, self._pending = self._pending, []
+                    admitting = list(self._admitting)
+            if stopping:
+                # Drain consumers (shutdown set every cancel event): queued
+                # requests end cleanly; in-flight admissions are marked
+                # dead so the decode loop drops their queued pieces.
+                for r in pending:
+                    r.out.put(("end", None))
+                for adm in admitting:
+                    adm.dead = True
+                    adm.req.out.put(("end", None))
+                    self._release_admission(adm)
+                return
+            obs.PREFILL_GROUP_ACTIVE.set(len(self._admitting))
+            try:
+                self._start_admissions()
+                self._step_admissions()
+            except Exception as e:  # fail open, prefill-group blast radius
+                try:
+                    with self._cond:
+                        adms = list(self._admitting)
+                    self._contain_prefill_failure(
+                        [a.req for a in adms], e, admissions=adms)
+                except Exception:
+                    pass
 
     # ---- constrained decoding: grammar arena + per-row DFA state -----------
 
@@ -2256,7 +2649,9 @@ class InferenceEngine:
                 )
             self._pending.append(req)
             self.n_requests += 1
-            self._cond.notify()
+            # notify_all: under disagg TWO scheduler loops wait on _cond,
+            # and waking only one could leave the admission loop asleep.
+            self._cond.notify_all()
         return req
 
     def metrics(self) -> dict:
@@ -2303,6 +2698,20 @@ class InferenceEngine:
                 "decode_loop_chunks_total": self.n_loop_chunks,
                 "drain_gap_seconds_total": round(self.drain_gap_s, 6),
                 "inflight_chunks": len(self._inflight),
+                # Disaggregated serving (0s when colocated): per-group
+                # device counts and occupancy, plus the device↔device KV
+                # handoff accounting (quorum_tpu/cache/kv_transfer.py).
+                "disagg": 1 if self.disagg else 0,
+                "prefill_group_devices": (
+                    int(self.prefill_mesh.devices.size) if self.disagg else 0),
+                "decode_group_devices": (
+                    int(self.mesh.devices.size) if self.disagg else 0),
+                "prefill_group_active": (
+                    len(self._admitting) if self.disagg else 0),
+                "decode_group_active": busy if self.disagg else 0,
+                "kv_handoffs_total": self.n_kv_handoffs,
+                "kv_handoff_bytes_total": self.kv_handoff_bytes,
+                "kv_handoff_seconds_total": round(self.kv_handoff_s, 6),
                 "rebuilds_total": self.n_rebuilds,
                 "deadline_exceeded_total": self.n_deadline_exceeded,
                 "breaker_state": self.breaker.state_code,
@@ -2318,6 +2727,13 @@ class InferenceEngine:
             stopped = self._stop
         return {
             "scheduler_alive": self._thread.is_alive() and not stopped,
+            # Group-aware liveness (docs/tpu_backends.md): under disagg the
+            # engine serves only while BOTH cooperating loops run — a dead
+            # decode loop must not hide behind a live prefill loop (or vice
+            # versa). True structurally when colocated (one loop).
+            "prefill_scheduler_alive": (
+                not self.disagg
+                or (self._prefill_thread.is_alive() and not stopped)),
             "snapshot_worker_alive": (
                 self.prefix_store is None or self._snap_thread.is_alive()),
             "breaker": self.breaker.state,
@@ -2348,43 +2764,76 @@ class InferenceEngine:
                 r.cancel.set()
             self._cond.notify_all()
         self._thread.join(timeout=timeout)
+        if self._prefill_thread is not None:
+            self._prefill_thread.join(timeout=timeout)
         if self.prefix_store is not None:
             # Stop the snapshot worker (sentinel after any queued fetches)
             # and release the host copies with the device state below.
             self._snap_queue.put(None)
             self._snap_thread.join(timeout=timeout)
             self.prefix_store.clear()
-        if self._thread.is_alive():
+        if self._thread.is_alive() or (
+                self._prefill_thread is not None
+                and self._prefill_thread.is_alive()):
             # A dispatch (e.g. a long XLA compile) is still in flight: do
             # NOT null the state under it — the thread exits at its next
             # scheduler-loop boundary and the GC reclaims everything then.
             return
         self.params = None
         self._ck = self._cv = None
+        if self.disagg:
+            self.prefill_params = None
+            self._sck = self._scv = None
+            self._handoffs.clear()
         if self._draft_rt is not None:  # draft weights + cache go with them
             self._draft_rt.params = None
             self._draft_rt._ck = self._draft_rt._cv = None
             self._draft_rt = None
 
     def _scheduler(self) -> None:
+        # Under disagg this loop is the DECODE group's: admissions and
+        # prefill segments belong to _prefill_scheduler, and the only
+        # admission work here is draining the handoff queue (slot writes +
+        # registers — all decode-cache mutation stays on this one thread).
         while True:
             with self._cond:
-                while not (self._stop or self._pending or self._admitting
+                while not (self._stop
+                           or (not self.disagg
+                               and (self._pending or self._admitting))
                            or any(self._slots) or self._inflight
-                           or self._pending_snaps):
+                           or self._pending_snaps or self._handoffs):
+                    if self.disagg:
+                        # Going idle: the occupancy gauge must read the
+                        # truth ("right now"), not the last reaped chunk's
+                        # batch size.
+                        obs.DECODE_GROUP_ACTIVE.set(
+                            sum(1 for r in self._slots if r is not None))
                     self._cond.wait()
                 if self._stop and not (
-                    self._pending or self._admitting or any(self._slots)
+                    (not self.disagg
+                     and (self._pending or self._admitting))
+                    or any(self._slots)
                     or self._inflight or self._pending_snaps
                 ):
                     # _pending_snaps blocks the exit: leaving deferred
                     # snapshots undispatched would strand _snap_backlog > 0
                     # and hang any concurrent drain_prefix_store() forever.
+                    # Queued handoff pieces are safe to drop — their
+                    # admissions were ended by the prefill loop's own exit.
+                    self._handoffs.clear()
                     return
             try:
                 self._sweep_deadlines()
-                self._start_admissions()
-                self._step_admissions()
+                if self.disagg:
+                    # The deferred decode-side state work the colocated
+                    # loop runs inside _start_admissions.
+                    self._flush_dfa_resets()
+                    self._maybe_reset_arena()
+                    self._dispatch_snapshots()
+                    self._drain_handoffs()
+                else:
+                    self._start_admissions()
+                    self._step_admissions()
                 if any(self._slots) or self._inflight:
                     self._run_chunk()
             except Exception as e:  # fail open: wake every waiting consumer
@@ -2483,10 +2932,16 @@ class InferenceEngine:
         program samples the first token inside the prefill, before any
         grammar mask could apply; the register path leaves the first
         sample to the next (masked) decode chunk. Their grammar tables are
-        placed in the device arena here, before the admission starts."""
-        self._flush_dfa_resets()
-        self._maybe_reset_arena()
-        self._dispatch_snapshots()
+        placed in the device arena here, before the admission starts.
+
+        Under disagg this runs on the PREFILL thread: every admission is
+        chunked into the staging cache (``_admit_disagg``), and the
+        decode-side state work (DFA resets, arena, snapshots, grammar
+        placement) moves to the decode loop."""
+        if not self.disagg:
+            self._flush_dfa_resets()
+            self._maybe_reset_arena()
+            self._dispatch_snapshots()
         if self.members > 1:
             self._start_admissions_members()
             return
@@ -2503,6 +2958,9 @@ class InferenceEngine:
                 req.out.put(("end", None))
                 continue
             self._note_admitted(req)
+            if self.disagg:
+                self._admit_disagg(req, slot)
+                continue
             if req.grammar is not None:
                 try:
                     req.g_start = self._ensure_grammar(req.grammar)
@@ -2624,7 +3082,7 @@ class InferenceEngine:
                     if slot is None:
                         continue
                     reuse = self._reuse_len(lcp, len(r.prompt_ids))
-                    if reuse or r.grammar is not None or (
+                    if reuse or r.grammar is not None or self.disagg or (
                             self.prefill_chunk
                             and len(r.prompt_ids) > self.prefill_chunk):
                         if reuse:
@@ -2658,7 +3116,10 @@ class InferenceEngine:
                     for r in group.values():
                         self._pending.remove(r)
             if (admit_chunked is not None
-                    and admit_chunked.req.grammar is not None):
+                    and admit_chunked.req.grammar is not None
+                    and not self.disagg):
+                # (Under disagg grammar placement is decode-group state —
+                # the decode loop places it at register time instead.)
                 # Arena placement outside _cond (a grammar's first table
                 # upload must not run under the scheduler lock); the
                 # admission's register turn — the only reader of g_start —
@@ -2798,10 +3259,17 @@ class InferenceEngine:
         for adm in list(self._admitting):
             req = adm.req
             if req.cancel.is_set():
-                self.n_cancelled += 1
-                req.out.put(("end", None))
+                with self._cond:  # races the decode loop's final branch
+                    if adm.dead:
+                        continue
+                    adm.dead = True
+                if not req.expired:  # deadline expiry already delivered err
+                    self.n_cancelled += 1
+                    req.out.put(("end", None))
                 self._release_admission(adm)
                 continue
+            if adm.final_sent:
+                continue  # disagg: staged; awaiting decode-group register
             seg = req.prompt_ids[adm.offset: adm.offset + self.prefill_chunk]
             bucket = prefill_bucket(len(seg), self.prefill_chunk)
             history = prefill_bucket(adm.offset + len(seg), self.spec.max_seq)
@@ -2820,9 +3288,14 @@ class InferenceEngine:
                 try:
                     self._run_member_segments(batch, bucket, history)
                 except Exception as e:
-                    self._contain_admission_failure(
-                        [adm.req for adm in batch.values()], e,
-                        admissions=list(batch.values()))
+                    if self.disagg:
+                        self._contain_prefill_failure(
+                            [adm.req for adm in batch.values()], e,
+                            admissions=list(batch.values()))
+                    else:
+                        self._contain_admission_failure(
+                            [adm.req for adm in batch.values()], e,
+                            admissions=list(batch.values()))
 
     def _run_member_segments(
         self, batch: dict[int, _Admission], bucket: int, history: int
@@ -2841,6 +3314,26 @@ class InferenceEngine:
             n_valids[m] = len(seg)
             slots[m] = adm.slot % n_s
             enables[m] = True
+        if self.disagg:
+            faults.fire("engine.prefill_segment")
+            # Same overlap discipline as the single-engine path: slices of
+            # the completed rows dispatch BEFORE the member-vmapped segment
+            # donates the staging buffers; the transfers then proceed while
+            # the prefill group computes the next segment.
+            disps = {m: self._handoff_dispatch(adm, adm.offset)
+                     for m, adm in batch.items()}
+            self._sck, self._scv = self._seg_fn_members(bucket, history)(
+                self.prefill_params, tokens, offsets, n_valids, slots,
+                enables, self._sck, self._scv,
+            )
+            for m, adm in batch.items():
+                adm.offset += int(n_valids[m])
+                self._handoff_commit(adm, disps[m])
+                if adm.offset >= len(adm.req.prompt_ids):
+                    self._handoff_commit(
+                        adm, self._handoff_dispatch(adm, adm.offset),
+                        final=True)
+            return
         self._ck, self._cv = self._seg_fn_members(bucket, history)(
             self.params, tokens, offsets, n_valids, slots, enables,
             self._ck, self._cv,
@@ -2911,16 +3404,53 @@ class InferenceEngine:
         for adm in list(self._admitting):
             req = adm.req
             if req.cancel.is_set():
-                self.n_cancelled += 1
-                req.out.put(("end", None))
+                # Atomic dead-marking: under disagg the decode loop's
+                # final-marker branch can race this cancel retirement —
+                # whichever side flips ``dead`` first retires the request
+                # exactly once. A deadline expiry already delivered its
+                # err frame (req.expired, _expire) — it is not a client
+                # cancellation and gets no extra end frame.
+                with self._cond:
+                    if adm.dead:
+                        continue
+                    adm.dead = True
+                if not req.expired:
+                    self.n_cancelled += 1
+                    req.out.put(("end", None))
                 self._release_admission(adm)
                 continue
+            if adm.final_sent:
+                continue  # fully staged; awaiting the decode-group register
             prompt = req.prompt_ids
             seg = prompt[adm.offset : adm.offset + self.prefill_chunk]
             bucket = prefill_bucket(len(seg), self.prefill_chunk)
             history = prefill_bucket(adm.offset + len(seg), self.spec.max_seq)
             tokens = np.zeros((1, bucket), np.int32)
             tokens[0, : len(seg)] = seg
+            if self.disagg:
+                try:
+                    faults.fire("engine.prefill_segment")
+                    # Overlap: slice the already-complete rows off the
+                    # PRE-segment staging buffers, dispatch the next
+                    # segment, then transfer — handoff of chunk i runs
+                    # while the prefill group computes chunk i+1.
+                    disp = self._handoff_dispatch(adm, adm.offset)
+                    self._sck, self._scv = self._seg_fn(bucket, history)(
+                        self.prefill_params, tokens, np.int32(adm.offset),
+                        np.int32(len(seg)),
+                        np.int32(adm.slot), self._sck, self._scv,
+                    )
+                    adm.offset += len(seg)
+                    self._handoff_commit(adm, disp)
+                    if adm.offset >= len(prompt):
+                        # The last segment's rows hand off now; the decode
+                        # loop registers once the final marker drains.
+                        self._handoff_commit(
+                            adm, self._handoff_dispatch(adm, adm.offset),
+                            final=True)
+                except Exception as e:
+                    self._contain_prefill_failure([req], e, admissions=[adm])
+                continue
             try:
                 faults.fire("engine.prefill_segment")
                 self._ck, self._cv = self._seg_fn(bucket, history)(
@@ -2944,6 +3474,11 @@ class InferenceEngine:
             if adm in self._admitting:
                 self._admitting.remove(adm)
             self._claimed.discard(adm.slot)
+            if self.disagg:
+                # A discarded claim is admission capacity the (possibly
+                # sleeping) prefill loop can use — and either loop may be
+                # the releaser here.
+                self._cond.notify_all()
 
     def _admit(self, req: _Request, slot: int) -> None:
         faults.fire("engine.admit")
@@ -3025,6 +3560,7 @@ class InferenceEngine:
         if req.trace is not None:
             now = time.perf_counter()
             req.trace.add_span_abs("deadline-exceeded", now, now, stage=stage)
+        req.expired = True
         req.out.put(("err", DeadlineExceeded(stage)))
         req.cancel.set()
 
@@ -3051,7 +3587,15 @@ class InferenceEngine:
             self._expire(r, "queue")
         for a in late_adm:
             self._expire(a.req, "prefill")
-            self._release_admission(a)
+            if self.disagg:
+                # The PREFILL thread owns this admission's staging rows; a
+                # release here could re-issue the slot claim under a
+                # segment still being dispatched. _expire set cancel — the
+                # prefill loop's own cancel branch releases it cleanly.
+                with self._cond:
+                    self._cond.notify_all()
+            else:
+                self._release_admission(a)
         for i, r in late_active:
             self._expire(r, "decode")
             with self._cond:
@@ -3154,7 +3698,15 @@ class InferenceEngine:
         actually claim a slot right now. Pending requests with NO free
         slot are NOT pressure — they cannot admit until a row finishes
         anyway, and deep/fused dispatch is exactly what finishes rows
-        sooner. Caller holds ``_cond``."""
+        sooner. Caller holds ``_cond``.
+
+        NEVER under disagg: admissions run on their own device group, so
+        the decode ring keeps its full depth (and full megachunk fusion)
+        through any admission burst — the whole point of the split. Handoff
+        writes/registers chain behind the in-flight ring without draining
+        it."""
+        if self.disagg:
+            return False
         if self._admitting:
             return True
         if not self._pending:
@@ -3296,6 +3848,8 @@ class InferenceEngine:
         t1 = time.perf_counter()
         obs.DECODE_CHUNK.observe(t1 - t0)
         obs.PIPELINE_DEPTH.set(len(self._inflight))
+        if self.disagg:
+            obs.DECODE_GROUP_ACTIVE.set(len(c.active))
         self.n_decode_chunks += 1
         self.n_decode_rows += len(c.active)
         # Megachunk accounting: chunk segments this dispatch actually
@@ -3346,6 +3900,10 @@ class InferenceEngine:
         device→host snapshot, so it survives the slot being reclaimed."""
         self._slots[i] = None
         self._resident[i] = req.hist[:-1]
+        if self.disagg:
+            # A freed decode slot is what the (possibly sleeping) prefill
+            # loop waits on to admit its next pending request.
+            self._cond.notify_all()
         if req.grammar is not None:
             # The row's device DFA state must return to FREE before an
             # unconstrained request can activate it (a stale grammar state
@@ -3580,6 +4138,11 @@ class InferenceEngine:
             doomed = list(doomed or [])
             doomed += [r for r in self._slots if r is not None]
             doomed += [a.req for a in self._admitting]
+            for a in self._admitting:
+                # Disagg: queued handoff pieces reference re-issued claims
+                # after the rebuild — the drain must drop them.
+                a.dead = True
+            self._handoffs.clear()
             self._slots = [None] * self._rows
             self._admitting = []
             self._claimed = set()
@@ -3593,6 +4156,9 @@ class InferenceEngine:
             # The rebuild below re-zeroes the per-row DFA state wholesale;
             # row-level resets queued before the failure are moot.
             self._pending_dfa_resets = []
+            # Freed slots are admission capacity: wake the prefill loop
+            # (disagg) so queued requests admit once the rebuild lands.
+            self._cond.notify_all()
         # In-flight chunk payloads reference (possibly poisoned) device
         # arrays from before the failure — drop them unread.
         self._inflight.clear()
@@ -3696,6 +4262,7 @@ def get_engine(
     draft_seed: int = 0,
     draft_ckpt: str | None = None,
     sp_impl: str = "ring",
+    prefill_mesh: Mesh | None = None,
 ) -> InferenceEngine:
     """Engines are keyed by weight identity (spec, seed, mesh, quant,
     ensemble, members, draft model) plus the cache representation (kv_quant)
@@ -3729,7 +4296,12 @@ def get_engine(
            draft_spec, draft_seed, draft_ckpt, sp_key,
            resolve_flash_decode(flash_decode),
            tuple(sorted(mesh.shape.items())),
-           tuple(map(str, mesh.devices.flat)))
+           tuple(map(str, mesh.devices.flat)),
+           # disagg is structural: the prefill group carries a second
+           # weight copy + staging cache, so colocated and disaggregated
+           # URLs must never share one engine.
+           tuple(map(str, prefill_mesh.devices.flat))
+           if prefill_mesh is not None else None)
     with _ENGINES_LOCK:
         eng = _ENGINES.get(key)
         if eng is None:
@@ -3750,6 +4322,7 @@ def get_engine(
                 members=members, kv_quant=kv_quant,
                 draft_spec=draft_spec, draft_seed=draft_seed,
                 draft_params=draft_params, sp_impl=sp_impl,
+                prefill_mesh=prefill_mesh,
             )
             _ENGINES[key] = eng
         else:
@@ -3780,6 +4353,7 @@ def get_engine_from_ckpt(
     kv_quant: str | None = None,
     draft_ckpt: str | None = None,
     sp_impl: str = "ring",
+    prefill_mesh: Mesh | None = None,
 ) -> InferenceEngine:
     """Engine over a local HF checkpoint; keyed by (resolved path, mesh,
     draft checkpoint) so N backends pointing at one checkpoint with the
@@ -3809,7 +4383,9 @@ def get_engine_from_ckpt(
     key = ("ckpt", resolved, eff_dtype, quant or None, kv_quant or None,
            draft_resolved, sp_key, resolve_flash_decode(flash_decode),
            tuple(sorted(mesh.shape.items())),
-           tuple(map(str, mesh.devices.flat)))
+           tuple(map(str, mesh.devices.flat)),
+           tuple(map(str, prefill_mesh.devices.flat))
+           if prefill_mesh is not None else None)
     with _ENGINES_LOCK:
         eng = _ENGINES.get(key)
         if eng is None:
@@ -3833,7 +4409,7 @@ def get_engine_from_ckpt(
                 ensemble=ensemble,
                 kv_quant=kv_quant,
                 draft_spec=draft_spec, draft_params=draft_params,
-                sp_impl=sp_impl,
+                sp_impl=sp_impl, prefill_mesh=prefill_mesh,
             )
             _ENGINES[key] = eng
         else:

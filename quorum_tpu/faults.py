@@ -19,6 +19,7 @@ rejects it so a typo'd chaos case cannot silently test nothing):
   ``engine.prefill_segment``  one chunked-prefill segment dispatch
   ``engine.decode``           decode-chunk dispatch (the batched hot path)
   ``engine.snapshot``         prefix-store snapshot worker fetch/insert
+  ``engine.kv_handoff``       disaggregated prefill→decode KV chunk handoff
   ``http.request``            HTTP backend non-streaming request I/O
   ``http.stream``             HTTP backend streaming request I/O
 """
@@ -33,6 +34,7 @@ SITES = (
     "engine.prefill_segment",
     "engine.decode",
     "engine.snapshot",
+    "engine.kv_handoff",
     "http.request",
     "http.stream",
 )

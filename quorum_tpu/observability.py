@@ -340,6 +340,32 @@ DECODE_LOOP_CHUNKS = METRICS.histogram(
     "produced tokens).",
     buckets=(1, 2, 4, 8, 16, 32, 64))
 
+# Disaggregated prefill/decode serving (tpu://…&disagg=P+D — docs/
+# tpu_backends.md): admission prefill runs on its own device group and a
+# completed admission's KV prefix hands off device→device into the claimed
+# decode-group slot (quorum_tpu/cache/kv_transfer.py). The handoff pair
+# counts every KV byte that crosses the group boundary; the per-group
+# occupancy gauges are the split view of the old single-mesh busy_slots.
+KV_HANDOFF_BYTES = METRICS.counter(
+    "quorum_tpu_kv_handoff_bytes_total",
+    "KV cache bytes handed off between device groups (prefill-group "
+    "staging -> decode-group slot; direct device->device, or the host "
+    "bounce fallback).")
+KV_HANDOFF_SECONDS = METRICS.histogram(
+    "quorum_tpu_kv_handoff_seconds",
+    "One chunk-granular KV handoff between device groups (slice dispatch "
+    "to landed-on-target), blocking on the prefill scheduler thread.",
+    buckets=(0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+             0.25, 0.5, 1.0, 2.5, 5.0))
+PREFILL_GROUP_ACTIVE = METRICS.gauge(
+    "quorum_tpu_prefill_group_active",
+    "In-flight chunked admissions occupying the prefill device group "
+    "right now (last-writer-wins across engines sharing the process).")
+DECODE_GROUP_ACTIVE = METRICS.gauge(
+    "quorum_tpu_decode_group_active",
+    "Busy decode-group slots right now (last-writer-wins across engines "
+    "sharing the process).")
+
 # Tiered KV prefix store (quorum_tpu/cache/prefix_store.py + the engine's
 # snapshot/restore hooks, docs/prefix_cache.md): host-RAM retention of
 # decoded KV prefixes beyond the resident slots. Process-wide families —

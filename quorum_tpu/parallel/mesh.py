@@ -78,3 +78,49 @@ def best_mesh(n_devices: int | None = None, *, want_dp: bool = False) -> Mesh:
 def single_device_mesh() -> Mesh:
     """A 1×1×1 mesh — lets all code paths be mesh-agnostic."""
     return make_mesh(MeshConfig(), jax.devices()[:1])
+
+
+def parse_disagg(raw: str) -> tuple[int, int]:
+    """``"4+4"`` → ``(n_prefill, n_decode)``. Strict: the knob is structural
+    (it decides device-group placement for the engine's lifetime), so a typo
+    must fail at config time, not silently colocate. URL query parsing
+    decodes ``+`` to a space, so a bare space separator is accepted too
+    (``disagg=4+4`` in config.yaml arrives here as ``"4 4"``)."""
+    import re
+
+    m = re.fullmatch(r"(\d+)[+ ](\d+)", str(raw).strip())
+    if not m:
+        raise ValueError(
+            f"invalid disagg={raw!r} (expected P+D device counts, e.g. 4+4)")
+    n_p, n_d = int(m.group(1)), int(m.group(2))
+    if n_p < 1 or n_d < 1:
+        raise ValueError(
+            f"invalid disagg={raw!r} (both device groups need >= 1 device)")
+    return n_p, n_d
+
+
+def disagg_meshes(n_prefill: int, n_decode: int,
+                  devices=None) -> tuple[Mesh, Mesh]:
+    """Two DISJOINT device-group meshes for disaggregated serving
+    (``tpu://…&disagg=P+D``): the first ``n_prefill`` devices become the
+    prefill group's tp mesh, the next ``n_decode`` the decode group's.
+
+    MPMD-style placement ("Scaling Deep Learning Training with MPMD Pipeline
+    Parallelism", PAPERS.md): admission prefill programs compile and run on
+    the first mesh, the decode ring on the second, and a completed
+    admission's KV prefix hands off device→device between them
+    (quorum_tpu/cache/kv_transfer.py). tp is the only axis per group — the
+    highest-traffic collectives stay nearest-neighbour inside each group,
+    and the inter-group hop is the explicit KV handoff, never a GSPMD
+    collective spanning both."""
+    if devices is None:
+        devices = jax.devices()
+    need = n_prefill + n_decode
+    if need > len(devices):
+        raise ValueError(
+            f"disagg={n_prefill}+{n_decode} needs {need} devices, have "
+            f"{len(devices)}")
+    prefill = make_mesh(MeshConfig(tp=n_prefill), devices[:n_prefill])
+    decode = make_mesh(MeshConfig(tp=n_decode),
+                       devices[n_prefill:n_prefill + n_decode])
+    return prefill, decode

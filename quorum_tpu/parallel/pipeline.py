@@ -62,8 +62,11 @@ def _pvary(tree, axes: tuple[str, ...]):
         return tree
     try:
         return jax.lax.pcast(tree, axes, to="varying")
-    except (AttributeError, TypeError):  # older jax spells it pvary
-        return jax.lax.pvary(tree, axes)
+    except (AttributeError, TypeError):
+        try:  # older jax spells it pvary
+            return jax.lax.pvary(tree, axes)
+        except AttributeError:
+            return tree  # pre-vma jax (< 0.5): no manual-varying typing
 
 
 def _check_pp_mesh(mesh: Mesh, spec: ModelSpec) -> int:

@@ -93,8 +93,11 @@ def _ring_local(q, k, v, lengths, *, axis: str, sp_size: int, _mesh_axes=()):
     # type matches its (varying) outputs under shard_map's vma typing.
     try:
         m0, l0, acc0 = jax.lax.pcast((m0, l0, acc0), tuple(_mesh_axes), to="varying")
-    except (AttributeError, TypeError):  # older jax spells it pvary
-        m0, l0, acc0 = jax.lax.pvary((m0, l0, acc0), tuple(_mesh_axes))
+    except (AttributeError, TypeError):
+        try:  # older jax spells it pvary
+            m0, l0, acc0 = jax.lax.pvary((m0, l0, acc0), tuple(_mesh_axes))
+        except AttributeError:
+            pass  # pre-vma jax (< 0.5): no varying-manual typing — no-op
     # sp_size-1 (compute + permute) steps, then one final compute with the
     # last-held block OUTSIDE the scan — the ring's last permutation would
     # only be thrown away, so it is never sent.

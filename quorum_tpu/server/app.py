@@ -218,7 +218,13 @@ def create_app(
         thread liveness, breaker state, queue depth vs capacity.
         ``unhealthy``: a serving thread is dead (only a restart recovers).
         ``degraded``: the failure breaker is open/half-open or the
-        admission queue is saturated — alive, but shedding."""
+        admission queue is saturated — alive, but shedding.
+
+        Group-aware under disaggregated serving (``disagg=P+D``): the
+        engine runs TWO cooperating scheduler loops, and a dead
+        decode-group loop must not report healthy because the prefill loop
+        is still alive (or vice versa) — /ready then sheds whenever either
+        group would."""
         checks: list[dict] = []
         for name, engine in _distinct_engines(rt.reg, "health"):
             row = engine.health()
@@ -227,6 +233,7 @@ def create_app(
         status = "healthy"
         for row in checks:
             if (not row["scheduler_alive"]
+                    or not row.get("prefill_scheduler_alive", True)
                     or not row["snapshot_worker_alive"]):
                 return "unhealthy", checks
             if (row["breaker"] != "closed"
@@ -301,6 +308,8 @@ def create_app(
                   "queue_limit", "decode_pipeline", "decode_loop",
                   "inflight_chunks",
                   "prefix_store_bytes", "prefix_store_entries",
+                  "disagg", "prefill_group_devices", "decode_group_devices",
+                  "prefill_group_active", "decode_group_active",
                   "breaker_state")
         # One snapshot per distinct engine (_distinct_engines). Each
         # family's TYPE line appears exactly once, with all its samples

@@ -37,6 +37,11 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 os.environ.pop("PALLAS_AXON_POOL_IPS", None)
 os.environ.setdefault("QUORUM_TPU_COMPILE_CACHE", "0")
+# The disagg handoff phase needs one virtual device per group.
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=2").strip()
 
 SCRIPT_TIMEOUT_S = 600.0   # watchdog over the whole sweep
 DEADLINE_SLACK_S = 2.0     # acceptance: timeout response within deadline + 2s
@@ -237,6 +242,68 @@ async def _run(quick: bool) -> None:
         sampled1 = text(await chat(temperature=0.9, seed=7))
         check("greedy output pinned across chaos", greedy1 == greedy0)
         check("sampled output pinned across chaos", sampled1 == sampled0)
+
+        # ---- phase 4b: disagg KV-handoff fault site under load -----------
+        # A small disaggregated (1+1 device group) engine beside the main
+        # colocated one: the prefill→decode handoff fails for ONE
+        # admission while a streaming request decodes and a bystander
+        # admission queues — only the faulted request dies, the stream and
+        # bystander complete unchanged, no requeue storm, no rebuild, and
+        # both group loops stay alive (docs/tpu_backends.md).
+        if not quick:
+            print("phase 4b: disagg kv handoff", flush=True)
+            from quorum_tpu.engine.engine import InferenceEngine
+            from quorum_tpu.models.model_config import resolve_spec
+            from quorum_tpu.ops.sampling import SamplerConfig
+            from quorum_tpu.parallel.mesh import disagg_meshes
+
+            pm, dm = disagg_meshes(1, 1)
+            tiny = resolve_spec("llama-tiny", {"n_kv_heads": "4"})
+            deng = InferenceEngine(
+                tiny, dm, prefill_mesh=pm, decode_chunk=4, n_slots=2,
+                prefill_chunk=16, seed=77)
+            samp = SamplerConfig(temperature=0.0)
+            base = deng.generate([3, 4, 5], max_new_tokens=6,
+                                 sampler=samp).token_ids
+            streamer = deng.submit([9, 8, 7], max_new_tokens=24,
+                                   sampler=samp)
+            stream_it = deng.stream_results(streamer)
+            # The streamer must be past its OWN admission handoff before
+            # the fault arms (times=1 must hit the victim's handoff, not
+            # the stream's): its first token proves it is decoding.
+            stream_toks = [next(stream_it)]
+            faults.reset_counts()
+            faults.arm("engine.kv_handoff", times=1)
+            bad = deng.submit([5, 6, 7], max_new_tokens=6, sampler=samp)
+            bystander = deng.submit([3, 4, 5], max_new_tokens=6,
+                                    sampler=samp)
+            err = None
+            try:
+                list(deng.stream_results(bad))
+            except Exception as e:
+                err = e
+            by_toks = list(deng.stream_results(bystander))
+            stream_toks += list(stream_it)
+            faults.disarm()
+            check("kv_handoff: fault fired",
+                  faults.fired("engine.kv_handoff") >= 1)
+            check("kv_handoff: failed handoff dooms its own request",
+                  isinstance(err, faults.FaultInjected), repr(err))
+            check("kv_handoff: queued bystander completes unchanged",
+                  by_toks == base, f"{by_toks} != {base}")
+            check("kv_handoff: concurrent stream unaffected",
+                  len(stream_toks) == 24, f"len={len(stream_toks)}")
+            follow = deng.generate([3, 4, 5], max_new_tokens=6,
+                                   sampler=samp).token_ids
+            check("kv_handoff: follow-up matches baseline", follow == base)
+            check("kv_handoff: no device-state rebuild",
+                  deng.n_rebuilds == 0, f"rebuilds={deng.n_rebuilds}")
+            dh = deng.health()
+            check("kv_handoff: both group loops alive",
+                  dh["scheduler_alive"] and dh["prefill_scheduler_alive"])
+            check("kv_handoff: KV crossed the group boundary",
+                  deng.kv_handoff_bytes > 0)
+            deng.shutdown()
 
         # ---- phase 5: HTTP backend retry ladder --------------------------
         print("phase 5: http retry", flush=True)

@@ -22,11 +22,19 @@ accounting the PR-1 counters expose:
                                 pipeline hid (≈ turnaround/(turnaround +
                                 chunk time) when fully hidden — PERF.md §2)
 
+It additionally measures **prefill interference** (the disagg=P+D
+acceptance number, docs/tpu_backends.md): the inter-token p50/p95/p99 gap
+of one streaming request while admission churn runs concurrently, colocated
+vs disaggregated — on the colocated engine every admission clamps the
+decode ring and interleaves its prefill segments between decode chunks,
+while the disagg engine prefills on its own device group and hands the KV
+off device→device, so the streaming gaps stay flat.
+
 Usage:  python scripts/hostpath_bench.py [--tokens N] [--chunk C]
-        [--depth K] [--loop C]
+        [--depth K] [--loop C] [--skip-interference]
 Prints one human-readable block and one machine-parsable JSON line.
 ``make hostpath-bench`` runs it; tests/test_hostpath_bench.py is the suite's
-smoke over the same entry point.
+smoke over the same entry points.
 """
 
 from __future__ import annotations
@@ -36,11 +44,20 @@ import json
 import os
 import statistics
 import sys
+import threading
 import time
 
 # Runnable as `python scripts/hostpath_bench.py` from a checkout without
 # `pip install -e`: the repo root (not scripts/) must be importable.
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# The interference legs need >= 2 virtual CPU devices (one per disagg
+# group). Effective only before the first `import jax` — standalone runs;
+# under pytest the suite conftest already forces an 8-device mesh.
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=2").strip()
 
 
 def run(tokens: int = 64, chunk: int = 4, depth: int = 4,
@@ -110,6 +127,107 @@ def run(tokens: int = 64, chunk: int = 4, depth: int = 4,
     return out
 
 
+def interference(tokens: int = 64, chunk: int = 4, depth: int = 4,
+                 loop: int = 4, churn: int = 4,
+                 churn_prompt_tokens: int = 48) -> dict:
+    """Streaming inter-token gaps under concurrent admission churn,
+    colocated vs ``disagg=1+1``: one long greedy stream's token-arrival
+    gaps (ms percentiles over the per-chunk reap gaps) while ``churn``
+    chunked admissions (prompts of ``churn_prompt_tokens`` ≫
+    prefill_chunk) are submitted back to back. The acceptance number is
+    the p99 gap: colocated admissions clamp the ring to depth 1 and
+    interleave prefill segments between decode chunks; the disagg leg's
+    prefill runs on its own device group."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+
+    import jax
+
+    from quorum_tpu.engine.engine import InferenceEngine
+    from quorum_tpu.models.model_config import MODEL_PRESETS
+    from quorum_tpu.ops.sampling import SamplerConfig
+    from quorum_tpu.parallel.mesh import disagg_meshes
+
+    if len(jax.devices()) < 2:
+        raise RuntimeError(
+            "the interference bench needs >= 2 virtual devices "
+            "(XLA_FLAGS=--xla_force_host_platform_device_count=2)")
+    spec = MODEL_PRESETS["llama-tiny"]
+    greedy = SamplerConfig(temperature=0.0)
+    stream_prompt = [5, 6, 7]
+    churn_prompt = [(11 + 3 * i) % spec.vocab_size
+                    for i in range(churn_prompt_tokens)]
+    out: dict = {"tokens": tokens, "churn_admissions": churn,
+                 "churn_prompt_tokens": churn_prompt_tokens}
+    streams: dict[str, list[int]] = {}
+
+    for tag, disagg in (("colocated", False), ("disagg", True)):
+        kw = dict(decode_chunk=chunk, decode_pipeline=depth,
+                  decode_loop=loop, n_slots=2, prefill_chunk=16)
+        if disagg:
+            pm, dm = disagg_meshes(1, 1)
+            eng = InferenceEngine(spec, dm, prefill_mesh=pm, **kw)
+        else:
+            eng = InferenceEngine(spec, **kw)
+        # Warm every program the measured pass dispatches (stream decode
+        # buckets, churn segment/handoff buckets): first-use XLA compiles
+        # would otherwise dominate the gap percentiles.
+        eng.generate(stream_prompt, max_new_tokens=tokens, sampler=greedy)
+        eng.generate(churn_prompt, max_new_tokens=2, sampler=greedy)
+
+        req = eng.submit(stream_prompt, max_new_tokens=tokens,
+                         sampler=greedy, seed=0)
+        stamps: list[float] = []
+        toks: list[int] = []
+        done = threading.Event()
+        n_churned = 0
+
+        def churn_loop():
+            nonlocal n_churned
+            while not done.is_set() and n_churned < churn * 4:
+                eng.generate(churn_prompt, max_new_tokens=2, sampler=greedy)
+                n_churned += 1
+
+        churner = threading.Thread(target=churn_loop, daemon=True)
+        churner.start()
+        for t in eng.stream_results(req):
+            toks.append(t)
+            stamps.append(time.perf_counter())
+        done.set()
+        churner.join()
+        streams[tag] = toks
+        # A decode chunk's k tokens reach the consumer microseconds apart;
+        # the per-chunk reap gap is the signal. Keep only gaps above 0.1ms
+        # so the intra-chunk deliveries don't dilute the percentiles.
+        gaps = sorted(b - a for a, b in zip(stamps, stamps[1:])
+                      if b - a > 1e-4)
+        if not gaps:
+            gaps = [0.0]
+
+        def pct(p):
+            return round(gaps[min(len(gaps) - 1,
+                                  int(p / 100 * len(gaps)))] * 1e3, 3)
+
+        out[f"{tag}_intertoken_p50_ms"] = pct(50)
+        out[f"{tag}_intertoken_p95_ms"] = pct(95)
+        out[f"{tag}_intertoken_p99_ms"] = pct(99)
+        out[f"{tag}_churn_completed"] = n_churned
+        if disagg:
+            out["disagg_kv_handoffs"] = eng.n_kv_handoffs
+            out["disagg_kv_handoff_bytes"] = eng.kv_handoff_bytes
+        eng.shutdown()
+
+    out["interference_tokens_match"] = (
+        streams["colocated"] == streams["disagg"])
+    c99, d99 = (out["colocated_intertoken_p99_ms"],
+                out["disagg_intertoken_p99_ms"])
+    # Floor the denominator at the gap filter (0.1 ms): a tiny-budget leg
+    # whose reap gaps all fell under the filter reports d99 = 0.0, and an
+    # unfloored ratio would record a billions-x artifact as the headline.
+    out["interference_p99_ratio"] = round(c99 / max(0.1, d99), 2)
+    return out
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--tokens", type=int, default=64)
@@ -118,7 +236,25 @@ def main() -> int:
     ap.add_argument("--loop", type=int, default=4,
                     help="decode_loop=C for the megachunk leg (>= 2)")
     ap.add_argument("--repeats", type=int, default=3)
+    ap.add_argument("--skip-interference", action="store_true",
+                    help="skip the colocated-vs-disagg interference legs")
+    ap.add_argument("--only-interference", action="store_true",
+                    help="run ONLY the interference legs (bench.py's "
+                         "subprocess phase — the depth/megachunk sweep "
+                         "would be compiled and thrown away)")
     args = ap.parse_args()
+    if args.only_interference:
+        mi = interference(args.tokens, args.chunk, args.depth, args.loop)
+        print("prefill interference (streaming inter-token gap under "
+              "admission churn):")
+        for tag in ("colocated", "disagg"):
+            print(f"  {tag:9}: p50 {mi[f'{tag}_intertoken_p50_ms']} ms, "
+                  f"p95 {mi[f'{tag}_intertoken_p95_ms']} ms, "
+                  f"p99 {mi[f'{tag}_intertoken_p99_ms']} ms "
+                  f"({mi[f'{tag}_churn_completed']} churn admissions)")
+        print(f"  p99 colocated/disagg: {mi['interference_p99_ratio']:.2f}x")
+        print(json.dumps(mi), flush=True)
+        return 0
     if args.depth < 2:
         ap.error("--depth must be >= 2 (1 is the K=1 baseline both legs run)")
     if args.loop < 2:
@@ -142,6 +278,22 @@ def main() -> int:
     print(f"  dispatch reduction at decode_loop={c}: "
           f"{m['loop_dispatch_reduction']:.1f}x")
     print(f"  token-for-token identical: {m['tokens_match']}")
+    if not args.skip_interference:
+        mi = interference(args.tokens, args.chunk, args.depth, args.loop)
+        m.update(mi)
+        print("prefill interference (streaming inter-token gap under "
+              "admission churn):")
+        for tag in ("colocated", "disagg"):
+            print(f"  {tag:9}: p50 {mi[f'{tag}_intertoken_p50_ms']} ms, "
+                  f"p95 {mi[f'{tag}_intertoken_p95_ms']} ms, "
+                  f"p99 {mi[f'{tag}_intertoken_p99_ms']} ms "
+                  f"({mi[f'{tag}_churn_completed']} churn admissions)")
+        print(f"  p99 colocated/disagg: {mi['interference_p99_ratio']:.2f}x"
+              f" (higher = disagg insulates better); KV handed off: "
+              f"{mi['disagg_kv_handoff_bytes']} bytes in "
+              f"{mi['disagg_kv_handoffs']} transfers")
+        print(f"  token-for-token identical: "
+              f"{mi['interference_tokens_match']}")
     print(json.dumps(m), flush=True)
     return 0
 

@@ -30,7 +30,7 @@ list; whatever the tunnel survives is kept:
      number that says int8 serving is quality-safe at the scale we ship.
 
 Usage: ``python scripts/onchip_session.py
-[--skip bench,ab,kvq,flash,megachunk,profile,qq]``
+[--skip bench,ab,kvq,flash,megachunk,disagg,profile,qq]``
 Each step is a subprocess with its own budget; a wedged step is recorded
 and skipped, never fatal. Results: ``ONCHIP.json`` (merged dict, one key
 prefix per step) + profile trace under ``profiles/``.
@@ -411,6 +411,37 @@ def main() -> None:
                 bank(run_step(
                     arm, [sys.executable, "-c", _SERVE_ONE, arm_url, "2",
                           arm, "600"], budget=b))
+    if "disagg" not in skip:
+        # Disaggregated vs colocated at 7B (PERF.md §5 step 7): the
+        # interference number — one streaming request's inter-token
+        # p95/p99 while admission churn runs — per arm, SEPARATE
+        # processes (disagg is structural). Needs a multi-chip host
+        # (disagg=P+D builds disjoint per-group meshes); on a single v5e
+        # chip the step records the skip rather than faking groups.
+        # Device count probed in a SUBPROCESS, like probe(): importing
+        # jax here would initialize (and exclusively hold) the TPU
+        # runtime in the orchestrator, starving every later child step.
+        try:
+            n_dev = int(subprocess.run(
+                [sys.executable, "-c",
+                 "import jax; print(len(jax.devices()))"],
+                capture_output=True, text=True, timeout=180,
+            ).stdout.strip() or 0)
+        except Exception:
+            n_dev = 0
+
+        if n_dev >= 2:
+            for arm, arm_url in (
+                    ("disagg_off", B7_URL),
+                    ("disagg_on", B7_URL + "&disagg=1+1&prefill_chunk=512")):
+                b = fits(arm, 1500)
+                if b:
+                    bank(run_step(
+                        arm, [sys.executable, "-c", _SERVE_ONE, arm_url,
+                              "2", arm, "600"], budget=b))
+        else:
+            bank({"disagg_skipped": "single-device host (disagg needs "
+                                    ">= 2 devices for disjoint groups)"})
     if "qq" not in skip:
         b = fits("qq", 3100, n_children=2)  # two ~1500s precision arms
         if b:

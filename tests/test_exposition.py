@@ -206,6 +206,32 @@ async def test_live_metrics_exposition_validates():
             in text)
     assert 'quorum_tpu_engine_decode_loop{backend="LLM1"} 2' in text
 
+    # disaggregated-serving families (ISSUE 8, docs/tpu_backends.md): the
+    # KV-handoff histogram exposes its full triplet even on a colocated
+    # engine (no handoff traffic), the byte counter carries a counter
+    # TYPE, the per-group occupancy gauges are registered, and the
+    # per-engine split (handoff totals + group sizes/occupancy) rides the
+    # engine block with the right kinds
+    fam = "quorum_tpu_kv_handoff_seconds"
+    assert f"# TYPE {fam} histogram" in text
+    assert f'{fam}_bucket{{le="+Inf"}}' in text
+    assert f"{fam}_sum" in text and f"{fam}_count" in text
+    assert "# TYPE quorum_tpu_kv_handoff_bytes_total counter" in text
+    assert "# TYPE quorum_tpu_prefill_group_active gauge" in text
+    assert "# TYPE quorum_tpu_decode_group_active gauge" in text
+    assert "# TYPE quorum_tpu_engine_disagg gauge" in text
+    assert "# TYPE quorum_tpu_engine_prefill_group_devices gauge" in text
+    assert "# TYPE quorum_tpu_engine_decode_group_devices gauge" in text
+    assert "# TYPE quorum_tpu_engine_prefill_group_active gauge" in text
+    assert "# TYPE quorum_tpu_engine_decode_group_active gauge" in text
+    assert "# TYPE quorum_tpu_engine_kv_handoffs_total counter" in text
+    assert "# TYPE quorum_tpu_engine_kv_handoff_bytes_total counter" in text
+    assert ("# TYPE quorum_tpu_engine_kv_handoff_seconds_total counter"
+            in text)
+    # colocated engine: the knob gauge reads 0 (the disagg leg's nonzero
+    # bytes are pinned by tests/test_disagg.py against a live handoff)
+    assert 'quorum_tpu_engine_disagg{backend="LLM1"} 0' in text
+
     # robustness families (docs/robustness.md): deadline sheds by stage,
     # HTTP retry attempts, and the per-engine rebuild/breaker block
     assert "# TYPE quorum_tpu_deadline_exceeded_total counter" in text
