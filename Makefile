@@ -2,7 +2,7 @@
 # clean — /root/reference/Makefile:1-25), adapted to this environment: no uv,
 # no uvicorn — the bundled h11 ASGI server serves the app.
 
-.PHONY: install run dev test test-all coverage bench hostpath-bench prefix-bench dryrun metrics-check chaos-check verify clean
+.PHONY: install run dev test test-all coverage bench hostpath-bench prefix-bench dryrun metrics-check chaos-check qlint verify clean
 
 install:
 	pip install -e .
@@ -79,8 +79,20 @@ metrics-check:
 chaos-check:
 	JAX_PLATFORMS=cpu python scripts/chaos_check.py
 
-# The local verify path: fast tier + exposition lint + chaos containment.
-verify: test metrics-check chaos-check
+# Hot-path static analysis (quorum_tpu/analysis/qlint.py, pure stdlib ast,
+# <10s — docs/static_analysis.md): device-sync taboo on the token critical
+# path, jit-boundary recompile hazards, and _GUARDED_BY lock-discipline
+# race checking over the engine's scheduler state. Fails on any finding
+# not fixed, reason-annotated (# qlint: allow-*(<reason>)), or listed in
+# analysis/qlint_baseline.json — whose entry count may only shrink
+# (`--baseline-update` refuses to grow max_count; burn-down is deliberate).
+qlint:
+	python -m quorum_tpu.analysis.qlint
+
+# The local verify path: static analysis + fast tier + exposition lint +
+# chaos containment. qlint runs FIRST — it is the cheapest gate and its
+# guarded-by/sync findings are exactly the bugs the later stages flake on.
+verify: qlint test metrics-check chaos-check
 
 # Multi-chip sharding validation on a virtual 8-device CPU mesh.
 # dryrun_multichip re-execs itself with a clean env (JAX_PLATFORMS=cpu,

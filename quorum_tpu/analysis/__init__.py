@@ -1,0 +1,16 @@
+"""Static analysis + runtime sentinels for the hot-path invariants.
+
+``qlint`` (AST pass, ``make qlint``) checks the three hazard classes every
+perf/robustness PR has hand-fought: implicit device→host syncs on the token
+critical path, recompile hazards at jit boundaries, and lock-discipline
+races on the engine's ``_GUARDED_BY`` fields. ``compile_watch`` backs the
+recompile rules at runtime (the ``quorum_tpu_recompiles_total`` counter);
+``budget`` exposes the checked-in program-key contract
+(``compile_budget.json``) the cache-key tests consume. See
+docs/static_analysis.md.
+"""
+
+# NB: quorum_tpu.analysis.qlint is deliberately NOT imported here — it is
+# the `python -m quorum_tpu.analysis.qlint` entry point, and importing it
+# from the package __init__ would trip runpy's double-import warning.
+from quorum_tpu.analysis import budget, compile_watch  # noqa: F401

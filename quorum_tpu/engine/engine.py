@@ -82,7 +82,9 @@ The reference has no analog — its "backends" are HTTP calls
 
 from __future__ import annotations
 
+import contextlib
 import logging
+import os
 import queue
 import threading
 import time
@@ -99,6 +101,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from quorum_tpu import faults
 from quorum_tpu import observability as obs
+from quorum_tpu.analysis import compile_watch
 from quorum_tpu.cache import kv_transfer
 from quorum_tpu.cache.prefix_store import (
     DEFAULT_PREFIX_STORE_BYTES,
@@ -126,6 +129,7 @@ from quorum_tpu.parallel.mesh import single_device_mesh
 from quorum_tpu.parallel.sharding import kv_cache_sharding, shard_pytree
 
 enable_persistent_compile_cache()  # restart compiles become disk reads
+compile_watch.install()  # count XLA compiles (quorum_tpu_recompiles_total)
 
 logger = logging.getLogger(__name__)
 
@@ -321,7 +325,10 @@ def _host_fetch(*arrays):
             return multihost_utils.process_allgather(x, tiled=True)
         return x
 
-    out = jax.device_get(tuple(gather(x) for x in arrays))
+    # THE designated device->host sync: one blocking fetch per dispatch
+    # reap, nothing else on the token path may transfer implicitly.
+    out = jax.device_get(  # qlint: allow-sync(the one blocking read per dispatch)
+        tuple(gather(x) for x in arrays))
     return tuple(out) if len(arrays) > 1 else out[0]
 
 
@@ -668,9 +675,12 @@ class _DraftRuntime:
                 tokens[i, k:] = seg[-1]
                 lengths[i] = pos[i]
                 wmask[i] = True
+            # Explicit uploads: draft turns run inside the engine's decode
+            # transfer guard (the verify step they feed is decode-path).
             toks, self._ck, self._cv = self._advance_fn(t_bite, history)(
-                self.params, jnp.asarray(tokens), jnp.asarray(lengths),
-                jnp.asarray(wmask), self._ck, self._cv)
+                self.params, jax.device_put(tokens),
+                jax.device_put(lengths), jax.device_put(wmask),
+                self._ck, self._cv)
             toks = np.asarray(_host_fetch(toks))
             for i, r in active:
                 if rem[i] <= 0:
@@ -691,12 +701,52 @@ class _DraftRuntime:
                 lengths[i] = len(r.hist)
                 wmask[i] = True
             toks, self._ck, self._cv = self._extend_fn(g - 1, history)(
-                self.params, jnp.asarray(token), jnp.asarray(lengths),
-                jnp.asarray(wmask), self._ck, self._cv)
+                self.params, jax.device_put(token),
+                jax.device_put(lengths), jax.device_put(wmask),
+                self._ck, self._cv)
             toks = np.asarray(_host_fetch(toks))  # [g-1, rows]
             for i, _ in active:
                 drafts[i].extend(int(t) for t in toks[:, i])
         return drafts
+
+
+# Lock-discipline contract for the engine's cross-thread state, verified by
+# static analysis (`make qlint`, quorum_tpu/analysis/qlint.py — the
+# "guarded" rule family; docs/static_analysis.md). This map is the SOURCE OF
+# TRUTH the "Scheduler state, guarded by _cond's lock" comment block in
+# __init__ points at. Three entry shapes:
+#
+#   {"lock": "_cond"}            every mutation must sit lexically inside
+#                                `with self._cond:`;
+#   {"lock": ..., "holders": []} methods documented as "caller holds the
+#                                lock" — their docstrings say so, their
+#                                call sites are all inside the lock, and
+#                                qlint trusts the list (keep it short);
+#   {"owner": [...]}             single-owner state: only these methods
+#                                (all running on ONE thread) may mutate,
+#                                no lock needed.
+#
+# Mutations of fields named here anywhere else fail `make qlint` — exactly
+# the unguarded-mutation / double-count races fixed four separate times in
+# the PR 3/4/7 reviews. Suppress a deliberate exception with
+# `# qlint: allow-unguarded(<reason>)`.
+_GUARDED_BY = {
+    # shared scheduler state: submit()/release paths vs the scheduler
+    # loop(s) — and under disagg BOTH loops plus the snapshot worker
+    "_pending": {"lock": "_cond"},
+    "_slots": {"lock": "_cond", "holders": ["_release_slot"]},
+    "_admitting": {"lock": "_cond"},
+    "_claimed": {"lock": "_cond"},
+    "_handoffs": {"lock": "_cond"},
+    "_pending_snaps": {"lock": "_cond", "holders": ["_queue_snapshot"]},
+    "_snap_backlog": {"lock": "_cond", "holders": ["_queue_snapshot"]},
+    "_pending_dfa_resets": {"lock": "_cond", "holders": ["_release_slot"]},
+    "_stop": {"lock": "_cond"},
+    # single-owner: the decode scheduler thread's dispatch ring (drained
+    # by _fail_all on that same thread's exception path)
+    "_inflight": {"owner": ["_fill_inflight", "_reap_oldest",
+                            "_drain_inflight", "_fail_all"]},
+}
 
 
 class InferenceEngine:
@@ -737,6 +787,7 @@ class InferenceEngine:
         draft_params=None,
         sp_impl: str = "ring",
         prefill_mesh: Mesh | None = None,
+        transfer_guard: str | None = None,
     ):
         self.spec = spec.validate()
         self.mesh = mesh or single_device_mesh()
@@ -812,6 +863,36 @@ class InferenceEngine:
         # per engine; QUORUM_TPU_FLASH_DECODE stays a process override —
         # ops/flash_decode.resolve_flash_decode). "" = masked-dense.
         self._flash = resolve_flash_decode(flash_decode)
+        # Runtime sync sentinel (docs/static_analysis.md): when set, the
+        # decode loop (_run_chunk — dispatch, reap, spec-verify) runs under
+        # jax.transfer_guard(mode), so an implicit host<->device transfer
+        # on the token critical path RAISES instead of silently stalling
+        # the dispatch ring. The designated explicit points (_host_fetch's
+        # device_get, the dispatch mask's device_put) stay allowed.
+        # tests/conftest.py defaults the env knob to "disallow" for the
+        # whole suite — the runtime half of qlint's static sync-taboo rule.
+        levels = ("allow", "log", "disallow",
+                  "log_explicit", "disallow_explicit")
+        if transfer_guard is not None:
+            # Explicit knob: fail fast on a typo.
+            if transfer_guard not in ("",) + levels:
+                raise ValueError(
+                    f"transfer_guard={transfer_guard!r} is not a jax "
+                    f"transfer-guard level ({', '.join(levels)} or empty "
+                    "to disable)")
+            tg = transfer_guard
+        else:
+            # Env knob: an unparseable value is a LOGGED loud off, never a
+            # construction crash (the QUORUM_TPU_FLASH_DECODE convention —
+            # an env typo must not take serving down).
+            tg = os.environ.get("QUORUM_TPU_TRANSFER_GUARD", "")
+            if tg and tg not in levels:
+                logger.error(
+                    "QUORUM_TPU_TRANSFER_GUARD=%r is not a jax transfer-"
+                    "guard level (%s); running with the guard OFF",
+                    tg, ", ".join(levels))
+                tg = ""
+        self.transfer_guard = tg or None
         self.n_slots = max(1, n_slots)
         # Admission gate for the direct device forwards (embeddings,
         # teacher-forced scoring): chat decode is slot-queue-gated, but
@@ -1000,6 +1081,10 @@ class InferenceEngine:
             if self.disagg else None)
         self._cache_sh = self._cache_sharding(self.mesh)
         self._rep = NamedSharding(self.mesh, P())
+        # Cached jit wrappers for the rebuild-path utility programs (the
+        # zero-fills): a fresh jax.jit per failure-containment rebuild
+        # would recompile them (qlint: recompile/jit-immediate-call).
+        self._util_fns: dict = {}
         self._init_device_state()
         if self.disagg:
             self._stage_sh = self._cache_sharding(self.prefill_mesh)
@@ -1016,7 +1101,12 @@ class InferenceEngine:
         self._admit_cache: dict[int, object] = {}   # bucket → compiled admit
         self._decode_cache: dict[int, object] = {}  # n_steps → compiled chunk
 
-        # Scheduler state, guarded by _cond's lock.
+        # Scheduler state, guarded by _cond's lock. The machine-checked
+        # source of truth is the module-level _GUARDED_BY map (every field
+        # listed there has its mutation sites verified by `make qlint` —
+        # lexically inside `with self._cond:`, a documented caller-holds-
+        # the-lock helper, or a single-owner thread's allowlisted methods);
+        # extend THAT map when adding shared state, not just this comment.
         self._pending: list[_Request] = []
         self._slots: list[_Request | None] = [None] * self._rows
         self._admitting: list[_Admission] = []
@@ -1221,10 +1311,14 @@ class InferenceEngine:
         self._pp = jax.device_put(np.zeros((s,), np.float32), rep)
         self._fp = jax.device_put(np.zeros((s,), np.float32), rep)
         v = self.spec.vocab_size
-        self._counts, self._bias = jax.jit(
-            lambda: (jnp.zeros((s, v), jnp.int32), jnp.zeros((s, v), jnp.float32)),
-            out_shardings=(self._rep, self._rep),
-        )()
+        zero_rows = self._util_fns.get("zero_rowstate")
+        if zero_rows is None:
+            zero_rows = self._util_fns["zero_rowstate"] = jax.jit(
+                lambda: (jnp.zeros((s, v), jnp.int32),
+                         jnp.zeros((s, v), jnp.float32)),
+                out_shardings=(self._rep, self._rep),
+            )
+        self._counts, self._bias = zero_rows()
         self._zero_bias = np.zeros((v,), np.float32)
         if self.members > 1:
             # Shared zero logit-bias template for coalesced member
@@ -1248,7 +1342,15 @@ class InferenceEngine:
                 cv = jax.tree.map(stack, cv)
             return ck, cv
 
-        return jax.jit(zero_cache, out_shardings=(shardings, shardings))()
+        # Wrapper cached per sharding set (decode cache vs disagg staging
+        # cache — both live on self, so id() is stable): rebuilds after
+        # failure containment reuse the compiled zero-fill.
+        key = ("zero_cache", id(shardings))
+        fn = self._util_fns.get(key)
+        if fn is None:
+            fn = self._util_fns[key] = jax.jit(
+                zero_cache, out_shardings=(shardings, shardings))
+        return fn()
 
     def _init_stage_state(self) -> None:
         """(Re)allocate the prefill group's staging KV cache (disagg only):
@@ -1700,10 +1802,12 @@ class InferenceEngine:
         if stage:
             self._sck, self._scv = self._restore_fn(n)(
                 self._sck, self._scv, np.int32(slot), np.int32(start), host)
+            # qlint: allow-sync(admission path; blocking here is the honest restore latency the histogram reports)
             jax.block_until_ready((self._sck, self._scv))
         else:
             self._ck, self._cv = self._restore_fn(n)(
                 self._ck, self._cv, np.int32(slot), np.int32(start), host)
+            # qlint: allow-sync(admission path; blocking here is the honest restore latency the histogram reports)
             jax.block_until_ready((self._ck, self._cv))
         t1 = time.perf_counter()
         obs.PREFIX_STORE_RESTORE.observe(t1 - t0)
@@ -2555,6 +2659,9 @@ class InferenceEngine:
         finally:
             # Consumer gone (or done): release the slot at the next boundary.
             req.cancel.set()
+            # First completed request = the process is warm; later XLA
+            # compiles land on quorum_tpu_recompiles_total (idempotent).
+            compile_watch.mark_warm()
 
     def generate(
         self,
@@ -2784,7 +2891,10 @@ class InferenceEngine:
         if self.disagg:
             self.prefill_params = None
             self._sck = self._scv = None
-            self._handoffs.clear()
+            # Both loops have exited (checked above), but the guarded-by
+            # contract is lexical: queue mutations hold the lock, period.
+            with self._cond:
+                self._handoffs.clear()
         if self._draft_rt is not None:  # draft weights + cache go with them
             self._draft_rt.params = None
             self._draft_rt._ck = self._draft_rt._cv = None
@@ -3645,7 +3755,22 @@ class InferenceEngine:
         else:
             self._fail_all(exc, doomed=reqs)
 
+    def _decode_guard(self):
+        """The decode loop's jax.transfer_guard context (transfer_guard= /
+        QUORUM_TPU_TRANSFER_GUARD) — a no-op unless the knob is set."""
+        if not self.transfer_guard:
+            return contextlib.nullcontext()
+        return jax.transfer_guard(self.transfer_guard)
+
     def _run_chunk(self) -> None:
+        # The guard covers everything the token critical path does on this
+        # thread: ring fill (dispatch), blocking reap, and speculative
+        # verify turns. Admission/prefill stays outside — uploading the
+        # prompt is a legitimate per-request transfer.
+        with self._decode_guard():
+            self._run_chunk_steps()
+
+    def _run_chunk_steps(self) -> None:
         self._sweep_cancelled()
         active = self._active_rows()
         if not active:
@@ -3926,6 +4051,11 @@ class InferenceEngine:
         state, so a chunk dispatched before its predecessor is read still
         masks from the right states)."""
         faults.fire("engine.decode")
+        # Explicit upload of the one host-built operand: the active-row
+        # mask. Every other input is already device-resident chained state,
+        # so under transfer_guard="disallow" a dispatch performs zero
+        # implicit transfers.
+        mask = jax.device_put(mask, self._rep)
         if constrained:
             out = self._decode_fn(n_steps, want_lp, history,
                                   tstates=self._g_bucket,
@@ -4065,6 +4195,9 @@ class InferenceEngine:
                 tokens[i, 1:] = draft
             else:
                 tokens[i, 1:] = -1  # never matches → accepts only s0
+        # Explicit uploads (transfer_guard discipline, like _dispatch_chunk)
+        mask = jax.device_put(mask, self._rep)
+        tokens = jax.device_put(tokens, self._rep)
         (s0, model_toks, ok, self._ck, self._cv, self._token, self._lengths,
          self._keys, self._counts,
          self._live, self._budget) = self._verify_fn(g, history)(

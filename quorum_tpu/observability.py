@@ -418,6 +418,20 @@ CONSTRAIN_COMPILE = METRICS.histogram(
     buckets=(0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
              1.0, 2.5, 5.0, 10.0))
 
+# Recompile sentinel (quorum_tpu/analysis/compile_watch.py, docs/
+# static_analysis.md): XLA compiles observed AFTER the process served its
+# first completed request. First-of-shape traffic still legitimately ticks
+# it (the first constrained request, a new history bucket, a second
+# engine); what indicates program-key drift — a shape-family leak, an
+# unhashable key component — is SUSTAINED growth under steady traffic,
+# which is what to alert on. The runtime half of the qlint recompile-budget
+# rules and the compile_budget.json contract.
+RECOMPILES = METRICS.counter(
+    "quorum_tpu_recompiles_total",
+    "XLA compilations observed after the first served request. Expected "
+    "to tick on first-of-shape traffic; sustained growth under steady "
+    "traffic indicates program-key drift (docs/static_analysis.md).")
+
 DEADLINE_EXCEEDED = METRICS.counter(
     "quorum_tpu_deadline_exceeded_total",
     "Requests that ran past their deadline, by stage: queue = shed before "

@@ -36,9 +36,25 @@ if "xla_force_host_platform_device_count" not in flags:
 # who wants the speed and accepts the flake.
 os.environ.setdefault("QUORUM_TPU_COMPILE_CACHE", "0")
 
+# Runtime sync sentinel (docs/static_analysis.md): every engine in the
+# suite runs its decode loop under jax.transfer_guard("disallow") — an
+# implicit host<->device transfer on the token critical path raises
+# instead of silently stalling the dispatch ring. The static half is
+# `make qlint`; an explicit QUORUM_TPU_TRANSFER_GUARD in the env wins.
+os.environ.setdefault("QUORUM_TPU_TRANSFER_GUARD", "disallow")
+
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+# Lowering-counter hook (quorum_tpu/analysis/compile_watch.py): registered
+# before any engine exists so compiles_total() covers the whole suite. The
+# warmed-engine zero-recompile sentinel in tests/test_qlint.py snapshots it
+# around a second identical generation — any new program family (a cache-key
+# drift compile_budget.json missed) fails loudly.
+from quorum_tpu.analysis import compile_watch  # noqa: E402
+
+compile_watch.install()
 
 import asyncio  # noqa: E402
 import inspect  # noqa: E402

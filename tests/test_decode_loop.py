@@ -1,10 +1,12 @@
 """Megachunk decode-loop gating and clamping (fast tier).
 
 The cache-key pin (same gating pattern as the PR 5 unconstrained pin): a
-``decode_loop=1`` engine must compile the EXACT pre-existing decode_chunk
-program variants — plain 3-tuple cache keys, never a "loop"-tagged one —
-so unfused users pay zero recompiles for this feature existing. The fused
-variants live under their own tagged keys on a ``decode_loop=C`` engine.
+``decode_loop=1`` engine must compile ONLY the pre-existing "plain"
+program family — never a "loop"-tagged one — so unfused users pay zero
+recompiles for this feature existing. The key shapes themselves are pinned
+once, in ``quorum_tpu/analysis/compile_budget.json``; these tests assert
+FAMILIES via quorum_tpu.analysis.budget (classification raises on any
+unknown or shape-drifted key), keeping one literal end-to-end sentinel.
 
 The effective-C clamp unit tests pin the scheduler-side safety rails:
 admission pressure → 1 (an admission must not wait C chunks), short
@@ -18,6 +20,7 @@ import time
 
 import pytest
 
+from quorum_tpu.analysis import budget
 from quorum_tpu.engine.engine import MAX_DECODE_LOOP, InferenceEngine
 from quorum_tpu.models.model_config import MODEL_PRESETS
 from quorum_tpu.ops.sampling import SamplerConfig
@@ -42,9 +45,8 @@ def test_decode_loop_1_pins_the_unfused_program_keys():
         eng.generate([5, 6, 7], max_new_tokens=12, sampler=GREEDY)
         keys = set(eng._decode_cache)
         assert keys, "the generation must have compiled decode programs"
-        assert all(isinstance(k, tuple) and len(k) == 3 for k in keys), (
-            f"decode_loop=1 must compile only pre-existing 3-tuple "
-            f"variants, got {keys}")
+        assert budget.decode_families(keys) == {"plain"}, (
+            f"decode_loop=1 must compile only the plain family, got {keys}")
     finally:
         eng.shutdown()
 
@@ -54,9 +56,13 @@ def test_decode_loop_4_uses_tagged_keys_only_for_fused_dispatches():
                           decode_loop=4)
     try:
         eng.generate([5, 6, 7], max_new_tokens=16, sampler=GREEDY)
+        fams = budget.decode_families(eng._decode_cache)
+        assert "loop" in fams, "a 4-chunk generation must fuse"
+        assert "loop_dfa" not in fams  # no grammar rows in this batch
+        # the one literal end-to-end sentinel this file keeps: the fused
+        # key carries n_chunks=4 right after its tag
         loop_keys = {k for k in eng._decode_cache if k[0] == "loop"}
-        assert loop_keys, "a 4-chunk generation must fuse"
-        assert all(k[1] == 4 and len(k) == 5 for k in loop_keys)
+        assert all(k[1] == 4 for k in loop_keys)
     finally:
         eng.shutdown()
 

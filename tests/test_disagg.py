@@ -19,6 +19,7 @@ import asyncio
 import pytest
 
 from quorum_tpu import faults
+from quorum_tpu.analysis import budget
 from quorum_tpu.engine.engine import InferenceEngine
 from quorum_tpu.models.model_config import resolve_spec
 from quorum_tpu.ops.sampling import SamplerConfig
@@ -109,16 +110,14 @@ def test_colocated_compiles_exact_preexisting_variants(smoke_engines):
     assert eng_c._prefill_thread is None
     assert eng_c.prefill_params is None
     assert not eng_c.disagg
-    keys = list(eng_c._admit_cache)
-    assert not any(isinstance(k, tuple) and k and k[0] in ("hslice", "hput")
-                   for k in keys), keys
-    # short prompt admitted single-shot (an int bucket key)
-    assert any(isinstance(k, int) for k in keys), keys
-    # decode variants stay the pre-existing unconstrained 3-tuple
-    dkeys = [k for k in eng_c._decode_cache if not (isinstance(k, tuple)
-             and k and k[0] in ("verify",))]
-    assert dkeys and all(len(k) == 3 and isinstance(k[0], int)
-                         for k in dkeys), dkeys
+    # program families against the shared budget (classifying also pins
+    # each key's exact shape — analysis/compile_budget.json)
+    assert budget.admit_families(eng_c._admit_cache) == {"single_shot"}
+    assert budget.decode_families(eng_c._decode_cache) == {"plain"}
+    # one end-to-end literal sentinel: the plain decode key is still the
+    # pre-existing (n_steps, want_lp, history) 3-tuple
+    assert any(isinstance(k, tuple) and len(k) == 3
+               and isinstance(k[0], int) for k in eng_c._decode_cache)
     assert eng_c.n_kv_handoffs == 0 and eng_c.kv_handoff_bytes == 0
 
 
@@ -140,8 +139,11 @@ def test_disagg_smoke_pinned_with_live_handoff(smoke_engines):
     assert m["disagg"] == 1 and m["kv_handoff_bytes_total"] > 0
     assert m["prefill_group_devices"] == 1
     assert m["decode_group_devices"] == 1
-    # never a single-shot admit program on the disagg engine
-    assert not any(isinstance(k, int) for k in eng_d._admit_cache)
+    # never a single-shot admit program on the disagg engine; every
+    # admission rides seg+handoff+register (compile_budget.json gates)
+    fams = budget.admit_families(eng_d._admit_cache)
+    assert "single_shot" not in fams
+    assert {"seg", "register", "hslice", "hput"} <= fams, fams
     # group-aware health: both loops alive
     h = eng_d.health()
     assert h["scheduler_alive"] and h["prefill_scheduler_alive"]

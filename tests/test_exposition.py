@@ -191,6 +191,13 @@ async def test_live_metrics_exposition_validates():
     assert ("# TYPE quorum_tpu_engine_constrain_masked_tokens_total "
             "counter" in text)
 
+    # recompile sentinel (ISSUE 9, docs/static_analysis.md): the counter
+    # fed by the analysis/compile_watch.py log-compiles hook exposes a
+    # sample even at zero — post-warmup compiles are a serving bug an
+    # operator must be able to alert on
+    assert "# TYPE quorum_tpu_recompiles_total counter" in text
+    assert "quorum_tpu_recompiles_total " in text
+
     # megachunk-decode families (ISSUE 6): chunk segments per dispatch as
     # a histogram (samples after any decode traffic — unfused dispatches
     # observe 1), the configured decode_loop as an engine gauge, and the
