@@ -64,7 +64,11 @@ Prints ONE JSON line:
    / "interference_p99_ratio" / "disagg_kv_handoff_bytes": the prefill-
    interference A/B (disagg=P+D, docs/tpu_backends.md) — streaming
    inter-token gap under concurrent admission churn, colocated vs
-   disaggregated device groups (QUORUM_TPU_BENCH_DISAGG=0 skips)}
+   disaggregated device groups (QUORUM_TPU_BENCH_DISAGG=0 skips),
+   "spec_{rep,crep}_*": the speculative-decoding A/B (ISSUE 10) — tok/s,
+   acceptance rate, dispatches/request and ring-overlap counters with
+   spec_decode on vs off, on a repetitive and a CONSTRAINED repetitive
+   leg, tokens asserted identical (QUORUM_TPU_BENCH_SPEC=0 skips)}
 
 The ``*_prefix_*`` keys measure automatic prefix caching where it matters —
 7B prefill dominates TTFT there: a long shared system preamble is sent
@@ -741,6 +745,32 @@ def run_interference_phase(budget: int = 900) -> dict:
     return {k: got[k] for k in keep if k in got}
 
 
+def run_spec_phase(budget: int = 900) -> dict:
+    """Speculative-decoding A/B (ISSUE 10, docs/tpu_backends.md):
+    acceptance rate / tok-s / dispatches-per-request with spec on vs off
+    on a repetitive leg and a constrained repetitive leg, tokens asserted
+    identical — scripts/hostpath_bench.py's measurement, run in a
+    SUBPROCESS (fresh engines, no program-cache bleed from the serving
+    phases). Gate with ``QUORUM_TPU_BENCH_SPEC=0``."""
+    if os.environ.get("QUORUM_TPU_BENCH_SPEC", "1") == "0":
+        return {}
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    script = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "scripts", "hostpath_bench.py")
+    got = _run_json_subprocess(
+        [sys.executable, script, "--tokens", "48", "--only-spec"],
+        "spec", budget, env)
+    keep = tuple(
+        f"spec_{leg}_{k}" for leg in ("rep", "crep")
+        for k in ("off_tok_s", "on_tok_s", "speedup", "tokens_match",
+                  "on_acceptance", "on_spec_turns", "on_spec_overlapped",
+                  "off_dispatches_per_request",
+                  "on_dispatches_per_request")) + ("spec_error",)
+    return {k: got[k] for k in keep if k in got}
+
+
 def _last_json_line(stdout: "str | None") -> "dict | None":
     """Latest parseable JSON object line. Malformed brace-prefixed lines are
     skipped, not fatal: a timed-out child's captured stdout can end mid-line,
@@ -1150,6 +1180,9 @@ async def main() -> None:
         # Prefill-interference A/B (disagg=P+D): streaming inter-token gap
         # percentiles under admission churn, colocated vs disaggregated.
         b7.update(run_interference_phase())
+        # Speculative-decoding A/B (ISSUE 10): acceptance / tok-s /
+        # dispatch counts spec on vs off, repetitive + constrained legs.
+        b7.update(run_spec_phase())
         await phase12_main(b7)
         return
 

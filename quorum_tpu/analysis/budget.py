@@ -48,10 +48,9 @@ def classify_decode_key(key) -> str:
         if key[0] == "loop":
             fam = "loop_dfa" if len(key) > 2 and key[2] == "dfa" else "loop"
             return _check_len("decode_cache", fam, key)
-        if key[0] == "dfa":
-            return _check_len("decode_cache", "dfa", key)
-        if key[0] == "verify":
-            return _check_len("decode_cache", "verify", key)
+        if key[0] in ("dfa", "verify", "dfa_verify", "spec_loop",
+                      "spec_loop_dfa"):
+            return _check_len("decode_cache", key[0], key)
         if all(isinstance(x, (int, bool)) for x in key):
             return _check_len("decode_cache", "plain", key)
     raise UnbudgetedProgramKey(

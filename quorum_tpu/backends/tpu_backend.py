@@ -79,21 +79,32 @@ URL grammar:  ``tpu://<model-id>?<spec overrides>&<engine options>``
                    chunks, so a long admission can't stall active streams
   queue=           admission queue bound (default 128); a full queue rejects
                    with 503 instead of growing without limit
-  spec_decode=G    speculative decoding (default 0 = off): when every active
-                   request is free of penalties/bias/logprobs (greedy OR
-                   sampled — verification samples each position with the
-                   row's own RNG chain, so tokens match the plain path bit
-                   for bit), each dispatch verifies G draft tokens in one
+  spec_decode=G    speculative decoding (default 0 = off): speculative
+                   dispatches verify up to G draft tokens PER ROW in one
                    multi-token forward — accepted runs advance G+1 tokens
-                   for one dispatch's weight reads (decode is HBM-bound)
+                   for one dispatch's weight reads (decode is HBM-bound).
+                   Composes with everything (ISSUE 10): row-wise gating
+                   (a penalties/logprobs row rides the same dispatch at
+                   draft length 0; bias and response_format rows draft at
+                   full length — constrained rows through the dfa-verify
+                   variant's per-position draft-prefix masking), and
+                   verify turns are ring-resident (they enter the
+                   decode_pipeline ring instead of draining it). Greedy
+                   OR sampled — verification samples each position with
+                   the row's own RNG chain, so tokens match the plain
+                   path bit for bit
   spec_model=<id>  draft-MODEL speculation: the named preset (random init,
                    seeded by spec_seed=, target's vocab/window) proposes
                    the G-token drafts instead of prompt lookup; its own
-                   slot KV cache tracks each request. Speed-only knob —
-                   acceptance still requires equality with the token the
-                   target itself emits (sampled with the request's RNG
-                   chain; greedy = argmax). Implies spec_decode=4 when unset;
-                   random-init engines only (rejected with ckpt=)
+                   slot KV cache tracks each request, and draft+verify
+                   run FUSED in one on-device scan (up to decode_loop=C
+                   turns per dispatch — the spec_loop program family), so
+                   consecutive dispatches pipeline with no host input.
+                   Speed-only knob — acceptance still requires equality
+                   with the token the target itself emits (sampled with
+                   the request's RNG chain; greedy = argmax). Implies
+                   spec_decode=4 when unset; random-init engines only
+                   (rejected with ckpt=)
   spec_ckpt=<dir>  draft-MODEL speculation from a REAL small checkpoint
                    (same tokenizer/vocab as the target; window raised to
                    the target's). Works for both ckpt= and random-init

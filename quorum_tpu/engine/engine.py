@@ -58,6 +58,18 @@ concurrent requests):
     with zero extra host round-trips at any ``decode_pipeline`` depth.
     Unconstrained batches compile and run the exact unconstrained program
     variant (the logprobs-gating pattern).
+  - **Composing speculative decoding** (``spec_decode=G``): speculative
+    dispatches verify up to G draft tokens PER ROW in one multi-token
+    forward, with row-wise gating (penalties/logprobs rows ride at draft
+    length 0; bias and constrained rows draft at full length — the
+    dfa-verify variant masks each position with its draft-prefix DFA
+    state), ring-resident verify turns (they enter the decode_pipeline
+    ring with on-device EOS/budget finish instead of draining it;
+    pipelined prompt-lookup drafts come from an optimistic source-
+    continuation cursor), and — with ``spec_model=`` — a fused on-device
+    draft→verify scan (``spec_loop``) that needs no host input between
+    dispatches. A draft is accepted only when it equals the token the
+    model itself samples, so speculation changes speed, never content.
   - **Quantized representations**: ``quant=int8`` stores weights int8 with
     per-channel scales (native int8 MXU matmuls); ``kv_quant=int8`` stores
     the KV cache as (int8, per-token scale) pairs with native int8 decode
@@ -392,7 +404,7 @@ class _Request:
         "eos_id", "cancel", "chunk_hint", "out", "emitted",
         "pp", "fp", "bias_row", "want_lp", "lp", "hist", "ngram", "member",
         "trace", "t_submit", "tspans", "deadline", "expired", "grammar",
-        "g_start",
+        "g_start", "dfa_host", "n_inflight", "spec_state",
     )
 
     def __init__(self, prompt_ids, budget, sampler: SamplerConfig, seed, eos_id,
@@ -427,6 +439,24 @@ class _Request:
         # engine's device arena — assigned at admission by _ensure_grammar.
         self.grammar = grammar
         self.g_start = 0
+        # Host shadow of the row's LOCAL DFA state, advanced in _emit over
+        # every delivered token. Only a draft-quality input (the grammar-
+        # aware draft filter truncates a prompt-lookup draft at its first
+        # dead token) — correctness rides the on-device mask, which never
+        # trusts the host's view.
+        self.dfa_host = grammar.start if grammar is not None else 0
+        # Dispatches currently in flight that cover this request (decode
+        # chunks AND speculative turns) — a fresh prompt-lookup draft may
+        # only be formed when this is 0, because the host's `hist` lags the
+        # device by every in-flight dispatch's emissions.
+        self.n_inflight = 0
+        # Pipelined-draft cursor (ring-resident speculation): while verify
+        # turns are in flight, the next draft is formed from the SOURCE
+        # continuation the last fresh draft came from, optimistically
+        # assuming full acceptance — (src index, last-two optimistic
+        # tokens, optimistic local DFA state). None = no continuation; any
+        # rejection at reap resets it.
+        self.spec_state: "tuple | None" = None
         self.lp: list = []
         # Request-scoped tracing: the server's trace (when this submission
         # happens inside a traced request context) rides along so the
@@ -445,25 +475,28 @@ class _Request:
         }
 
     @property
-    def spec_clean(self) -> bool:
-        """Eligible for speculative verification: no sampling state that
-        depends on the accepted prefix (penalties/bias), no logprobs.
-        SAMPLED requests qualify too — verification samples every position
-        with the row's own RNG chain (one key split per emitted token,
-        exactly the decode path's discipline), so the emitted tokens equal
-        the non-speculative path's bit for bit; a draft token is accepted
-        iff it equals the token the model itself SAMPLES there.
+    def spec_draft_ok(self) -> bool:
+        """May carry a nonzero draft length in a speculative dispatch.
+        SAMPLED requests qualify — verification samples every position with
+        the row's own RNG chain (one key split per emitted token, exactly
+        the decode path's discipline), so the emitted tokens equal the
+        non-speculative path's bit for bit; a draft token is accepted iff
+        it equals the token the model itself SAMPLES there. logit_bias
+        qualifies too (a static per-row additive term the verify program
+        applies at every position), and CONSTRAINED requests qualify: the
+        draft tokens are known before dispatch, so the dfa-verify variant
+        advances the token-DFA over the draft prefix up front and masks
+        each position with its draft-prefix state — the accepted-prefix
+        state wherever a position can actually be emitted — without
+        serializing the g+1 samples.
 
-        Constrained requests are excluded: the verify program samples all
-        g+1 positions in PARALLEL, while the DFA mask at position i depends
-        on the model's own token at i−1 — serializing the samples would
-        cost g+1 dependent top-p sorts per turn. They fall back to the
-        plain chunked path instead (exactly as penalties do), which the
-        spec-compose test pins token-for-token against the non-speculative
-        constrained stream (docs/structured_output.md fallback matrix)."""
-        return (self.pp == 0.0 and self.fp == 0.0
-                and self.bias_row is None and self.want_lp < 0
-                and self.grammar is None)
+        Rows that return False still RIDE speculative dispatches (draft
+        length 0: a sentinel draft that never matches, so they emit exactly
+        the model's own next token): presence/frequency penalties depend on
+        the running generated-token counts position by position, and
+        logprobs requests emit one lp record per token — both exact at one
+        token per dispatch, wrong beyond it."""
+        return self.pp == 0.0 and self.fp == 0.0 and self.want_lp < 0
 
 
 class _InflightChunk:
@@ -476,10 +509,12 @@ class _InflightChunk:
     at dispatch (0 = the blocking chunk), recorded on the decode span."""
 
     __slots__ = ("payload", "active", "n_steps", "t0", "history", "depth",
-                 "constrained", "n_chunks")
+                 "constrained", "n_chunks", "spec_turn", "drafted",
+                 "stacked")
 
     def __init__(self, payload, active, n_steps, t0, history, depth,
-                 constrained=False, n_chunks=1):
+                 constrained=False, n_chunks=1, spec_turn=False, drafted=0,
+                 stacked=None):
         self.payload = payload
         self.active = active
         self.n_steps = n_steps
@@ -495,6 +530,16 @@ class _InflightChunk:
         # fused variant whose token/valid/aux arrays carry a leading
         # per-chunk axis the reap drains segment by segment.
         self.n_chunks = n_chunks
+        # Speculative dispatch (a verify turn, or n_chunks fused draft→
+        # verify turns): the reap counts spec turns/draft/accepted tokens
+        # and records spec-verify spans instead of decode spans.
+        # ``drafted`` = real (non-sentinel) draft tokens proposed per turn.
+        self.spec_turn = spec_turn
+        self.drafted = drafted
+        # Whether the payload ALREADY carries the leading per-segment axis
+        # (the fused draft→verify scan emits it even at one turn; plain
+        # chunk/verify payloads gain it in the reap's normalization).
+        self.stacked = n_chunks > 1 if stacked is None else stacked
 
     @property
     def tokens_ahead(self) -> int:
@@ -560,14 +605,17 @@ class _DraftRuntime:
     scheduler thread (no locking).
 
     State: the draft model's own slot KV cache plus, per target slot, how
-    many of the request's tokens have been fed (``synced``). Each turn the
-    unsynced history advances through ``decode_multi`` in ≤``BITE``-token
-    bites (rows that finish early are padded by repeating their last token;
-    the pad writes land beyond their true length and are overwritten later
-    — the same property the target's verify path relies on), then g−1
-    greedy ``decode_step`` calls extend the draft. Drafted positions sit
-    beyond ``synced``, so the next turn's advance overwrites them — no
-    rollback is ever needed.
+    many of the request's tokens have been fed (``synced``). The serving
+    path is the FUSED draft→verify scan (``engine._spec_loop_fn``): the
+    draft cache rides the fused program's donated carry, the per-turn
+    ingest/extend happens on device, and the only host work left here is
+    :meth:`resync` — bringing a reassigned slot's draft cache up to the
+    request's history before its first fused dispatch. :meth:`draft_all`
+    (the original host-paced reference: advance in ≤``BITE``-token bites,
+    then g−1 greedy ``decode_step`` extensions) is kept as the
+    correctness oracle the draft-runtime unit tests exercise directly.
+    Drafted/pad positions sit beyond ``synced`` and are overwritten by the
+    next ingest — no rollback is ever needed.
     """
 
     BITE = 16  # max tokens per advance program (T buckets: powers of two ≤ 16)
@@ -590,13 +638,28 @@ class _DraftRuntime:
                 f"{target_spec.max_seq}: the draft cache must hold every "
                 "position the target can reach")
         self.spec = spec.validate()
-        self.params = params if params is not None else init_params(spec, seed)
+        # Explicit device placement for provided (checkpoint) weights: the
+        # draft programs dispatch inside the engine's decode transfer
+        # guard, where a lazy numpy→device transfer on first use would be
+        # a guard violation (and a per-call risk).
+        self.params = (jax.device_put(params) if params is not None
+                       else init_params(spec, seed))
         self.rows = rows
         self._ck, self._cv = init_cache(spec, rows)
         self.synced = [0] * rows
         self.reqs: list = [None] * rows
         self._advance_cache: dict = {}
         self._step_cache: dict = {}
+        # Fused-loop carry (engine._spec_loop_fn): the last verify turn's
+        # emitted chain per row ([rows, g+1] tokens + counts). The next
+        # turn re-ingests it through a decode_multi of the SAME width as
+        # the verify forward, so accepted positions' draft-cache K/V
+        # reassociates like the target's — for an oracle draft the chains
+        # then agree everywhere but true near-ties. Allocated at first
+        # fused dispatch (width is g+1).
+        self._chain = None
+        self._chain_n = None
+        self._chain_w = 0  # host mirror of the chain width (g + 1)
 
     def _advance_fn(self, t: int, history: int):
         fn = self._advance_cache.get((t, history))
@@ -709,6 +772,74 @@ class _DraftRuntime:
                 drafts[i].extend(int(t) for t in toks[:, i])
         return drafts
 
+    def ensure_chain(self, g: int, rep) -> None:
+        """Allocate (or re-shape) the fused-loop chain carry. A width
+        change (a shared engine's spec_decode was raised) resets every
+        row's assignment so resync rebuilds a coherent chain — draft
+        quality only, never correctness."""
+        if self._chain_w == g + 1:
+            return
+        self._chain = jax.device_put(
+            np.zeros((self.rows, g + 1), np.int32), rep)
+        self._chain_n = jax.device_put(np.ones((self.rows,), np.int32), rep)
+        self._chain_w = g + 1
+        self.reqs = [None] * self.rows
+
+    def _chain_set_fn(self):
+        fn = self._advance_cache.get("chain_set")
+        if fn is None:
+            fn = jax.jit(
+                lambda chain, n, row, tok: (chain.at[row, 0].set(tok),
+                                            n.at[row].set(1)),
+                donate_argnums=(0, 1))
+            self._advance_cache["chain_set"] = fn
+        return fn
+
+    def resync(self, i: int, r, g: int) -> None:
+        """Bring draft row ``i`` to the fused-loop invariant for a newly
+        (re)assigned request: the draft cache holds K/V for ``hist[:-1]``
+        and the chain carry holds the one token the target will anchor on
+        (``hist[-1]`` — the fused ingest then (re)writes it at position
+        ``lengths`` = ``len(hist) - 1``), so draft and target stay
+        position-aligned with no further host work. Runs on the scheduler
+        thread; its dispatches chain behind any in-flight fused program
+        still writing this row (the later write wins, and pad writes land
+        beyond the true length — the standard overwrite discipline)."""
+        self.reqs[i] = r
+        self.synced[i] = len(r.hist) - 1
+        self._chain, self._chain_n = self._chain_set_fn()(
+            self._chain, self._chain_n,
+            jax.device_put(np.int32(i)), jax.device_put(np.int32(r.hist[-1])))
+        n = len(r.hist) - 1
+        if n <= 0:
+            return
+        history = prefill_bucket(
+            min(len(r.hist) + g + 1, self.spec.max_seq), self.spec.max_seq)
+        pos = 0
+        while pos < n:
+            t_bite = min(self.BITE, n - pos)
+            # Same near-cap clamp as draft_all: the pad-write span must not
+            # run past max_seq (dynamic_update_slice would clamp the start
+            # backwards and corrupt already-synced positions).
+            t_bite = min(t_bite, self.spec.max_seq - pos)
+            t_bite = 1 << (t_bite - 1).bit_length()
+            if t_bite > self.spec.max_seq - pos:
+                t_bite >>= 1
+            k = min(n - pos, t_bite)
+            seg = r.hist[pos: pos + k]
+            tokens = np.zeros((self.rows, t_bite), np.int32)
+            tokens[i, :k] = seg
+            tokens[i, k:] = seg[-1]
+            lengths = np.zeros((self.rows,), np.int32)
+            lengths[i] = pos
+            wmask = np.zeros((self.rows,), bool)
+            wmask[i] = True
+            _, self._ck, self._cv = self._advance_fn(t_bite, history)(
+                self.params, jax.device_put(tokens),
+                jax.device_put(lengths), jax.device_put(wmask),
+                self._ck, self._cv)
+            pos += k
+
 
 # Lock-discipline contract for the engine's cross-thread state, verified by
 # static analysis (`make qlint`, quorum_tpu/analysis/qlint.py — the
@@ -743,9 +874,11 @@ _GUARDED_BY = {
     "_pending_dfa_resets": {"lock": "_cond", "holders": ["_release_slot"]},
     "_stop": {"lock": "_cond"},
     # single-owner: the decode scheduler thread's dispatch ring (drained
-    # by _fail_all on that same thread's exception path)
-    "_inflight": {"owner": ["_fill_inflight", "_reap_oldest",
-                            "_drain_inflight", "_fail_all"]},
+    # by _fail_all on that same thread's exception path; speculative
+    # dispatches append through _try_spec_dispatch on the same thread)
+    "_inflight": {"owner": ["_fill_inflight", "_try_spec_dispatch",
+                            "_reap_oldest", "_drain_inflight",
+                            "_fail_all"]},
 }
 
 
@@ -906,9 +1039,11 @@ class InferenceEngine:
         # whole fan-out's admissions in ONE queue, so M members must carry
         # the aggregate capacity M separate engines would have had.
         self.max_pending = max(1, max_pending) * max(1, int(members))
-        # Speculative decoding draft length (0 = off): when every active
-        # request is spec_clean, each dispatch verifies spec_decode
-        # prompt-lookup draft tokens in one multi-token forward.
+        # Speculative decoding draft length (0 = off): verify dispatches
+        # score up to spec_decode draft tokens per row in one multi-token
+        # forward — ROW-WISE gated (penalties/logprobs rows ride along at
+        # one token per dispatch) and ring-resident (verify turns enter the
+        # decode_pipeline ring instead of draining it).
         self.spec_decode = max(0, min(spec_decode, 16))
         # Chunked prefill needs segment offsets that never cross max_seq
         # (dynamic_update_slice clamps out-of-range starts, which would
@@ -1135,9 +1270,17 @@ class InferenceEngine:
         # first; each entry is (payload arrays, active rows at dispatch,
         # n_steps, dispatch stamp, history bucket, depth at dispatch).
         self._inflight: deque = deque()
-        self.n_spec_turns = 0      # speculative verify dispatches
+        self.n_spec_turns = 0      # speculative verify turns executed
         self.n_spec_accepted = 0   # draft tokens accepted across them
-        self.n_decode_chunks = 0   # plain batched decode dispatch turns
+        self.n_spec_drafted = 0    # real draft tokens proposed across them
+        # Speculative dispatches issued at ring depth > 0 — the ring-
+        # resident-verify acceptance counter: verify turns that would have
+        # DRAINED the pipeline before this PR now overlap it.
+        self.n_spec_overlapped = 0
+        # Decode-path dispatches (batched chunks AND speculative turns —
+        # ring-resident verify made both first-class ring entries, so this
+        # is dispatches/request's denominator across spec on/off arms).
+        self.n_decode_chunks = 0
         # Megachunk accounting: device-side chunk segments that produced at
         # least one delivered/overrun token, summed over megachunk (and
         # plain — they count 1) dispatches. decode_chunks_total keeps
@@ -1175,14 +1318,17 @@ class InferenceEngine:
         self._pending_dfa_resets: list[int] = []
         self.n_constrained = 0
         self.n_constrain_masked = 0
-        # Occupancy accounting: active rows summed over every scheduler turn
-        # (decode chunks AND verify turns) — average batch occupancy is
-        # decode_busy_rows_total / (decode_chunks_total + spec_turns_total).
+        # Occupancy accounting: active rows summed over every decode-path
+        # DISPATCH (chunks and speculative turns alike — decode_chunks_total
+        # counts both since ring-resident verify) — average batch occupancy
+        # is decode_busy_rows_total / decode_chunks_total.
         self.n_decode_rows = 0
         # Draft-MODEL speculative decoding (spec_model=…): a second, small
-        # model proposes each verify turn's draft instead of prompt lookup.
-        # Subject to spec_clean gating like all speculation; excluded
-        # for stacked/ensemble engines — the draft runtime is not
+        # model proposes each verify turn's draft instead of prompt lookup
+        # — fused with the verify into one on-device draft→verify scan
+        # (_spec_loop_fn), so consecutive dispatches pipeline with no host
+        # input. Subject to the same row-wise spec_draft_ok gating;
+        # excluded for stacked/ensemble engines — the draft runtime is not
         # member-vmapped.
         if draft_spec is not None:
             if self.members > 1 or self.ensemble > 1:
@@ -2443,14 +2589,43 @@ class InferenceEngine:
         self._decode_cache[key] = fn
         return fn
 
-    def _verify_fn(self, g: int, history: int):
-        """Jitted speculative-verification step: every position 0..g is
-        SAMPLED with the row's own RNG chain exactly as the normal decode
-        path would sample it (one key split per position; greedy rows
-        reduce to argmax), and the longest draft prefix matching that
-        sampled chain is accepted — 1 + n_accept tokens emitted for ONE
-        dispatch's worth of weight reads (decode is bandwidth-bound, so the
-        g extra positions are nearly free).
+    def _verify_core(self, g: int, history: int, want_lp: bool,
+                     constrained: bool):
+        """The speculative-verification turn body shared by the standalone
+        verify programs (:meth:`_verify_fn`) and the fused draft→verify
+        scan (:meth:`_spec_loop_fn`): every position 0..g is SAMPLED with
+        the row's own RNG chain exactly as the normal decode path would
+        sample it (one key split per position; greedy rows reduce to
+        argmax), and the longest draft prefix matching that sampled chain
+        is accepted — 1 + n_accept tokens for ONE dispatch's worth of
+        weight reads (decode is bandwidth-bound, so the g extra positions
+        are nearly free).
+
+        Ring-ready (the dispatch never drains the pipeline), so finish
+        accounting is ON DEVICE like a decode chunk's: the emitted count
+        truncates at the chain's first EOS and at the remaining budget,
+        liveness follows ``(active) & live & (budget > 0)``, and the
+        payload is shaped exactly like a chunk payload with n_steps = g+1
+        (tokens [S, g+1] + per-row n_valid, plus the want_lp logprob
+        triple and the constrained masked-entry vector) — one reap path
+        serves both.
+
+        Row-wise draft lengths ride in the DRAFT CONTENT: a row whose
+        draft is the −1 sentinel can never match the sampled chain, so it
+        emits exactly the model's own next token — penalties/logprobs rows
+        co-batch with accepting rows at no gate. The sampler adjustment
+        applies the bias/penalty terms with the TURN-START counts at every
+        position: exact, because rows that may emit more than one token
+        have zero penalty terms and a static bias, and penalty rows emit
+        only position 0 (whose counts are the turn-start counts).
+
+        ``constrained`` threads the grammar arena: the per-position DFA
+        states are advanced over the DRAFT up front (position j's state is
+        the draft-prefix state — the accepted-prefix state wherever j can
+        actually be emitted, including the bonus token at the rejection
+        point), each position's logits are masked by its state's
+        allow-set, and the carried per-row state advances over the
+        actually-emitted chain.
 
         Acceptance is sound regardless of where drafts come from: draft i
         is accepted only if it EQUALS the token the model itself samples at
@@ -2459,19 +2634,26 @@ class InferenceEngine:
         forward may reassociate float ops differently from the single-token
         program; a near-tie flip under a sampling threshold is the same
         caveat as any program-shape change.)"""
-        fn = self._decode_cache.get(("verify", g, history))
-        if fn is not None:
-            return fn
         spec = self.spec
-        n_slots = self._rows  # flat rows (member-major on stacked engines)
+        n_rows = self._rows  # flat rows (member-major on stacked engines)
         n_s = self.n_slots
         ens = self.ensemble
         mem = self.members
+        vocab = spec.vocab_size
+        n_top = min(TOP_LOGPROBS, vocab)
 
-        def verify(params, active, tokens, ck, cv, token_s, lengths_s, keys_s,
-                   temp_s, topp_s, topk_s, counts_s, live_s, budget_s):
-            live = active > 0
+        def core(params, active, eos_s, draft, ck, cv, token_s, lengths_s,
+                 keys_s, temp_s, topp_s, topk_s, pp_s, fp_s, counts_s,
+                 bias_s, live_s, budget_s,
+                 trans_t=None, accept_t=None, dfa_s=None):
+            live = (active > 0) & live_s & (budget_s > 0)
             pos = jnp.where(live, lengths_s, 0)
+            # feed row: the device-carried anchor token + the g draft
+            # tokens (−1 sentinels clamp to 0 in the embedding gather and
+            # can never be accepted — a sampled token is always >= 0).
+            tokens = jnp.concatenate(
+                [jnp.where(live, token_s, 0)[:, None],
+                 jnp.maximum(draft, 0)], axis=1)                 # [S, g+1]
             if mem > 1:
                 # Stacked members: verify all members' drafts in one
                 # member-vmapped multi-token forward (same fold/unfold as
@@ -2480,16 +2662,47 @@ class InferenceEngine:
                     mem, n_s,
                     lambda p, k, v, t, ps, wm: decode_multi(
                         p, spec, t, ps, k, v, write_mask=wm,
-                        history=history),
+                        history=history, clamp_writes=True),
                     params, ck, cv, tokens, pos, live)
             else:
                 logits, ck, cv = _member_call(
                     ens,
                     lambda p, k, v: decode_multi(
                         p, spec, tokens, pos, k, v, write_mask=live,
-                        history=history),
+                        history=history, clamp_writes=True),
                     params, ck, cv,
                 )  # [S, g+1, V]
+            lg_pos = jnp.moveaxis(logits, 1, 0).astype(jnp.float32)
+            if constrained:
+                # Advance the DFA over the draft up front: states[j] masks
+                # position j. A dead/sentinel draft token parks the chain
+                # in FREE — those positions can never be emitted (the
+                # chain already broke at the dead token).
+                def dfa_step(st, dtok):
+                    nxt = jnp.take_along_axis(
+                        trans_t[st], jnp.maximum(dtok, 0)[:, None],
+                        axis=1)[:, 0]
+                    return jnp.where((dtok >= 0) & (nxt >= 0), nxt, 0), st
+
+                st_end, st_pre = lax.scan(dfa_step, dfa_s, draft.T)
+                states = jnp.concatenate(
+                    [st_pre, st_end[None]], axis=0)              # [g+1, S]
+                eos_col = jnp.arange(vocab)[None, :] == eos_s[:, None]
+
+                def position_adj(lg, st):
+                    adj = (lg + bias_s - fp_s[:, None] * counts_s
+                           - pp_s[:, None] * (counts_s > 0))
+                    rowt = trans_t[st]                           # [S, V]
+                    allow = rowt >= 0
+                    allow = jnp.where(
+                        eos_col,
+                        (accept_t[st] & (eos_s >= 0))[:, None], allow)
+                    return apply_token_mask(adj, allow), allow
+
+                adj_pos, allow_pos = jax.vmap(position_adj)(lg_pos, states)
+            else:
+                adj_pos = (lg_pos + bias_s - fp_s[:, None] * counts_s
+                           - pp_s[:, None] * (counts_s > 0))
             # The model's own token chain over positions 0..g, SAMPLED with
             # each row's key stream — one split per position, exactly the
             # decode path's per-token discipline, so emitted tokens (and the
@@ -2506,59 +2719,305 @@ class InferenceEngine:
             _, (key_chain, samp_keys) = lax.scan(
                 key_step, keys_s, None, length=g + 1)
             sampled = jax.vmap(
-                lambda lg, kk: sample_token_rows(
-                    lg.astype(jnp.float32), kk, temp_s, topp_s, topk_s)
-            )(jnp.moveaxis(logits, 1, 0), samp_keys)            # [g+1, S]
+                lambda adj, kk: sample_token_rows(
+                    adj, kk, temp_s, topp_s, topk_s)
+            )(adj_pos, samp_keys)                               # [g+1, S]
             sampled = jnp.swapaxes(sampled, 0, 1)               # [S, g+1]
-            s0 = jnp.where(live, sampled[:, 0], tokens[:, 0])
-            model_rest = sampled[:, 1:]                          # [S, g]
-            # chain: draft i (tokens[:, i]) must equal the model's token at
-            # that position (s0 for i=1, model_rest[i-2] for i>=2)
-            prev = jnp.concatenate([s0[:, None], model_rest[:, :-1]], axis=1)
+            # chain acceptance: draft j must equal the model's token at
+            # position j; EMISSION additionally truncates at the chain's
+            # first EOS and at the remaining budget (on-device finish — the
+            # ring may hold younger dispatches that must see true state).
             ok = jnp.cumprod(
-                (tokens[:, 1:] == prev).astype(jnp.int32), axis=1)  # [S,g]
-            ok = ok * live[:, None].astype(jnp.int32)
-            n_extra = jnp.sum(ok, axis=1)                            # [S]
-            emitted = 1 + n_extra
-            last = jnp.where(
-                n_extra > 0,
-                jnp.take_along_axis(
-                    model_rest, jnp.maximum(n_extra - 1, 0)[:, None],
-                    axis=1)[:, 0],
-                s0,
-            )
-            rows = jnp.arange(n_slots)
-            counts_s = counts_s.at[rows, s0].add(live.astype(jnp.int32))
-            for i in range(g):
-                counts_s = counts_s.at[rows, model_rest[:, i]].add(ok[:, i])
-            # Key after `emitted` splits per row (dead rows keep theirs).
+                (draft == sampled[:, :-1]).astype(jnp.int32), axis=1)
+            not_eos = ((sampled[:, :-1] != eos_s[:, None])
+                       | (eos_s < 0)[:, None])
+            steps = jnp.arange(1, g + 1)[None, :]
+            cont = ok.astype(bool) & not_eos & (budget_s[:, None] > steps)
+            emit = jnp.concatenate(
+                [jnp.ones((n_rows, 1), jnp.int32),
+                 jnp.cumprod(cont.astype(jnp.int32), axis=1)], axis=1)
+            emit = emit * live[:, None].astype(jnp.int32)       # [S, g+1]
+            e = jnp.sum(emit, axis=1)                           # [S]
+            rows = jnp.arange(n_rows)
+            e1 = jnp.maximum(e, 1)
+            last = sampled[rows, e1 - 1]
+            counts_new = counts_s
+            for j in range(g + 1):
+                counts_new = counts_new.at[rows, sampled[:, j]].add(
+                    emit[:, j])
+            # Key after `e` splits per row (dead rows keep theirs).
             key_sel = jnp.take_along_axis(
                 jnp.moveaxis(key_chain, 0, 1),                   # [S,g+1,2]
-                (emitted - 1)[:, None, None], axis=1)[:, 0]
-            new_keys = jnp.where(live[:, None], key_sel, keys_s)
-            # Keep the on-device budget honest through verify turns: a later
-            # pipelined decode chunk reads budget_s to bound the row, so the
-            # emitted count must come off here too. (EOS finishes inside the
-            # chain are the host's to handle — verify turns run with the
-            # pipeline drained, and the host releases the row immediately.)
-            budget_s = budget_s - emitted * live.astype(budget_s.dtype)
-            live_s = jnp.where(live, budget_s > 0, live_s)
-            return (
-                s0, model_rest, ok,
-                ck, cv,
-                jnp.where(live, last, token_s),
-                lengths_s + emitted * live.astype(lengths_s.dtype),
-                new_keys,
-                counts_s,
-                live_s, budget_s,
+                (e1 - 1)[:, None, None], axis=1)[:, 0]
+            keys_new = jnp.where(live[:, None], key_sel, keys_s)
+            budget_new = budget_s - e
+            lengths_new = lengths_s + e
+            fin = live & ((last == eos_s) | (budget_new <= 0))
+            live_new = jnp.where(active > 0, live & ~fin, live_s)
+            token_new = jnp.where(live, last, token_s)
+            if want_lp:
+                lp_all = jax.nn.log_softmax(adj_pos, axis=-1)    # [g+1,S,V]
+                s_lp = jnp.take_along_axis(
+                    lp_all, jnp.swapaxes(sampled, 0, 1)[:, :, None],
+                    axis=2)[:, :, 0]                             # [g+1, S]
+                top_lp, top_ix = lax.top_k(lp_all, n_top)
+                lp_out = (s_lp.T, jnp.swapaxes(top_ix, 0, 1),
+                          jnp.swapaxes(top_lp, 0, 1))
+            else:
+                lp_out = ()
+            if constrained:
+                # Masked-entry counts for live constrained rows, gated to
+                # positions that actually emitted (metric parity with the
+                # chunk variant's per-step vector).
+                con = live & (dfa_s > 0)
+                masked = jnp.sum(
+                    (~allow_pos) & con[None, :, None]
+                    & (jnp.swapaxes(emit, 0, 1)[:, :, None] > 0),
+                    axis=(1, 2), dtype=jnp.int32)                # [g+1]
+                # Carried state: the accepted-prefix state at the last
+                # emitted position, advanced on the last emitted token
+                # (stay put on EOS, exactly the chunk variant's rule).
+                st_last = jnp.take_along_axis(
+                    jnp.swapaxes(states, 0, 1), (e1 - 1)[:, None],
+                    axis=1)[:, 0]
+                nd = jnp.take_along_axis(
+                    trans_t[st_last], last[:, None], axis=1)[:, 0]
+                adv = (last != eos_s) & (nd >= 0)
+                dfa_new = jnp.where(live, jnp.where(adv, nd, st_last),
+                                    dfa_s)
+                mask_out = (masked,)
+            else:
+                mask_out = ()
+            tail = (ck, cv, token_new, lengths_new, keys_new, counts_new,
+                    live_new, budget_new)
+            if constrained:
+                tail = tail + (dfa_new,)
+            return (sampled, e) + lp_out + mask_out + tail
+
+        return core
+
+    def _verify_key(self, g: int, want_lp: bool, history: int,
+                    constrained: bool):
+        if constrained:
+            return ("dfa_verify", g, want_lp, history, self._g_bucket)
+        return ("verify", g, want_lp, history)
+
+    def _verify_fn(self, g: int, history: int, want_lp: bool = False,
+                   tstates: int = 0):
+        """Jitted ring-resident speculative-verification step (see
+        :meth:`_verify_core`). Variants per (g, want_lp, history[, arena
+        bucket]) — the same gating pattern as the decode chunk: only a
+        batch that contains a logprobs/constrained row pays that
+        variant."""
+        constrained = tstates > 0
+        key = self._verify_key(g, want_lp, history, constrained)
+        fn = self._decode_cache.get(key)
+        if fn is not None:
+            return fn
+        core = self._verify_core(g, history, want_lp, constrained)
+
+        if constrained:
+            def verify(params, active, eos_s, draft, trans_t, accept_t,
+                       ck, cv, token_s, lengths_s, keys_s, temp_s, topp_s,
+                       topk_s, pp_s, fp_s, counts_s, bias_s, live_s,
+                       budget_s, dfa_s):
+                return core(params, active, eos_s, draft, ck, cv, token_s,
+                            lengths_s, keys_s, temp_s, topp_s, topk_s,
+                            pp_s, fp_s, counts_s, bias_s, live_s, budget_s,
+                            trans_t=trans_t, accept_t=accept_t, dfa_s=dfa_s)
+
+            fn = jax.jit(
+                verify,
+                donate_argnames=("ck", "cv", "token_s", "lengths_s",
+                                 "keys_s", "counts_s", "live_s",
+                                 "budget_s", "dfa_s"),
             )
+        else:
+            def verify(params, active, eos_s, draft, ck, cv, token_s,
+                       lengths_s, keys_s, temp_s, topp_s, topk_s, pp_s,
+                       fp_s, counts_s, bias_s, live_s, budget_s):
+                return core(params, active, eos_s, draft, ck, cv, token_s,
+                            lengths_s, keys_s, temp_s, topp_s, topk_s,
+                            pp_s, fp_s, counts_s, bias_s, live_s, budget_s)
+
+            fn = jax.jit(
+                verify,
+                donate_argnames=("ck", "cv", "token_s", "lengths_s",
+                                 "keys_s", "counts_s", "live_s",
+                                 "budget_s"),
+            )
+        self._decode_cache[key] = fn
+        return fn
+
+    def _spec_loop_key(self, n_turns: int, g: int, want_lp: bool,
+                       history: int, constrained: bool):
+        if constrained:
+            return ("spec_loop_dfa", n_turns, g, want_lp, history,
+                    self._g_bucket)
+        return ("spec_loop", n_turns, g, want_lp, history)
+
+    def _spec_loop_fn(self, g: int, n_turns: int, history: int,
+                      want_lp: bool = False, tstates: int = 0):
+        """Jitted fused draft→verify scan for ``spec_model=`` engines: up
+        to ``n_turns`` speculative turns in ONE dispatch, borrowing the
+        decode_loop carry pattern (all-rows-finished early exit; token/
+        n_valid outputs gain a leading per-turn axis the megachunk reap
+        drains segment by segment).
+
+        Each turn: (1) ingest the target's carried token into the draft
+        model (one draft decode_step at the shared ``lengths`` position —
+        the draft cache already holds every earlier accepted token because
+        accepted drafts ARE the tokens the extension wrote; only the
+        rejection-point token ever differs, and this ingest rewrites it),
+        (2) extend g−1 greedy draft steps — with the grammar arena
+        threaded, each draft logit row is masked by its draft-prefix
+        allow-set first, so the draft never proposes a dead token, (3)
+        verify against the target (:meth:`_verify_core`). The draft cache
+        rides the donated carry, so consecutive fused dispatches chain on
+        device with NO host input beyond the active mask — what lets
+        draft-model speculation keep the decode_pipeline ring full."""
+        constrained = tstates > 0
+        key = self._spec_loop_key(n_turns, g, want_lp, history, constrained)
+        fn = self._decode_cache.get(key)
+        if fn is not None:
+            return fn
+        dspec = self._draft_rt.spec
+        dflash = self._draft_rt.flash
+        vocab = self.spec.vocab_size
+        n_rows = self._rows
+        core = self._verify_core(g, history, want_lp, constrained)
+
+        def spec_loop(params, dparams, active, spec_ok, eos_s, trans_t,
+                      accept_t, ck, cv, dck, dcv, chain, chain_n, token_s,
+                      lengths_s, keys_s, temp_s, topp_s, topk_s, pp_s,
+                      fp_s, counts_s, bias_s, live_s, budget_s, dfa_s):
+            def pick(lg, st):
+                # Greedy draft pick, grammar-filtered: mask by the draft-
+                # prefix state's allow-set (EOS allowed exactly in accept
+                # states) before the argmax, so the draft never proposes a
+                # dead token. A filtered draft can still be rejected — only
+                # the target's own sampled chain decides.
+                lg = lg.astype(jnp.float32)
+                if constrained:
+                    rowt = trans_t[st]
+                    allow = rowt >= 0
+                    eos_col = (jnp.arange(vocab)[None, :]
+                               == eos_s[:, None])
+                    allow = jnp.where(
+                        eos_col,
+                        (accept_t[st] & (eos_s >= 0))[:, None], allow)
+                    lg = apply_token_mask(lg, allow)
+                return jnp.argmax(lg, axis=-1).astype(jnp.int32)
+
+            def dfa_adv(st, tok):
+                nd = jnp.take_along_axis(trans_t[st], tok[:, None],
+                                         axis=1)[:, 0]
+                return jnp.where(nd >= 0, nd, 0)
+
+            def run_turn(op):
+                (ck, cv, dck, dcv, chain, chain_n, token_s, lengths_s,
+                 keys_s, counts_s, live_s, budget_s, dfa_s) = op
+                live = (active > 0) & live_s & (budget_s > 0)
+                # (1) ingest: re-feed the last verify turn's emitted chain
+                # (ending at the target's carried token — positions
+                # lengths−n+1..lengths) through a decode_multi of the SAME
+                # width as the verify forward, so accepted positions'
+                # draft-cache K/V reassociates like the target cache's —
+                # what keeps an oracle draft's chain agreeing with the
+                # target everywhere but true near-ties. Padding repeats
+                # the last chain token; its writes land beyond the stream
+                # and the extension below overwrites them.
+                idx = jnp.minimum(jnp.arange(g + 1)[None, :],
+                                  chain_n[:, None] - 1)
+                feed = jnp.take_along_axis(chain, idx, axis=1)
+                pos0 = jnp.where(live, lengths_s - chain_n + 1, 0)
+                dlg_all, dck, dcv = decode_multi(
+                    dparams, dspec, feed, pos0, dck, dcv, write_mask=live,
+                    history=history, clamp_writes=True)
+                dlg = jnp.take_along_axis(
+                    dlg_all, (chain_n - 1)[:, None, None], axis=1)[:, 0]
+                st = dfa_s if constrained else jnp.zeros((n_rows,),
+                                                         jnp.int32)
+                d0 = pick(dlg, st)
+                if g > 1:
+                    # Extension writes can transiently run past max_seq for
+                    # near-cap rows: only DRAFT cache positions, overwritten
+                    # as the true stream reaches them — draft quality, never
+                    # correctness (the target verify clamps its own writes).
+                    def ext(carry2, _):
+                        tok, dlen, dck, dcv, st = carry2
+                        lgs, dck, dcv = decode_step(
+                            dparams, dspec, tok, dlen, dck, dcv,
+                            write_mask=live, history=history, flash=dflash)
+                        st = dfa_adv(st, tok) if constrained else st
+                        nxt = pick(lgs, st)
+                        return (nxt, dlen + 1, dck, dcv, st), nxt
+
+                    (_, _, dck, dcv, _), rest = lax.scan(
+                        ext,
+                        (d0, jnp.where(live, lengths_s + 1, 0), dck, dcv,
+                         st),
+                        None, length=g - 1)
+                    drafted = jnp.concatenate(
+                        [d0[:, None], jnp.swapaxes(rest, 0, 1)], axis=1)
+                else:
+                    drafted = d0[:, None]
+                # Rows that may not draft (penalties/logprobs ride at one
+                # token per turn): sentinel out their drafts.
+                drafted = jnp.where(spec_ok[:, None], drafted, -1)
+                # (3) verify against the target.
+                kw = ({"trans_t": trans_t, "accept_t": accept_t,
+                       "dfa_s": dfa_s} if constrained else {})
+                out = core(params, active, eos_s, drafted, ck, cv, token_s,
+                           lengths_s, keys_s, temp_s, topp_s, topk_s, pp_s,
+                           fp_s, counts_s, bias_s, live_s, budget_s, **kw)
+                n_tail = 9 if constrained else 8
+                outs, tail = out[:-n_tail], out[-n_tail:]
+                if constrained:
+                    (ck, cv, token_s, lengths_s, keys_s, counts_s, live_s,
+                     budget_s, dfa_s) = tail
+                else:
+                    (ck, cv, token_s, lengths_s, keys_s, counts_s, live_s,
+                     budget_s) = tail
+                # Chain carry for the next turn's ingest: the emitted
+                # tokens (outs[0] first e1 valid), count clamped >= 1.
+                sampled, e = outs[0], outs[1]
+                chain = jnp.where(live[:, None], sampled, chain)
+                chain_n = jnp.where(live, jnp.maximum(e, 1), chain_n)
+                return (ck, cv, dck, dcv, chain, chain_n, token_s,
+                        lengths_s, keys_s, counts_s, live_s, budget_s,
+                        dfa_s), tuple(outs)
+
+            carry0 = (ck, cv, dck, dcv, chain, chain_n, token_s, lengths_s,
+                      keys_s, counts_s, live_s, budget_s, dfa_s)
+            # The decode_loop skip pattern: the dead branch must emit the
+            # same output pytree as a real turn; eval_shape is trace-free.
+            out_shapes = jax.eval_shape(lambda op: run_turn(op)[1], carry0)
+
+            def skip_turn(op):
+                zeros = jax.tree.map(
+                    lambda s: jnp.zeros(s.shape, s.dtype), out_shapes)
+                return op, zeros
+
+            def body(carry, _):
+                alive = jnp.any((active > 0) & carry[10] & (carry[11] > 0))
+                return lax.cond(alive, run_turn, skip_turn, carry)
+
+            carry, outs = lax.scan(body, carry0, None, length=n_turns)
+            (ck, cv, dck, dcv, chain, chain_n, token_s, lengths_s, keys_s,
+             counts_s, live_s, budget_s, dfa_s) = carry
+            # outs: (sampled [C, S, g+1], e [C, S], lp?…, masked? [C, g+1])
+            tail = (ck, cv, dck, dcv, chain, chain_n, token_s, lengths_s,
+                    keys_s, counts_s, live_s, budget_s, dfa_s)
+            return tuple(outs) + tail
 
         fn = jax.jit(
-            verify,
-            donate_argnames=("ck", "cv", "token_s", "lengths_s", "keys_s",
-                             "counts_s", "live_s", "budget_s"),
+            spec_loop,
+            donate_argnames=("ck", "cv", "dck", "dcv", "chain", "chain_n",
+                             "token_s", "lengths_s", "keys_s", "counts_s",
+                             "live_s", "budget_s", "dfa_s"),
         )
-        self._decode_cache[("verify", g, history)] = fn
+        self._decode_cache[key] = fn
         return fn
 
     # ---- public API -------------------------------------------------------
@@ -2778,6 +3237,8 @@ class InferenceEngine:
                 "cancellations_total": self.n_cancelled,
                 "spec_turns_total": self.n_spec_turns,
                 "spec_accepted_total": self.n_spec_accepted,
+                "spec_draft_tokens_total": self.n_spec_drafted,
+                "spec_overlapped_total": self.n_spec_overlapped,
                 "decode_chunks_total": self.n_decode_chunks,
                 "decode_busy_rows_total": self.n_decode_rows,
                 "prefix_hits_total": self.prefix_hits,
@@ -3723,7 +4184,7 @@ class InferenceEngine:
                 (self._ck, self._cv, self._token, self._lengths, self._keys,
                  self._temp, self._topp, self._topk, self._pp, self._fp,
                  self._counts, self._bias, self._live, self._budget,
-                 self._eos))
+                 self._eos, self._dfa))
             return not any(x.is_deleted() for x in leaves
                            if isinstance(x, jax.Array))
         except Exception:
@@ -3772,40 +4233,14 @@ class InferenceEngine:
 
     def _run_chunk_steps(self) -> None:
         self._sweep_cancelled()
-        active = self._active_rows()
-        if not active:
+        if not self._active_rows():
             self._drain_inflight()
             return
-        max_len = max(len(r.prompt_ids) + r.emitted for _, r in active)
-        g = self.spec_decode
-        if (g > 0
-                and all(r.spec_clean for _, r in active)
-                and max_len + g + 1 <= self.spec.max_seq):
-            # Speculative turns are host-synchronous (the draft needs the
-            # request's full accepted history): drain the ring first, then
-            # re-check — rows can finish or get cancelled inside the drain.
-            self._drain_inflight()
-            self._sweep_cancelled()
-            active = self._active_rows()
-            if not active:
-                return
-            max_len = max(len(r.prompt_ids) + r.emitted for _, r in active)
-            if (all(r.spec_clean for _, r in active)
-                    and max_len + g + 1 <= self.spec.max_seq):
-                if self._draft_rt is not None:
-                    drafts = self._draft_rt.draft_all(active, g)
-                else:
-                    drafts = {i: self._draft(r, g) for i, r in active}
-                # Fall through to the chunked path when NO row has a draft —
-                # a draftless verify step would emit 1 token per dispatch and
-                # forfeit decode_chunk amortization for nothing. (A draft
-                # MODEL always drafts.)
-                if any(d is not None for d in drafts.values()):
-                    self._run_verify_step(active, g, max_len, drafts)
-                    return
-        # Depth-K pipelined decode: top the ring up, then block on (only)
-        # the oldest chunk. The device rolls chunk-to-chunk while the host
-        # detokenizes, SSE-emits, and schedules the next iteration.
+        # Depth-K pipelined decode: top the ring up (speculative verify
+        # turns enter the ring like any chunk — they no longer drain it),
+        # then block on (only) the oldest dispatch. The device rolls
+        # dispatch-to-dispatch while the host detokenizes, SSE-emits, and
+        # schedules the next iteration.
         self._fill_inflight()
         if self._inflight:
             self._reap_oldest()
@@ -3902,6 +4337,123 @@ class InferenceEngine:
                 c //= 2
         return max(1, c)
 
+    def _form_draft(self, req: _Request, g: int) -> "list[int] | None":
+        """Per-row prompt-lookup draft for the NEXT verify dispatch.
+
+        Fresh (nothing in flight for this row): delegate to :meth:`_draft`
+        on the true history, and — when the draft is the n-gram index's own
+        continuation — remember its source so pipelined turns can keep
+        drafting. Pipelined (dispatches in flight): continue from the
+        remembered source, optimistically assuming the in-flight turns
+        accept in full; a full-accept turn emits exactly its g drafts plus
+        ONE undrafted position (the bonus token), and the next turn's
+        first draft proposes that turn's own first sample — so the cursor
+        skips 1 between drafts. When the cursor runs off the real history
+        it re-anchors through the n-gram index on the last two optimistic
+        tokens — periodic text keeps drafting at any ring depth. A wrong
+        assumption only costs acceptance: the stale draft fails
+        verification and the reap resets the cursor."""
+        if req.n_inflight == 0:
+            d = self._draft(req, g)
+            req.spec_state = None
+            if d is None:
+                return None
+            if req.grammar is not None:
+                d = self._filter_draft(req, req.dfa_host, d)
+            if d is not None and all(t >= 0 for t in d) and len(
+                    req.hist) >= 4:
+                pos = req.ngram.get((req.hist[-2], req.hist[-1]))
+                if pos is not None:
+                    cont = req.hist[pos + 1: pos + 1 + g]
+                    if d == cont + [cont[-1]] * (g - len(cont)):
+                        opt = (req.hist + d)[-2:]
+                        odfa = self._advance_local(req, req.dfa_host, d)
+                        req.spec_state = (pos + 1 + g, opt[0], opt[1],
+                                          odfa)
+            return d
+        state = req.spec_state
+        if state is None:
+            return None
+        cont: list[int] = []
+        truncated = False
+        for k in range(g + 1):
+            step = self._spec_take(req, state)
+            if step is None:
+                state = None
+                break
+            state, tok = step
+            if req.grammar is not None:
+                src, t1, t2, odfa = state
+                odfa = (int(req.grammar.trans[odfa, tok])
+                        if odfa >= 0 else -1)
+                if odfa < 0:
+                    # The optimistic stream leaves the grammar here: the
+                    # full-accept assumption cannot extend past it.
+                    state = None
+                    truncated = True
+                    break
+                state = (src, t1, t2, odfa)
+            if k >= 1:       # the first taken token is the undrafted bonus
+                cont.append(tok)
+        req.spec_state = state
+        if not cont:
+            return None
+        if len(cont) < g:
+            pad = -1 if truncated else cont[-1]
+            cont = cont + [pad] * (g - len(cont))
+        return cont
+
+    @staticmethod
+    def _spec_take(req: _Request, state):
+        """Advance the optimistic-draft cursor one source token; returns
+        ``(new state, token)`` or None when the cursor dies. Re-anchors
+        through the n-gram index when it runs off the real history (the
+        optimistic stream's trailing pair rides in the state), so periodic
+        text keeps drafting at any ring depth."""
+        src, t1, t2, odfa = state
+        if src >= len(req.hist):
+            pos = req.ngram.get((t1, t2))
+            if pos is None or pos + 1 >= len(req.hist):
+                return None
+            src = pos + 1
+        tok = req.hist[src]
+        return (src + 1, t2, tok, odfa), tok
+
+    @staticmethod
+    def _advance_local(req: _Request, state: int, d: "list[int]") -> int:
+        """Walk a host-side LOCAL DFA state over draft tokens (−1 =
+        unknown, stays unknown). Draft quality only — the device mask is
+        the correctness backstop."""
+        if req.grammar is None:
+            return -1
+        for t in d:
+            if state < 0 or t < 0:
+                return -1
+            state = int(req.grammar.trans[state, t])
+        return state
+
+    @staticmethod
+    def _filter_draft(req: _Request, state: int, d: "list[int]"):
+        """Grammar-aware draft filter: truncate a prompt-lookup draft at
+        its first dead token (walking the request's compiled table from
+        the LOCAL ``state``; −1 = unknown, no filtering), padding with the
+        −1 sentinel — the draft never proposes a token the device mask
+        would −inf anyway. A stale state only costs acceptance."""
+        if state < 0:
+            return d  # unknown state: let the device mask decide
+        out: list[int] = []
+        for t in d:
+            if t < 0:
+                break
+            nxt = int(req.grammar.trans[state, t])
+            if nxt < 0:
+                break
+            out.append(t)
+            state = nxt
+        if not out:
+            return None
+        return out + [-1] * (len(d) - len(out))
+
     def _fill_inflight(self) -> None:
         target = self._target_depth()
         while len(self._inflight) < target:
@@ -3910,10 +4462,24 @@ class InferenceEngine:
             if not active:
                 return
             depth = len(self._inflight)
-            # Fixed chunk size per hint value: tailoring n_steps to remaining
-            # budgets would compile a program per distinct tail length; the
-            # on-device budget mask stops a finished row's sampling mid-chunk
-            # anyway, so tail steps cost forward FLOPs, never wrong tokens.
+            # Planned lengths: host-known emitted counts plus every step
+            # already in flight — an upper bound on where rows can be when
+            # this chunk runs (rows that finish on device stop short of it).
+            ahead = sum(c.tokens_ahead for c in self._inflight)
+            if depth > 0 and not any(
+                    r.budget - r.emitted > ahead for _, r in active):
+                # Dispatching AHEAD of the read is worth it only when some
+                # row can still be decoding in this dispatch (the device
+                # budget would otherwise mask the whole window off).
+                return
+            g = self.spec_decode
+            if g > 0 and any(r.spec_draft_ok for _, r in active):
+                disp = self._try_spec_dispatch(active, g, ahead, depth)
+                if disp == "dispatched":
+                    continue
+                if disp == "stop":
+                    return
+                # disp == "chunk": no draft anywhere — fall through.
             n_steps = max(
                 1, min(r.chunk_hint or self.decode_chunk for _, r in active))
             want_lp = any(r.want_lp >= 0 for _, r in active)
@@ -3922,28 +4488,19 @@ class InferenceEngine:
             # variant — its table gathers AND its operand shapes. A batch
             # with none dispatches the exact pre-constrain program.
             constrained = any(r.grammar is not None for _, r in active)
-            # Planned lengths: host-known emitted counts plus every step
-            # already in flight — an upper bound on where rows can be when
-            # this chunk runs (rows that finish on device stop short of it).
-            ahead = sum(c.tokens_ahead for c in self._inflight)
             n_chunks = self._effective_loop(active, n_steps, ahead)
             planned = max(len(r.prompt_ids) + r.emitted for _, r in active)
             planned += ahead
             history = prefill_bucket(
                 min(planned + n_steps * n_chunks, self.spec.max_seq),
                 self.spec.max_seq)
-            if depth > 0:
-                # Dispatching AHEAD of the read is worth it only when some
-                # row can still be decoding in this chunk (the device budget
-                # would otherwise mask the whole window off), and only onto
-                # a warm program — a first-use history bucket would stall
-                # the already-computed older chunks behind a full XLA
-                # compile.
-                if not any(r.budget - r.emitted > ahead for _, r in active):
-                    return
-                if self._decode_key(n_steps, want_lp, history, constrained,
-                                    n_chunks) not in self._decode_cache:
-                    return
+            if depth > 0 and self._decode_key(
+                    n_steps, want_lp, history, constrained,
+                    n_chunks) not in self._decode_cache:
+                # Only dispatch ahead onto a warm program — a first-use
+                # history bucket would stall the already-computed older
+                # chunks behind a full XLA compile.
+                return
             mask = np.zeros((self._rows,), np.int32)
             for i, _ in active:
                 mask[i] = 1
@@ -3953,9 +4510,186 @@ class InferenceEngine:
             self._inflight.append(
                 _InflightChunk(payload, active, n_steps, t0, history, depth,
                                constrained, n_chunks))
+            for _, r in active:
+                r.n_inflight += 1
             if depth > 0:
                 self.n_overlapped += 1
             obs.PIPELINE_DEPTH.set(len(self._inflight))
+
+    def _try_spec_dispatch(self, active, g: int, ahead: int,
+                           depth: int) -> str:
+        """Try to make the next ring entry a speculative dispatch. Returns
+        ``"dispatched"`` (an entry was appended), ``"chunk"`` (no draft
+        available anywhere and none in flight — the plain chunked path
+        should dispatch instead), or ``"stop"`` (leave the ring as is: a
+        verify turn is in flight and no pipelined draft exists, so a chunk
+        dispatched now would advance rows past the host's view and poison
+        every future draft — or the spec program is cold and compiling it
+        would stall the in-flight entries)."""
+        want_lp = any(r.want_lp >= 0 for _, r in active)
+        constrained = any(r.grammar is not None for _, r in active)
+        n_steps = g + 1
+        fused = self._draft_rt is not None
+        n_turns = (self._effective_loop(active, n_steps, ahead)
+                   if fused else 1)
+        planned = max(len(r.prompt_ids) + r.emitted for _, r in active)
+        planned += ahead
+        history = prefill_bucket(
+            min(planned + n_steps * n_turns, self.spec.max_seq),
+            self.spec.max_seq)
+        tstates = self._g_bucket if constrained else 0
+        if fused:
+            key = self._spec_loop_key(n_turns, g, want_lp, history,
+                                      constrained)
+        else:
+            key = self._verify_key(g, want_lp, history, constrained)
+        if depth > 0 and key not in self._decode_cache:
+            return "stop"
+        if fused and depth > 0 and any(
+                self._draft_rt.reqs[i] is not r for i, r in active):
+            # A reassigned slot needs a draft resync whose advance/chain
+            # programs may be first-use XLA compiles — never pay those
+            # behind K−1 already-computed dispatches (the same stall the
+            # warm-program guard above prevents); the ring drains to the
+            # blocking entry and the resync runs at depth 0.
+            return "stop"
+        drafts: dict[int, list[int]] = {}
+        if not fused:
+            for i, r in active:
+                if not r.spec_draft_ok:
+                    continue
+                d = self._form_draft(r, g)
+                if d is not None:
+                    drafts[i] = d
+            if not drafts:
+                # A draftless verify turn would emit 1 token per dispatch
+                # and forfeit decode_chunk amortization for nothing.
+                if any(c.spec_turn for c in self._inflight):
+                    return "stop"
+                if any(r.spec_draft_ok and r.n_inflight > 0
+                       and (r.spec_state is not None
+                            or (len(r.hist) >= 4
+                                and r.ngram.get(
+                                    (r.hist[-2], r.hist[-1])) is not None))
+                       for _, r in active):
+                    # A repetitive-looking row is only STALE (dispatches in
+                    # flight hide its true tail): hold the ring instead of
+                    # piling chunks on — it drains within <= K reaps, the
+                    # history catches up, and a fresh draft re-engages
+                    # speculation. Rows with no n-gram signal never hold
+                    # the ring, so plain traffic keeps full chunk depth.
+                    return "stop"
+                return "chunk"
+        t0 = time.perf_counter()
+        try:
+            payload, drafted = self._dispatch_spec(
+                active, g, n_turns, want_lp, history, tstates, drafts)
+        except Exception as exc:
+            self._contain_verify_failure(active, exc)
+            return "stop"
+        self._inflight.append(
+            _InflightChunk(payload, active, n_steps, t0, history, depth,
+                           constrained, n_turns, spec_turn=True,
+                           drafted=drafted, stacked=fused))
+        for _, r in active:
+            r.n_inflight += 1
+        if depth > 0:
+            self.n_overlapped += 1
+            self.n_spec_overlapped += 1
+        obs.PIPELINE_DEPTH.set(len(self._inflight))
+        return "dispatched"
+
+    def _dispatch_spec(self, active, g: int, n_turns: int, want_lp: bool,
+                       history: int, tstates: int, drafts):
+        """Enqueue one speculative dispatch (non-blocking): a verify turn
+        over host-formed drafts, or — with a draft model — ``n_turns``
+        fused draft→verify turns whose drafts the device generates itself.
+        Chains the per-slot device state (and the draft runtime's cache)
+        exactly like :meth:`_dispatch_chunk`; returns ``(payload, drafted
+        tokens per turn)``."""
+        faults.fire("engine.verify")
+        constrained = tstates > 0
+        mask = np.zeros((self._rows,), np.int32)
+        for i, _ in active:
+            mask[i] = 1
+        mask = jax.device_put(mask, self._rep)
+        if self._draft_rt is not None:
+            rt = self._draft_rt
+            rt.ensure_chain(g, self._rep)
+            for i, r in active:
+                if rt.reqs[i] is not r:
+                    rt.resync(i, r, g)
+            spec_ok = np.zeros((self._rows,), bool)
+            n_ok = 0
+            for i, r in active:
+                spec_ok[i] = r.spec_draft_ok
+                n_ok += int(r.spec_draft_ok)
+            spec_ok = jax.device_put(spec_ok, self._rep)
+            out = self._spec_loop_fn(g, n_turns, history, want_lp,
+                                     tstates=tstates)(
+                self.params, rt.params, mask, spec_ok, self._eos,
+                self._g_trans, self._g_accept, self._ck, self._cv,
+                rt._ck, rt._cv, rt._chain, rt._chain_n, self._token,
+                self._lengths, self._keys, self._temp, self._topp,
+                self._topk, self._pp, self._fp, self._counts, self._bias,
+                self._live, self._budget, self._dfa)
+            n_pay = len(out) - 13
+            payload, tail = out[:n_pay], out[n_pay:]
+            (self._ck, self._cv, rt._ck, rt._cv, rt._chain, rt._chain_n,
+             self._token, self._lengths, self._keys, self._counts,
+             self._live, self._budget, self._dfa) = tail
+            return tuple(payload), g * n_ok
+        draft = np.full((self._rows, g), -1, np.int32)
+        drafted = 0
+        for i, d in drafts.items():
+            draft[i, : len(d)] = d
+            drafted += sum(1 for t in d if t >= 0)
+        draft = jax.device_put(draft, self._rep)
+        if constrained:
+            out = self._verify_fn(g, history, want_lp, tstates=tstates)(
+                self.params, mask, self._eos, draft, self._g_trans,
+                self._g_accept, self._ck, self._cv, self._token,
+                self._lengths, self._keys, self._temp, self._topp,
+                self._topk, self._pp, self._fp, self._counts, self._bias,
+                self._live, self._budget, self._dfa)
+            n_pay = len(out) - 9
+            payload, tail = out[:n_pay], out[n_pay:]
+            (self._ck, self._cv, self._token, self._lengths, self._keys,
+             self._counts, self._live, self._budget, self._dfa) = tail
+            return tuple(payload), drafted
+        out = self._verify_fn(g, history, want_lp)(
+            self.params, mask, self._eos, draft, self._ck, self._cv,
+            self._token, self._lengths, self._keys, self._temp, self._topp,
+            self._topk, self._pp, self._fp, self._counts, self._bias,
+            self._live, self._budget)
+        n_pay = len(out) - 8
+        payload, tail = out[:n_pay], out[n_pay:]
+        (self._ck, self._cv, self._token, self._lengths, self._keys,
+         self._counts, self._live, self._budget) = tail
+        return tuple(payload), drafted
+
+    def _contain_verify_failure(self, active, exc: Exception) -> None:
+        """A speculative dispatch failed (fault injection, host-side
+        error) BEFORE advancing the chained device state: doom only this
+        turn's rows. Older in-flight dispatches reap normally — their
+        tokens for the released rows count as overrun — and pending
+        requests keep their place; the ring is never drained. A failure
+        that consumed donated buffers escalates to the scheduler's
+        :meth:`_fail_all` instead (the co-batched KV went with them)."""
+        if not self._device_state_ok():
+            raise exc
+        self.n_failures += len(active)
+        for _, r in active:
+            if r.trace is not None:
+                now = time.perf_counter()
+                r.trace.add_span_abs("engine-failure", now, now,
+                                     error=type(exc).__name__,
+                                     contained=True)
+            r.out.put(("err", exc))
+        with self._cond:
+            for i, r in active:
+                if self._slots[i] is r:
+                    self._release_slot(i, r)
 
     def _reap_oldest(self) -> None:
         """Block on the oldest in-flight chunk and deliver its tokens.
@@ -3969,7 +4703,7 @@ class InferenceEngine:
         dispatch-to-reap latency is kept as the span's ``inflight`` attr."""
         c = self._inflight.popleft()
         t0 = time.perf_counter()
-        done, n_exec = self._emit_chunk(c)
+        done, n_exec, delivered = self._emit_chunk(c)
         t1 = time.perf_counter()
         obs.DECODE_CHUNK.observe(t1 - t0)
         obs.PIPELINE_DEPTH.set(len(self._inflight))
@@ -3977,6 +4711,42 @@ class InferenceEngine:
             obs.DECODE_GROUP_ACTIVE.set(len(c.active))
         self.n_decode_chunks += 1
         self.n_decode_rows += len(c.active)
+        for _, req in c.active:
+            req.n_inflight = max(0, req.n_inflight - 1)
+        if c.spec_turn:
+            # One spec turn per EXECUTED segment (a fused dispatch covers
+            # n_chunks turns; the early exit skips the all-dead tail). The
+            # per-turn latency feeds the same EWMA the deadline clamp
+            # estimates fused dispatch lengths from.
+            per_turn = (t1 - c.t0) / max(1, n_exec)
+            self._chunk_ewma_s = (
+                per_turn if self._chunk_ewma_s == 0.0
+                else (1 - CHUNK_EWMA_ALPHA) * self._chunk_ewma_s
+                + CHUNK_EWMA_ALPHA * per_turn)
+            self.n_spec_turns += n_exec
+            obs.SPEC_TURNS.inc(n_exec)
+            self.n_spec_drafted += c.drafted * n_exec
+            obs.SPEC_DRAFT_TOKENS.inc(c.drafted * n_exec)
+            g = c.n_steps - 1
+            for i, req in c.active:
+                got, segs = delivered.get(i, (0, 0))
+                if req.spec_state is not None and (
+                        segs < c.n_chunks or got < segs * c.n_steps):
+                    # Any rejection breaks the optimistic full-accept
+                    # assumption every pipelined draft was formed under.
+                    req.spec_state = None
+                if self._slots[i] is req or i in done:
+                    self._turn_span(req, "spec-verify", t0, t1, drafted=g,
+                                    accepted=max(0, got - max(1, segs)),
+                                    occupancy=len(c.active),
+                                    depth=c.depth,
+                                    inflight=round(t0 - c.t0, 6))
+            if done:
+                with self._cond:
+                    for i, req in c.active:
+                        if i in done and self._slots[i] is req:
+                            self._release_slot(i, req)
+            return
         # Megachunk accounting: chunk segments this dispatch actually
         # produced tokens for (the early exit skips the all-dead tail),
         # plus the per-chunk latency EWMA the deadline clamp estimates
@@ -4090,7 +4860,7 @@ class InferenceEngine:
          self._keys, self._counts, self._live, self._budget) = out
         return (toks, n_valid)
 
-    def _emit_chunk(self, c: "_InflightChunk") -> tuple[set[int], int]:
+    def _emit_chunk(self, c: "_InflightChunk"):
         """Block on one dispatched chunk's outputs and deliver its tokens.
 
         ``n_valid[i]`` (computed ON DEVICE) bounds row i's delivery: a row
@@ -4109,7 +4879,9 @@ class InferenceEngine:
         the same loop.
 
         Returns ``(slots that finished in THIS dispatch, segments that
-        produced any token)``."""
+        produced any token, per-row (tokens delivered, segments with a
+        delivery))`` — the trailing stats drive the speculative-turn
+        accounting (accepted = delivered − 1 per executed turn)."""
         active, payload = c.active, c.payload
         fetched = _host_fetch(*payload)
         t_fetch = time.perf_counter()
@@ -4127,13 +4899,14 @@ class InferenceEngine:
             toks, n_valid = fetched
             s_lp = top_ix = top_lp = None
         toks, n_valid = np.asarray(toks), np.asarray(n_valid)
-        if c.n_chunks == 1:
+        if not c.stacked:
             toks, n_valid = toks[None], n_valid[None]
             if s_lp is not None:
                 s_lp, top_ix, top_lp = (
                     np.asarray(s_lp)[None], np.asarray(top_ix)[None],
                     np.asarray(top_lp)[None])
         done: set[int] = set()
+        delivered: dict[int, tuple[int, int]] = {}
         n_exec = 0
         for ci in range(toks.shape[0]):
             nv = n_valid[ci]
@@ -4158,10 +4931,21 @@ class InferenceEngine:
                     if self._emit(req, int(toks[ci, i, j])):
                         done.add(i)
                         break
-                self.n_overrun += k - (req.emitted - before)
+                got = req.emitted - before
+                self.n_overrun += k - got
+                if got:
+                    d0, s0 = delivered.get(i, (0, 0))
+                    delivered[i] = (d0 + got, s0 + 1)
+                    if c.spec_turn:
+                        # Accepted drafts per executed turn: everything the
+                        # stream got beyond the model's own first token.
+                        acc = max(0, got - 1)
+                        self.n_spec_accepted += acc
+                        obs.SPEC_ACCEPTED_TOKENS.inc(acc)
+                        obs.SPEC_ACCEPTANCE.observe(acc)
         # Host-drain gap: payload-on-host to last token in consumer queues.
         self.drain_gap_s += time.perf_counter() - t_fetch
-        return done, n_exec
+        return done, n_exec, delivered
 
     @staticmethod
     def _draft(req: _Request, g: int) -> list[int] | None:
@@ -4180,61 +4964,6 @@ class InferenceEngine:
         cont = hist[pos + 1 : pos + 1 + g]
         return cont + [cont[-1]] * (g - len(cont))
 
-    def _run_verify_step(self, active, g: int, max_len: int, drafts) -> None:
-        """One speculative dispatch: verify each row's draft against the
-        model's own sampled chain (greedy rows: argmax)."""
-        t0 = time.perf_counter()
-        history = prefill_bucket(max_len + g + 1, self.spec.max_seq)
-        mask = np.zeros((self._rows,), np.int32)
-        tokens = np.zeros((self._rows, g + 1), np.int32)
-        for i, r in active:
-            mask[i] = 1
-            tokens[i, 0] = r.hist[-1]
-            draft = drafts.get(i)
-            if draft is not None:
-                tokens[i, 1:] = draft
-            else:
-                tokens[i, 1:] = -1  # never matches → accepts only s0
-        # Explicit uploads (transfer_guard discipline, like _dispatch_chunk)
-        mask = jax.device_put(mask, self._rep)
-        tokens = jax.device_put(tokens, self._rep)
-        (s0, model_toks, ok, self._ck, self._cv, self._token, self._lengths,
-         self._keys, self._counts,
-         self._live, self._budget) = self._verify_fn(g, history)(
-            self.params, mask, tokens, self._ck, self._cv, self._token,
-            self._lengths, self._keys, self._temp, self._topp, self._topk,
-            self._counts, self._live, self._budget,
-        )
-        s0, model_toks, ok = _host_fetch(s0, model_toks, ok)
-        t1 = time.perf_counter()
-        obs.DECODE_CHUNK.observe(t1 - t0)
-        self.n_spec_turns += 1
-        self.n_decode_rows += len(active)
-        for i, req in active:
-            toks = [int(s0[i])]
-            for j in range(g):
-                if not ok[i, j]:
-                    break
-                toks.append(int(model_toks[i, j]))
-            finished = False
-            emitted_before = req.emitted
-            for t in toks:
-                if self._emit(req, t):
-                    finished = True
-                    break
-            # Count accepted drafts by what actually reached the stream:
-            # req.emitted only advances for delivered tokens, so drafts past
-            # an EOS/budget/cancel finish never inflate the metric. The
-            # chain's first token (s0) is the model's own step, not a draft.
-            self.n_spec_accepted += max(0, req.emitted - emitted_before - 1)
-            self._turn_span(
-                req, "spec-verify", t0, t1, drafted=g,
-                accepted=max(0, req.emitted - emitted_before - 1),
-                occupancy=len(active))
-            if finished:
-                with self._cond:
-                    self._release_slot(i, req)
-
     def _emit(self, req: _Request, tok: int) -> bool:
         """Deliver one token; returns True when the request just finished."""
         if req.cancel.is_set():
@@ -4246,6 +4975,11 @@ class InferenceEngine:
         hist.append(tok)
         if len(hist) >= 3:  # lagged n-gram index update (see _Request)
             req.ngram[(hist[-3], hist[-2])] = len(hist) - 2
+        if req.grammar is not None and req.dfa_host >= 0 and tok != req.eos_id:
+            # Host DFA shadow (LOCAL state) for the grammar-aware draft
+            # filter; a masked-sampled token is always allowed, so a dead
+            # transition here means the shadow lost sync — park unknown.
+            req.dfa_host = int(req.grammar.trans[req.dfa_host, tok])
         self.n_tokens += 1
         req.out.put(("tok", tok))
         if req.eos_id is not None and tok == req.eos_id:

@@ -33,6 +33,7 @@ SITES = (
     "engine.admit",
     "engine.prefill_segment",
     "engine.decode",
+    "engine.verify",
     "engine.snapshot",
     "engine.kv_handoff",
     "http.request",

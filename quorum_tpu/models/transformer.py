@@ -758,6 +758,7 @@ def decode_multi(
     cache_v: jnp.ndarray,
     write_mask: jnp.ndarray | None = None,  # [B] bool
     history: int | None = None,
+    clamp_writes: bool = False,
 ):
     """T-token decode: logits for positions lengths..lengths+T-1 of each row
     in ONE forward. Returns (logits [B,T,V], cache_k, cache_v).
@@ -770,6 +771,15 @@ def decode_multi(
     K/V for all T positions is written into the cache (rejected positions
     land beyond the advanced length — masked by every later read and
     overwritten as generation proceeds). ``decode_step`` ≡ T = 1.
+
+    ``clamp_writes`` makes the per-row window cap-safe: a row whose write
+    span ``[lengths, lengths+T)`` runs past ``max_seq`` drops exactly the
+    out-of-range positions instead of letting ``dynamic_update_slice``
+    clamp the start backwards and silently corrupt earlier (valid) cache
+    entries. The ring-resident verify path uses this so near-cap rows can
+    ride every speculative dispatch — their emission is bounded by the
+    on-device budget (always ≤ the remaining window), so a dropped
+    position is never one that gets accepted.
     """
     b, t = tokens.shape
     x = _emb_rows(params["tok_emb"], tokens, jnp.dtype(spec.dtype))  # [B,T,D]
@@ -777,13 +787,31 @@ def decode_multi(
         x = x * jnp.asarray(spec.emb_scale, x.dtype)
     pos = lengths[:, None] + jnp.arange(t)[None, :]              # [B,T]
     if spec.pos == "learned":
-        x = x + params["pos_emb"][pos].astype(x.dtype)
+        # clamp_writes implies positions may (transiently) run past the
+        # table; those positions' logits are never accepted (budget-bounded
+        # emission), so the clamped gather is only shape safety.
+        p_ix = jnp.minimum(pos, spec.max_seq - 1) if clamp_writes else pos
+        x = x + params["pos_emb"][p_ix].astype(x.dtype)
     cos, sin = rope_cos_sin_for(spec)
     hist = spec.max_seq if history is None else min(history, spec.max_seq)
     allow = (jnp.ones((b,), bool) if write_mask is None else write_mask)
 
     def write_row(cache_row, new_row, idx, w):
         # cache_row [K, max_seq, hd] (or [K, max_seq] scale), new_row likewise
+        if clamp_writes:
+            # Shift the window start back so the slice stays in bounds, and
+            # roll the values right by the same amount so each kept value
+            # still lands at its intended position; slice indices below the
+            # shift write the OLD contents back (those intended positions
+            # are >= max_seq — dropped).
+            delta = jnp.maximum(idx + t - spec.max_seq, 0)
+            start = (0, idx - delta, 0)[: cache_row.ndim]
+            old = lax.dynamic_slice(cache_row, start, new_row.shape)
+            rolled = jnp.roll(new_row, delta, axis=1)
+            keep = (jnp.arange(t) >= delta).reshape(
+                (1, t) + (1,) * (new_row.ndim - 2))
+            return lax.dynamic_update_slice(
+                cache_row, jnp.where(keep & w, rolled, old), start)
         start = (0, idx, 0)[: cache_row.ndim]
         old = lax.dynamic_slice(cache_row, start, new_row.shape)
         return lax.dynamic_update_slice(

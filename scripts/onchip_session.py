@@ -21,16 +21,21 @@ list; whatever the tunnel survives is kept:
      already pins token equality and the ~C× dispatch reduction
      (make hostpath-bench); this arm measures what the killed dispatch
      boundary is worth in decode tok/s on real silicon.
-  6. One ``QUORUM_TPU_PROFILE_DIR`` trace of steady-state 7B decode, to
+  6. Spec-compose A/B (``spec_decode=4`` vs off, ISSUE 10): ring-resident
+     row-wise speculation at 7B, separate processes per arm. CPU already
+     pins token equality and >90% forced-periodic acceptance (incl. the
+     constrained dfa-verify leg); this arm measures natural-text
+     acceptance and the tok/s win per accepted token.
+  7. One ``QUORUM_TPU_PROFILE_DIR`` trace of steady-state 7B decode, to
      attribute the ~38% HBM-roofline gap (PERF §4).
-  7. int8 QUALITY at 7B scale: teacher-forced scoring (engine/score.py)
+  8. int8 QUALITY at 7B scale: teacher-forced scoring (engine/score.py)
      of one fixed prompt under bf16 and under quant=int8 of the SAME
      seed-0 mistral-7b weights — mean |Δlogprob| and the ppl ratio. The
      CPU suite pins quantization error only on tiny models; this is the
      number that says int8 serving is quality-safe at the scale we ship.
 
 Usage: ``python scripts/onchip_session.py
-[--skip bench,ab,kvq,flash,megachunk,disagg,profile,qq]``
+[--skip bench,ab,kvq,flash,megachunk,spec,disagg,profile,qq]``
 Each step is a subprocess with its own budget; a wedged step is recorded
 and skipped, never fatal. Results: ``ONCHIP.json`` (merged dict, one key
 prefix per step) + profile trace under ``profiles/``.
@@ -406,6 +411,23 @@ def main() -> None:
         # chunk-dispatch boundary between chunks.
         for arm, arm_url in (("loop_off", B7_URL),
                              ("loop_on", B7_URL + "&decode_loop=4")):
+            b = fits(arm, 1500)
+            if b:
+                bank(run_step(
+                    arm, [sys.executable, "-c", _SERVE_ONE, arm_url, "2",
+                          arm, "600"], budget=b))
+    if "spec" not in skip:
+        # Spec-compose A/B (PERF.md §5 step 6): spec_decode=4 vs off at
+        # 7B, SEPARATE processes per arm (spec engages per engine; the
+        # off arm must dispatch the exact pre-existing programs). The
+        # runbook drive's generations self-repeat on a real model, so
+        # prompt-lookup drafting engages on natural traffic; the banked
+        # numbers are steady-state decode tok/s plus the engine-block
+        # spec_{turns,accepted,draft_tokens,overlapped}_total counters
+        # (acceptance rate = accepted/drafted; overlapped > 0 = the ring
+        # stayed resident through verify turns).
+        for arm, arm_url in (("spec_off", B7_URL),
+                             ("spec_on", B7_URL + "&spec_decode=4")):
             b = fits(arm, 1500)
             if b:
                 bank(run_step(

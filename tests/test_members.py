@@ -258,8 +258,8 @@ def test_members_speculative_decoding():
     verifies = {"n": 0}
     real = fast._verify_fn
 
-    def counting(g, history):
-        fn = real(g, history)
+    def counting(*args, **kwargs):
+        fn = real(*args, **kwargs)
 
         def wrapped(*a, **k):
             verifies["n"] += 1

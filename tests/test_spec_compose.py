@@ -1,0 +1,312 @@
+"""Spec decode that composes (ISSUE 10 acceptance): grammar-aware drafts,
+per-row gating, ring-resident verify.
+
+The invariant is unchanged — a draft token is accepted ONLY when it equals
+the token the model itself samples at that position, so speculation changes
+speed, never content. What is new here:
+
+- **grammar-aware speculation**: constrained (response_format) rows ride
+  verify dispatches through the dfa-verify variant — each position's logits
+  masked by its draft-prefix DFA state — pinned token-for-token against the
+  non-speculative constrained stream at K=4·C=4, greedy and sampled;
+- **per-row gating**: one penalized/logprobs row no longer turns
+  speculation off for the batch — it rides the same dispatch at draft
+  length 0 (one token per dispatch) while clean rows accept more;
+- **ring-resident verify**: verify dispatches enter the decode_pipeline=K
+  ring instead of draining it — pipelined drafts come from the optimistic
+  source-continuation cursor, and the dispatch-counter acceptance shows
+  sustained in-flight depth >= K-1 through pure spec traffic;
+- **containment**: a failed verify dispatch (faults site ``engine.verify``)
+  dooms only its own turn's rows; pending requests keep their place and
+  the engine keeps serving.
+"""
+
+import threading
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+import pytest
+
+from quorum_tpu.analysis import budget
+from quorum_tpu.constrain import compile_response_format
+from quorum_tpu.engine.engine import InferenceEngine
+from quorum_tpu.engine.tokenizer import ByteTokenizer
+from quorum_tpu.models.model_config import MODEL_PRESETS
+from quorum_tpu.ops.sampling import SamplerConfig
+
+pytestmark = pytest.mark.slow
+
+TINY = MODEL_PRESETS["llama-tiny"]
+TOK = ByteTokenizer(TINY.vocab_size)
+GREEDY = SamplerConfig(temperature=0.0)
+SCHEMA = {"type": "object", "properties": {
+    "ok": {"type": "boolean"},
+    "n": {"type": "integer"}}}
+
+
+def _grammar():
+    rf = {"type": "json_schema", "json_schema": {"schema": SCHEMA}}
+    return compile_response_format(rf, TOK, TINY.vocab_size)
+
+
+def _run_constrained(eng, grammar, *, temp, seed, max_new=48):
+    req = eng.submit(
+        TOK.encode("go"), max_new_tokens=max_new,
+        sampler=SamplerConfig(temperature=temp), seed=seed,
+        eos_id=TOK.eos_id, grammar=grammar)
+    return list(eng.stream_results(req))
+
+
+def _oracle(eng, ref):
+    """Install oracle drafting: propose the reference continuation."""
+    body = [t for t in ref if t != TOK.eos_id]
+    eng._draft = lambda req, g: (
+        body[req.emitted: req.emitted + g]
+        if req.emitted + g <= len(body) else None)
+
+
+def test_constrained_spec_pin_at_k4_c4_greedy_and_sampled():
+    """Acceptance pin (a): constrained + spec_decode vs non-speculative
+    constrained at decode_pipeline=4 · decode_loop=4, token for token,
+    greedy AND sampled — with drafts genuinely accepted (oracle)."""
+    plain = InferenceEngine(TINY, decode_chunk=4, decode_pipeline=4,
+                            decode_loop=4)
+    spec = InferenceEngine(TINY, decode_chunk=4, decode_pipeline=4,
+                           decode_loop=4, spec_decode=4)
+    try:
+        g = _grammar()
+        for temp, seed in ((0.0, 3), (0.8, 11)):
+            want = _run_constrained(plain, g, temp=temp, seed=seed)
+            _oracle(spec, want)
+            acc0 = spec.n_spec_accepted
+            got = _run_constrained(spec, g, temp=temp, seed=seed)
+            assert got == want, (
+                f"temp={temp}: constrained spec stream diverged")
+            assert spec.n_spec_accepted > acc0, (
+                f"temp={temp}: no draft accepted under the grammar")
+        fams = budget.decode_families(spec._decode_cache)
+        assert "dfa_verify" in fams, fams
+    finally:
+        plain.shutdown()
+        spec.shutdown()
+
+
+def test_mixed_batch_per_row_gating_pin():
+    """Acceptance pin (b): a mixed batch — clean + penalized + constrained
+    rows co-batched on one spec engine — matches the non-speculative
+    engine row for row, with the clean row accepting >1 token per
+    dispatch while the penalized row advances 1/dispatch (its draft
+    length is 0 by gating, not by batch exclusion)."""
+    grammar = _grammar()
+    sampler = SamplerConfig(temperature=0.8, top_p=0.9)
+
+    def jobs(eng):
+        def clean():
+            return eng.generate([7, 7, 7, 7, 7, 7], max_new_tokens=20,
+                                sampler=GREEDY, seed=0).token_ids
+
+        def penalized():
+            req = eng.submit([5, 6, 7, 5, 6, 7], max_new_tokens=20,
+                             sampler=sampler, seed=3,
+                             frequency_penalty=1.5)
+            return list(eng.stream_results(req))
+
+        def constrained():
+            return _run_constrained(eng, grammar, temp=0.8, seed=9,
+                                    max_new=20)
+
+        with ThreadPoolExecutor(max_workers=3) as ex:
+            fs = [ex.submit(f) for f in (clean, penalized, constrained)]
+            return [f.result() for f in fs]
+
+    plain = InferenceEngine(TINY, decode_chunk=4, decode_pipeline=4,
+                            n_slots=3)
+    want = jobs(plain)
+    plain.shutdown()
+
+    spec = InferenceEngine(TINY, decode_chunk=4, decode_pipeline=4,
+                           n_slots=3, spec_decode=4)
+    # Oracle for the clean row only: its draft is its own reference
+    # continuation, so it accepts while the penalized row (gated to draft
+    # length 0) rides the same dispatches one token at a time.
+    clean_ref = want[0]
+    real_draft = InferenceEngine._draft
+
+    def draft(req, g):
+        if req.hist[: 6] == [7, 7, 7, 7, 7, 7] and req.pp == 0.0 \
+                and req.fp == 0.0 and req.grammar is None:
+            return (clean_ref[req.emitted: req.emitted + g]
+                    if req.emitted + g <= len(clean_ref) else None)
+        return real_draft(req, g)
+
+    spec._draft = draft
+    got = jobs(spec)
+    m = spec.metrics()
+    spec.shutdown()
+    assert got == want, "mixed batch diverged from the non-speculative runs"
+    assert m["spec_turns_total"] > 0
+    # the clean row accepted >1 token on some dispatch while the penalized
+    # row rode along: accepted > 0 proves multi-token turns happened in a
+    # batch that CONTAINED ineligible rows (the old all-rows gate would
+    # have forced every dispatch to the chunked path).
+    assert m["spec_accepted_total"] > 0
+
+
+def test_logprobs_row_rides_spec_dispatches():
+    """A logprobs request on a spec engine (draft length 0) still gets one
+    lp record per token, equal to the non-speculative engine's within
+    float-reassociation tolerance, with tokens exact."""
+    def run(eng):
+        req = eng.submit([7, 7, 7, 7, 7], max_new_tokens=12,
+                         sampler=GREEDY, seed=0, logprobs=3)
+        toks = list(eng.stream_results(req))
+        return toks, [lp for lp, _, _ in req.lp]
+
+    plain = InferenceEngine(TINY, decode_chunk=4, n_slots=2)
+    want_t, want_lp = run(plain)
+    plain.shutdown()
+
+    spec = InferenceEngine(TINY, decode_chunk=4, n_slots=2, spec_decode=4)
+    # another clean row co-batches and drafts, forcing verify dispatches
+    def side():
+        spec.generate([9, 8, 9, 8, 9, 8, 9, 8], max_new_tokens=24,
+                      sampler=GREEDY, seed=1)
+
+    t = threading.Thread(target=side)
+    t.start()
+    got_t, got_lp = run(spec)
+    t.join()
+    m = spec.metrics()
+    spec.shutdown()
+    assert got_t == want_t
+    assert len(got_lp) == len(got_t)
+    np.testing.assert_allclose(got_lp, want_lp, atol=2e-3)
+    assert m["spec_turns_total"] >= 0  # speculation may or may not engage
+
+
+def test_pipelined_cursor_alignment_beyond_period_1():
+    """The optimistic cursor skips exactly ONE undrafted position per
+    pipelined turn — the bonus token; the next turn's first draft proposes
+    that turn's own first sample. On a period-6 source the pipelined
+    drafts must continue the periodic text exactly (an off-by-one here is
+    invisible on the period-1 bias streams but rejects position 0 of
+    every pipelined draft on real repetitive text)."""
+    from quorum_tpu.engine.engine import _Request
+
+    eng = InferenceEngine.__new__(InferenceEngine)  # only _form_draft
+    req = _Request([1, 2, 3, 4, 5, 6, 1, 2], 64, GREEDY, 0, None, None,
+                   None)
+    d = eng._form_draft(req, 4)  # fresh: continuation of pair (1,2)
+    assert d == [3, 4, 5, 6]
+    assert req.spec_state is not None
+    req.n_inflight = 1
+    # turn 1 optimistically emits d + bonus (1): the stream is
+    # ...5,6,1,2 | 3,4,5,6,1 — turn 2 then drafts [2,3,4,5], turn 3
+    # [1,2,3,4], each continuing the period-6 text.
+    assert eng._form_draft(req, 4) == [2, 3, 4, 5]
+    assert eng._form_draft(req, 4) == [1, 2, 3, 4]
+    assert eng._form_draft(req, 4) == [6, 1, 2, 3]
+
+
+def test_ring_stays_full_through_spec_traffic():
+    """Acceptance pin: verify turns no longer drain decode_pipeline=K.
+    A logit_bias-forced periodic stream (bias rows ARE draft-eligible)
+    keeps the prompt-lookup cursor drafting pipelined turns, and the
+    dispatch counters show sustained in-flight depth >= K-1."""
+    k = 4
+    eng = InferenceEngine(TINY, decode_chunk=4, decode_pipeline=k,
+                          n_slots=2, spec_decode=4)
+    depths = []
+    orig = InferenceEngine._reap_oldest
+
+    def probe(self):
+        depths.append(len(self._inflight))
+        return orig(self)
+
+    eng._reap_oldest = probe.__get__(eng)
+    bias = np.zeros((TINY.vocab_size,), np.float32)
+    bias[7] = 1e9  # greedy emits token 7 forever: period-1 repetition
+
+    def run():
+        req = eng.submit([7, 7, 7, 7], max_new_tokens=64, sampler=GREEDY,
+                         seed=0, logit_bias=bias)
+        return list(eng.stream_results(req))
+
+    run()  # warm every (depth, history-bucket) verify program
+    depths.clear()
+    t0, o0 = eng.n_spec_turns, eng.n_spec_overlapped
+    out = run()
+    m = eng.metrics()
+    eng.shutdown()
+    assert out == [7] * 64
+    turns = m["spec_turns_total"] - t0
+    overlapped = m["spec_overlapped_total"] - o0
+    assert turns > 4, m
+    # The dispatch-counter acceptance: most speculative dispatches were
+    # issued onto a NON-EMPTY ring (the pre-PR engine drained it for every
+    # verify turn, so this was structurally zero)...
+    assert overlapped >= turns // 2, (depths, turns, overlapped)
+    # ...and the ring genuinely reaches full depth K with only verify
+    # turns in it, holding >= K-1 in front of the blocking reap for a
+    # majority of steady-state turns (the tail drains as budgets end).
+    assert max(depths) >= k, depths
+    steady = depths[: -k] if len(depths) > k else depths
+    deep = sum(1 for d in steady if d >= k - 1)
+    assert deep / max(1, len(steady)) >= 0.5, (
+        f"ring not sustained through spec traffic: depths={depths}")
+
+
+def test_spec_engine_unchanged_paths_compile_preexisting_keys():
+    """A spec engine whose traffic never drafts dispatches the EXACT
+    pre-existing chunk program families — speculation must cost nothing
+    until a draft exists."""
+    eng = InferenceEngine(TINY, decode_chunk=4, decode_pipeline=2,
+                          spec_decode=4)
+    try:
+        # distinct non-repeating tokens: no 2-gram recurrence, no drafts
+        eng.generate(list(range(7, 27)), max_new_tokens=8, sampler=GREEDY)
+        fams = budget.decode_families(eng._decode_cache)
+        assert fams == {"plain"}, fams
+        # repetitive traffic then adds ONLY verify-family programs
+        eng.generate([9, 8, 9, 8, 9, 8, 9, 8], max_new_tokens=16,
+                     sampler=GREEDY)
+        fams = budget.decode_families(eng._decode_cache)
+        assert fams <= {"plain", "verify"}, fams
+    finally:
+        eng.shutdown()
+
+
+def test_verify_fault_dooms_only_its_turn():
+    """faults site ``engine.verify``: a failed speculative dispatch dooms
+    the rows of that turn only — pending requests keep their place, no
+    rebuild is counted, and the engine keeps serving."""
+    from quorum_tpu import faults
+
+    eng = InferenceEngine(TINY, decode_chunk=4, decode_pipeline=2,
+                          n_slots=1, spec_decode=4)
+    bias = np.zeros((TINY.vocab_size,), np.float32)
+    bias[7] = 1e9  # forced periodic stream: drafts form on every turn
+
+    def run():
+        req = eng.submit([7, 7, 7, 7], max_new_tokens=12, sampler=GREEDY,
+                         seed=0, logit_bias=bias)
+        return list(eng.stream_results(req))
+
+    try:
+        ref = run()
+        assert eng.n_spec_turns > 0  # the workload really speculates
+        rebuilds0 = eng.n_rebuilds
+        faults.arm("engine.verify", times=1)
+        try:
+            victim = eng.submit([7, 7, 7, 7], max_new_tokens=12,
+                                sampler=GREEDY, seed=0, logit_bias=bias)
+            with pytest.raises(faults.FaultInjected):
+                list(eng.stream_results(victim))
+        finally:
+            faults.disarm()
+        # the engine serves again immediately, identically, no rebuild
+        assert run() == ref
+        assert eng.n_rebuilds == rebuilds0, (
+            "a contained verify fault must not rebuild device state")
+    finally:
+        eng.shutdown()

@@ -103,8 +103,8 @@ def test_verification_accepts_correct_drafts():
     calls = {"n": 0}
     real = eng._verify_fn
 
-    def counting(g, history):
-        fn = real(g, history)
+    def counting(*args, **kwargs):
+        fn = real(*args, **kwargs)
 
         def wrapped(*a, **k):
             calls["n"] += 1
