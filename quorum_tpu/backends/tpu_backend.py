@@ -26,6 +26,21 @@ URL grammar:  ``tpu://<model-id>?<spec overrides>&<engine options>``
                    runtime is not group-placed); requires chunked prefill
                    (prefill_chunk >= 16). See docs/tpu_backends.md for the
                    interaction matrix
+  zero_drain=0|1   zero-drain continuous batching (default 0): the disagg
+                   admission split applied WITHIN one device group. Every
+                   admission prefills into a same-mesh staging cache on an
+                   independent dispatch chain and the new row's KV injects
+                   into its claimed slot at a reap boundary — the
+                   decode_pipeline=K × decode_loop=C ring keeps full depth
+                   through admission bursts instead of clamping to 1
+                   (quorum_tpu_admission_stall_seconds_total is
+                   structurally 0). Tokens are identical to the
+                   drain-based engine's for dense models (admissions ride
+                   the chunked register path). Structural (part of the
+                   engine cache key); requires chunked prefill
+                   (prefill_chunk >= 16); does not compose with disagg=
+                   (zero-drain is structural there). See
+                   docs/tpu_backends.md for the interaction matrix
   tp=, dp=, sp=    mesh shape (default: single device); sp>1 runs admission
   sp_impl=         sp>1 attention strategy: "ring" (default — O(S/sp)
                    memory, KV blocks ppermute the ICI ring) or "ulysses"
@@ -455,6 +470,18 @@ class TpuBackend:
         tp = int(opts.get("tp", 1))
         dp = int(opts.get("dp", 1))
         sp = int(opts.get("sp", 1))
+        zero_drain = _parse_bool_opt(
+            "zero_drain", opts.get("zero_drain", "0"))
+        if zero_drain and opts.get("disagg"):
+            # Checked at config time BEFORE the disagg mesh builds (the
+            # engine re-checks): the URL names two structural answers to
+            # the same problem — fail with the reason, never silently
+            # pick one.
+            raise ValueError(
+                "zero_drain=1 does not compose with disagg=P+D: "
+                "disaggregated admissions already run on their own device "
+                "group with the ring at full depth — zero-drain is "
+                "structural there (drop one knob)")
         prefill_mesh = None
         if opts.get("disagg"):
             from quorum_tpu.parallel.mesh import disagg_meshes, parse_disagg
@@ -484,6 +511,7 @@ class TpuBackend:
         eng_kw = dict(
             n_slots=n_slots,
             prefill_mesh=prefill_mesh,
+            zero_drain=zero_drain,
             decode_pipeline=int(
                 opts.get("decode_pipeline", DEFAULT_DECODE_PIPELINE)),
             decode_loop=int(opts.get("decode_loop", DEFAULT_DECODE_LOOP)),

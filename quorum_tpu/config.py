@@ -20,7 +20,10 @@ decode kernel, ``slots=``,
 ``quant=``, ``prefix_store=host``/``prefix_store_bytes=``/
 ``prefix_store_chunk=`` for the tiered host KV prefix store,
 ``disagg=P+D`` for disaggregated prefill/decode device groups with
-device→device KV handoff, ``spec_decode=G``/``spec_model=``/``spec_ckpt=``
+device→device KV handoff, ``zero_drain=0|1`` for zero-drain continuous
+batching on colocated engines (staged in-flight row injection — admission
+bursts never clamp the decode ring),
+``spec_decode=G``/``spec_model=``/``spec_ckpt=``
 for speculative decoding — ring-resident, row-wise gated, and composing
 with ``response_format`` grammars since ISSUE 10 — … the full grammar is
 the docstring of

@@ -879,6 +879,10 @@ _GUARDED_BY = {
     "_inflight": {"owner": ["_fill_inflight", "_try_spec_dispatch",
                             "_reap_oldest", "_drain_inflight",
                             "_fail_all"]},
+    # single-owner: the admission-clamp stall window (scheduler thread's
+    # ring-fill turn — quorum_tpu_admission_stall_seconds_total)
+    "_clamp_t0": {"owner": ["_note_admission_clamp"]},
+    "admission_stall_s": {"owner": ["_note_admission_clamp"]},
 }
 
 
@@ -921,6 +925,7 @@ class InferenceEngine:
         sp_impl: str = "ring",
         prefill_mesh: Mesh | None = None,
         transfer_guard: str | None = None,
+        zero_drain: bool = False,
     ):
         self.spec = spec.validate()
         self.mesh = mesh or single_device_mesh()
@@ -1104,6 +1109,39 @@ class InferenceEngine:
                     "silently widen the receptive field); use "
                     "sp_impl=ulysses, whose full-sequence local attention "
                     "applies windows unchanged")
+        # Zero-drain continuous batching (tpu://…&zero_drain=1): the
+        # disagg admission split applied WITHIN one device group. Every
+        # admission prefills into a staging cache (same mesh, same
+        # slot-batched layout) whose dispatch chain is independent of the
+        # decode state, then the staged KV is injected into the claimed
+        # slot (the disagg hslice/hput programs, no cross-group transfer)
+        # and the row registers at the next reap boundary — so
+        # _admission_pressure is structurally False and the
+        # decode_pipeline=K × decode_loop=C ring keeps its full depth
+        # through any admission burst. The tradeoff mirrors disagg's:
+        # admission TTFT now shares device time with resident megachunks
+        # instead of clamping them to K=1/C=1 (docs/tpu_backends.md).
+        self.zero_drain = bool(zero_drain)
+        if self.zero_drain:
+            if self.disagg:
+                raise ValueError(
+                    "zero_drain=1 does not compose with disagg=P+D: "
+                    "disaggregated admissions already run on their own "
+                    "device group with the ring at full depth — zero-drain "
+                    "is structural there (drop one knob)")
+            if self.prefill_chunk <= 0:
+                raise ValueError(
+                    "zero_drain requires chunked prefill (prefill_chunk >= "
+                    "16 after power-of-two alignment): admissions prefill "
+                    "into the staging cache segment by segment and inject "
+                    "at a reap boundary — the single-shot admit program "
+                    "blocks the host on its first-token fetch, which "
+                    "behind a full dispatch ring is exactly the stall "
+                    "zero_drain exists to remove")
+        # Staged admissions (disagg OR zero_drain): every admission rides
+        # the chunked path into the staging cache and reaches its decode
+        # slot through the handoff/injection queue + register.
+        self.staged = self.disagg or self.zero_drain
         if self.ensemble > 1:
             if self._use_sp:
                 raise ValueError(
@@ -1135,8 +1173,13 @@ class InferenceEngine:
         # (host→prefill staging) is the cross-admission tier instead, and
         # outputs stay token-for-token identical either way (reuse only
         # skips recompute of identical KV).
+        # (Also disabled under zero_drain, for the same structural reason:
+        # the resident KV lives in the decode cache, where the staging
+        # segments cannot attend over it. Outputs are identical either way
+        # — reuse only skips recompute — and the prefix STORE remains the
+        # cross-admission tier, restored into staging.)
         self.prefix_cache = (bool(prefix_cache) and self.prefill_chunk > 0
-                             and not self.disagg)
+                             and not self.staged)
         # Tiered KV prefix store (quorum_tpu/cache/prefix_store.py,
         # docs/prefix_cache.md): a host-RAM cache tier behind the
         # slot-resident prefix cache. On slot release the valid KV prefix is
@@ -1211,9 +1254,12 @@ class InferenceEngine:
         # spans both meshes) and a staging KV cache the admission segments
         # write into before the handoff. Same seeds, same init programs →
         # identical weights on both groups.
+        # Zero-drain engines stage on the SAME device group: the segment
+        # programs run the one resident weight copy (prefill_params is an
+        # alias, not a second allocation).
         self.prefill_params = (
             self._build_params(self.prefill_mesh, params, seed)
-            if self.disagg else None)
+            if self.disagg else (self.params if self.zero_drain else None))
         self._cache_sh = self._cache_sharding(self.mesh)
         self._rep = NamedSharding(self.mesh, P())
         # Cached jit wrappers for the rebuild-path utility programs (the
@@ -1221,8 +1267,12 @@ class InferenceEngine:
         # would recompile them (qlint: recompile/jit-immediate-call).
         self._util_fns: dict = {}
         self._init_device_state()
-        if self.disagg:
-            self._stage_sh = self._cache_sharding(self.prefill_mesh)
+        if self.staged:
+            # Disagg: the staging cache lives on the prefill mesh. Zero-
+            # drain: same slot-batched layout on the decode mesh itself —
+            # reusing _cache_sh keeps one compiled zero-fill program.
+            self._stage_sh = (self._cache_sharding(self.prefill_mesh)
+                              if self.disagg else self._cache_sh)
             self._init_stage_state()
         # Handoff queue between the two scheduler loops (disagg): the
         # prefill loop appends transferred KV pieces (already resident on
@@ -1232,6 +1282,18 @@ class InferenceEngine:
         self.n_kv_handoffs = 0
         self.kv_handoff_bytes = 0
         self.kv_handoff_s = 0.0
+        # Zero-drain acceptance accounting. n_admission_overlap counts
+        # injected admissions that registered onto a NON-EMPTY dispatch
+        # ring (structurally 0 before this PR: colocated admissions
+        # clamped the ring to depth 1 and drained it first).
+        # admission_stall_s accumulates wall time the ring spent clamped
+        # to K=1/C=1 for an admission (structurally 0 under zero_drain and
+        # disagg — pressure never clamps there); _clamp_t0 is the
+        # in-progress clamp window's last observation stamp, owned by the
+        # scheduler thread (_note_admission_clamp).
+        self.n_admission_overlap = 0
+        self.admission_stall_s = 0.0
+        self._clamp_t0: "float | None" = None
 
         self._admit_cache: dict[int, object] = {}   # bucket → compiled admit
         self._decode_cache: dict[int, object] = {}  # n_steps → compiled chunk
@@ -2030,11 +2092,18 @@ class InferenceEngine:
             payload, start, b, upto = disp
             faults.fire("engine.kv_handoff")
             t0 = time.perf_counter()
-            moved, n_bytes, dt, route = kv_transfer.transfer(
-                payload, self._rep)
-            self.n_kv_handoffs += 1
-            self.kv_handoff_bytes += n_bytes
-            self.kv_handoff_s += dt
+            if self.zero_drain:
+                # Same device group: the sliced chunk is already resident
+                # on the decode mesh — no transfer, no handoff bytes. The
+                # queued piece is a pure data dependency the injection
+                # write consumes at the next reap boundary.
+                moved, n_bytes, route = payload, 0, "resident"
+            else:
+                moved, n_bytes, dt, route = kv_transfer.transfer(
+                    payload, self._rep)
+                self.n_kv_handoffs += 1
+                self.kv_handoff_bytes += n_bytes
+                self.kv_handoff_s += dt
             if adm.req.trace is not None:
                 adm.req.trace.add_span_abs(
                     "kv-handoff", t0, time.perf_counter(), tokens=b,
@@ -2095,17 +2164,31 @@ class InferenceEngine:
                     self.n_constrained += 1
                 with self._cond:
                     self._resident[adm.slot] = list(req.prompt_ids)
+                    live = any(r is not None for r in self._slots)
+                if self._inflight or live:
+                    # The injected row registers onto a LIVE ring — other
+                    # rows' dispatches in flight, or resident rows decoding
+                    # at full depth (on a fast device the ring can be
+                    # momentarily drained-by-completion at the reap
+                    # boundary; those admissions still never clamped it).
+                    # The zero-drain acceptance counter: structurally 0 on
+                    # drain-based colocated engines, whose admissions
+                    # never ride the injection queue at all.
+                    self.n_admission_overlap += 1
+                    obs.ADMISSION_OVERLAP.inc()
                 self._finish_admission(adm)
             except Exception as e:
                 adm.dead = True
                 self._contain_admission_failure([req], e, admissions=[adm])
 
-    def _admit_disagg(self, req: _Request, slot: int) -> None:
-        """Claim the decode-group slot and start the admission on the
-        prefill group. Every disagg admission rides the chunked path; a
+    def _admit_staged(self, req: _Request, slot: int) -> None:
+        """Claim the decode slot and start the admission against the
+        staging cache (disagg: on the prefill group; zero_drain: on the
+        same group, but on an independent dispatch chain the decode ring
+        never blocks on). Every staged admission rides the chunked path; a
         host prefix-store match restores into the STAGING slot first (the
         tail segments attend over it there) and reaches the decode slot
-        through the ordinary handoff."""
+        through the ordinary handoff/injection queue."""
         offset = 0
         try:
             # Inside containment: the request is already popped from
@@ -2113,6 +2196,7 @@ class InferenceEngine:
             # here (host-RAM pressure in the store concatenate, say) would
             # slip past the outer catch's admitting sweep and leave the
             # consumer blocked forever.
+            faults.fire("engine.admit")
             restore = self._store_lookup(req.prompt_ids, 0)
         except Exception as e:
             self._contain_prefill_failure([req], e)
@@ -3280,6 +3364,14 @@ class InferenceEngine:
                 "kv_handoffs_total": self.n_kv_handoffs,
                 "kv_handoff_bytes_total": self.kv_handoff_bytes,
                 "kv_handoff_seconds_total": round(self.kv_handoff_s, 6),
+                # Zero-drain continuous batching (tpu://…&zero_drain=1):
+                # staged-injection admissions that registered onto a
+                # non-empty ring, and wall time the ring spent clamped to
+                # depth 1 for admissions (structurally 0 with zero_drain).
+                "zero_drain": 1 if self.zero_drain else 0,
+                "admission_overlap_total": self.n_admission_overlap,
+                "admission_stall_seconds_total": round(
+                    self.admission_stall_s, 6),
                 "rebuilds_total": self.n_rebuilds,
                 "deadline_exceeded_total": self.n_deadline_exceeded,
                 "breaker_state": self.breaker.state_code,
@@ -3349,7 +3441,7 @@ class InferenceEngine:
             return
         self.params = None
         self._ck = self._cv = None
-        if self.disagg:
+        if self.staged:
             self.prefill_params = None
             self._sck = self._scv = None
             # Both loops have exited (checked above), but the guarded-by
@@ -3405,6 +3497,13 @@ class InferenceEngine:
                 else:
                     self._start_admissions()
                     self._step_admissions()
+                    if self.zero_drain:
+                        # Reap-boundary injection: staged pieces write into
+                        # their claimed slots (chained behind the in-flight
+                        # ring, never draining it) and fully-staged
+                        # admissions register — the row joins the batch at
+                        # the very next ring fill.
+                        self._drain_handoffs()
                 if any(self._slots) or self._inflight:
                     self._run_chunk()
             except Exception as e:  # fail open: wake every waiting consumer
@@ -3506,7 +3605,7 @@ class InferenceEngine:
         placed in the device arena here, before the admission starts.
 
         Under disagg this runs on the PREFILL thread: every admission is
-        chunked into the staging cache (``_admit_disagg``), and the
+        chunked into the staging cache (``_admit_staged``), and the
         decode-side state work (DFA resets, arena, snapshots, grammar
         placement) moves to the decode loop."""
         if not self.disagg:
@@ -3529,8 +3628,8 @@ class InferenceEngine:
                 req.out.put(("end", None))
                 continue
             self._note_admitted(req)
-            if self.disagg:
-                self._admit_disagg(req, slot)
+            if self.staged:
+                self._admit_staged(req, slot)
                 continue
             if req.grammar is not None:
                 try:
@@ -3653,7 +3752,7 @@ class InferenceEngine:
                     if slot is None:
                         continue
                     reuse = self._reuse_len(lcp, len(r.prompt_ids))
-                    if reuse or r.grammar is not None or self.disagg or (
+                    if reuse or r.grammar is not None or self.staged or (
                             self.prefill_chunk
                             and len(r.prompt_ids) > self.prefill_chunk):
                         if reuse:
@@ -3688,9 +3787,10 @@ class InferenceEngine:
                         self._pending.remove(r)
             if (admit_chunked is not None
                     and admit_chunked.req.grammar is not None
-                    and not self.disagg):
-                # (Under disagg grammar placement is decode-group state —
-                # the decode loop places it at register time instead.)
+                    and not self.staged):
+                # (Under disagg/zero_drain grammar placement is decode-
+                # side state — placed at register time in _drain_handoffs
+                # instead.)
                 # Arena placement outside _cond (a grammar's first table
                 # upload must not run under the scheduler lock); the
                 # admission's register turn — the only reader of g_start —
@@ -3840,7 +3940,7 @@ class InferenceEngine:
                 self._release_admission(adm)
                 continue
             if adm.final_sent:
-                continue  # disagg: staged; awaiting decode-group register
+                continue  # staged (disagg/zero_drain); awaiting register
             seg = req.prompt_ids[adm.offset: adm.offset + self.prefill_chunk]
             bucket = prefill_bucket(len(seg), self.prefill_chunk)
             history = prefill_bucket(adm.offset + len(seg), self.spec.max_seq)
@@ -3859,7 +3959,7 @@ class InferenceEngine:
                 try:
                     self._run_member_segments(batch, bucket, history)
                 except Exception as e:
-                    if self.disagg:
+                    if self.staged:
                         self._contain_prefill_failure(
                             [adm.req for adm in batch.values()], e,
                             admissions=list(batch.values()))
@@ -3885,7 +3985,7 @@ class InferenceEngine:
             n_valids[m] = len(seg)
             slots[m] = adm.slot % n_s
             enables[m] = True
-        if self.disagg:
+        if self.staged:
             faults.fire("engine.prefill_segment")
             # Same overlap discipline as the single-engine path: slices of
             # the completed rows dispatch BEFORE the member-vmapped segment
@@ -3991,20 +4091,23 @@ class InferenceEngine:
                 self._release_admission(adm)
                 continue
             if adm.final_sent:
-                continue  # fully staged; awaiting the decode-group register
+                continue  # fully staged; awaiting the register
             prompt = req.prompt_ids
             seg = prompt[adm.offset : adm.offset + self.prefill_chunk]
             bucket = prefill_bucket(len(seg), self.prefill_chunk)
             history = prefill_bucket(adm.offset + len(seg), self.spec.max_seq)
             tokens = np.zeros((1, bucket), np.int32)
             tokens[0, : len(seg)] = seg
-            if self.disagg:
+            if self.staged:
                 try:
                     faults.fire("engine.prefill_segment")
                     # Overlap: slice the already-complete rows off the
                     # PRE-segment staging buffers, dispatch the next
                     # segment, then transfer — handoff of chunk i runs
-                    # while the prefill group computes chunk i+1.
+                    # while the prefill group computes chunk i+1. (Under
+                    # zero_drain there is no transfer; the slice payload
+                    # is already resident and the overlap is with the
+                    # decode ring's own megachunks instead.)
                     disp = self._handoff_dispatch(adm, adm.offset)
                     self._sck, self._scv = self._seg_fn(bucket, history)(
                         self.prefill_params, tokens, np.int32(adm.offset),
@@ -4158,11 +4261,14 @@ class InferenceEngine:
             self._expire(r, "queue")
         for a in late_adm:
             self._expire(a.req, "prefill")
-            if self.disagg:
-                # The PREFILL thread owns this admission's staging rows; a
-                # release here could re-issue the slot claim under a
-                # segment still being dispatched. _expire set cancel — the
-                # prefill loop's own cancel branch releases it cleanly.
+            if self.staged:
+                # The staging path owns this admission's rows (disagg: the
+                # PREFILL thread; zero_drain: this same scheduler's next
+                # _step_admissions/_drain_handoffs turn); a release here
+                # could re-issue the slot claim with injection pieces
+                # still queued. _expire set cancel — the staged path's own
+                # cancel branch retires it dead-marked, so stale pieces
+                # are dropped instead of written into a new tenant.
                 with self._cond:
                     self._cond.notify_all()
             else:
@@ -4234,6 +4340,9 @@ class InferenceEngine:
     def _run_chunk_steps(self) -> None:
         self._sweep_cancelled()
         if not self._active_rows():
+            # No rows to clamp: discard any dangling clamp stamp so the
+            # idle gap until the next admission never reads as stall.
+            self._note_admission_clamp(False)
             self._drain_inflight()
             return
         # Depth-K pipelined decode: top the ring up (speculative verify
@@ -4264,8 +4373,16 @@ class InferenceEngine:
         the decode ring keeps its full depth (and full megachunk fusion)
         through any admission burst — the whole point of the split. Handoff
         writes/registers chain behind the in-flight ring without draining
-        it."""
-        if self.disagg:
+        it.
+
+        NEVER under zero_drain either: that is the knob's whole contract.
+        Admission segments run against the staging cache (an independent
+        dispatch chain — they never extend the decode-state chain the ring
+        blocks on), and the injection write + register are the same small
+        chained programs a disagg handoff ends in, landing at a reap
+        boundary. The structural C=1/K=1 coupling this predicate used to
+        impose on colocated engines is retired behind the knob."""
+        if self.disagg or self.zero_drain:
             return False
         if self._admitting:
             return True
@@ -4283,11 +4400,34 @@ class InferenceEngine:
         """How deep the ring may run right now. Admission pressure caps it
         at 1 (dispatch-then-drain): every extra in-flight chunk would
         delay the admission by a whole chunk on device (its programs
-        chain behind the ring)."""
+        chain behind the ring). Under zero_drain/disagg pressure is
+        structurally False and the ring keeps its configured depth."""
         with self._cond:
-            if self._stop or self._admission_pressure():
-                return 1
-            return self.decode_pipeline
+            clamped = not self._stop and self._admission_pressure()
+        self._note_admission_clamp(clamped)
+        if clamped or self._stop:
+            return 1
+        return self.decode_pipeline
+
+    def _note_admission_clamp(self, clamped: bool) -> None:
+        """Accumulate wall time the decode ring spends clamped to depth 1
+        for an admission (quorum_tpu_admission_stall_seconds_total) —
+        observed once per ring-fill turn on the scheduler thread (the
+        field's single owner). Only the span between CONSECUTIVE clamped
+        observations counts: a dangling stamp is discarded when the clamp
+        lifts or the ring goes idle, so an idle gap can never read as
+        stall (slightly under-counts the clamp's last turn; never over).
+        Engines whose ring cannot clamp (K=1 and C=1 — depth 1 IS the
+        configuration) record nothing; zero_drain/disagg engines record
+        nothing structurally (pressure is always False there)."""
+        if self.decode_pipeline <= 1 and self.decode_loop <= 1:
+            return
+        now = time.monotonic()
+        if clamped and self._clamp_t0 is not None:
+            dt = now - self._clamp_t0
+            self.admission_stall_s += dt
+            obs.ADMISSION_STALL_SECONDS.inc(dt)
+        self._clamp_t0 = now if clamped else None
 
     def _effective_loop(self, active, n_steps: int, ahead: int) -> int:
         """Chunks THIS dispatch may fuse (1..decode_loop), clamped so the
@@ -5048,6 +5188,13 @@ class InferenceEngine:
         # cache the shutdown exists to release.
         if not self._stop:
             self._init_device_state()
+            if self.zero_drain and not self._stage_state_ok():
+                # The zero-drain staging cache shares this scheduler's
+                # turn: a failure that consumed it must not leave the next
+                # admission's segments dispatching into deleted arrays.
+                # (Disagg staging belongs to the prefill loop and rebuilds
+                # through _contain_prefill_failure instead.)
+                self._init_stage_state()
 
 
 # ---- engine sharing -------------------------------------------------------
@@ -5130,6 +5277,7 @@ def get_engine(
     draft_ckpt: str | None = None,
     sp_impl: str = "ring",
     prefill_mesh: Mesh | None = None,
+    zero_drain: bool = False,
 ) -> InferenceEngine:
     """Engines are keyed by weight identity (spec, seed, mesh, quant,
     ensemble, members, draft model) plus the cache representation (kv_quant)
@@ -5168,7 +5316,12 @@ def get_engine(
            # weight copy + staging cache, so colocated and disaggregated
            # URLs must never share one engine.
            tuple(map(str, prefill_mesh.devices.flat))
-           if prefill_mesh is not None else None)
+           if prefill_mesh is not None else None,
+           # zero_drain is structural too: the staging cache + staged
+           # admission routing exist (or not) at construction, and a
+           # drain-based URL must never silently serve zero-drain (or
+           # vice versa — the cache-key pin tests depend on it).
+           bool(zero_drain))
     with _ENGINES_LOCK:
         eng = _ENGINES.get(key)
         if eng is None:
@@ -5189,7 +5342,7 @@ def get_engine(
                 members=members, kv_quant=kv_quant,
                 draft_spec=draft_spec, draft_seed=draft_seed,
                 draft_params=draft_params, sp_impl=sp_impl,
-                prefill_mesh=prefill_mesh,
+                prefill_mesh=prefill_mesh, zero_drain=zero_drain,
             )
             _ENGINES[key] = eng
         else:
@@ -5221,6 +5374,7 @@ def get_engine_from_ckpt(
     draft_ckpt: str | None = None,
     sp_impl: str = "ring",
     prefill_mesh: Mesh | None = None,
+    zero_drain: bool = False,
 ) -> InferenceEngine:
     """Engine over a local HF checkpoint; keyed by (resolved path, mesh,
     draft checkpoint) so N backends pointing at one checkpoint with the
@@ -5252,7 +5406,8 @@ def get_engine_from_ckpt(
            tuple(sorted(mesh.shape.items())),
            tuple(map(str, mesh.devices.flat)),
            tuple(map(str, prefill_mesh.devices.flat))
-           if prefill_mesh is not None else None)
+           if prefill_mesh is not None else None,
+           bool(zero_drain))
     with _ENGINES_LOCK:
         eng = _ENGINES.get(key)
         if eng is None:
@@ -5277,6 +5432,7 @@ def get_engine_from_ckpt(
                 kv_quant=kv_quant,
                 draft_spec=draft_spec, draft_params=draft_params,
                 sp_impl=sp_impl, prefill_mesh=prefill_mesh,
+                zero_drain=zero_drain,
             )
             _ENGINES[key] = eng
         else:

@@ -366,6 +366,25 @@ DECODE_GROUP_ACTIVE = METRICS.gauge(
     "Busy decode-group slots right now (last-writer-wins across engines "
     "sharing the process).")
 
+# Zero-drain continuous batching (tpu://…&zero_drain=1 — docs/
+# tpu_backends.md): staged in-flight row injection on colocated engines.
+# Admissions prefill into a same-mesh staging cache and the new row's KV
+# injects into its claimed slot at a reap boundary while the
+# decode_pipeline=K × decode_loop=C ring holds the other rows' in-flight
+# state — the structural admission-pressure clamp (C=1/K=1) is retired.
+ADMISSION_OVERLAP = METRICS.counter(
+    "quorum_tpu_admission_overlap_total",
+    "Staged-injection admissions that registered onto a live ring "
+    "(in-flight dispatches or active resident rows the admission never "
+    "drained or clamped). Structurally 0 on drain-based colocated "
+    "engines, whose admissions never ride the injection queue.")
+ADMISSION_STALL_SECONDS = METRICS.counter(
+    "quorum_tpu_admission_stall_seconds_total",
+    "Wall time the decode dispatch ring spent clamped to depth 1 for an "
+    "admission (the drain-based coupling). Structurally 0 under "
+    "zero_drain=1 and under disagg=P+D, where admission pressure never "
+    "clamps the ring.")
+
 # Tiered KV prefix store (quorum_tpu/cache/prefix_store.py + the engine's
 # snapshot/restore hooks, docs/prefix_cache.md): host-RAM retention of
 # decoded KV prefixes beyond the resident slots. Process-wide families —

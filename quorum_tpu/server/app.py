@@ -310,7 +310,7 @@ def create_app(
                   "prefix_store_bytes", "prefix_store_entries",
                   "disagg", "prefill_group_devices", "decode_group_devices",
                   "prefill_group_active", "decode_group_active",
-                  "breaker_state")
+                  "zero_drain", "breaker_state")
         # One snapshot per distinct engine (_distinct_engines). Each
         # family's TYPE line appears exactly once, with all its samples
         # grouped — the Prometheus text format rejects repeated TYPE lines.

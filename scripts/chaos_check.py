@@ -362,6 +362,78 @@ async def _run(quick: bool) -> None:
             check("verify: follow-up matches baseline", srun() == sbase)
             seng.shutdown()
 
+        # ---- phase 4d: zero-drain injection-path faults ------------------
+        # A colocated zero_drain=1 engine (ISSUE 11): an engine.admit or
+        # engine.prefill_segment failure while the decode ring is full
+        # dooms ONLY the injecting request — never an in-flight megachunk
+        # or the queued bystander, with no device-state rebuild (staging
+        # is the blast-radius boundary, exactly like a disagg prefill
+        # fault) and zero admission stall throughout (the ring never
+        # clamps for an admission under zero_drain).
+        if not quick:
+            print("phase 4d: zero-drain injection", flush=True)
+            from quorum_tpu.engine.engine import InferenceEngine
+            from quorum_tpu.models.model_config import resolve_spec
+            from quorum_tpu.ops.sampling import SamplerConfig
+
+            tiny = resolve_spec("llama-tiny", {"n_kv_heads": "4"})
+            zeng = InferenceEngine(
+                tiny, decode_chunk=4, n_slots=2, decode_pipeline=4,
+                decode_loop=2, prefill_chunk=16, zero_drain=True, seed=81)
+            samp = SamplerConfig(temperature=0.0)
+            zbase = zeng.generate([3, 4, 5], max_new_tokens=6,
+                                  sampler=samp).token_ids
+            long_ids = [(7 + 3 * i) % tiny.vocab_size for i in range(40)]
+            zeng.generate(long_ids, max_new_tokens=2, sampler=samp)
+            for site in ("engine.admit", "engine.prefill_segment"):
+                # Budget past the ring's K*C*chunk capacity: a stream
+                # that fits one ring fill would finish before the
+                # injection faults even land.
+                streamer = zeng.submit([9, 8, 7], max_new_tokens=48,
+                                       sampler=samp)
+                stream_it = zeng.stream_results(streamer)
+                # The streamer must be decoding (its own injection done)
+                # before the fault arms — times=1 must hit the victim.
+                stream_toks = [next(stream_it)]
+                faults.reset_counts()
+                faults.arm(site, times=1)
+                bad = zeng.submit(long_ids, max_new_tokens=6, sampler=samp)
+                bystander = zeng.submit([3, 4, 5], max_new_tokens=6,
+                                        sampler=samp)
+                err = None
+                try:
+                    list(zeng.stream_results(bad))
+                except Exception as e:
+                    err = e
+                by_toks = list(zeng.stream_results(bystander))
+                stream_toks += list(stream_it)
+                faults.disarm()
+                check(f"zero-drain {site}: fault fired",
+                      faults.fired(site) >= 1)
+                check(f"zero-drain {site}: dooms only the injecting "
+                      "request", isinstance(err, faults.FaultInjected),
+                      repr(err))
+                check(f"zero-drain {site}: queued bystander completes "
+                      "unchanged", by_toks == zbase,
+                      f"{by_toks} != {zbase}")
+                check(f"zero-drain {site}: concurrent stream unaffected",
+                      len(stream_toks) == 48, f"len={len(stream_toks)}")
+                check(f"zero-drain {site}: no device-state rebuild",
+                      zeng.n_rebuilds == 0, f"rebuilds={zeng.n_rebuilds}")
+            follow = zeng.generate([3, 4, 5], max_new_tokens=6,
+                                   sampler=samp).token_ids
+            check("zero-drain: follow-up matches baseline",
+                  follow == zbase)
+            check("zero-drain: ring never clamped for admission",
+                  zeng.admission_stall_s == 0.0,
+                  f"stall={zeng.admission_stall_s}")
+            check("zero-drain: injections overlapped live work",
+                  zeng.n_admission_overlap >= 1,
+                  f"overlap={zeng.n_admission_overlap}")
+            check("zero-drain: scheduler alive",
+                  zeng.health()["scheduler_alive"])
+            zeng.shutdown()
+
         # ---- phase 5: HTTP backend retry ladder --------------------------
         print("phase 5: http retry", flush=True)
         from quorum_tpu.backends.http_backend import HttpBackend

@@ -203,14 +203,19 @@ def spec(tokens: int = 64, chunk: int = 4, depth: int = 4,
 def interference(tokens: int = 64, chunk: int = 4, depth: int = 4,
                  loop: int = 4, churn: int = 4,
                  churn_prompt_tokens: int = 48) -> dict:
-    """Streaming inter-token gaps under concurrent admission churn,
-    colocated vs ``disagg=1+1``: one long greedy stream's token-arrival
-    gaps (ms percentiles over the per-chunk reap gaps) while ``churn``
-    chunked admissions (prompts of ``churn_prompt_tokens`` ≫
-    prefill_chunk) are submitted back to back. The acceptance number is
-    the p99 gap: colocated admissions clamp the ring to depth 1 and
-    interleave prefill segments between decode chunks; the disagg leg's
-    prefill runs on its own device group."""
+    """Streaming inter-token gaps under concurrent admission churn, three
+    arms: colocated (drain-based), colocated + ``zero_drain=1`` (staged
+    in-flight row injection, ISSUE 11), and ``disagg=1+1``. One long
+    greedy stream's token-arrival gaps (ms percentiles over the per-chunk
+    reap gaps) while ``churn`` chunked admissions (prompts of
+    ``churn_prompt_tokens`` ≫ prefill_chunk) are submitted back to back.
+    The acceptance number is the p99 gap: drain-based colocated
+    admissions clamp the ring to depth 1 and interleave prefill segments
+    between decode chunks; the zero-drain arm keeps the ring at full
+    K×C depth and injects at reap boundaries (admission stall
+    structurally 0); the disagg arm's prefill runs on its own device
+    group entirely. The gate (ISSUE 11): zero-drain p99 within ~2× of
+    disagg's, all three streams token-for-token identical."""
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     os.environ.pop("PALLAS_AXON_POOL_IPS", None)
 
@@ -228,37 +233,64 @@ def interference(tokens: int = 64, chunk: int = 4, depth: int = 4,
     spec = MODEL_PRESETS["llama-tiny"]
     greedy = SamplerConfig(temperature=0.0)
     stream_prompt = [5, 6, 7]
-    churn_prompt = [(11 + 3 * i) % spec.vocab_size
-                    for i in range(churn_prompt_tokens)]
+
+    def churn_ids(i: int) -> list[int]:
+        # DISTINCT prompt per churn admission: a repeated prompt is
+        # slot-resident after its first admission, so the colocated arm
+        # would tier-0-reuse all but one segment of every later churn
+        # admission (the staged arms cannot reuse) — the arms would stop
+        # measuring the same admission work.
+        return [(11 + 3 * j + 5 * i) % spec.vocab_size
+                for j in range(churn_prompt_tokens)]
+
+    # The measured stream must OUTLIVE the dispatch ring: a budget within
+    # K×C×chunk tokens fits entirely in one ring fill, finishing before
+    # any churn admission can interfere — the phase would measure nothing.
+    tokens = max(tokens, 2 * depth * loop * chunk)
     out: dict = {"tokens": tokens, "churn_admissions": churn,
                  "churn_prompt_tokens": churn_prompt_tokens}
     streams: dict[str, list[int]] = {}
 
-    for tag, disagg in (("colocated", False), ("disagg", True)):
+    for tag in ("colocated", "zero_drain", "disagg"):
         kw = dict(decode_chunk=chunk, decode_pipeline=depth,
                   decode_loop=loop, n_slots=2, prefill_chunk=16)
-        if disagg:
+        if tag == "disagg":
             pm, dm = disagg_meshes(1, 1)
             eng = InferenceEngine(spec, dm, prefill_mesh=pm, **kw)
+        elif tag == "zero_drain":
+            eng = InferenceEngine(spec, zero_drain=True, **kw)
         else:
             eng = InferenceEngine(spec, **kw)
         # Warm every program the measured pass dispatches (stream decode
         # buckets, churn segment/handoff buckets): first-use XLA compiles
-        # would otherwise dominate the gap percentiles.
-        eng.generate(stream_prompt, max_new_tokens=tokens, sampler=greedy)
-        eng.generate(churn_prompt, max_new_tokens=2, sampler=greedy)
+        # would otherwise dominate the gap percentiles. The churn runs
+        # CONCURRENTLY with the warmup stream so the drain-based arm also
+        # compiles its clamped (C=1, deep-history) decode variants — the
+        # admission-pressure window is exactly what the measured pass
+        # spends its time in there.
+        warm = eng.submit(stream_prompt, max_new_tokens=tokens,
+                          sampler=greedy, seed=0)
+        eng.generate(churn_ids(0), max_new_tokens=2, sampler=greedy)
+        list(eng.stream_results(warm))
 
         req = eng.submit(stream_prompt, max_new_tokens=tokens,
                          sampler=greedy, seed=0)
+        # One churn admission enqueued BEFORE the stream is consumed (same
+        # in every arm): a fused K×C stream can finish in a handful of
+        # dispatches, and a churner thread that loses the startup race
+        # would leave the admission-interference window unexercised. This
+        # one is guaranteed to admit while the stream decodes.
+        pre = eng.submit(churn_ids(1), max_new_tokens=2, sampler=greedy)
         stamps: list[float] = []
         toks: list[int] = []
         done = threading.Event()
-        n_churned = 0
+        n_churned = 1
 
         def churn_loop():
             nonlocal n_churned
             while not done.is_set() and n_churned < churn * 4:
-                eng.generate(churn_prompt, max_new_tokens=2, sampler=greedy)
+                eng.generate(churn_ids(1 + n_churned), max_new_tokens=2,
+                             sampler=greedy)
                 n_churned += 1
 
         churner = threading.Thread(target=churn_loop, daemon=True)
@@ -266,6 +298,7 @@ def interference(tokens: int = 64, chunk: int = 4, depth: int = 4,
         for t in eng.stream_results(req):
             toks.append(t)
             stamps.append(time.perf_counter())
+        list(eng.stream_results(pre))
         done.set()
         churner.join()
         streams[tag] = toks
@@ -285,19 +318,35 @@ def interference(tokens: int = 64, chunk: int = 4, depth: int = 4,
         out[f"{tag}_intertoken_p95_ms"] = pct(95)
         out[f"{tag}_intertoken_p99_ms"] = pct(99)
         out[f"{tag}_churn_completed"] = n_churned
-        if disagg:
+        if tag == "disagg":
             out["disagg_kv_handoffs"] = eng.n_kv_handoffs
             out["disagg_kv_handoff_bytes"] = eng.kv_handoff_bytes
+        elif tag == "zero_drain":
+            # The zero-drain acceptance counters: injections that landed
+            # on a live ring, and the structural-0 admission stall.
+            out["zero_drain_admission_overlap"] = eng.n_admission_overlap
+            out["zero_drain_admission_stall_s"] = round(
+                eng.admission_stall_s, 6)
+        else:
+            # Wall time the drain-based ring spent clamped for admissions
+            # — what zero_drain removes (structurally 0 there).
+            out["colocated_admission_stall_s"] = round(
+                eng.admission_stall_s, 6)
         eng.shutdown()
 
     out["interference_tokens_match"] = (
-        streams["colocated"] == streams["disagg"])
-    c99, d99 = (out["colocated_intertoken_p99_ms"],
-                out["disagg_intertoken_p99_ms"])
+        streams["colocated"] == streams["disagg"]
+        and streams["colocated"] == streams["zero_drain"])
+    c99, z99, d99 = (out["colocated_intertoken_p99_ms"],
+                     out["zero_drain_intertoken_p99_ms"],
+                     out["disagg_intertoken_p99_ms"])
     # Floor the denominator at the gap filter (0.1 ms): a tiny-budget leg
     # whose reap gaps all fell under the filter reports d99 = 0.0, and an
     # unfloored ratio would record a billions-x artifact as the headline.
     out["interference_p99_ratio"] = round(c99 / max(0.1, d99), 2)
+    # The ISSUE 11 gate: zero-drain p99 within ~2x of the disagg number.
+    out["zero_drain_p99_vs_disagg"] = round(z99 / max(0.1, d99), 2)
+    out["zero_drain_p99_vs_colocated"] = round(c99 / max(0.1, z99), 2)
     return out
 
 
@@ -336,12 +385,15 @@ def main() -> int:
         mi = interference(args.tokens, args.chunk, args.depth, args.loop)
         print("prefill interference (streaming inter-token gap under "
               "admission churn):")
-        for tag in ("colocated", "disagg"):
-            print(f"  {tag:9}: p50 {mi[f'{tag}_intertoken_p50_ms']} ms, "
+        for tag in ("colocated", "zero_drain", "disagg"):
+            print(f"  {tag:10}: p50 {mi[f'{tag}_intertoken_p50_ms']} ms, "
                   f"p95 {mi[f'{tag}_intertoken_p95_ms']} ms, "
                   f"p99 {mi[f'{tag}_intertoken_p99_ms']} ms "
                   f"({mi[f'{tag}_churn_completed']} churn admissions)")
-        print(f"  p99 colocated/disagg: {mi['interference_p99_ratio']:.2f}x")
+        print(f"  p99 colocated/disagg: {mi['interference_p99_ratio']:.2f}x"
+              f", zero_drain/disagg: {mi['zero_drain_p99_vs_disagg']:.2f}x"
+              f" (gate: ~2x), colocated/zero_drain: "
+              f"{mi['zero_drain_p99_vs_colocated']:.2f}x")
         print(json.dumps(mi), flush=True)
         return 0
     if args.depth < 2:
@@ -390,8 +442,8 @@ def main() -> int:
         m.update(mi)
         print("prefill interference (streaming inter-token gap under "
               "admission churn):")
-        for tag in ("colocated", "disagg"):
-            print(f"  {tag:9}: p50 {mi[f'{tag}_intertoken_p50_ms']} ms, "
+        for tag in ("colocated", "zero_drain", "disagg"):
+            print(f"  {tag:10}: p50 {mi[f'{tag}_intertoken_p50_ms']} ms, "
                   f"p95 {mi[f'{tag}_intertoken_p95_ms']} ms, "
                   f"p99 {mi[f'{tag}_intertoken_p99_ms']} ms "
                   f"({mi[f'{tag}_churn_completed']} churn admissions)")
@@ -399,6 +451,12 @@ def main() -> int:
               f" (higher = disagg insulates better); KV handed off: "
               f"{mi['disagg_kv_handoff_bytes']} bytes in "
               f"{mi['disagg_kv_handoffs']} transfers")
+        print(f"  p99 zero_drain/disagg: "
+              f"{mi['zero_drain_p99_vs_disagg']:.2f}x (gate: ~2x, in "
+              "software on one device group); injections onto a live "
+              f"ring: {mi['zero_drain_admission_overlap']}, admission "
+              f"stall {mi['zero_drain_admission_stall_s']}s "
+              f"(drain-based arm: {mi['colocated_admission_stall_s']}s)")
         print(f"  token-for-token identical: "
               f"{mi['interference_tokens_match']}")
     print(json.dumps(m), flush=True)

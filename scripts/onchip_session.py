@@ -35,7 +35,7 @@ list; whatever the tunnel survives is kept:
      number that says int8 serving is quality-safe at the scale we ship.
 
 Usage: ``python scripts/onchip_session.py
-[--skip bench,ab,kvq,flash,megachunk,spec,disagg,profile,qq]``
+[--skip bench,ab,kvq,flash,megachunk,spec,disagg,zero_drain,profile,qq]``
 Each step is a subprocess with its own budget; a wedged step is recorded
 and skipped, never fatal. Results: ``ONCHIP.json`` (merged dict, one key
 prefix per step) + profile trace under ``profiles/``.
@@ -464,6 +464,21 @@ def main() -> None:
         else:
             bank({"disagg_skipped": "single-device host (disagg needs "
                                     ">= 2 devices for disjoint groups)"})
+    if "zero_drain" not in skip:
+        # Zero-drain vs drain-based colocated at 7B (PERF.md §5 step 7b):
+        # the SAME interference number as the disagg step, on ONE device
+        # group — the software answer where disagg's second group isn't
+        # available (runs on a single v5e chip, no device-count probe).
+        # SEPARATE processes per arm (zero_drain is structural — it
+        # splits the engine cache key and the admission routing).
+        for arm, arm_url in (
+                ("zero_drain_off", B7_URL),
+                ("zero_drain_on", B7_URL + "&zero_drain=1")):
+            b = fits(arm, 1500)
+            if b:
+                bank(run_step(
+                    arm, [sys.executable, "-c", _SERVE_ONE, arm_url, "2",
+                          arm, "600"], budget=b))
     if "qq" not in skip:
         b = fits("qq", 3100, n_children=2)  # two ~1500s precision arms
         if b:

@@ -413,3 +413,81 @@ def test_banked_onchip_merges_nested(monkeypatch, capsys, tmp_path):
     monkeypatch.delenv("QUORUM_TPU_BENCH_ONCHIP_MERGE")
     onchip.unlink()
     assert real_loader() is None
+
+
+def test_classify_round_sentinels_are_not_measurements():
+    """The driver's probe-failure/watchdog sentinel records (value -1.0,
+    vs_baseline 0.0 — BENCH_r03–r05's exact shape) must classify as
+    no_measurement, never as a measured (regressed) value."""
+    bench = _load_bench()
+    sentinel = {"metric": "p50_ttft_ms", "value": -1.0, "unit": "ms",
+                "vs_baseline": 0.0,
+                "error": "skipped: device probe failed (tunnel dead)"}
+    assert bench.classify_round(sentinel) == "no_measurement"
+    # the in-progress snapshot shape (status marker, headline still -1.0)
+    assert bench.classify_round(
+        {"metric": "p50_ttft_ms", "value": -1.0, "vs_baseline": 0.0,
+         "status": "in progress: probing b7q"}) == "no_measurement"
+    # a real measured round
+    assert bench.classify_round(
+        {"metric": "p50_ttft_ms", "value": 73.96,
+         "vs_baseline": 5.83}) == "measured"
+    # parsed: null (round 4's rc-124 hard kill) and junk shapes
+    assert bench.classify_round(None) == "unparsed"
+    assert bench.classify_round("tail text") == "unparsed"
+    assert bench.classify_round({}) == "unparsed"
+    # a zero value is no measurement either (nothing can serve in 0 ms)
+    assert bench.classify_round(
+        {"metric": "p50_ttft_ms", "value": 0.0}) == "no_measurement"
+
+
+def test_summarize_trajectory_excludes_sentinel_rounds(tmp_path):
+    """Value statistics span measured rounds ONLY: a trajectory whose last
+    rounds are dead-tunnel sentinels keeps the earlier real numbers as
+    best/latest instead of charting -1.0 as a collapse."""
+    import json as _json
+
+    bench = _load_bench()
+    rows = [
+        ("BENCH_r01.json", {"parsed": {"metric": "p50_ttft_ms",
+                                       "value": 313.4}}),
+        ("BENCH_r02.json", {"parsed": {"metric": "p50_ttft_ms",
+                                       "value": 73.96,
+                                       "vs_baseline": 5.83}}),
+        ("BENCH_r03.json", {"parsed": {"metric": "p50_ttft_ms",
+                                       "value": -1.0, "vs_baseline": 0.0,
+                                       "error": "skipped: probe failed"}}),
+        ("BENCH_r04.json", {"parsed": None}),
+    ]
+    paths = []
+    for name, rec in rows:
+        p = tmp_path / name
+        p.write_text(_json.dumps(rec))
+        paths.append(str(p))
+    out = bench.summarize_trajectory(paths)
+    assert [r["status"] for r in out["rounds"]] == [
+        "measured", "measured", "no_measurement", "unparsed"]
+    assert out["measured_rounds"] == 2
+    assert out["sentinel_rounds"] == 1
+    assert out["unparsed_rounds"] == 1
+    assert out["latest_measured"] == 73.96   # NOT -1.0
+    assert out["best_measured"] == 73.96
+    assert out["first_measured"] == 313.4
+    assert out["best_vs_first"] == 4.24
+    # sentinel rounds surface their reason instead of a value
+    assert "error" in out["rounds"][2] and "value" not in out["rounds"][2]
+
+
+def test_summarize_trajectory_real_repo_artifacts():
+    """The committed BENCH_r01–r05 artifacts themselves: rounds 3–5 were
+    probe-failure/hard-kill rounds and must never read as regressions from
+    round 2's 73.96 ms headline."""
+    bench = _load_bench()
+    out = bench.summarize_trajectory()
+    if out["measured_rounds"] == 0:
+        pytest.skip("no measured driver rounds in this checkout")
+    assert out["latest_measured"] > 0
+    assert out["best_measured"] > 0
+    for r in out["rounds"]:
+        if r["status"] == "measured":
+            assert r["value"] > 0

@@ -258,6 +258,24 @@ async def test_live_metrics_exposition_validates():
     # bytes are pinned by tests/test_disagg.py against a live handoff)
     assert 'quorum_tpu_engine_disagg{backend="LLM1"} 0' in text
 
+    # zero-drain continuous batching (ISSUE 11, docs/tpu_backends.md):
+    # the injection-overlap counter and the admission-stall counter expose
+    # even at zero (this app serves a drain-based engine — overlap is
+    # structurally 0 there and the stall only accumulates when a burst
+    # actually clamps the ring), and the engine block carries the
+    # per-engine split plus the knob gauge
+    assert "# TYPE quorum_tpu_admission_overlap_total counter" in text
+    assert ("# TYPE quorum_tpu_admission_stall_seconds_total counter"
+            in text)
+    assert "# TYPE quorum_tpu_engine_zero_drain gauge" in text
+    assert ("# TYPE quorum_tpu_engine_admission_overlap_total counter"
+            in text)
+    assert ("# TYPE quorum_tpu_engine_admission_stall_seconds_total "
+            "counter" in text)
+    assert 'quorum_tpu_engine_zero_drain{backend="LLM1"} 0' in text
+    assert 'quorum_tpu_engine_admission_overlap_total{backend="LLM1"} 0' \
+        in text
+
     # robustness families (docs/robustness.md): deadline sheds by stage,
     # HTTP retry attempts, and the per-engine rebuild/breaker block
     assert "# TYPE quorum_tpu_deadline_exceeded_total counter" in text

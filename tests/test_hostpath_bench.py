@@ -11,10 +11,12 @@ into every suite run), and pins the dispatch accounting the bench reports:
     syncs/request still <= 1 (the megachunk acceptance)
   - zero overrun tokens when rows finish on device
   - token-for-token identical output across depths AND fusion
-  - the prefill-interference legs (colocated vs disagg=1+1) produce the
-    streamed tokens identically with a live device→device KV handoff
-    (the p99-gap ORDERING is the bench's printed acceptance number, not a
-    suite assertion — wall-clock percentiles on a shared CI core flake)
+  - the prefill-interference legs (colocated vs colocated+zero_drain vs
+    disagg=1+1, ISSUE 11) produce the streamed tokens identically with a
+    live device→device KV handoff on the disagg arm and zero admission
+    stall on the zero-drain arm (the p99-gap ORDERING is the bench's
+    printed acceptance number, not a suite assertion — wall-clock
+    percentiles on a shared CI core flake)
   - the speculative A/B legs (ISSUE 10): acceptance rate > 0 on the
     repetitive AND the constrained repetitive leg, tokens identical spec
     on vs off, verify turns overlapping the ring (tok/s ORDERING is the
@@ -60,7 +62,7 @@ def test_spec_bench_smoke():
 def test_interference_bench_smoke():
     m = interference(tokens=24, chunk=4, depth=4, loop=4, churn=2,
                      churn_prompt_tokens=40)
-    for tag in ("colocated", "disagg"):
+    for tag in ("colocated", "zero_drain", "disagg"):
         for p in ("p50", "p95", "p99"):
             assert m[f"{tag}_intertoken_{p}_ms"] >= 0.0
     # The disagg leg really ran disaggregated: its stream equals the
@@ -68,3 +70,12 @@ def test_interference_bench_smoke():
     assert m["interference_tokens_match"] is True
     assert m["disagg_kv_handoffs"] >= 1
     assert m["disagg_kv_handoff_bytes"] > 0
+    # The zero-drain leg really injected: zero admission stall
+    # (structurally — pressure never clamps the ring), zero handoff bytes
+    # (one device group), and the p99 ratios are finite numbers (their
+    # ORDERING is the bench's printed acceptance; wall-clock percentiles
+    # on a shared CI core flake).
+    assert m["zero_drain_admission_stall_s"] == 0.0
+    assert m["zero_drain_p99_vs_disagg"] >= 0.0
+    assert m["zero_drain_p99_vs_colocated"] >= 0.0
+    assert m["zero_drain_admission_overlap"] >= 0

@@ -76,7 +76,8 @@ def test_session_budget_exhaustion_skips_cleanly(tmp_path, monkeypatch):
     assert calls == [], "no step may launch with an exhausted budget"
     banked = json.loads(out.read_text())
     for step in ("bench", "ab", "kvq", "flash_off", "flash_on",
-                 "loop_off", "loop_on", "spec_off", "spec_on", "qq",
+                 "loop_off", "loop_on", "spec_off", "spec_on",
+                 "zero_drain_off", "zero_drain_on", "qq",
                  "profile"):
         assert banked.get(f"{step}_error") == (
             "skipped: session budget exhausted"), (step, banked)
