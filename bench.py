@@ -822,6 +822,8 @@ def run_interference_phase(budget: int = 900) -> dict:
             "colocated_admission_stall_s",
             "interference_p99_ratio", "interference_tokens_match",
             "disagg_kv_handoffs", "disagg_kv_handoff_bytes",
+            "colocated_device_seconds", "zero_drain_device_seconds",
+            "disagg_device_seconds",
             "interference_error")
     return {k: got[k] for k in keep if k in got}
 
@@ -848,7 +850,9 @@ def run_spec_phase(budget: int = 900) -> dict:
         for k in ("off_tok_s", "on_tok_s", "speedup", "tokens_match",
                   "on_acceptance", "on_spec_turns", "on_spec_overlapped",
                   "off_dispatches_per_request",
-                  "on_dispatches_per_request")) + ("spec_error",)
+                  "on_dispatches_per_request",
+                  "off_device_seconds", "on_device_seconds")) + (
+                      "spec_error",)
     return {k: got[k] for k in keep if k in got}
 
 

@@ -221,6 +221,7 @@ from quorum_tpu.engine.engine import (
 from quorum_tpu.engine.tokenizer import get_tokenizer
 from quorum_tpu.models.model_config import resolve_spec
 from quorum_tpu.observability import current_trace, trace_span
+from quorum_tpu.telemetry.recorder import RECORDER
 from quorum_tpu.ops.flash_decode import parse_flash_decode
 from quorum_tpu.ops.sampling import SamplerConfig
 from quorum_tpu.parallel.mesh import MeshConfig, make_mesh, single_device_mesh
@@ -651,6 +652,20 @@ class TpuBackend:
     # miss still answers within deadline + this slack.
     DEADLINE_SLACK_S = 2.0
 
+    def _note_backstop(self, timeout: float) -> None:
+        """The DEADLINE_SLACK_S backstop fired: the engine's own deadline
+        sweep should have answered well inside ``timeout`` — a wedged
+        scheduler is exactly what the flight-recorder post-mortem exists
+        for, so the ring dumps to logs/ (docs/observability.md). The dump
+        (full-ring JSON serialization + disk write) runs on its own
+        thread: this method is called from the asyncio event loop, and a
+        blocking write there would stall every concurrent SSE stream."""
+        RECORDER.record("backstop", loop="server", backend=self.name,
+                        timeout=round(float(timeout), 3))
+        threading.Thread(target=RECORDER.dump, args=("backstop",),
+                         name="flightrec-backstop-dump",
+                         daemon=True).start()
+
     def _acquire_score_slot(self) -> None:
         """Admit one scoring/embedding device forward or raise 503.
 
@@ -1026,6 +1041,7 @@ class TpuBackend:
         except asyncio.TimeoutError:
             # Abort the on-device loop at the next chunk boundary; don't hold
             # the request open waiting for the full generation.
+            self._note_backstop(timeout)
             cancel_all()
             raise _timeout_error(self.name, timeout) from None
         except DeadlineExceeded as e:
@@ -1386,6 +1402,7 @@ class TpuBackend:
                     run, max(0.0, deadline - _time.monotonic())
                     + self.DEADLINE_SLACK_S)
             except asyncio.TimeoutError:
+                self._note_backstop(timeout)
                 cancel_all()
                 raise _timeout_error(self.name, timeout) from None
             except DeadlineExceeded as e:
@@ -1629,6 +1646,7 @@ class TpuBackend:
                         raise BackendError(
                             f"Backend {self.name} failed: {val}") from val
         except asyncio.TimeoutError:
+            self._note_backstop(timeout)
             cancel_all()  # abort the device loops at the next chunk boundary
             raise _timeout_error(self.name, timeout) from None
         except BaseException:

@@ -95,6 +95,7 @@ The reference has no analog — its "backends" are HTTP calls
 from __future__ import annotations
 
 import contextlib
+import itertools
 import logging
 import os
 import queue
@@ -113,7 +114,10 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from quorum_tpu import faults
 from quorum_tpu import observability as obs
+from quorum_tpu.analysis import budget as _budget
 from quorum_tpu.analysis import compile_watch
+from quorum_tpu.telemetry.latency import LatencyModel
+from quorum_tpu.telemetry.recorder import RECORDER as FLIGHT
 from quorum_tpu.cache import kv_transfer
 from quorum_tpu.cache.prefix_store import (
     DEFAULT_PREFIX_STORE_BYTES,
@@ -373,6 +377,13 @@ def _stacked_rows_call(mem: int, n_s: int, fn, params, ck, cv, *rows):
     return logits.reshape((mem * n_s,) + logits.shape[2:]), ck, cv
 
 
+# Monotonic fallback-rid source for engine-direct submissions (next() on a
+# count is atomic under the GIL). NOT id(req): CPython freelists reuse
+# addresses aggressively, and an aliased rid would conflate two unrelated
+# requests' flight-recorder timelines.
+_REQ_SEQ = itertools.count(1)
+
+
 def prefill_bucket(n: int, max_seq: int) -> int:
     """Smallest power-of-two ≥ n, clamped to [MIN_BUCKET, max_seq]."""
     b = MIN_BUCKET
@@ -404,7 +415,7 @@ class _Request:
         "eos_id", "cancel", "chunk_hint", "out", "emitted",
         "pp", "fp", "bias_row", "want_lp", "lp", "hist", "ngram", "member",
         "trace", "t_submit", "tspans", "deadline", "expired", "grammar",
-        "g_start", "dfa_host", "n_inflight", "spec_state",
+        "g_start", "dfa_host", "n_inflight", "spec_state", "rid",
     )
 
     def __init__(self, prompt_ids, budget, sampler: SamplerConfig, seed, eos_id,
@@ -462,6 +473,13 @@ class _Request:
         # happens inside a traced request context) rides along so the
         # scheduler thread can append queue-wait/prefill/decode spans to it.
         self.trace = obs.current_trace()
+        # Flight-recorder correlation id: the traced request's
+        # X-Request-Id, else a process-unique synthetic one — one id
+        # follows the request across the prefill and decode loops, which
+        # is what makes the dual-loop (disagg) and staged-injection
+        # (zero_drain) timelines correlatable.
+        self.rid = (self.trace.request_id if self.trace is not None
+                    else f"q{next(_REQ_SEQ)}")
         self.t_submit = time.perf_counter()
         self.tspans: dict = {}  # span kind -> (last span, turn count)
         # Prompt-lookup drafting state: the running token history and an
@@ -510,11 +528,11 @@ class _InflightChunk:
 
     __slots__ = ("payload", "active", "n_steps", "t0", "history", "depth",
                  "constrained", "n_chunks", "spec_turn", "drafted",
-                 "stacked")
+                 "stacked", "family", "seq", "t_ready")
 
     def __init__(self, payload, active, n_steps, t0, history, depth,
                  constrained=False, n_chunks=1, spec_turn=False, drafted=0,
-                 stacked=None):
+                 stacked=None, family="", seq=0):
         self.payload = payload
         self.active = active
         self.n_steps = n_steps
@@ -540,6 +558,16 @@ class _InflightChunk:
         # (the fused draft→verify scan emits it even at one turn; plain
         # chunk/verify payloads gain it in the reap's normalization).
         self.stacked = n_chunks > 1 if stacked is None else stacked
+        # Device-time attribution (telemetry/latency.py): the program-key
+        # family this dispatch compiled under (compile_budget.json), its
+        # flight-recorder sequence number, and the first stamp at which the
+        # payload was observed landed — the ready() probe's success, else
+        # the blocking fetch's completion. dispatch→t_ready is the
+        # per-family device-seconds observation; neither stamp adds a
+        # blocking sync.
+        self.family = family
+        self.seq = seq
+        self.t_ready: "float | None" = None
 
     @property
     def tokens_ahead(self) -> int:
@@ -551,10 +579,13 @@ class _InflightChunk:
         the incremental-drain check: a completed dispatch behind the
         blocking oldest can be reaped without pacing the device."""
         try:
-            return all(x.is_ready() for x in jax.tree.leaves(self.payload)
-                       if isinstance(x, jax.Array))
+            landed = all(x.is_ready() for x in jax.tree.leaves(self.payload)
+                         if isinstance(x, jax.Array))
         except Exception:
             return False
+        if landed and self.t_ready is None:
+            self.t_ready = time.perf_counter()
+        return landed
 
 
 class _Admission:
@@ -883,6 +914,14 @@ _GUARDED_BY = {
     # ring-fill turn — quorum_tpu_admission_stall_seconds_total)
     "_clamp_t0": {"owner": ["_note_admission_clamp"]},
     "admission_stall_s": {"owner": ["_note_admission_clamp"]},
+    # single-owner: flight-recorder state on the engine side (ISSUE 12) —
+    # the dispatch sequence counter (decode scheduler thread's ring-fill
+    # turn) and the program-key → compile-budget-family memo (first
+    # classified at dispatch/attribution time on whichever loop owns that
+    # program; the dict is only ever extended through _family_of, and a
+    # racing double-classify writes the same value).
+    "_dispatch_seq": {"owner": ["_next_seq"]},
+    "_family_cache": {"owner": ["_family_of"]},
 }
 
 
@@ -1294,6 +1333,17 @@ class InferenceEngine:
         self.n_admission_overlap = 0
         self.admission_stall_s = 0.0
         self._clamp_t0: "float | None" = None
+        # Engine flight recorder + per-family device-time attribution
+        # (quorum_tpu/telemetry/, ISSUE 12): this engine's tag on every
+        # recorder event (= its thread names), the per-dispatch sequence
+        # counter pairing dispatch/reap events, the program-key →
+        # compile-budget-family memo, and the per-family latency model
+        # (EWMAs + percentiles — the generalization of _chunk_ewma_s that
+        # open item 1's preemption cost model consumes).
+        self._tag = f"engine-{id(self):x}"
+        self._dispatch_seq = 0
+        self._family_cache: dict = {}
+        self.latency = LatencyModel(alpha=CHUNK_EWMA_ALPHA)
 
         self._admit_cache: dict[int, object] = {}   # bucket → compiled admit
         self._decode_cache: dict[int, object] = {}  # n_steps → compiled chunk
@@ -1888,8 +1938,9 @@ class InferenceEngine:
                 have = self.prefix_store.covered(tokens)
                 if have >= len(tokens):
                     continue
-                payload = self._snapshot_fn(len(tokens) - have)(
-                    self._ck, self._cv, np.int32(slot), np.int32(have))
+                with self._attr_time("snap"):
+                    payload = self._snapshot_fn(len(tokens) - have)(
+                        self._ck, self._cv, np.int32(slot), np.int32(have))
                 self._snap_queue.put((tokens, have, payload))
             except Exception:
                 # Snapshots are opportunistic: a failed slice (first-use
@@ -2019,6 +2070,7 @@ class InferenceEngine:
             jax.block_until_ready((self._ck, self._cv))
         t1 = time.perf_counter()
         obs.PREFIX_STORE_RESTORE.observe(t1 - t0)
+        self._observe_device_time("restore", t1 - t0)
         obs.PREFIX_STORE_HITS.inc()
         obs.PREFIX_STORE_RESTORED_TOKENS.inc(n)
         self.prefix_store_hits += 1
@@ -2077,8 +2129,9 @@ class InferenceEngine:
         b = 1 << (upto - adm.handed - 1).bit_length()
         b = min(b, self.spec.max_seq)
         start = max(0, upto - b)
-        payload = self._handoff_slice_fn(b)(
-            self._sck, self._scv, np.int32(adm.slot), np.int32(start))
+        with self._attr_time("hslice"):
+            payload = self._handoff_slice_fn(b)(
+                self._sck, self._scv, np.int32(adm.slot), np.int32(start))
         return (payload, start, b, upto)
 
     def _handoff_commit(self, adm: _Admission, disp, final: bool = False):
@@ -2108,6 +2161,10 @@ class InferenceEngine:
                 adm.req.trace.add_span_abs(
                     "kv-handoff", t0, time.perf_counter(), tokens=b,
                     slot=adm.slot, bytes=n_bytes, route=route)
+            FLIGHT.record("handoff", rid=adm.req.rid, engine=self._tag,
+                          loop="prefill" if self.disagg else "decode",
+                          slot=adm.slot, tokens=b, bytes=n_bytes,
+                          route=route)
             adm.handed = upto
             with self._cond:
                 self._handoffs.append(("kv", adm, moved, start, b))
@@ -2132,9 +2189,13 @@ class InferenceEngine:
                 continue
             if kind == "kv":
                 try:
-                    self._ck, self._cv = self._handoff_write_fn(n)(
-                        self._ck, self._cv, chunk,
-                        np.int32(adm.slot), np.int32(start))
+                    with self._attr_time("hput"):
+                        self._ck, self._cv = self._handoff_write_fn(n)(
+                            self._ck, self._cv, chunk,
+                            np.int32(adm.slot), np.int32(start))
+                    FLIGHT.record("inject", rid=adm.req.rid,
+                                  engine=self._tag, loop="decode",
+                                  slot=adm.slot, tokens=n)
                 except Exception as e:
                     # Same containment contract as the register branch: a
                     # failed slot write dooms only this admission when the
@@ -2204,6 +2265,9 @@ class InferenceEngine:
         if restore is not None:
             offset = restore[0]
         adm = _Admission(req, slot, offset=offset, restored=offset)
+        FLIGHT.record("stage-admit", rid=req.rid, engine=self._tag,
+                      loop="prefill" if self.disagg else "decode",
+                      slot=slot, restored=offset)
         with self._cond:
             self._claimed.add(slot)
             self._resident[slot] = []
@@ -2236,6 +2300,12 @@ class InferenceEngine:
         admitting set and rebuild the STAGING cache, leaving pending
         requests queued and active decode streams completely untouched
         (the insulation disagg exists for)."""
+        FLIGHT.record("containment", engine=self._tag,
+                      loop="prefill" if self.disagg else "decode",
+                      site="prefill",
+                      error=f"{type(exc).__name__}: {exc}"[:200],
+                      rids=[r.rid for r in reqs])
+        FLIGHT.dump("containment")
         for adm in admissions or ():
             adm.dead = True
             self._release_admission(adm)
@@ -2258,7 +2328,7 @@ class InferenceEngine:
                 doomed.append(a.req)
             self._release_admission(a)
         self.n_rebuilds += 1
-        self.breaker.record_failure()
+        self._record_breaker_failure()
         self.n_failures += len(doomed)
         for r in doomed:
             if r.trace is not None:
@@ -2427,7 +2497,8 @@ class InferenceEngine:
         with self._cond:
             rows, self._pending_dfa_resets = self._pending_dfa_resets, []
         for r in rows:
-            self._dfa = self._dfa_reset_fn()(self._dfa, np.int32(r))
+            with self._attr_time("dfa_reset"):
+                self._dfa = self._dfa_reset_fn()(self._dfa, np.int32(r))
 
     def _decode_key(self, n_steps: int, want_lp: bool, history: int,
                     constrained: bool, n_chunks: int = 1):
@@ -3506,6 +3577,14 @@ class InferenceEngine:
                         self._drain_handoffs()
                 if any(self._slots) or self._inflight:
                     self._run_chunk()
+                else:
+                    # No decode work this turn (the clamped stream finished
+                    # and/or the admission retired without activating):
+                    # discard any dangling clamp stamp NOW — _run_chunk's
+                    # own discard sites never run again before the loop
+                    # sleeps, and the next burst's first clamped turn would
+                    # otherwise book the whole idle gap as admission stall.
+                    self._note_admission_clamp(False)
             except Exception as e:  # fail open: wake every waiting consumer
                 try:
                     self._fail_all(e)
@@ -3545,13 +3624,18 @@ class InferenceEngine:
             span = trace.add_span_abs(name, t0, t1, **meta)
         req.tspans[name] = (span, count)
 
-    @staticmethod
-    def _note_admitted(req: _Request) -> None:
+    def _note_admitted(self, req: _Request) -> None:
         """A pending request just claimed a slot: close its queue-wait —
         the histogram observation plus (when the request is traced) the
-        queue-wait span, tagged with the member whose rows it landed on."""
+        queue-wait span, tagged with the member whose rows it landed on —
+        and record the admission on the flight recorder (under disagg this
+        runs on the PREFILL loop; the rid correlates it with the decode
+        loop's register/reap events)."""
         now = time.perf_counter()
         obs.QUEUE_WAIT.observe(now - req.t_submit)
+        FLIGHT.record("admit", rid=req.rid, engine=self._tag,
+                      loop="prefill" if self.disagg else "decode",
+                      queue_wait_s=round(now - req.t_submit, 6))
         if req.trace is not None:
             req.trace.add_span_abs("queue-wait", req.t_submit, now,
                                    member=req.member)
@@ -3877,6 +3961,7 @@ class InferenceEngine:
             firsts, s_lp, top_ix, top_lp)
         t1 = time.perf_counter()
         obs.PREFILL.observe(t1 - t0)
+        self._observe_device_time("single_shot", t1 - t0)
         self.breaker.record_success()
         for m, req in live.items():
             if req.trace is not None:
@@ -3993,10 +4078,11 @@ class InferenceEngine:
             # the prefill group computes the next segment.
             disps = {m: self._handoff_dispatch(adm, adm.offset)
                      for m, adm in batch.items()}
-            self._sck, self._scv = self._seg_fn_members(bucket, history)(
-                self.prefill_params, tokens, offsets, n_valids, slots,
-                enables, self._sck, self._scv,
-            )
+            with self._attr_time("mseg"):
+                self._sck, self._scv = self._seg_fn_members(bucket, history)(
+                    self.prefill_params, tokens, offsets, n_valids, slots,
+                    enables, self._sck, self._scv,
+                )
             for m, adm in batch.items():
                 adm.offset += int(n_valids[m])
                 self._handoff_commit(adm, disps[m])
@@ -4005,10 +4091,11 @@ class InferenceEngine:
                         adm, self._handoff_dispatch(adm, adm.offset),
                         final=True)
             return
-        self._ck, self._cv = self._seg_fn_members(bucket, history)(
-            self.params, tokens, offsets, n_valids, slots, enables,
-            self._ck, self._cv,
-        )
+        with self._attr_time("mseg"):
+            self._ck, self._cv = self._seg_fn_members(bucket, history)(
+                self.params, tokens, offsets, n_valids, slots, enables,
+                self._ck, self._cv,
+            )
         for m, adm in batch.items():
             adm.offset += int(n_valids[m])
             self._resident[adm.slot] = adm.req.prompt_ids[: adm.offset]
@@ -4021,6 +4108,7 @@ class InferenceEngine:
         req = adm.req
         prompt = req.prompt_ids
         bias = req.bias_row if req.bias_row is not None else self._zero_bias
+        t_reg = time.perf_counter()
         (self._token, self._lengths, self._keys, self._temp,
          self._topp, self._topk, self._pp, self._fp,
          self._counts, self._bias,
@@ -4045,6 +4133,10 @@ class InferenceEngine:
             self._live, self._budget, self._eos, self._dfa,
         )
         t1 = time.perf_counter()
+        self._observe_device_time("register", t1 - t_reg)
+        FLIGHT.record("register", rid=req.rid, engine=self._tag,
+                      loop="decode", slot=adm.slot, tokens=len(prompt),
+                      reused=adm.offset0, restored=adm.restored)
         # Wall time from slot claim to cache-complete: chunked admissions
         # include the decode turns interleaved between segments — that IS
         # the latency the admitted request experienced.
@@ -4109,11 +4201,12 @@ class InferenceEngine:
                     # is already resident and the overlap is with the
                     # decode ring's own megachunks instead.)
                     disp = self._handoff_dispatch(adm, adm.offset)
-                    self._sck, self._scv = self._seg_fn(bucket, history)(
-                        self.prefill_params, tokens, np.int32(adm.offset),
-                        np.int32(len(seg)),
-                        np.int32(adm.slot), self._sck, self._scv,
-                    )
+                    with self._attr_time("seg"):
+                        self._sck, self._scv = self._seg_fn(bucket, history)(
+                            self.prefill_params, tokens,
+                            np.int32(adm.offset), np.int32(len(seg)),
+                            np.int32(adm.slot), self._sck, self._scv,
+                        )
                     adm.offset += len(seg)
                     self._handoff_commit(adm, disp)
                     if adm.offset >= len(prompt):
@@ -4127,11 +4220,12 @@ class InferenceEngine:
                 continue
             try:
                 faults.fire("engine.prefill_segment")
-                self._ck, self._cv = self._seg_fn(bucket, history)(
-                    self.params, tokens, np.int32(adm.offset),
-                    np.int32(len(seg)),
-                    np.int32(adm.slot), self._ck, self._cv,
-                )
+                with self._attr_time("seg"):
+                    self._ck, self._cv = self._seg_fn(bucket, history)(
+                        self.params, tokens, np.int32(adm.offset),
+                        np.int32(len(seg)),
+                        np.int32(adm.slot), self._ck, self._cv,
+                    )
                 adm.offset += len(seg)
                 # keep the prefix-cache view in sync with the cache rows
                 self._resident[adm.slot] = prompt[: adm.offset]
@@ -4188,6 +4282,9 @@ class InferenceEngine:
         first, s_lp, top_ix, top_lp = _host_fetch(first, s_lp, top_ix, top_lp)
         t1 = time.perf_counter()
         obs.PREFILL.observe(t1 - t0)
+        # Honest device time: the single-shot admit blocks on its own
+        # first-token fetch, so dispatch→fetch IS the program's span.
+        self._observe_device_time("single_shot", t1 - t0)
         self.breaker.record_success()  # a half-open probe admitted cleanly
         if req.trace is not None:
             # reused/restored are structurally 0 on the single-shot path
@@ -4234,6 +4331,8 @@ class InferenceEngine:
         if req.trace is not None:
             now = time.perf_counter()
             req.trace.add_span_abs("deadline-exceeded", now, now, stage=stage)
+        FLIGHT.record("deadline", rid=req.rid, engine=self._tag,
+                      loop="decode", stage=stage)
         req.expired = True
         req.out.put(("err", DeadlineExceeded(stage)))
         req.cancel.set()
@@ -4308,6 +4407,11 @@ class InferenceEngine:
         donated buffers were consumed, escalate to :meth:`_fail_all` (the
         co-batched KV went with them) — which still keeps pending requests
         queued."""
+        FLIGHT.record("containment", engine=self._tag, loop="decode",
+                      site="admission",
+                      error=f"{type(exc).__name__}: {exc}"[:200],
+                      rids=[r.rid for r in reqs])
+        FLIGHT.dump("containment")
         for adm in admissions or ():
             self._release_admission(adm)
         if self._device_state_ok():
@@ -4328,6 +4432,64 @@ class InferenceEngine:
         if not self.transfer_guard:
             return contextlib.nullcontext()
         return jax.transfer_guard(self.transfer_guard)
+
+    # ---- flight recorder + per-family device-time attribution --------------
+
+    def _next_seq(self) -> int:
+        """Dispatch sequence number pairing a ring entry's dispatch and
+        reap flight-recorder events (decode scheduler thread only)."""
+        self._dispatch_seq += 1
+        return self._dispatch_seq
+
+    def _family_of(self, key, cache: str = "decode_cache") -> str:
+        """compile_budget.json family for a program-cache key, memoized.
+        Classification failures degrade to ``"unknown"`` — attribution must
+        never take a serving dispatch down (the budget tests are where
+        unknown keys FAIL; here they are a label)."""
+        fam = self._family_cache.get(key)
+        if fam is None:
+            try:
+                fam = (_budget.classify_decode_key(key)
+                       if cache == "decode_cache"
+                       else _budget.classify_admit_key(key))
+            except Exception:
+                fam = "unknown"
+            self._family_cache[key] = fam
+        return fam
+
+    def _observe_device_time(self, family: str, seconds: float) -> None:
+        """One per-family device-time observation: the engine's latency
+        model (EWMA + percentiles) and the process-global
+        quorum_tpu_dispatch_device_seconds{family=...} histogram."""
+        self.latency.observe(family, seconds)
+        obs.DISPATCH_DEVICE_SECONDS.observe(max(0.0, seconds), family=family)
+
+    def _record_breaker_failure(self) -> None:
+        """Feed the failure breaker and, on the CLOSED/HALF-OPEN → OPEN
+        transition only, record the breaker event + post-mortem dump — a
+        failure storm with the breaker already open must not spray one
+        spurious 'transition' (and one dump file) per failure."""
+        was_open = self.breaker.state == "open"
+        self.breaker.record_failure()
+        if not was_open and self.breaker.state == "open":
+            FLIGHT.record("breaker", engine=self._tag, state="open")
+            FLIGHT.dump("breaker-open")
+
+    @contextlib.contextmanager
+    def _attr_time(self, family: str):
+        """Attribute the wall time of an admission-path program call site
+        to its admit-cache family. For call sites that block (single-shot
+        admit's first-token fetch, the prefix restore) this is honest
+        device time; for chained async dispatches (staged segments) it is
+        the enqueue cost — a lower bound, labeled by the same family either
+        way so the family APPEARS in the attribution with its call rate.
+        The decode ring's families use dispatch→ready instead
+        (_reap_oldest)."""
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self._observe_device_time(family, time.perf_counter() - t0)
 
     def _run_chunk(self) -> None:
         # The guard covers everything the token critical path does on this
@@ -4423,6 +4585,17 @@ class InferenceEngine:
         if self.decode_pipeline <= 1 and self.decode_loop <= 1:
             return
         now = time.monotonic()
+        # Effective-C/K clamp TRANSITIONS ride the flight recorder (state
+        # changes only — not one event per clamped turn): the timeline
+        # shows exactly when an admission pinned the ring to depth 1 and
+        # when it lifted, with the accumulated stall on the lift event.
+        if clamped and self._clamp_t0 is None:
+            FLIGHT.record("clamp", engine=self._tag, loop="decode",
+                          state="on")
+        elif not clamped and self._clamp_t0 is not None:
+            FLIGHT.record("clamp", engine=self._tag, loop="decode",
+                          state="off",
+                          stalled_s=round(self.admission_stall_s, 6))
         if clamped and self._clamp_t0 is not None:
             dt = now - self._clamp_t0
             self.admission_stall_s += dt
@@ -4634,9 +4807,9 @@ class InferenceEngine:
             history = prefill_bucket(
                 min(planned + n_steps * n_chunks, self.spec.max_seq),
                 self.spec.max_seq)
-            if depth > 0 and self._decode_key(
-                    n_steps, want_lp, history, constrained,
-                    n_chunks) not in self._decode_cache:
+            key = self._decode_key(n_steps, want_lp, history, constrained,
+                                   n_chunks)
+            if depth > 0 and key not in self._decode_cache:
                 # Only dispatch ahead onto a warm program — a first-use
                 # history bucket would stall the already-computed older
                 # chunks behind a full XLA compile.
@@ -4647,9 +4820,15 @@ class InferenceEngine:
             t0 = time.perf_counter()
             payload = self._dispatch_chunk(mask, n_steps, want_lp, history,
                                            constrained, n_chunks)
+            fam = self._family_of(key)
+            seq = self._next_seq()
             self._inflight.append(
                 _InflightChunk(payload, active, n_steps, t0, history, depth,
-                               constrained, n_chunks))
+                               constrained, n_chunks, family=fam, seq=seq))
+            FLIGHT.record("dispatch", engine=self._tag, loop="decode", t=t0,
+                          seq=seq, family=fam, depth=depth, chunks=n_chunks,
+                          steps=n_steps,
+                          rids=[r.rid for _, r in active])
             for _, r in active:
                 r.n_inflight += 1
             if depth > 0:
@@ -4727,10 +4906,17 @@ class InferenceEngine:
         except Exception as exc:
             self._contain_verify_failure(active, exc)
             return "stop"
+        fam = self._family_of(key)
+        seq = self._next_seq()
         self._inflight.append(
             _InflightChunk(payload, active, n_steps, t0, history, depth,
                            constrained, n_turns, spec_turn=True,
-                           drafted=drafted, stacked=fused))
+                           drafted=drafted, stacked=fused,
+                           family=fam, seq=seq))
+        FLIGHT.record("dispatch", engine=self._tag, loop="decode", t=t0,
+                      seq=seq, family=fam, depth=depth, chunks=n_turns,
+                      steps=n_steps, drafted=drafted,
+                      rids=[r.rid for _, r in active])
         for _, r in active:
             r.n_inflight += 1
         if depth > 0:
@@ -4818,6 +5004,11 @@ class InferenceEngine:
         :meth:`_fail_all` instead (the co-batched KV went with them)."""
         if not self._device_state_ok():
             raise exc
+        FLIGHT.record("containment", engine=self._tag, loop="decode",
+                      site="verify",
+                      error=f"{type(exc).__name__}: {exc}"[:200],
+                      rids=[r.rid for _, r in active])
+        FLIGHT.dump("containment")
         self.n_failures += len(active)
         for _, r in active:
             if r.trace is not None:
@@ -4846,6 +5037,19 @@ class InferenceEngine:
         done, n_exec, delivered = self._emit_chunk(c)
         t1 = time.perf_counter()
         obs.DECODE_CHUNK.observe(t1 - t0)
+        # Per-family device-time attribution (telemetry/latency.py):
+        # dispatch→ready, where "ready" is the first stamp the payload was
+        # observed landed — the incremental drain's is_ready probe when it
+        # fired, else the blocking fetch's completion (an upper bound by
+        # the host-fetch time; zero NEW blocking syncs either way).
+        t_ready = c.t_ready if c.t_ready is not None else t1
+        self._observe_device_time(c.family or "unknown", t_ready - c.t0)
+        FLIGHT.record("reap", engine=self._tag, loop="decode",
+                      seq=c.seq, family=c.family or "unknown",
+                      depth=c.depth, t_issue=round(c.t0, 6),
+                      t_ready=round(t_ready, 6), chunks=n_exec,
+                      spec=c.spec_turn,
+                      rids=[r.rid for _, r in c.active])
         obs.PIPELINE_DEPTH.set(len(self._inflight))
         if self.disagg:
             obs.DECODE_GROUP_ACTIVE.set(len(c.active))
@@ -5025,6 +5229,10 @@ class InferenceEngine:
         active, payload = c.active, c.payload
         fetched = _host_fetch(*payload)
         t_fetch = time.perf_counter()
+        if c.t_ready is None:
+            # First observation of the payload landed (the blocking path;
+            # the incremental drain's ready() probe stamps earlier/tighter).
+            c.t_ready = t_fetch
         if c.constrained:
             # The grammar variant's trailing per-step masked-entry counts
             # ride the fetch the tokens already require — no extra sync.
@@ -5171,7 +5379,14 @@ class InferenceEngine:
         self._inflight.clear()
         obs.PIPELINE_DEPTH.set(0)
         self.n_rebuilds += 1
-        self.breaker.record_failure()
+        # The post-mortem artifact (docs/observability.md): the ring holds
+        # the dispatch/admission/deadline timeline that led here — dumped
+        # BEFORE the rebuild so the artifact ends at the failure.
+        FLIGHT.record("fail-all", engine=self._tag, loop="decode",
+                      error=f"{type(exc).__name__}: {exc}"[:200],
+                      doomed=len(doomed), rids=[r.rid for r in doomed])
+        FLIGHT.dump("fail-all")
+        self._record_breaker_failure()
         # Wake consumers first — the state rebuild below can itself fail, and
         # doomed requests must never hang on their queues.
         self.n_failures += len(doomed)

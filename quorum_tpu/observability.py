@@ -29,11 +29,19 @@ records into:
 TPU profiling: when ``QUORUM_TPU_PROFILE_DIR`` is set, :func:`maybe_profile`
 wraps a request in ``jax.profiler.trace`` so device timelines land in
 TensorBoard-readable traces — the TPU-native analog of a CPU profiler.
+:func:`profile_process` is the on-demand variant behind
+``POST /debug/profile?seconds=N`` (single-flight — the jax profiler is
+process-global and cannot nest; concurrent requests get 409).
+
+The Prometheus primitive types (Histogram/Counter/Gauge/MetricsRegistry)
+and :func:`validate_exposition` moved to ``quorum_tpu.telemetry.metrics``
+when the telemetry package grew the flight recorder / latency-model / SLO
+subsystems (ISSUE 12) — re-exported here so every existing import keeps
+working; the REGISTERED families stay in this module.
 """
 
 from __future__ import annotations
 
-import bisect
 import contextlib
 import contextvars
 import logging
@@ -43,6 +51,20 @@ import time
 from collections import deque
 from pathlib import Path
 from typing import Any, Iterator
+
+from quorum_tpu.telemetry.metrics import (  # noqa: F401  (re-exports)
+    DEFAULT_BUCKETS,
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    _esc_label,
+    _fmt_float,
+    _fmt_labels,
+    _split_labels,
+    validate_exposition,
+)
+from quorum_tpu.telemetry.recorder import RECORDER
 
 logger = logging.getLogger(__name__)
 aggregation_logger = logging.getLogger("aggregation")
@@ -76,222 +98,8 @@ def setup_aggregation_log(log_dir: str | os.PathLike = "logs") -> Path:
 
 
 # ---- histogram metrics -----------------------------------------------------
-
-# Serving-latency bucket ladder: sub-millisecond (intra-chunk host work)
-# through minutes (a long generation behind a queue). Upper bounds in
-# seconds, strictly increasing; +Inf is implicit.
-DEFAULT_BUCKETS = (
-    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
-    1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0,
-)
-
-
-def _fmt_float(v: float) -> str:
-    """Prometheus sample value: shortest exact-enough decimal repr."""
-    out = repr(float(v))
-    return out
-
-
-def _esc_label(v: str) -> str:
-    """Prometheus label-value escaping: backslash, quote, newline."""
-    return v.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
-
-
-def _fmt_labels(labels: tuple[tuple[str, str], ...], extra: str = "") -> str:
-    parts = [f'{k}="{_esc_label(v)}"' for k, v in labels]
-    if extra:
-        parts.append(extra)
-    return "{" + ",".join(parts) + "}" if parts else ""
-
-
-class Histogram:
-    """One Prometheus histogram family: thread-safe ``observe`` plus text
-    exposition with cumulative ``_bucket`` samples, ``_sum`` and ``_count``.
-
-    Per-bucket counts are stored non-cumulative and summed at expose time, so
-    ``observe`` is O(log buckets) (bisect) under a short lock. Labeled
-    children share the family (one ``# TYPE`` line, samples grouped)."""
-
-    def __init__(self, name: str, help_text: str,
-                 buckets: tuple[float, ...] = DEFAULT_BUCKETS):
-        if list(buckets) != sorted(set(buckets)):
-            raise ValueError(f"histogram buckets must strictly increase: {buckets}")
-        self.name = name
-        self.help = help_text
-        self.buckets = tuple(float(b) for b in buckets)
-        self._lock = threading.Lock()
-        # label-tuple -> [per-bucket counts..., +Inf count, sum, count]
-        self._series: dict[tuple[tuple[str, str], ...], list] = {}
-
-    def observe(self, value: float, **labels: str) -> None:
-        key = tuple(sorted((k, str(v)) for k, v in labels.items()))
-        idx = bisect.bisect_left(self.buckets, float(value))
-        with self._lock:
-            row = self._series.get(key)
-            if row is None:
-                row = [0] * (len(self.buckets) + 1) + [0.0, 0]
-                self._series[key] = row
-            row[idx] += 1
-            row[-2] += float(value)
-            row[-1] += 1
-
-    def snapshot(self) -> dict:
-        """{labels: {"buckets": cumulative counts, "sum": s, "count": n}}."""
-        with self._lock:
-            series = {k: list(v) for k, v in self._series.items()}
-        out = {}
-        for key, row in series.items():
-            cum, total = [], 0
-            for c in row[: len(self.buckets) + 1]:
-                total += c
-                cum.append(total)
-            out[key] = {"buckets": cum, "sum": row[-2], "count": row[-1]}
-        return out
-
-    def expose(self) -> list[str]:
-        lines = [f"# HELP {self.name} {self.help}",
-                 f"# TYPE {self.name} histogram"]
-        snap = self.snapshot() or {(): {"buckets": [0] * (len(self.buckets) + 1),
-                                        "sum": 0.0, "count": 0}}
-        for key in sorted(snap):
-            s = snap[key]
-            bounds = [_fmt_float(b) for b in self.buckets] + ["+Inf"]
-            for ub, c in zip(bounds, s["buckets"]):
-                le = 'le="%s"' % ub
-                lines.append(f"{self.name}_bucket{_fmt_labels(key, le)} {c}")
-            lines.append(f"{self.name}_sum{_fmt_labels(key)} {_fmt_float(s['sum'])}")
-            lines.append(f"{self.name}_count{_fmt_labels(key)} {s['count']}")
-        return lines
-
-
-class Counter:
-    """One Prometheus counter family: thread-safe monotonic ``inc`` plus
-    exposition. ``inc`` accepts labels (``inc(stage="queue")``) — each
-    distinct label set is its own series under the family's one ``# TYPE``
-    line; label-less families expose a single bare sample.
-
-    Process-wide like the registry's other families — engines sharing the
-    process accumulate into one series (the per-engine breakdown lives in
-    the ``quorum_tpu_engine_*`` block each engine's ``metrics()`` feeds)."""
-
-    def __init__(self, name: str, help_text: str):
-        self.name = name
-        self.help = help_text
-        self._lock = threading.Lock()
-        self._series: dict[tuple[tuple[str, str], ...], float] = {}
-
-    def inc(self, amount: float = 1.0, **labels: str) -> None:
-        if amount < 0:
-            raise ValueError(f"counter {self.name} cannot decrease")
-        key = tuple(sorted((k, str(v)) for k, v in labels.items()))
-        with self._lock:
-            self._series[key] = self._series.get(key, 0.0) + float(amount)
-
-    @property
-    def value(self) -> float:
-        """Total across every labeled series (the label-less reading)."""
-        with self._lock:
-            return sum(self._series.values())
-
-    def value_of(self, **labels: str) -> float:
-        key = tuple(sorted((k, str(v)) for k, v in labels.items()))
-        with self._lock:
-            return self._series.get(key, 0.0)
-
-    def expose(self) -> list[str]:
-        lines = [f"# HELP {self.name} {self.help}",
-                 f"# TYPE {self.name} counter"]
-        with self._lock:
-            snap = dict(self._series) or {(): 0.0}
-        for key in sorted(snap):
-            lines.append(f"{self.name}{_fmt_labels(key)} "
-                         f"{_fmt_float(snap[key])}")
-        return lines
-
-
-class Gauge:
-    """One Prometheus gauge: thread-safe ``set`` plus exposition.
-
-    Process-wide last-writer-wins semantics (the scheduler threads of
-    several engines share one family); fine for the depth-style gauges this
-    registry carries — they describe "now", not an accumulation."""
-
-    def __init__(self, name: str, help_text: str):
-        self.name = name
-        self.help = help_text
-        self._lock = threading.Lock()
-        self._value = 0.0
-
-    def set(self, value: float) -> None:
-        with self._lock:
-            self._value = float(value)
-
-    @property
-    def value(self) -> float:
-        with self._lock:
-            return self._value
-
-    def expose(self) -> list[str]:
-        return [f"# HELP {self.name} {self.help}",
-                f"# TYPE {self.name} gauge",
-                f"{self.name} {_fmt_float(self.value)}"]
-
-
-class MetricsRegistry:
-    """Ordered collection of histogram/gauge families, one-call exposition."""
-
-    def __init__(self) -> None:
-        self._lock = threading.Lock()
-        self._hists: dict[str, Histogram] = {}
-        self._gauges: dict[str, Gauge] = {}
-        self._counters: dict[str, Counter] = {}
-
-    def histogram(self, name: str, help_text: str,
-                  buckets: tuple[float, ...] = DEFAULT_BUCKETS) -> Histogram:
-        with self._lock:
-            h = self._hists.get(name)
-            if h is None:
-                h = Histogram(name, help_text, buckets)
-                self._hists[name] = h
-            return h
-
-    def gauge(self, name: str, help_text: str) -> Gauge:
-        with self._lock:
-            g = self._gauges.get(name)
-            if g is None:
-                g = Gauge(name, help_text)
-                self._gauges[name] = g
-            return g
-
-    def counter(self, name: str, help_text: str) -> Counter:
-        with self._lock:
-            c = self._counters.get(name)
-            if c is None:
-                c = Counter(name, help_text)
-                self._counters[name] = c
-            return c
-
-    def expose(self) -> list[str]:
-        with self._lock:
-            families = (list(self._hists.values())
-                        + list(self._counters.values())
-                        + list(self._gauges.values()))
-        lines: list[str] = []
-        for fam in families:
-            lines.extend(fam.expose())
-        return lines
-
-    def reset(self) -> None:
-        """Drop all recorded samples (tests)."""
-        with self._lock:
-            for h in self._hists.values():
-                with h._lock:
-                    h._series.clear()
-            for g in self._gauges.values():
-                g.set(0.0)
-            for c in self._counters.values():
-                with c._lock:
-                    c._series.clear()
+# (Primitive types live in quorum_tpu/telemetry/metrics.py; this module
+# registers the serving families on the process-wide registry below.)
 
 
 METRICS = MetricsRegistry()
@@ -486,6 +294,56 @@ BACKEND_RETRIES = METRICS.counter(
     "HTTP backend attempts retried after a connect error or 5xx "
     "(opt-in per-backend retries= config knob), by backend.")
 
+# Engine flight recorder + per-family device-time attribution + SLO
+# accounting (quorum_tpu/telemetry/, docs/observability.md — ISSUE 12).
+# Decode-ring dispatches attribute dispatch→ready time (issue stamp to the
+# payload's non-blocking is_ready probe / fetch completion — zero new
+# blocking syncs) to their compile_budget.json program family; admission-
+# path programs (seg/register/hslice/hput/...) attribute the dispatch wall
+# observed at their call sites. Buckets reach below the serving ladder:
+# one tiny-chunk dispatch is sub-millisecond on a warm TPU.
+DISPATCH_DEVICE_SECONDS = METRICS.histogram(
+    "quorum_tpu_dispatch_device_seconds",
+    "Per-dispatch device time by compile_budget.json program family "
+    "(decode-ring families: dispatch to payload-ready; admission-path "
+    "families: dispatch wall at the call site).",
+    buckets=(0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025,
+             0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0))
+# SLO accounting (quorum_tpu/telemetry/slo.py): requests classify by
+# deadline headroom into interactive/batch and score one good-or-breached
+# observation per stage (ttft / inter_token / deadline) at teardown. The
+# burn rate (breached/observed over a sliding window) rides /health.
+SLO_GOOD = METRICS.counter(
+    "quorum_tpu_slo_good_total",
+    "Requests that met the stage's objective for their SLO class "
+    "(class=interactive|batch, stage=ttft|inter_token|deadline).")
+SLO_BREACHED = METRICS.counter(
+    "quorum_tpu_slo_breached_total",
+    "Requests that breached the stage's objective for their SLO class "
+    "(class=interactive|batch, stage=ttft|inter_token|deadline).")
+# Flight-recorder self-accounting: current ring depth (refreshed on
+# /metrics scrapes) and events overwritten by the bounded ring.
+FLIGHT_RECORDER_EVENTS = METRICS.gauge(
+    "quorum_tpu_flight_recorder_events",
+    "Events currently held in the engine flight recorder's bounded ring "
+    "(GET /debug/engine/timeline; QUORUM_TPU_FLIGHT_EVENTS caps it).")
+FLIGHT_RECORDER_DROPPED = METRICS.counter(
+    "quorum_tpu_flight_recorder_dropped_total",
+    "Flight-recorder events overwritten by the bounded ring (the oldest "
+    "event falls off when a new one lands on a full ring).")
+# On-demand/per-request jax profiling: requests that proceeded UNTRACED
+# because the process-global profiler was already busy (maybe_profile's
+# silent skip, made visible — ISSUE 12 satellite).
+PROFILE_SKIPPED = METRICS.counter(
+    "quorum_tpu_profile_skipped_total",
+    "Requests that ran unprofiled because the process-global jax "
+    "profiler was busy with another trace (QUORUM_TPU_PROFILE_DIR "
+    "per-request tracing, or a POST /debug/profile in flight).")
+
+# The bounded ring's overwrite hook (the recorder itself imports nothing
+# from this module — the wiring lives on this side of the boundary).
+RECORDER.on_drop = FLIGHT_RECORDER_DROPPED.inc
+
 
 # ---- request-scoped tracing ------------------------------------------------
 
@@ -547,6 +405,10 @@ class RequestTrace:
         self.ttft: float | None = None
         self.token_times: list[float] = []  # wire flush times, rel. seconds
         self.n_tokens = 0        # content flushes, NOT capped like the list
+        # Worst gap between consecutive content flushes, tracked UNCAPPED
+        # (the token_times list stops at MAX_TOKEN_TIMES — a stall past
+        # the cap must still be visible to the SLO inter_token scorer).
+        self.max_token_gap: float | None = None
         self._last_token_t: float | None = None
         self.n_flushes = 0
         self.status: int | None = None
@@ -620,7 +482,10 @@ class RequestTrace:
                 # MAX_TOKEN_TIMES. One observation per FLUSH: frames inside
                 # a coalesced write arrived together, a zero gap per extra
                 # frame would fake wire latency the client never saw.
-                INTER_TOKEN.observe(t - self._last_token_t)
+                gap = t - self._last_token_t
+                INTER_TOKEN.observe(gap)
+                if self.max_token_gap is None or gap > self.max_token_gap:
+                    self.max_token_gap = gap
             self._last_token_t = t
             self.n_tokens += count
             # All of a coalesced flush's tokens hit the wire at t.
@@ -822,151 +687,18 @@ def trace_span(trace: RequestTrace | None, name: str, **meta: Any):
 def finish_request_trace(trace: RequestTrace, status: int | None = None,
                          mode: str = "") -> None:
     """Request teardown: close the trace, move it to the completed ring,
+    score its SLO class (when the server tagged one — telemetry/slo.py),
     and emit the one structured per-request summary line."""
     trace.finish(status=status)
     TRACES.complete(trace)
-    trace.log(mode or trace.meta.get("mode", ""), status=trace.status)
+    if trace.meta.get("slo"):
+        from quorum_tpu.telemetry.slo import SLO
 
-
-# ---- exposition validation -------------------------------------------------
-
-def validate_exposition(text: str) -> list[str]:
-    """Promtool-style pure-Python check of a Prometheus text exposition.
-
-    Returns a list of human-readable problems (empty = valid). Checks line
-    grammar, one ``# TYPE`` line per family (samples grouped after it),
-    numeric sample values, histogram bucket monotonicity, a ``+Inf`` bucket,
-    and ``_count`` == the ``+Inf`` bucket per labeled series."""
-    import re
-
-    errors: list[str] = []
-    typed: dict[str, str] = {}
-    seen_sample_families: set[str] = set()
-    # family -> labelkey -> {"buckets": [(le, v)...], "count": v, "sum": v}
-    hist: dict[str, dict[str, dict]] = {}
-    name_re = re.compile(r"[a-zA-Z_:][a-zA-Z0-9_:]*")
-    sample_re = re.compile(
-        r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{([^}]*)\})?\s+(\S+)(\s+\S+)?$")
-    label_re = re.compile(r'^([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"$')
-
-    def family_of(name: str) -> str:
-        for suffix in ("_bucket", "_sum", "_count"):
-            if name.endswith(suffix) and name[: -len(suffix)] in typed \
-                    and typed[name[: -len(suffix)]] == "histogram":
-                return name[: -len(suffix)]
-        return name
-
-    for n, raw in enumerate(text.splitlines(), 1):
-        line = raw
-        if not line.strip():
-            continue
-        if line.startswith("# TYPE "):
-            parts = line.split()
-            if len(parts) != 4 or not name_re.fullmatch(parts[2]) or \
-                    parts[3] not in ("counter", "gauge", "histogram",
-                                     "summary", "untyped"):
-                errors.append(f"line {n}: malformed TYPE line: {raw!r}")
-                continue
-            fam = parts[2]
-            if fam in typed:
-                errors.append(f"line {n}: duplicate TYPE line for {fam}")
-            if fam in seen_sample_families:
-                errors.append(
-                    f"line {n}: TYPE for {fam} appears after its samples")
-            typed[fam] = parts[3]
-            continue
-        if line.startswith("#"):
-            continue  # HELP / comments
-        m = sample_re.match(line)
-        if m is None:
-            errors.append(f"line {n}: malformed sample line: {raw!r}")
-            continue
-        name, _, labelstr, value, _ = m.groups()
-        labels: dict[str, str] = {}
-        if labelstr:
-            for part in _split_labels(labelstr):
-                lm = label_re.match(part.strip())
-                if lm is None:
-                    errors.append(f"line {n}: malformed label {part!r}")
-                    continue
-                labels[lm.group(1)] = lm.group(2)
         try:
-            val = float(value)
-        except ValueError:
-            errors.append(f"line {n}: non-numeric value {value!r}")
-            continue
-        fam = family_of(name)
-        seen_sample_families.add(fam)
-        if typed.get(fam) == "histogram":
-            series = hist.setdefault(fam, {})
-            key = ",".join(f"{k}={v}" for k, v in sorted(labels.items())
-                           if k != "le")
-            entry = series.setdefault(key, {"buckets": [], "count": None,
-                                            "sum": None})
-            if name.endswith("_bucket"):
-                if "le" not in labels:
-                    errors.append(f"line {n}: _bucket sample without le label")
-                else:
-                    le = (float("inf") if labels["le"] == "+Inf"
-                          else float(labels["le"]))
-                    entry["buckets"].append((le, val))
-            elif name.endswith("_count"):
-                entry["count"] = val
-            elif name.endswith("_sum"):
-                entry["sum"] = val
-    for fam, series in hist.items():
-        for key, entry in series.items():
-            buckets = entry["buckets"]
-            if not buckets:
-                errors.append(f"{fam}{{{key}}}: histogram with no buckets")
-                continue
-            if buckets[-1][0] != float("inf"):
-                errors.append(f"{fam}{{{key}}}: missing +Inf bucket")
-            for (le1, v1), (le2, v2) in zip(buckets, buckets[1:]):
-                if le2 <= le1:
-                    errors.append(
-                        f"{fam}{{{key}}}: bucket bounds not increasing "
-                        f"({le1} -> {le2})")
-                if v2 < v1:
-                    errors.append(
-                        f"{fam}{{{key}}}: bucket counts not monotonic "
-                        f"(le={le1}:{v1} > le={le2}:{v2})")
-            if entry["count"] is None:
-                errors.append(f"{fam}{{{key}}}: missing _count sample")
-            elif buckets and buckets[-1][0] == float("inf") \
-                    and entry["count"] != buckets[-1][1]:
-                errors.append(
-                    f"{fam}{{{key}}}: _count {entry['count']} != +Inf "
-                    f"bucket {buckets[-1][1]}")
-            if entry["sum"] is None:
-                errors.append(f"{fam}{{{key}}}: missing _sum sample")
-    return errors
-
-
-def _split_labels(labelstr: str) -> list[str]:
-    """Split ``a="x",b="y,z"`` on commas outside quoted values."""
-    parts, buf, in_q, esc = [], [], False, False
-    for ch in labelstr:
-        if esc:
-            buf.append(ch)
-            esc = False
-            continue
-        if ch == "\\":
-            buf.append(ch)
-            esc = True
-            continue
-        if ch == '"':
-            in_q = not in_q
-            buf.append(ch)
-            continue
-        if ch == "," and not in_q:
-            parts.append("".join(buf))
-            buf = []
-            continue
-        buf.append(ch)
-    if buf:
-        parts.append("".join(buf))
-    return parts
+            SLO.score_trace(trace)
+        except Exception:
+            logger.exception("SLO scoring failed for %s", trace.request_id)
+    trace.log(mode or trace.meta.get("mode", ""), status=trace.status)
 
 
 _profile_lock = threading.Lock()
@@ -978,14 +710,18 @@ def maybe_profile(request_id: str):
     is set; no-op (and no jax import) otherwise.
 
     The jax profiler is process-global and cannot nest: when another request
-    is already being traced, this one proceeds untraced (logged at DEBUG)
-    instead of erroring the request."""
+    is already being traced, this one proceeds untraced — visibly: the skip
+    ticks ``quorum_tpu_profile_skipped_total`` and records a
+    ``profile-skipped`` flight-recorder event, so dropped profiles no longer
+    vanish into a DEBUG line (ISSUE 12 satellite)."""
     profile_dir = os.environ.get("QUORUM_TPU_PROFILE_DIR", "")
     if not profile_dir:
         yield
         return
     if not _profile_lock.acquire(blocking=False):
         logger.debug("profiler busy — request %s runs untraced", request_id)
+        PROFILE_SKIPPED.inc()
+        RECORDER.record("profile-skipped", rid=request_id, loop="server")
         yield
         return
     try:
@@ -993,5 +729,38 @@ def maybe_profile(request_id: str):
 
         with jax.profiler.trace(os.path.join(profile_dir, request_id)):
             yield
+    finally:
+        _profile_lock.release()
+
+
+class ProfilerBusy(RuntimeError):
+    """The process-global jax profiler is already tracing (surface as 409)."""
+
+
+def profile_process(seconds: float, profile_dir: str | None = None) -> str:
+    """On-demand whole-process device profile (``POST /debug/profile``):
+    run ``jax.profiler.trace`` over everything the process dispatches for
+    ``seconds``, blocking the calling thread (the route runs it in an
+    executor). Returns the trace directory.
+
+    Single-flight behind the same lock as :func:`maybe_profile` — the jax
+    profiler cannot nest — raising :class:`ProfilerBusy` instead of
+    queueing: a profile of "the next N seconds, later" is not the profile
+    the operator asked for."""
+    if not _profile_lock.acquire(blocking=False):
+        PROFILE_SKIPPED.inc()
+        RECORDER.record("profile-skipped", rid="on-demand", loop="server")
+        raise ProfilerBusy("jax profiler busy with another trace")
+    try:
+        import jax
+
+        base = (profile_dir or os.environ.get("QUORUM_TPU_PROFILE_DIR", "")
+                or os.path.join("profiles", "ondemand"))
+        out = os.path.join(base, time.strftime("%Y%m%d-%H%M%S"))
+        RECORDER.record("profile-start", rid="on-demand", loop="server",
+                        seconds=seconds, dir=out)
+        with jax.profiler.trace(out):
+            time.sleep(max(0.0, float(seconds)))
+        return out
     finally:
         _profile_lock.release()

@@ -36,13 +36,18 @@ from typing import Any, AsyncIterator
 
 from quorum_tpu import oai, sse
 from quorum_tpu.observability import (
+    FLIGHT_RECORDER_EVENTS,
     METRICS,
     TRACES,
+    ProfilerBusy,
     RequestTrace,
     finish_request_trace,
     maybe_profile,
+    profile_process,
     use_trace,
 )
+from quorum_tpu.telemetry import slo as slo_mod
+from quorum_tpu.telemetry.recorder import RECORDER
 from quorum_tpu.backends.base import Backend, BackendError
 from quorum_tpu.backends.registry import BackendRegistry, build_registry
 from quorum_tpu.config import Config, load_config
@@ -239,6 +244,14 @@ def create_app(
             if (row["breaker"] != "closed"
                     or row["pending"] >= row["queue_limit"]):
                 status = "degraded"
+        # SLO burn-rate degradation (telemetry/slo.py): opt-in via
+        # QUORUM_TPU_SLO_READY_BURN — while a class burns objectives past
+        # the threshold the process reports degraded (and /ready sheds),
+        # so a load balancer rotates the replica before more clients eat
+        # the breaches. Only meaningful for engine-backed processes.
+        if status == "healthy" and checks \
+                and slo_mod.burning_class() is not None:
+            status = "degraded"
         return status, checks
 
     @app.route("GET", "/health", "/v1/health")
@@ -252,6 +265,11 @@ def create_app(
         body: dict = {"status": status}
         if checks:
             body["checks"] = checks
+            # Per-class SLO accounting (good/breached by stage + burn
+            # rate over the sliding window) — the degradation signal's
+            # raw numbers, only for engine-backed processes (the bare
+            # reference body stays exact without them).
+            body["slo"] = slo_mod.SLO.snapshot()
         if status == "unhealthy":
             return JSONResponse(body, status_code=503,
                                 headers={"Retry-After": "5"})
@@ -327,6 +345,7 @@ def create_app(
         # Latency histogram families (request duration, TTFT, inter-token,
         # queue wait, prefill, decode chunk) — recorded by the tracing spine
         # across server/strategy/engine layers (observability.METRICS).
+        FLIGHT_RECORDER_EVENTS.set(RECORDER.depth())  # scrape-time truth
         lines.extend(METRICS.expose())
         return Response(
             ("\n".join(lines) + "\n").encode(),
@@ -353,6 +372,77 @@ def create_app(
                 status_code=404,
             )
         return JSONResponse(trace.to_dict())
+
+    @app.route("GET", "/debug/engine/timeline", "/v1/debug/engine/timeline")
+    async def debug_timeline(request: Request) -> Response:
+        """The engine flight recorder (quorum_tpu/telemetry/recorder.py):
+        the bounded ring of structured engine events — dispatches tagged
+        with their compile-budget program family, admissions/injections/
+        handoffs/registers, clamp transitions, deadline expiries, breaker
+        and containment events — correlated across the prefill and decode
+        loops by request id. ``?format=perfetto`` returns Chrome
+        trace-event JSON (save it and open in ui.perfetto.dev); the
+        default JSON form additionally carries each engine's per-family
+        device-time statistics and the SLO accounting snapshot."""
+        _, reg = await current()
+        fmt = request.query_params.get("format", "json")
+        if fmt in ("perfetto", "trace", "chrome"):
+            return JSONResponse({"displayTimeUnit": "ms",
+                                 "traceEvents": RECORDER.to_trace_events()})
+        if fmt != "json":
+            return JSONResponse(
+                {"error": {"message": f"unknown format {fmt!r} "
+                           "(json or perfetto)",
+                           "type": "invalid_request_error"}},
+                status_code=400)
+        device_time = {
+            name: engine.latency.snapshot()
+            for name, engine in _distinct_engines(reg, "latency")}
+        return JSONResponse({
+            "clock": "perf_counter",
+            "capacity": RECORDER.capacity,
+            "recorded_total": RECORDER.total(),
+            "events": RECORDER.snapshot(),
+            "device_time": device_time,
+            "slo": slo_mod.SLO.snapshot(),
+        })
+
+    @app.route("POST", "/debug/profile", "/v1/debug/profile")
+    async def debug_profile(request: Request) -> Response:
+        """On-demand whole-process jax device profile
+        (``?seconds=N``, default 1, capped at 60): runs
+        ``jax.profiler.trace`` over everything the process dispatches for
+        N seconds and returns the trace directory (TensorBoard/XProf-
+        readable). Single-flight — the jax profiler is process-global and
+        cannot nest, so a second request while one runs gets 409
+        ``conflict_error`` (the same guard per-request
+        QUORUM_TPU_PROFILE_DIR tracing shares; its losers are counted in
+        ``quorum_tpu_profile_skipped_total``)."""
+        raw = request.query_params.get("seconds", "1")
+        try:
+            seconds = float(raw)
+        except ValueError:
+            seconds = -1.0
+        if not 0.0 < seconds <= 60.0:
+            return JSONResponse(
+                {"error": {"message": f"'seconds' must be a number in "
+                           f"(0, 60], got {raw!r}",
+                           "type": "invalid_request_error"}},
+                status_code=400)
+        try:
+            out_dir = await asyncio.to_thread(profile_process, seconds)
+        except ProfilerBusy:
+            return JSONResponse(
+                {"error": {"message": "profiler busy: another profile "
+                           "(on-demand or per-request) is in flight",
+                           "type": "conflict_error"}},
+                status_code=409, headers={"Retry-After": "5"})
+        except Exception as e:
+            return JSONResponse(
+                {"error": {"message": f"profiling failed: {e}",
+                           "type": "proxy_error"}},
+                status_code=500)
+        return JSONResponse({"profile_dir": out_dir, "seconds": seconds})
 
     @app.route("POST", "/chat/completions", "/v1/chat/completions")
     async def chat_completions(request: Request) -> Response:
@@ -453,6 +543,10 @@ def create_app(
         # split one allowance instead of each getting a fresh full one.
         timeout = float(body.pop("timeout", None) or cfg.timeout)
         deadline = time.monotonic() + timeout
+        # SLO class from deadline headroom (telemetry/slo.py): tagged on
+        # the trace now, scored once against the class's TTFT/inter-token/
+        # deadline objectives at teardown (finish_request_trace).
+        trace.meta["slo"] = slo_mod.classify(timeout)
 
         # Resolve the actual fan-out targets first: in aggregate strategy only
         # the configured source_backends are called (fix of quirk 4), and both

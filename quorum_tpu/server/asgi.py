@@ -33,6 +33,19 @@ class Request:
         return self.scope["path"]
 
     @property
+    def query_params(self) -> dict[str, str]:
+        """Decoded query-string parameters (last value wins on repeats) —
+        the router matches on ``path`` alone, so ``?seconds=5`` style knobs
+        (POST /debug/profile) read from here."""
+        if not hasattr(self, "_query_params"):
+            from urllib.parse import parse_qsl
+
+            raw = self.scope.get("query_string", b"") or b""
+            self._query_params = dict(
+                parse_qsl(raw.decode("latin-1"), keep_blank_values=True))
+        return self._query_params
+
+    @property
     def headers(self) -> dict[str, str]:
         """Headers with original casing preserved (the reference forwards
         header casing through to upstreams; latin-1 per ASGI spec)."""

@@ -27,9 +27,11 @@ from __future__ import annotations
 
 import argparse
 import asyncio
+import glob
 import json
 import os
 import sys
+import tempfile
 import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
@@ -52,6 +54,38 @@ _CHECKS: list[tuple[str, bool, str]] = []
 def check(name: str, ok: bool, detail: str = "") -> None:
     _CHECKS.append((name, bool(ok), detail))
     print(f"  [{'ok' if ok else 'FAIL'}] {name}" + (f" — {detail}" if detail and not ok else ""), flush=True)
+
+
+def _flight_dump_check(label: str, needle: str) -> None:
+    """Containments are no longer post-mortem-blind (ISSUE 12): after a
+    containment phase, a flight-recorder dump artifact must exist in the
+    sweep's dump dir, parse as JSON, and hold an event mentioning the
+    faulted site (the containment/fail-all event's error carries the
+    FaultInjected message, which names the site). Dumps are cumulative
+    ring snapshots, so any artifact written at-or-after the phase holds
+    its events — newest first."""
+    files = sorted(glob.glob(os.path.join(
+        os.environ.get("QUORUM_TPU_FLIGHT_DIR", "logs"),
+        "flightrec-*.json")), reverse=True)
+    ok, detail = False, "no flightrec-*.json dump artifacts found"
+    for path in files:
+        try:
+            with open(path) as f:
+                body = json.load(f)
+        except Exception as e:
+            detail = f"unparseable dump {path}: {e}"
+            continue
+        events = body.get("events")
+        if not isinstance(events, list):
+            detail = f"dump {path} has no events list"
+            continue
+        if any(needle in json.dumps(ev) for ev in events):
+            ok = True
+            detail = os.path.basename(path)
+            break
+        detail = f"site {needle!r} in none of {len(files)} dumps"
+    check(f"{label}: flight-recorder dump holds the faulted site", ok,
+          detail)
 
 
 def _config() -> dict:
@@ -149,6 +183,7 @@ async def _run(quick: bool) -> None:
             check(f"{site}: next request succeeds",
                   follow.status_code == 200 and text(follow) == greedy0,
                   f"status={follow.status_code}")
+            _flight_dump_check(site, site)
 
         # snapshot worker: a fault there may cost one snapshot, never a
         # request or the worker thread.
@@ -303,6 +338,7 @@ async def _run(quick: bool) -> None:
                   dh["scheduler_alive"] and dh["prefill_scheduler_alive"])
             check("kv_handoff: KV crossed the group boundary",
                   deng.kv_handoff_bytes > 0)
+            _flight_dump_check("kv_handoff", "engine.kv_handoff")
             deng.shutdown()
 
         # ---- phase 4c: speculative verify fault site ---------------------
@@ -360,6 +396,7 @@ async def _run(quick: bool) -> None:
             check("verify: no device-state rebuild (ring never doomed)",
                   seng.n_rebuilds == 0, f"rebuilds={seng.n_rebuilds}")
             check("verify: follow-up matches baseline", srun() == sbase)
+            _flight_dump_check("verify", "engine.verify")
             seng.shutdown()
 
         # ---- phase 4d: zero-drain injection-path faults ------------------
@@ -420,6 +457,7 @@ async def _run(quick: bool) -> None:
                       len(stream_toks) == 48, f"len={len(stream_toks)}")
                 check(f"zero-drain {site}: no device-state rebuild",
                       zeng.n_rebuilds == 0, f"rebuilds={zeng.n_rebuilds}")
+                _flight_dump_check(f"zero-drain {site}", site)
             follow = zeng.generate([3, 4, 5], max_new_tokens=6,
                                    sampler=samp).token_ids
             check("zero-drain: follow-up matches baseline",
@@ -477,7 +515,25 @@ def run(quick: bool = False) -> dict:
     """Entry point shared with the tests/test_robustness.py smoke: run the
     sweep, return {"passed": n, "failed": n, "failures": [names]}."""
     _CHECKS.clear()
-    asyncio.run(asyncio.wait_for(_run(quick), SCRIPT_TIMEOUT_S))
+    # Flight-recorder dumps land in a fresh sweep-local dir (not the
+    # serving logs/), un-rate-limited so every containment phase leaves
+    # its own artifact for _flight_dump_check. The env override is
+    # restored afterwards: the tests/test_robustness.py smoke calls run()
+    # inside the pytest process, and later tests' dumps must keep their
+    # own dir/rate-limit.
+    saved = {k: os.environ.get(k) for k in
+             ("QUORUM_TPU_FLIGHT_DIR", "QUORUM_TPU_FLIGHT_DUMP_INTERVAL")}
+    os.environ["QUORUM_TPU_FLIGHT_DIR"] = tempfile.mkdtemp(
+        prefix="chaos-flightrec-")
+    os.environ["QUORUM_TPU_FLIGHT_DUMP_INTERVAL"] = "0"
+    try:
+        asyncio.run(asyncio.wait_for(_run(quick), SCRIPT_TIMEOUT_S))
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
     failures = [name for name, ok, _ in _CHECKS if not ok]
     return {"passed": sum(1 for _, ok, _ in _CHECKS if ok),
             "failed": len(failures), "failures": failures}

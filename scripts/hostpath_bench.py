@@ -112,6 +112,12 @@ def run(tokens: int = 64, chunk: int = 4, depth: int = 4,
             (eng.drain_gap_s - g0) / max(1.0, dispatches * repeats) * 1e3, 3)
         out[f"{tag}_wall_s"] = round(statistics.median(walls), 4)
         out[f"{tag}_tok_s"] = round(tokens / statistics.median(walls), 1)
+        # Per-family device-seconds (ISSUE 12): the leg's dispatch time
+        # attributed by compile-budget program family (p50/p99 from the
+        # engine's LatencyModel reservoir) — an A/B arm's win is
+        # attributable to the family that moved (the loop leg's time
+        # lives under "loop", the unfused legs' under "plain").
+        out[f"{tag}_device_seconds"] = eng.latency.snapshot()
         eng.shutdown()
 
     t1, tk = out["k1_wall_s"], out[f"k{depth}_wall_s"]
@@ -192,6 +198,10 @@ def spec(tokens: int = 64, chunk: int = 4, depth: int = 4,
                     / max(1, eng.n_spec_drafted - d0), 3)
                 out[f"{pre}_spec_turns"] = eng.n_spec_turns - t0
                 out[f"{pre}_spec_overlapped"] = eng.n_spec_overlapped - o0
+            # Per-family attribution: the spec-on arm's device time lives
+            # under the verify/dfa_verify families, the off arm's under
+            # plain/dfa — the A/B win is attributable by family.
+            out[f"{pre}_device_seconds"] = eng.latency.snapshot()
             eng.shutdown()
         out[f"spec_{leg}_tokens_match"] = streams["off"] == streams["on"]
         out[f"spec_{leg}_speedup"] = round(
@@ -332,6 +342,11 @@ def interference(tokens: int = 64, chunk: int = 4, depth: int = 4,
             # — what zero_drain removes (structurally 0 there).
             out["colocated_admission_stall_s"] = round(
                 eng.admission_stall_s, 6)
+        # Per-family attribution per arm: the colocated arm's admission
+        # cost shows under seg/single_shot against its clamped decode
+        # families; the staged arms split theirs across seg/hslice/hput/
+        # register while loop keeps full-depth time.
+        out[f"{tag}_device_seconds"] = eng.latency.snapshot()
         eng.shutdown()
 
     out["interference_tokens_match"] = (
@@ -411,6 +426,16 @@ def main() -> int:
               f"syncs/req, {m[f'{tag}_tok_s']} tok/s, "
               f"{m[f'{tag}_drain_gap_ms_per_dispatch']:.2f} ms drain "
               "gap/dispatch")
+        fams = m.get(f"{tag}_device_seconds", {})
+        decode_fams = {f: s for f, s in fams.items()
+                       if f in ("plain", "loop", "dfa", "loop_dfa",
+                                "verify", "dfa_verify", "spec_loop",
+                                "spec_loop_dfa", "unknown")}
+        if decode_fams:
+            parts = ", ".join(
+                f"{f} p50 {s['p50_ms']}ms / p99 {s['p99_ms']}ms "
+                f"(n={s['count']})" for f, s in sorted(decode_fams.items()))
+            print(f"             device-seconds by family: {parts}")
     print(f"  overrun tokens: K=1 {m['k1_overrun_tokens']}, "
           f"K={k} {m[f'k{k}_overrun_tokens']}, "
           f"C={c} {m[f'loop{c}_overrun_tokens']} (on-device finish)")

@@ -276,6 +276,28 @@ async def test_live_metrics_exposition_validates():
     assert 'quorum_tpu_engine_admission_overlap_total{backend="LLM1"} 0' \
         in text
 
+    # telemetry families (ISSUE 12, docs/observability.md): the
+    # per-program-family device-time histogram carries real samples after
+    # any traffic (every dispatch attributes), labeled by family; the SLO
+    # counters expose (the chat requests above were classified and scored
+    # at teardown); the flight-recorder depth gauge and drop counter
+    # expose; and the profiler-skip counter exposes even at zero
+    fam = "quorum_tpu_dispatch_device_seconds"
+    assert f"# TYPE {fam} histogram" in text
+    assert f'{fam}_bucket{{family="' in text
+    assert f"{fam}_sum" in text and f"{fam}_count" in text
+    for counter in ("quorum_tpu_slo_good_total",
+                    "quorum_tpu_slo_breached_total"):
+        assert f"# TYPE {counter} counter" in text, counter
+    # the served requests above carried a class and scored the deadline
+    # stage (status 200 => good)
+    assert 'quorum_tpu_slo_good_total{class="' in text
+    assert "# TYPE quorum_tpu_flight_recorder_events gauge" in text
+    assert ("# TYPE quorum_tpu_flight_recorder_dropped_total counter"
+            in text)
+    assert "# TYPE quorum_tpu_profile_skipped_total counter" in text
+    assert "quorum_tpu_profile_skipped_total " in text
+
     # robustness families (docs/robustness.md): deadline sheds by stage,
     # HTTP retry attempts, and the per-engine rebuild/breaker block
     assert "# TYPE quorum_tpu_deadline_exceeded_total counter" in text

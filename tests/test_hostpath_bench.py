@@ -42,6 +42,15 @@ def test_hostpath_bench_counters():
     assert m["tokens_match"] is True
     assert 0.0 <= m["host_turnaround_share"] < 1.0
     assert m["loop4_drain_gap_ms_per_dispatch"] >= 0.0
+    # Per-family device-seconds attribution (ISSUE 12): the unfused legs'
+    # decode time lives under "plain", the megachunk leg's under "loop",
+    # with sane percentiles from the engine's LatencyModel reservoir.
+    assert "plain" in m["k1_device_seconds"], m["k1_device_seconds"]
+    assert "loop" in m["loop4_device_seconds"], m["loop4_device_seconds"]
+    for leg in ("k1", "k4", "loop4"):
+        for fam, stats in m[f"{leg}_device_seconds"].items():
+            assert stats["count"] > 0, (leg, fam)
+            assert 0.0 <= stats["p50_ms"] <= stats["p99_ms"], (leg, fam)
 
 
 def test_spec_bench_smoke():
@@ -79,3 +88,11 @@ def test_interference_bench_smoke():
     assert m["zero_drain_p99_vs_disagg"] >= 0.0
     assert m["zero_drain_p99_vs_colocated"] >= 0.0
     assert m["zero_drain_admission_overlap"] >= 0
+    # Per-family device-seconds per arm (ISSUE 12): every arm decoded
+    # fused megachunks ("loop"), and the staged arms' injection programs
+    # attributed under the handoff write family.
+    for tag in ("colocated", "zero_drain", "disagg"):
+        assert "loop" in m[f"{tag}_device_seconds"], (
+            tag, m[f"{tag}_device_seconds"])
+    assert "hput" in m["zero_drain_device_seconds"]
+    assert "hput" in m["disagg_device_seconds"]

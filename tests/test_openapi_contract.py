@@ -57,7 +57,8 @@ def test_document_paths_match_served_routes():
     assert set(DOC["paths"]) == {
         "/chat/completions", "/completions", "/embeddings", "/health",
         "/ready", "/models", "/metrics", "/debug/traces",
-        "/debug/traces/{request_id}"}
+        "/debug/traces/{request_id}", "/debug/engine/timeline",
+        "/debug/profile"}
     assert [s["url"] for s in DOC["servers"]] == ["/", "/v1"]
     post = DOC["paths"]["/chat/completions"]["post"]
     assert set(post["responses"]) == {
@@ -85,7 +86,7 @@ def test_error_type_enum_matches_docs_table():
     assert set(enum) == {"invalid_request_error", "auth_error",
                         "configuration_error", "proxy_error",
                         "overloaded_error", "timeout_error",
-                        "grammar_error"}
+                        "grammar_error", "conflict_error"}
 
 
 def test_response_format_schema_accepts_documented_variants():
@@ -195,6 +196,22 @@ async def test_live_aux_endpoints_conform():
         metrics = await client.get("/metrics")
         assert metrics.status_code == 200
         assert metrics.text.startswith("#") or "quorum_tpu" in metrics.text
+        timeline = await client.get("/debug/engine/timeline")
+        check("EngineTimeline", timeline.json())
+        perfetto = await client.get("/debug/engine/timeline?format=perfetto")
+        assert "traceEvents" in perfetto.json()
+        bad_fmt = await client.get("/debug/engine/timeline?format=nope")
+        assert bad_fmt.status_code == 400
+        check("ErrorResponse", bad_fmt.json())
+        # On-demand profile: a tiny capture conforms; out-of-range 400s;
+        # a concurrent request hits the single-flight 409 (exercised via
+        # the shared profiler lock in tests/test_telemetry.py).
+        prof = await client.post("/v1/debug/profile?seconds=0.05")
+        assert prof.status_code == 200, prof.text
+        check("ProfileResult", prof.json())
+        bad = await client.post("/debug/profile?seconds=0")
+        assert bad.status_code == 400
+        check("ErrorResponse", bad.json())
 
 
 @pytest.mark.parametrize("req,headers,status,err_type", [
