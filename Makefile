@@ -2,7 +2,7 @@
 # clean — /root/reference/Makefile:1-25), adapted to this environment: no uv,
 # no uvicorn — the bundled h11 ASGI server serves the app.
 
-.PHONY: install run dev test test-all coverage bench hostpath-bench prefix-bench dryrun metrics-check chaos-check qlint verify clean
+.PHONY: install run dev test test-all coverage bench hostpath-bench prefix-bench router-bench dryrun metrics-check chaos-check qlint verify clean
 
 install:
 	pip install -e .
@@ -58,6 +58,17 @@ hostpath-bench:
 # runs the same entry point as a fast smoke.
 prefix-bench:
 	JAX_PLATFORMS=cpu python scripts/prefix_bench.py
+
+# Multi-replica router tier bench (scripts/router_bench.py, docs/
+# scaling.md "Replica tier"): prefix-affinity routing vs a random baseline
+# — fake (jax-free scripted replicas, N=2 and 4, seconds) and real legs
+# (subprocess tiny-engine replicas with prefix_store=host under slot
+# churn, N=2, minutes on CPU). Asserts affinity's prefix-hit rate strictly
+# above random and per-conversation outputs token-for-token identical to
+# single-replica serving. The fake leg's fast smoke
+# (tests/test_router_bench.py) rides `make test` inside `make verify`.
+router-bench:
+	JAX_PLATFORMS=cpu python scripts/router_bench.py
 
 # Promtool-style exposition lint (pure Python, no extra deps): spins the
 # app over a tiny tpu:// backend, pulls the FULL /metrics output, and

@@ -13,16 +13,23 @@ Error normalization parity: any transport exception becomes a 500
 their status and parsed body through (oai_proxy.py:216-248).
 
 Retry (opt-in, docs/robustness.md): a ``retries: N`` key on the backend's
-``primary_backends`` entry retries *non-streaming* calls up to N extra
-attempts on connect errors and upstream 5xx, with capped exponential
-backoff + full jitter, never past the request's deadline. Streaming is
-never retried — bytes may already be on the client's wire. Each retried
-attempt counts into ``quorum_tpu_backend_retries_total{backend=...}``.
+``primary_backends`` entry retries calls up to N extra attempts on connect
+errors and upstream 5xx, with capped exponential backoff + full jitter,
+never past the request's deadline. The streaming contract is sharper:
+retries apply only BEFORE the first byte is relayed — a connect error or a
+pre-stream non-2xx (the upstream rejected the call before opening the
+event stream) retries exactly like a non-streaming call, but once a 2xx
+stream is open nothing is ever retried, because bytes may already be on
+the client's wire and a second attempt would double-deliver tokens (the
+router tier's failover leans on exactly this boundary —
+tests/test_robustness.py pins it). Each retried attempt counts into
+``quorum_tpu_backend_retries_total{backend=...}``.
 """
 
 from __future__ import annotations
 
 import asyncio
+import contextlib
 import json
 import logging
 import random
@@ -93,13 +100,34 @@ class HttpBackend:
         return True
 
     @staticmethod
-    def _retry_after(resp: "httpx.Response") -> float:
-        """The upstream's Retry-After in seconds (0.0 when absent or in
-        the HTTP-date form — close enough to 'no ask' for a retry floor)."""
-        try:
-            return max(0.0, float(resp.headers.get("Retry-After", 0)))
-        except ValueError:
+    def _retry_after_s(resp: "httpx.Response") -> float:
+        """The upstream's Retry-After ask in seconds. Both RFC 9110
+        §10.2.3 forms parse: the delay-seconds integer AND the HTTP-date
+        (``Fri, 01 Aug 2026 12:00:00 GMT`` — proxies and CDNs emit this
+        one), which converts to seconds-from-now. Absent, malformed, or
+        already-past dates are 0.0 — 'no ask'. The router tier paces its
+        failover retries on this value, so silently reading a date form as
+        0 would hammer a replica inside its own named recovery window."""
+        raw = resp.headers.get("Retry-After", "")
+        if not raw:
             return 0.0
+        try:
+            return max(0.0, float(raw))
+        except ValueError:
+            pass
+        from email.utils import parsedate_to_datetime
+
+        try:
+            dt = parsedate_to_datetime(raw)
+        except (TypeError, ValueError):
+            return 0.0
+        if dt is None:
+            return 0.0
+        if dt.tzinfo is None:
+            from datetime import timezone
+
+            dt = dt.replace(tzinfo=timezone.utc)
+        return max(0.0, dt.timestamp() - time.time())
 
     async def _post_json(
         self, endpoint: str, req_body: dict[str, Any],
@@ -133,7 +161,7 @@ class HttpBackend:
                 ) from e
             if (resp.status_code >= 500
                     and await self._backoff(attempt, deadline,
-                                            floor=self._retry_after(resp))):
+                                            floor=self._retry_after_s(resp))):
                 attempt += 1
                 continue
             break
@@ -185,47 +213,90 @@ class HttpBackend:
     async def stream(
         self, body: dict[str, Any], headers: dict[str, str], timeout: float
     ) -> AsyncIterator[dict[str, Any]]:
+        """Stream upstream SSE events as dicts.
+
+        The retry boundary is the first relayed byte: connect errors and
+        pre-stream non-2xx responses (the upstream never opened a 2xx
+        event stream) retry inside the deadline exactly like non-streaming
+        calls; once a 2xx stream is OPEN, a mid-stream failure surfaces —
+        never retries — because tokens may already be on the client's wire
+        and a second attempt would double-deliver them. Failover across
+        replicas (quorum_tpu/router/) rides the same boundary."""
         req_body = prepare_body(body, self.model)
         req_body["stream"] = True
+        deadline = time.monotonic() + timeout
+        attempt = 0
+        while True:  # pre-first-byte attempts only
+            cm = None
+            try:
+                faults.fire("http.stream")
+                cm = self._client.stream(
+                    "POST",
+                    self._endpoint,
+                    json=req_body,
+                    headers=_clean_headers(headers),
+                    timeout=max(0.001, deadline - time.monotonic()),
+                )
+                resp = await cm.__aenter__()
+            except Exception as e:
+                if cm is not None:
+                    with contextlib.suppress(Exception):
+                        await cm.__aexit__(None, None, None)
+                if (isinstance(e, _RETRYABLE_EXC)
+                        and await self._backoff(attempt, deadline)):
+                    attempt += 1
+                    continue
+                logger.warning(
+                    "Backend %s stream failure: %s", self.name, e)
+                raise BackendError(
+                    f"Backend {self.name} error: {e}", status_code=500
+                ) from e
+            if resp.status_code < 200 or resp.status_code >= 300:
+                raw = await resp.aread()
+                retry_floor = self._retry_after_s(resp)
+                retry_after = resp.headers.get("Retry-After")
+                await cm.__aexit__(None, None, None)
+                if (resp.status_code >= 500
+                        and await self._backoff(attempt, deadline,
+                                                floor=retry_floor)):
+                    attempt += 1
+                    continue
+                try:
+                    err = json.loads(raw)
+                except (json.JSONDecodeError, ValueError):
+                    err = oai.error_body(
+                        raw.decode("utf-8", "replace") or f"HTTP {resp.status_code}",
+                        code=resp.status_code,
+                    )
+                raise BackendError(
+                    f"Backend {self.name} HTTP {resp.status_code}",
+                    status_code=resp.status_code,
+                    body=err,
+                    # Retry-After relayed verbatim (the BackendError
+                    # header contract — stream and non-stream paths must
+                    # pace clients identically, docs/robustness.md).
+                    headers=({"Retry-After": retry_after}
+                             if retry_after is not None else None),
+                )
+            break  # 2xx stream open: past here nothing ever retries
         parser = sse.SSEParser()
         try:
-            faults.fire("http.stream")
-            async with self._client.stream(
-                "POST",
-                self._endpoint,
-                json=req_body,
-                headers=_clean_headers(headers),
-                timeout=timeout,
-            ) as resp:
-                if resp.status_code < 200 or resp.status_code >= 300:
-                    raw = await resp.aread()
-                    try:
-                        err = json.loads(raw)
-                    except (json.JSONDecodeError, ValueError):
-                        err = oai.error_body(
-                            raw.decode("utf-8", "replace") or f"HTTP {resp.status_code}",
-                            code=resp.status_code,
-                        )
-                    raise BackendError(
-                        f"Backend {self.name} HTTP {resp.status_code}",
-                        status_code=resp.status_code,
-                        body=err,
-                    )
-                async for raw_chunk in resp.aiter_bytes():
-                    for event in parser.feed(raw_chunk):
-                        if event == sse.DONE:
-                            return
-                        if isinstance(event, dict):
-                            yield event
-                        # Non-JSON data lines are skipped (oai_proxy.py:612-615).
-                for event in parser.flush():
+            async for raw_chunk in resp.aiter_bytes():
+                for event in parser.feed(raw_chunk):
+                    if event == sse.DONE:
+                        return
                     if isinstance(event, dict):
                         yield event
-        except BackendError:
-            raise
+                    # Non-JSON data lines are skipped (oai_proxy.py:612-615).
+            for event in parser.flush():
+                if isinstance(event, dict):
+                    yield event
         except Exception as e:
             logger.warning("Backend %s stream failure: %s", self.name, e)
             raise BackendError(f"Backend {self.name} error: {e}", status_code=500) from e
+        finally:
+            with contextlib.suppress(Exception):
+                await cm.__aexit__(None, None, None)
 
     async def aclose(self) -> None:
         await self._client.aclose()

@@ -12,10 +12,27 @@ host→device and prefills only the tail. See docs/prefix_cache.md.
 layer both tiers and the disaggregated prefill→decode handoff build on:
 generic cache-row slice/write bodies plus a direct device→device transfer
 route (host-bounce fallback) with bytes/seconds accounting.
+
+:mod:`~quorum_tpu.cache.prefix_wire` serializes store chunk chains for the
+replica-to-replica migration path (``GET/PUT /debug/prefix/chunks``) the
+multi-replica router tier drives when a replica rotates out of the ring.
 """
 
-from quorum_tpu.cache import kv_transfer  # noqa: F401
+from quorum_tpu.cache import prefix_wire  # noqa: F401
 from quorum_tpu.cache.prefix_store import (  # noqa: F401
     DEFAULT_PREFIX_STORE_BYTES,
     PrefixStore,
 )
+
+
+def __getattr__(name: str):
+    # kv_transfer imports jax; the store/wire halves are pure numpy. Lazy
+    # so jax-free processes (the router tier, its fake replicas) can use
+    # the store and the migration wire format without paying an XLA
+    # client import. ``from quorum_tpu.cache import kv_transfer`` still
+    # works — Python falls through to the submodule import.
+    if name == "kv_transfer":
+        import importlib
+
+        return importlib.import_module("quorum_tpu.cache.kv_transfer")
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

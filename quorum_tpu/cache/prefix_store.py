@@ -150,6 +150,69 @@ class PrefixStore:
             self._touch_chain(walked)
             return len(payloads) * self.chunk_tokens, payloads
 
+    def export_chains(
+        self, max_bytes: int | None = None,
+    ) -> list[tuple[list[int], list[list["np.ndarray"]]]]:
+        """Every maximal restorable chunk chain as ``(tokens, per-chunk
+        payload lists)`` — the serialization feed for prefix migration
+        (quorum_tpu/cache/prefix_wire.py, docs/prefix_cache.md).
+
+        A chain ends at the first payload-less node on its path: chunks
+        beyond an evicted ancestor are unmatchable (``longest_match`` stops
+        there), so exporting them would ship bytes the importer could never
+        restore. Branching conversations export one chain per branch — the
+        shared prefix's payloads are referenced (not copied) by each, so
+        the duplication costs only at serialization time. ``max_bytes``
+        bounds the total payload bytes exported (whole chains, skipping
+        chains that would breach it). Does NOT touch LRU order: exporting a
+        departing replica's store must not make its chains look hot."""
+        with self._lock:
+            out: list[tuple[list[int], list[list[np.ndarray]]]] = []
+            budget = max_bytes if max_bytes is not None else float("inf")
+            spent = 0
+            stack: list[tuple[_Node, list[int], list]] = [
+                (self._root, [], [])]
+            while stack:
+                node, toks, pay = stack.pop()
+                extended = False
+                for edge, child in node.children.items():
+                    if child.entry is None:
+                        continue
+                    stack.append((child, toks + list(edge),
+                                  pay + [child.entry.arrays]))
+                    extended = True
+                if extended or not pay:
+                    continue
+                nbytes = sum(a.nbytes for chunk in pay for a in chunk)
+                if spent + nbytes > budget:
+                    continue
+                spent += nbytes
+                out.append((toks, pay))
+            return out
+
+    def import_chain(self, tokens, chunk_payloads) -> int:
+        """Seed a full chain from its root (the migration import half):
+        ``chunk_payloads`` covers EVERY chunk of ``tokens``; chunks the
+        store already holds are skipped (their resident payloads win — they
+        came off this engine's own device). Returns the number of tokens
+        newly covered (0 when fully covered already, or when the insert was
+        refused)."""
+        c = self.chunk_tokens
+        n = len(tokens) - len(tokens) % c
+        tokens = list(tokens[:n])
+        if not tokens:
+            return 0
+        if len(chunk_payloads) < n // c:
+            raise ValueError(
+                f"{len(chunk_payloads)} payload chunks cannot cover the "
+                f"{n // c} chunks of the token chain")
+        with self._lock:
+            have = self.covered(tokens)
+            if have >= n:
+                return 0
+            ok = self.insert(tokens, have, chunk_payloads[have // c: n // c])
+        return n - have if ok else 0
+
     # ---- mutation ---------------------------------------------------------
 
     def insert(self, tokens, offset: int,

@@ -1,0 +1,194 @@
+"""Serialization of prefix-store chunk chains — the migration wire format.
+
+The multi-replica router tier (``quorum_tpu/router/``) migrates hot KV
+prefixes between replicas: when a replica rotates out of the routing ring,
+the router fetches its serialized chunk chains (``GET /debug/prefix/chunks``)
+and seeds whichever replica each conversation's key now hashes to
+(``PUT /debug/prefix/chunks``), so the successor serves a tier-hit restore
+instead of a cold prefill. This module is the one wire format both ends of
+that transfer speak — and it is deliberately dumb: a JSON manifest (token
+chains + per-array dtype/shape/offset) followed by the raw array bytes, in
+the cache's NATIVE representation exactly as the store holds them
+(``kv_quant=int8`` chains migrate at half the bytes, same as they are held).
+
+Layout::
+
+    MAGIC  b"QTPX1\\n"
+    u64    manifest length (big-endian)
+    bytes  manifest JSON (utf-8)
+    bytes  concatenated array payloads (C-order, offsets in the manifest)
+
+Manifest::
+
+    {"version": 1,
+     "chunk_tokens": C,
+     "chains": [{"tokens": [...],                 # chunk-aligned token ids
+                 "chunks": [[{"dtype": "...", "shape": [...],
+                              "offset": N, "nbytes": N}, ...],  # per leaf
+                            ...]},                              # per chunk
+                ...]}
+
+The importer validates structure here (magic, counts, bounds) and leaves
+cache-layout validation (leaf count, per-leaf dtype/shape) to the engine,
+which knows its cache pytree — see ``Engine.import_prefix_chunks``.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass
+
+import numpy as np
+
+MAGIC = b"QTPX1\n"
+_LEN_BYTES = 8
+
+
+class WireError(ValueError):
+    """The blob is not a valid prefix-chunk wire payload."""
+
+
+def _dtype_name(dt: np.dtype) -> str:
+    """Dtypes travel by NAME ("bfloat16", "float32", "int8"), not by
+    ``dtype.str``: the ml_dtypes extension types jax caches use on host
+    (bfloat16 above all) stringify as opaque void records ("|V2"), which
+    would round-trip into a different dtype and corrupt every restored
+    KV byte."""
+    return np.dtype(dt).name
+
+
+def _resolve_dtype(name: str) -> np.dtype:
+    try:
+        return np.dtype(name)
+    except TypeError:
+        pass
+    try:
+        import ml_dtypes  # numpy extension types (bfloat16, fp8 families)
+
+        return np.dtype(getattr(ml_dtypes, name))
+    except (ImportError, AttributeError, TypeError) as e:
+        raise WireError(f"unknown array dtype {name!r}") from e
+
+
+@dataclass
+class Chain:
+    """One deserialized chunk chain: ``tokens`` (chunk-aligned) plus the
+    per-chunk payloads, each a list of host arrays in cache-leaf order."""
+
+    tokens: list[int]
+    payloads: list[list[np.ndarray]]
+
+    @property
+    def nbytes(self) -> int:
+        return sum(a.nbytes for chunk in self.payloads for a in chunk)
+
+
+def serialize_chains(
+    chains: list[tuple[list[int], list[list[np.ndarray]]]],
+    chunk_tokens: int,
+) -> bytes:
+    """``(tokens, per-chunk payload lists)`` chains → one wire blob."""
+    manifest_chains = []
+    parts: list[bytes] = []
+    offset = 0
+    for tokens, payloads in chains:
+        chunk_rows = []
+        for arrays in payloads:
+            row = []
+            for a in arrays:
+                a = np.ascontiguousarray(a)
+                raw = a.tobytes()
+                row.append({"dtype": _dtype_name(a.dtype),
+                            "shape": list(a.shape),
+                            "offset": offset, "nbytes": len(raw)})
+                parts.append(raw)
+                offset += len(raw)
+            chunk_rows.append(row)
+        manifest_chains.append(
+            {"tokens": [int(t) for t in tokens], "chunks": chunk_rows})
+    manifest = json.dumps({
+        "version": 1,
+        "chunk_tokens": int(chunk_tokens),
+        "chains": manifest_chains,
+    }).encode()
+    return b"".join(
+        [MAGIC, len(manifest).to_bytes(_LEN_BYTES, "big"), manifest] + parts)
+
+
+def parse(blob: bytes) -> tuple[int, list[Chain]]:
+    """Wire blob → ``(chunk_tokens, chains)``. Array payloads are COPIES
+    (never views into ``blob``): the importing store will hold them long
+    after the request body is gone, and a view would pin the whole blob."""
+    if not isinstance(blob, (bytes, bytearray, memoryview)):
+        raise WireError("prefix-chunk payload must be bytes")
+    blob = bytes(blob)
+    if not blob.startswith(MAGIC):
+        raise WireError("bad magic: not a prefix-chunk payload")
+    head = len(MAGIC) + _LEN_BYTES
+    if len(blob) < head:
+        raise WireError("truncated header")
+    mlen = int.from_bytes(blob[len(MAGIC):head], "big")
+    if head + mlen > len(blob):
+        raise WireError("manifest length exceeds payload")
+    try:
+        manifest = json.loads(blob[head:head + mlen])
+    except json.JSONDecodeError as e:
+        raise WireError(f"unparseable manifest: {e}") from e
+    if not isinstance(manifest, dict) or manifest.get("version") != 1:
+        raise WireError("unsupported prefix-chunk payload version")
+    chunk_tokens = manifest.get("chunk_tokens")
+    if not isinstance(chunk_tokens, int) or chunk_tokens < 1:
+        raise WireError(f"bad chunk_tokens: {chunk_tokens!r}")
+    body = blob[head + mlen:]
+    chains: list[Chain] = []
+    for entry in manifest.get("chains", []):
+        tokens = entry.get("tokens") if isinstance(entry, dict) else None
+        chunks = entry.get("chunks", []) if isinstance(entry, dict) else None
+        if (not isinstance(tokens, list) or not isinstance(chunks, list)
+                or not all(isinstance(t, int) for t in tokens)
+                or len(tokens) % chunk_tokens
+                or len(tokens) // chunk_tokens != len(chunks)
+                or not all(isinstance(row, list) for row in chunks)):
+            raise WireError("chain tokens not chunk-aligned to its payloads")
+        payloads = []
+        for row in chunks:
+            arrays = []
+            for spec in row:
+                try:
+                    dtype = _resolve_dtype(spec["dtype"])
+                    shape = tuple(int(d) for d in spec["shape"])
+                    off, n = int(spec["offset"]), int(spec["nbytes"])
+                except (KeyError, TypeError, ValueError) as e:
+                    raise WireError(f"bad array spec: {e}") from e
+                if off < 0 or n < 0 or off + n > len(body):
+                    raise WireError("array bytes out of payload bounds")
+                want = dtype.itemsize * int(np.prod(shape, dtype=np.int64))
+                if want != n:
+                    raise WireError(
+                        f"array spec {shape}/{dtype} wants {want} bytes, "
+                        f"manifest says {n}")
+                arrays.append(np.frombuffer(
+                    body, dtype=dtype, count=want // dtype.itemsize,
+                    offset=off).reshape(shape).copy())
+            payloads.append(arrays)
+        chains.append(Chain(tokens=[int(t) for t in tokens],
+                            payloads=payloads))
+    return chunk_tokens, chains
+
+
+def stats(blob: bytes) -> dict:
+    """Cheap summary of a wire blob WITHOUT copying array payloads (the
+    router logs/attributes migrations by these numbers)."""
+    if not blob.startswith(MAGIC):
+        raise WireError("bad magic: not a prefix-chunk payload")
+    head = len(MAGIC) + _LEN_BYTES
+    mlen = int.from_bytes(blob[len(MAGIC):head], "big")
+    manifest = json.loads(blob[head:head + mlen])
+    chains = manifest.get("chains", [])
+    return {
+        "chunk_tokens": manifest.get("chunk_tokens"),
+        "chains": len(chains),
+        "chunks": sum(len(c.get("chunks", [])) for c in chains),
+        "tokens": sum(len(c.get("tokens", [])) for c in chains),
+        "payload_bytes": len(blob) - head - mlen,
+    }

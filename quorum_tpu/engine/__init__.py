@@ -8,7 +8,6 @@ model-serving mechanics: bucketed prefill, chunked autoregressive decode,
 sampling, incremental detokenization, and KV-cache lifecycle.
 """
 
-from quorum_tpu.engine.engine import GenerationResult, InferenceEngine, get_engine
 from quorum_tpu.engine.tokenizer import ByteTokenizer, IncrementalDetokenizer, render_chat
 
 __all__ = [
@@ -19,3 +18,18 @@ __all__ = [
     "get_engine",
     "render_chat",
 ]
+
+_ENGINE_EXPORTS = ("GenerationResult", "InferenceEngine", "get_engine")
+
+
+def __getattr__(name: str):
+    # engine.py imports jax at module scope; the tokenizer half is pure
+    # host code the jax-free router tier (quorum_tpu/router/affinity.py)
+    # shares for prefix-stable conversation keys. Lazy resolution keeps
+    # both `from quorum_tpu.engine import InferenceEngine` and a jax-free
+    # `from quorum_tpu.engine.tokenizer import ByteTokenizer` working.
+    if name in _ENGINE_EXPORTS:
+        from quorum_tpu.engine import engine as _engine
+
+        return getattr(_engine, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -116,6 +116,12 @@ from quorum_tpu import faults
 from quorum_tpu import observability as obs
 from quorum_tpu.analysis import budget as _budget
 from quorum_tpu.analysis import compile_watch
+from quorum_tpu.breaker import (  # noqa: F401  (constants re-exported)
+    BREAKER_COOLDOWN_S,
+    BREAKER_THRESHOLD,
+    BREAKER_WINDOW_S,
+    Breaker,
+)
 from quorum_tpu.telemetry.latency import LatencyModel
 from quorum_tpu.telemetry.recorder import RECORDER as FLIGHT
 from quorum_tpu.cache import kv_transfer
@@ -243,84 +249,11 @@ class EngineBreakerOpen(Exception):
         self.retry_after = retry_after
 
 
-# Failure-breaker defaults: >= BREAKER_THRESHOLD device-state rebuilds inside
-# BREAKER_WINDOW_S seconds open the breaker for BREAKER_COOLDOWN_S, after
-# which ONE probe admission is let through per cooldown interval; a probe
-# that admits cleanly closes the breaker, a rebuild while probing reopens it.
-BREAKER_THRESHOLD = 3
-BREAKER_WINDOW_S = 30.0
-BREAKER_COOLDOWN_S = 5.0
-
-
-class _Breaker:
-    """Sliding-window circuit breaker over engine device-state rebuilds.
-
-    Rebuilds — not request failures — are the signal: a request rejected at
-    validation costs nothing shared, but a poison-pill whose dispatch
-    consumes the donated cache forces a full KV-cache reallocation and dooms
-    every co-batched stream. A client retry loop on such a request would
-    re-brick the shared engine forever; the breaker converts that storm into
-    fast 503s until a probe admission proves the engine serves again.
-    Thread-safe (``submit`` callers and the scheduler both touch it)."""
-
-    _CODES = {"closed": 0, "open": 1, "half_open": 2}
-
-    def __init__(self, threshold: int = BREAKER_THRESHOLD,
-                 window: float = BREAKER_WINDOW_S,
-                 cooldown: float = BREAKER_COOLDOWN_S):
-        self.threshold = max(1, int(threshold))
-        self.window = float(window)
-        self.cooldown = float(cooldown)
-        self._lock = threading.Lock()
-        self._failures: deque[float] = deque()
-        self._open_until = 0.0
-        self._last_probe = 0.0
-        self.state = "closed"
-
-    def record_failure(self, now: float | None = None) -> None:
-        now = time.monotonic() if now is None else now
-        with self._lock:
-            self._failures.append(now)
-            while self._failures and self._failures[0] < now - self.window:
-                self._failures.popleft()
-            if (self.state != "closed"
-                    or len(self._failures) >= self.threshold):
-                self.state = "open"
-                self._open_until = now + self.cooldown
-
-    def record_success(self) -> None:
-        with self._lock:
-            if self.state != "closed":
-                self.state = "closed"
-                self._failures.clear()
-
-    def allow(self, now: float | None = None) -> bool:
-        """May a new admission proceed right now? Open → no until the
-        cooldown elapses; then half-open, letting one probe through per
-        cooldown interval (a stamp, not a flag — a probe whose client
-        vanished must not wedge the breaker half-open forever)."""
-        now = time.monotonic() if now is None else now
-        with self._lock:
-            if self.state == "closed":
-                return True
-            if self.state == "open":
-                if now < self._open_until:
-                    return False
-                self.state = "half_open"
-            if now - self._last_probe < self.cooldown and self._last_probe:
-                return False
-            self._last_probe = now
-            return True
-
-    def retry_after(self, now: float | None = None) -> float:
-        now = time.monotonic() if now is None else now
-        with self._lock:
-            return max(self._open_until - now, 0.0) or self.cooldown
-
-    @property
-    def state_code(self) -> int:
-        """0 = closed, 1 = open, 2 = half-open (the breaker_state gauge)."""
-        return self._CODES[self.state]
+# The sliding-window failure breaker moved to quorum_tpu/breaker.py when
+# the multi-replica router tier grew its per-replica instance (the same
+# state machine over upstream failures); re-exported under its
+# historical private name so existing imports keep working.
+_Breaker = Breaker
 
 
 def _host_fetch(*arrays):
@@ -2007,6 +1940,81 @@ class InferenceEngine:
             with self._cond:
                 if not self._snap_backlog:
                     return
+
+    def export_prefix_chunks(self, max_bytes: int | None = None) -> bytes:
+        """Serialize the host prefix store's restorable chunk chains into
+        the migration wire format (quorum_tpu/cache/prefix_wire.py) —
+        served by ``GET /debug/prefix/chunks`` so the router tier can move
+        a rotating replica's hot prefixes to its ring successor. Pure host
+        work: the store's payloads are already host arrays in the cache's
+        native representation; no device touch, no scheduler interaction."""
+        if self.prefix_store is None:
+            raise ValueError(
+                "no host prefix store on this engine (prefix_store=host "
+                "is not configured)")
+        from quorum_tpu.cache import prefix_wire
+
+        return prefix_wire.serialize_chains(
+            self.prefix_store.export_chains(max_bytes=max_bytes),
+            self.prefix_store.chunk_tokens)
+
+    def import_prefix_chunks(self, blob: bytes) -> dict:
+        """Seed the host prefix store from a wire blob exported by another
+        replica (``PUT /debug/prefix/chunks``). Validates the payload
+        against THIS engine's cache layout — chunk granularity, leaf count,
+        per-leaf dtype and chunk shape — so a blob from a differently
+        configured replica is a 400, never a poisoned store (a wrong-shape
+        payload would corrupt the next restore's cache write). Returns
+        insert accounting. Pure host work; the seeded chains restore
+        host→device through the ordinary admission path
+        (``kv_transfer.write_rows`` — the same host-bounce glue snapshots
+        already ride)."""
+        if self.prefix_store is None:
+            raise ValueError(
+                "no host prefix store on this engine (prefix_store=host "
+                "is not configured)")
+        from quorum_tpu.cache import prefix_wire
+
+        chunk_tokens, chains = prefix_wire.parse(blob)
+        c = self.prefix_store.chunk_tokens
+        if chunk_tokens != c:
+            raise ValueError(
+                f"payload chunk_tokens={chunk_tokens} does not match this "
+                f"engine's prefix_store_chunk={c}")
+        # Expected per-leaf chunk spec, from the decode cache's own leaves:
+        # a [L, S, K, T, …] cache leaf snapshots as [L, K, c, …] chunks
+        # (kv_transfer.slice_rows wire layout, position on axis 2).
+        expected = [
+            ((a.shape[0], a.shape[2], c) + tuple(a.shape[4:]),
+             np.dtype(a.dtype))
+            for a in jax.tree.leaves((self._ck, self._cv))
+        ]
+        for chain in chains:
+            for arrays in chain.payloads:
+                if len(arrays) != len(expected):
+                    raise ValueError(
+                        f"chunk carries {len(arrays)} arrays, this cache "
+                        f"has {len(expected)} leaves")
+                for a, (shape, dtype) in zip(arrays, expected):
+                    if a.shape != shape or a.dtype != dtype:
+                        raise ValueError(
+                            f"chunk leaf {a.shape}/{a.dtype} does not "
+                            f"match the cache layout {shape}/{dtype}")
+        tokens_imported = 0
+        chains_imported = 0
+        for chain in chains:
+            got = self.prefix_store.import_chain(chain.tokens,
+                                                 chain.payloads)
+            if got:
+                chains_imported += 1
+                tokens_imported += got
+        return {
+            "chains": len(chains),
+            "chains_imported": chains_imported,
+            "tokens_imported": tokens_imported,
+            "store_bytes": self.prefix_store.bytes_held,
+            "store_entries": self.prefix_store.n_entries,
+        }
 
     def _store_lookup(
         self, prompt: list[int], slot_reuse: int

@@ -294,6 +294,37 @@ BACKEND_RETRIES = METRICS.counter(
     "HTTP backend attempts retried after a connect error or 5xx "
     "(opt-in per-backend retries= config knob), by backend.")
 
+# Multi-replica router tier (quorum_tpu/router/, docs/scaling.md): the
+# standalone prefix-affinity router process records its placement,
+# failover, and prefix-migration accounting on these families; they expose
+# on the ROUTER's /metrics (the same process-wide registry — on a serving
+# replica they simply read 0).
+ROUTER_REQUESTS = METRICS.counter(
+    "quorum_tpu_router_requests_total",
+    "Requests the router placed, by replica and outcome (ok = a 2xx/4xx "
+    "relay, failover = this replica failed pre-stream and the request "
+    "moved on, error = the relayed terminal failure).")
+ROUTER_AFFINITY_HITS = METRICS.counter(
+    "quorum_tpu_router_affinity_hits_total",
+    "Requests served by the replica their conversation key hashes to "
+    "(the bounded-load consistent-hash primary) — where the KV prefix "
+    "from earlier turns lives.")
+ROUTER_AFFINITY_MISSES = METRICS.counter(
+    "quorum_tpu_router_affinity_misses_total",
+    "Requests served AWAY from their affinity primary: bounded-load "
+    "spill, failover, the primary out of the ring, or policy=random.")
+ROUTER_FAILOVERS = METRICS.counter(
+    "quorum_tpu_router_failovers_total",
+    "Pre-first-byte upstream failures that moved a request to the next "
+    "ring candidate, by the replica that failed.")
+ROUTER_MIGRATED_BYTES = METRICS.counter(
+    "quorum_tpu_router_migrated_bytes_total",
+    "Serialized KV prefix-chunk bytes moved between replicas by the "
+    "router's rotation migration (GET/PUT /debug/prefix/chunks).")
+ROUTER_MIGRATED_CHAINS = METRICS.counter(
+    "quorum_tpu_router_migrated_chains_total",
+    "Prefix chunk chains moved between replicas by rotation migration.")
+
 # Engine flight recorder + per-family device-time attribution + SLO
 # accounting (quorum_tpu/telemetry/, docs/observability.md — ISSUE 12).
 # Decode-ring dispatches attribute dispatch→ready time (issue stamp to the

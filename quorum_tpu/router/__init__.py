@@ -1,0 +1,30 @@
+"""Multi-replica serving tier: a prefix-affinity router across engine cells.
+
+The third tier of the MPMD topology (docs/scaling.md): device groups make
+one engine cell (``disagg=P+D``), engine cells make one replica process,
+and this package is the tier over N replicas — a standalone asyncio router
+(``python -m quorum_tpu.router``) speaking the same OpenAI surface as
+``server/app.py`` and placing each request by **conversation-prefix
+affinity**: the tokenized prompt's chunk-trie root hashes through
+bounded-load consistent hashing, so a conversation's turns land on the
+replica whose PR 3 prefix store already holds its KV prefix. Affinity —
+not raw fan-out — is what converts extra replicas into throughput
+(Jupiter's collaborative-inference lesson, PAPERS.md).
+
+Layout:
+  ring.py       bounded-load consistent hashing over replica names
+  affinity.py   conversation/chain → ring key (prefix-stable hashing)
+  replica.py    per-replica HttpBackend + Breaker, /ready rotation,
+                prefix-chunk migration between replicas
+  app.py        the router ASGI app (chat surface, failover, metrics)
+  fake_replica  a jax-free scripted replica process (bench baseline,
+                chaos replica-kill drill, tests)
+"""
+
+from quorum_tpu.router.app import (  # noqa: F401
+    RouterConfig,
+    build_replica_set,
+    create_router_app,
+)
+from quorum_tpu.router.replica import Replica, ReplicaSet  # noqa: F401
+from quorum_tpu.router.ring import BoundedLoadRing, hash_key  # noqa: F401

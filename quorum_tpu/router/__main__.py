@@ -1,0 +1,91 @@
+"""``python -m quorum_tpu.router`` — run the prefix-affinity router.
+
+Config-driven replica list, either inline::
+
+    python -m quorum_tpu.router --port 8080 \\
+        --replicas http://host-a:8000,http://host-b:8000
+
+or from a YAML file (``--config router.yaml``)::
+
+    replicas:
+      - {name: cell-a, url: "http://host-a:8000"}
+      - {name: cell-b, url: "http://host-b:8000"}
+    policy: affinity          # or random (the bench baseline)
+    affinity_chunk: 64
+    retries: 1
+    ready_interval: 2.0
+    migrate_on_rotation: true
+
+The router is pure host/HTTP code — no jax, no device state; it runs on
+any box that can reach the replicas. See docs/scaling.md ("Replica tier").
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import logging
+
+from quorum_tpu.router.app import RouterConfig, create_router_app
+from quorum_tpu.server.serve import serve
+
+
+def load_router_config(path: str | None,
+                       replicas_arg: str | None,
+                       **overrides) -> RouterConfig:
+    raw: dict = {}
+    if path:
+        import yaml
+
+        with open(path) as f:
+            loaded = yaml.safe_load(f)
+        if not isinstance(loaded, dict):
+            raise ValueError(f"router config {path} is not a mapping")
+        raw = loaded.get("router", loaded)
+    if replicas_arg:
+        raw["replicas"] = [u.strip() for u in replicas_arg.split(",")
+                           if u.strip()]
+    for k, v in overrides.items():
+        if v is not None:
+            raw[k] = v
+    return RouterConfig.from_dict(raw)
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser(
+        description="quorum_tpu prefix-affinity replica router")
+    parser.add_argument("--host", default="0.0.0.0")
+    parser.add_argument("--port", type=int, default=8080)
+    parser.add_argument("--config", default=None,
+                        help="router YAML (replicas/policy/… keys)")
+    parser.add_argument("--replicas", default=None,
+                        help="comma-separated replica base URLs "
+                             "(overrides the config file's list)")
+    parser.add_argument("--policy", default=None,
+                        choices=("affinity", "random"))
+    parser.add_argument("--affinity-chunk", type=int, default=None)
+    parser.add_argument("--retries", type=int, default=None)
+    parser.add_argument("--ready-interval", type=float, default=None)
+    parser.add_argument("--log-level", default="INFO")
+    args = parser.parse_args()
+
+    logging.basicConfig(
+        level=getattr(logging, args.log_level.upper(), logging.INFO),
+        format="%(levelname)s:%(asctime)s:%(name)s: %(message)s")
+    cfg = load_router_config(
+        args.config, args.replicas,
+        policy=args.policy, affinity_chunk=args.affinity_chunk,
+        retries=args.retries, ready_interval=args.ready_interval)
+    app = create_router_app(cfg)
+    logging.getLogger(__name__).info(
+        "router over %d replicas (policy=%s): %s",
+        len(cfg.replicas), cfg.policy,
+        ", ".join(f"{n}={u}" for n, u in cfg.replicas))
+    try:
+        asyncio.run(serve(app, args.host, args.port))
+    except KeyboardInterrupt:
+        pass
+
+
+if __name__ == "__main__":
+    main()

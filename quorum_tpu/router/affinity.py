@@ -1,0 +1,114 @@
+"""Conversation → ring key: the prefix-affinity hash.
+
+A conversation's turns must all land on the SAME replica for the PR 3
+prefix store to hit past one box — and chat clients rebuild the prompt by
+appending, so every turn's prompt *starts with* the first turn's prompt.
+The stable identity of a conversation is therefore the ROOT of its
+chunk-trie path: tokenize the rendered prompt exactly the way
+``cache/prefix_store.py`` chunks it (fixed-size token chunks over the
+rendered chat template) and hash the first chunk. Turn 2..N extend the
+path; the root edge never changes, so the key never changes.
+
+Two consequences, both deliberate:
+
+  - Conversations sharing a long system prompt share a root chunk and
+    co-locate — which is exactly where shared-prefix cache hits live. The
+    bounded-load ring (``ring.py``) keeps such a hot key range from
+    melting one replica.
+  - The router's tokenizer need not match the replicas' (a replica may
+    serve a real HF vocab): the key only has to be a *stable, prefix-
+    preserving* function of the conversation, and the deterministic byte
+    tokenizer is that for any replica tokenizer.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from quorum_tpu.engine.tokenizer import ByteTokenizer
+from quorum_tpu.oai import flatten_content
+from quorum_tpu.router.ring import hash_key
+
+# Affinity chunk: tokens of rendered prompt hashed as the conversation key.
+# Mirrors the prefix store's chunk granularity in spirit; the router knob
+# (--affinity-chunk) tunes it. 64 byte-tokens ≈ the opening system line.
+DEFAULT_AFFINITY_CHUNK = 64
+
+# One byte-level tokenizer for the router process (vocab 259 = specials +
+# all 256 bytes — chunk boundaries then cut the SAME byte positions for
+# every prompt regardless of any replica's model vocab).
+_TOKENIZER = ByteTokenizer(259)
+
+
+def conversation_tokens(body: dict[str, Any]) -> list[int]:
+    """Byte tokens of the conversation's IMMUTABLE head: the rendered
+    messages up to and including the first user message. Later turns
+    append messages, so this head never changes — and because chat
+    rendering is line-by-line, its rendered text is a byte-PREFIX of every
+    later turn's full rendered prompt, i.e. the root of the conversation's
+    chunk-trie path. (Keying the full prompt truncated to one chunk is NOT
+    stable: a first turn shorter than the chunk grows past the truncation
+    point on turn two and changes its own key.)"""
+    messages = body.get("messages")
+    if isinstance(messages, list) and messages:
+        head = []
+        for m in messages:
+            if not isinstance(m, dict):
+                continue
+            head.append(m)
+            if m.get("role") == "user":
+                break
+        # render_chat appends the "assistant:" generation cue — strip it:
+        # the head must be a byte-prefix of the FULL rendered prompt,
+        # where the next line after the first user message is a history
+        # message, not the cue.
+        text = "\n".join(
+            _TOKENIZER.render_chat(head).splitlines()[:-1]) + "\n"
+    else:
+        # Legacy /completions-shaped bodies: the raw prompt is the
+        # conversation.
+        text = flatten_content(body.get("prompt"))
+    return _TOKENIZER.encode(text)
+
+
+def _key_of_ids(ids: list[int], chunk_tokens: int) -> int:
+    """Hash of the first ``chunk_tokens`` ids — ONE packing (4 bytes per
+    id, covering any real vocab) shared by the conversation and chain
+    keys, so a chain exported by a byte-tokenizing replica re-keys to the
+    same ring position as the conversation that grew it."""
+    head = ids[:max(1, int(chunk_tokens))]
+    return hash_key(b"".join(int(t).to_bytes(4, "big") for t in head))
+
+
+def conversation_key(body: dict[str, Any],
+                     chunk_tokens: int = DEFAULT_AFFINITY_CHUNK) -> int:
+    """Ring position of the conversation: hash of the first
+    ``chunk_tokens`` tokens (the chunk-trie root edge); prompts shorter
+    than one chunk hash whole, so tiny prompts still spread."""
+    return _key_of_ids(conversation_tokens(body), chunk_tokens)
+
+
+def chain_key(tokens: list[int],
+              chunk_tokens: int = DEFAULT_AFFINITY_CHUNK) -> int:
+    """Ring position of an exported prefix chunk chain (migration
+    regrouping). The conversation key hashes only the conversation's
+    HEAD (up to the first user message) — which may be SHORTER than one
+    affinity chunk — so hashing the chain's first chunk blindly would
+    mis-key every short-head conversation and seed its chains on a
+    replica its next turn never routes to. With byte-tokenizing replicas
+    (the default) the chain's ids decode back to the rendered text
+    exactly, so the head boundary is recoverable: decode the chain's
+    opening, cut at the first ``\\nassistant:`` line break (the rendered
+    prompt's first post-head line — history reply or generation cue),
+    and re-key the head's own ids. When the boundary is not found (a
+    custom replica vocab whose ids fold differently, or a first message
+    containing the delimiter) fall back to the first-chunk hash — still
+    deterministic, merely unaligned."""
+    head = ids = list(tokens)
+    text = _TOKENIZER.decode(ids[: 4 * max(1, int(chunk_tokens))])
+    cut = text.find("\nassistant:")
+    if cut >= 0:
+        head = _TOKENIZER.encode(text[: cut + 1])
+        if ids[: len(head)] != head:
+            head = ids  # decode/encode disagree: not byte-token ids
+    return _key_of_ids(head, chunk_tokens)

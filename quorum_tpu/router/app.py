@@ -1,0 +1,458 @@
+"""The router ASGI app: the OpenAI surface, placed by prefix affinity.
+
+Accepts the same ``POST /chat/completions`` (+ ``/v1`` alias) surface as
+``server/app.py`` and places each request on a replica by conversation-
+prefix affinity (``affinity.py`` key → ``ring.py`` bounded-load consistent
+hashing), so a conversation's turns land where its KV prefix already lives.
+Everything upstream-facing reuses the PR 4 HTTP machinery: per-replica
+:class:`HttpBackend` (pooled clients, capped-exponential retries,
+Retry-After pacing), per-replica :class:`Breaker` for failover, ``/ready``
+polling for ring rotation with prefix migration (``replica.py``).
+
+Failover contract (the one the HTTP backend's streaming retry boundary
+makes safe): a replica that fails BEFORE its 2xx event stream opens —
+connect error, 5xx, 503 shed — moves the request to the next ring
+candidate; once a stream is open, tokens are on the client's wire and a
+mid-stream failure surfaces as an SSE error chunk, never a re-send
+(double-delivered tokens are a correctness bug, not a retry). Non-streaming
+requests failover on any 5xx outcome. 4xx outcomes relay immediately — a
+client error is the same on every replica.
+
+SSE pass-through preserves TTFT: upstream events re-encode and flush
+frame-by-frame as they arrive (no buffering, no coalescing beyond the
+upstream's own), with the router adding only its hash lookup (~µs) to the
+first-byte path.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import random
+import time
+import uuid
+from dataclasses import dataclass, field
+from typing import Any, AsyncIterator
+
+import httpx
+
+from quorum_tpu import oai, sse
+from quorum_tpu.backends.base import BackendError
+from quorum_tpu.observability import (
+    METRICS,
+    ROUTER_AFFINITY_HITS,
+    ROUTER_AFFINITY_MISSES,
+    ROUTER_FAILOVERS,
+    ROUTER_REQUESTS,
+)
+from quorum_tpu.router import affinity
+from quorum_tpu.router.replica import Replica, ReplicaSet
+from quorum_tpu.server.asgi import (
+    App,
+    JSONResponse,
+    Request,
+    Response,
+    StreamingResponse,
+)
+from quorum_tpu.telemetry.recorder import RECORDER
+
+logger = logging.getLogger(__name__)
+
+# Response headers recomputed by this hop, never relayed from upstream.
+_PASSTHROUGH_SKIP = {"content-length", "content-type", "transfer-encoding",
+                     "content-encoding", "connection"}
+
+
+class _StreamGuard:
+    """Wraps the passthrough generator so the replica's in-flight count
+    decrements EXACTLY once no matter how the stream ends — exhaustion,
+    an exception, or ``aclose()`` on a generator whose body never ran
+    (PEP 525: closing an unstarted async generator skips its ``finally``,
+    which is how a client disconnecting before the response starts would
+    otherwise leak ``inflight`` forever and bounded-load placement would
+    drift all traffic off a healthy replica)."""
+
+    def __init__(self, gen, dec):
+        self._gen = gen
+        self._dec = dec
+
+    def __aiter__(self):
+        return self
+
+    async def __anext__(self):
+        try:
+            return await self._gen.__anext__()
+        except StopAsyncIteration:
+            self._dec()
+            raise
+        except BaseException:
+            self._dec()
+            raise
+
+    async def aclose(self):
+        self._dec()
+        aclose = getattr(self._gen, "aclose", None)
+        if aclose is not None:
+            await aclose()
+
+
+@dataclass
+class RouterConfig:
+    """Config for one router process (``python -m quorum_tpu.router``)."""
+
+    replicas: list[tuple[str, str]] = field(default_factory=list)
+    policy: str = "affinity"            # or "random" (the bench baseline)
+    affinity_chunk: int = affinity.DEFAULT_AFFINITY_CHUNK
+    retries: int = 1                    # per-replica HttpBackend retries
+    timeout: float = 120.0              # default request budget (seconds)
+    ready_interval: float = 2.0         # /ready poll period; <=0 disables
+    migrate_on_rotation: bool = True
+    vnodes: int = 64
+    load_factor: float = 1.25
+    breaker_threshold: int = 3
+    breaker_window: float = 30.0
+    breaker_cooldown: float = 5.0
+
+    def __post_init__(self) -> None:
+        if self.policy not in ("affinity", "random"):
+            raise ValueError(
+                f"unknown routing policy {self.policy!r} "
+                "(affinity or random)")
+        if not self.replicas:
+            raise ValueError("router config names no replicas")
+
+    @classmethod
+    def from_dict(cls, raw: dict) -> "RouterConfig":
+        replicas = []
+        for i, entry in enumerate(raw.get("replicas") or []):
+            if isinstance(entry, str):
+                replicas.append((f"replica-{i}", entry))
+            elif isinstance(entry, dict) and entry.get("url"):
+                replicas.append(
+                    (str(entry.get("name") or f"replica-{i}"),
+                     str(entry["url"])))
+            else:
+                raise ValueError(f"bad replica entry: {entry!r}")
+        kwargs = {k: raw[k] for k in (
+            "policy", "affinity_chunk", "retries", "timeout",
+            "ready_interval", "migrate_on_rotation", "vnodes",
+            "load_factor", "breaker_threshold", "breaker_window",
+            "breaker_cooldown") if k in raw}
+        return cls(replicas=replicas, **kwargs)
+
+
+def build_replica_set(cfg: RouterConfig,
+                      client: httpx.AsyncClient | None = None,
+                      control_client: httpx.AsyncClient | None = None,
+                      ) -> ReplicaSet:
+    from quorum_tpu.breaker import Breaker
+
+    replicas = [
+        Replica(name, url, retries=cfg.retries, client=client,
+                breaker=Breaker(threshold=cfg.breaker_threshold,
+                                window=cfg.breaker_window,
+                                cooldown=cfg.breaker_cooldown))
+        for name, url in cfg.replicas
+    ]
+    return ReplicaSet(
+        replicas,
+        vnodes=cfg.vnodes, load_factor=cfg.load_factor,
+        affinity_chunk=cfg.affinity_chunk,
+        ready_interval=cfg.ready_interval,
+        migrate_on_rotation=cfg.migrate_on_rotation,
+        control_client=control_client)
+
+
+def create_router_app(cfg: RouterConfig,
+                      replica_set: ReplicaSet | None = None,
+                      client: httpx.AsyncClient | None = None,
+                      control_client: httpx.AsyncClient | None = None,
+                      ) -> App:
+    """Build the router ASGI app. Tests inject a shared ``client``
+    (e.g. an ASGITransport-backed one) or a prebuilt ``replica_set``."""
+    mgr = replica_set if replica_set is not None else build_replica_set(
+        cfg, client=client, control_client=control_client)
+
+    app = App()
+    app.state["router_config"] = cfg
+    app.state["replica_set"] = mgr
+    started = time.monotonic()
+
+    def _forward_headers(request: Request) -> dict[str, str]:
+        """Relay the client's headers minus host (the reference proxy's
+        contract) — auth passes through for the REPLICA to enforce; the
+        router holds no credential policy of its own."""
+        return {k: v for k, v in request.headers.items()
+                if k.lower() != "host"}
+
+    def _shed_response() -> JSONResponse:
+        retry = max([r.breaker.retry_after()
+                     for r in mgr.replicas.values()] or [1.0])
+        return JSONResponse(
+            {"error": {"message": "no replica available "
+                       "(all rotated out, breaker-open, or unreachable)",
+                       "type": "overloaded_error"}},
+            status_code=503,
+            headers={"Retry-After": str(max(1, int(retry)))})
+
+    def _pick(body: dict) -> tuple[str | None, list[str]]:
+        """(affinity primary, candidate order) under the active policy."""
+        if cfg.policy == "random":
+            members = sorted(mgr.ring.members)
+            random.shuffle(members)
+            return None, members
+        key = affinity.conversation_key(body, cfg.affinity_chunk)
+        return mgr.placement(key)
+
+    def _score_affinity(primary: str | None, served_by: str) -> None:
+        if primary is not None and served_by == primary:
+            ROUTER_AFFINITY_HITS.inc()
+        else:
+            ROUTER_AFFINITY_MISSES.inc()
+
+    @app.route("POST", "/chat/completions", "/v1/chat/completions")
+    async def chat_completions(request: Request) -> Response:
+        await mgr.ensure_poller()
+        rid = f"req-{uuid.uuid4().hex[:16]}"
+        try:
+            body = await request.json()
+            if not isinstance(body, dict):
+                raise ValueError("request body must be a JSON object")
+        except Exception as e:
+            return JSONResponse(
+                {"error": {"message": f"Invalid JSON body: {e}",
+                           "type": "invalid_request_error"}},
+                status_code=400)
+        headers = _forward_headers(request)
+        is_streaming = bool(body.get("stream", False))
+        # The timeout knob is READ, not consumed — the replica's server
+        # pops and enforces it; the router only bounds its own HTTP waits.
+        try:
+            timeout = float(body.get("timeout") or cfg.timeout)
+        except (TypeError, ValueError):
+            timeout = cfg.timeout
+        deadline = time.monotonic() + timeout
+
+        primary, candidates = _pick(body)
+        if not candidates:
+            return _shed_response()
+
+        last_err: BackendError | None = None
+        last_result = None
+        for name in candidates:
+            r = mgr.replicas[name]
+            if not r.breaker.allow():
+                continue
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                break
+            r.inflight += 1
+            r.requests += 1
+            decremented = [False]
+            guard_owns = False  # True once a _StreamGuard took ownership
+
+            def dec(r=r, flag=decremented):
+                if not flag[0]:
+                    flag[0] = True
+                    r.inflight -= 1
+
+            try:
+                if is_streaming:
+                    stream = r.backend.stream(body, headers, remaining)
+                    try:
+                        first = await stream.__anext__()
+                    except StopAsyncIteration:
+                        first = None
+                    # 2xx stream open (or cleanly empty): committed.
+                    r.breaker.record_success()
+                    ROUTER_REQUESTS.inc(replica=name, outcome="ok")
+                    _score_affinity(primary, name)
+                    RECORDER.record("router-route", rid=rid, loop="router",
+                                    replica=name, stream=True,
+                                    affinity=bool(primary == name))
+                    resp = StreamingResponse(_StreamGuard(
+                        _passthrough(r, rid, first, stream), dec))
+                    guard_owns = True
+                    resp.headers["X-Routed-To"] = name
+                    resp.headers["X-Request-Id"] = rid
+                    return resp
+                result = await r.backend.complete(body, headers, remaining)
+                if result.status_code >= 500:
+                    # The replica already burned its own retry budget;
+                    # the router's move is the NEXT replica.
+                    r.breaker.record_failure()
+                    ROUTER_FAILOVERS.inc(replica=name)
+                    ROUTER_REQUESTS.inc(replica=name, outcome="failover")
+                    RECORDER.record("router-failover", rid=rid,
+                                    loop="router", replica=name,
+                                    status=result.status_code)
+                    last_result = result
+                    continue
+                r.breaker.record_success()
+                ROUTER_REQUESTS.inc(replica=name, outcome="ok")
+                _score_affinity(primary, name)
+                RECORDER.record("router-route", rid=rid, loop="router",
+                                replica=name, stream=False,
+                                affinity=bool(primary == name))
+                resp_headers = {
+                    k: v for k, v in result.headers.items()
+                    if k.lower() not in _PASSTHROUGH_SKIP}
+                resp_headers["X-Routed-To"] = name
+                resp_headers["X-Request-Id"] = rid
+                return JSONResponse(result.body,
+                                    status_code=result.status_code,
+                                    headers=resp_headers)
+            except BackendError as e:
+                if e.status_code < 500:
+                    # Client errors are replica-independent: relay
+                    # (outcome "ok" — a faithful 4xx relay is the same
+                    # series on the stream and non-stream paths).
+                    ROUTER_REQUESTS.inc(replica=name, outcome="ok")
+                    resp_headers = dict(e.headers)
+                    resp_headers["X-Routed-To"] = name
+                    return JSONResponse(e.body, status_code=e.status_code,
+                                        headers=resp_headers)
+                r.breaker.record_failure()
+                ROUTER_FAILOVERS.inc(replica=name)
+                ROUTER_REQUESTS.inc(replica=name, outcome="failover")
+                RECORDER.record("router-failover", rid=rid, loop="router",
+                                replica=name, status=e.status_code)
+                last_err = e
+                continue
+            finally:
+                # Streaming success hands the single decrement to the
+                # _StreamGuard; every other exit (non-streaming, any
+                # failure, even a non-BackendError) releases here. The
+                # once-guard keeps the two hand-offs from double-counting.
+                if not guard_owns:
+                    dec()
+        # Exhausted every candidate: relay the terminal failure with its
+        # own status/Retry-After, else shed.
+        if last_err is not None:
+            ROUTER_REQUESTS.inc(replica="none", outcome="error")
+            return JSONResponse(last_err.body,
+                                status_code=last_err.status_code,
+                                headers=last_err.headers)
+        if last_result is not None:
+            ROUTER_REQUESTS.inc(replica="none", outcome="error")
+            resp_headers = {k: v for k, v in last_result.headers.items()
+                            if k.lower() not in _PASSTHROUGH_SKIP}
+            return JSONResponse(last_result.body,
+                                status_code=last_result.status_code,
+                                headers=resp_headers)
+        return _shed_response()
+
+    async def _passthrough(
+        r: Replica, rid: str,
+        first: dict[str, Any] | None,
+        rest: AsyncIterator[dict[str, Any]],
+    ) -> AsyncIterator[bytes]:
+        """SSE pass-through: re-encode upstream events frame-by-frame (the
+        h11 server flushes each yield — TTFT rides the first upstream
+        event untouched). Mid-stream failure → error chunk + [DONE],
+        NEVER a failover (tokens are already on the wire). The in-flight
+        decrement belongs to the wrapping :class:`_StreamGuard`, which
+        fires even when this body never runs."""
+        model = "unknown"
+        try:
+            if first is not None:
+                model = first.get("model") or model
+                yield sse.encode_event(first)
+            async for event in rest:
+                yield sse.encode_event(event)
+        except BackendError as e:
+            r.breaker.record_failure()
+            RECORDER.record("router-stream-broken", rid=rid, loop="router",
+                            replica=r.name, error=str(e)[:200])
+            yield sse.encode_event(
+                oai.error_chunk(f"Backend failed: {e}", model=model))
+        yield sse.encode_done()
+
+    @app.route("GET", "/health", "/v1/health")
+    async def health(request: Request) -> Response:
+        await mgr.ensure_poller()
+        rows = [r.state() | {"in_ring": r.name in mgr.ring}
+                for r in mgr.replicas.values()]
+        in_ring = sum(1 for row in rows if row["in_ring"])
+        if in_ring == len(rows):
+            status = "healthy"
+        elif in_ring:
+            status = "degraded"
+        else:
+            status = "unhealthy"
+        body = {"status": status, "role": "router", "replicas": rows}
+        if status == "unhealthy":
+            return JSONResponse(body, status_code=503,
+                                headers={"Retry-After": "5"})
+        return JSONResponse(body)
+
+    @app.route("GET", "/ready", "/v1/ready")
+    async def ready(request: Request) -> Response:
+        await mgr.ensure_poller()
+        if len(mgr.ring):
+            return JSONResponse({"status": "ready"})
+        return JSONResponse(
+            {"status": "unready", "reason": "no replica in the ring"},
+            status_code=503, headers={"Retry-After": "5"})
+
+    @app.route("GET", "/metrics", "/v1/metrics")
+    async def metrics(request: Request) -> Response:
+        lines = [
+            "# TYPE quorum_tpu_uptime_seconds gauge",
+            f"quorum_tpu_uptime_seconds {time.monotonic() - started:.3f}",
+            "# TYPE quorum_tpu_router_replica_up gauge",
+        ]
+        for name, r in sorted(mgr.replicas.items()):
+            up = 1 if name in mgr.ring else 0
+            lines.append(
+                f'quorum_tpu_router_replica_up{{replica="{name}"}} {up}')
+        lines.append("# TYPE quorum_tpu_router_replicas_in_ring gauge")
+        lines.append(
+            f"quorum_tpu_router_replicas_in_ring {len(mgr.ring)}")
+        lines.append("# TYPE quorum_tpu_router_inflight gauge")
+        lines.append(
+            f"quorum_tpu_router_inflight "
+            f"{sum(r.inflight for r in mgr.replicas.values())}")
+        lines.extend(METRICS.expose())
+        return Response(("\n".join(lines) + "\n").encode(),
+                        media_type="text/plain; version=0.0.4")
+
+    @app.route("GET", "/router/replicas", "/v1/router/replicas")
+    async def replicas(request: Request) -> Response:
+        """Debug surface: live placement state per replica."""
+        await mgr.ensure_poller()
+        return JSONResponse({
+            "policy": cfg.policy,
+            "affinity_chunk": cfg.affinity_chunk,
+            "in_ring": sorted(mgr.ring.members),
+            "migrations": mgr.n_migrations,
+            "replicas": [r.state() | {"in_ring": r.name in mgr.ring}
+                         for r in mgr.replicas.values()],
+        })
+
+    @app.route("POST", "/router/migrate", "/v1/router/migrate")
+    async def migrate(request: Request) -> Response:
+        """Operator-triggered prefix migration: drain ``?from=NAME``'s hot
+        chains to their current ring homes (or pin to ``?to=NAME``) ahead
+        of a planned rotation — the same path the /ready poller drives
+        automatically when a replica sheds."""
+        src = request.query_params.get("from", "")
+        dst = request.query_params.get("to") or None
+        if src not in mgr.replicas or (dst is not None
+                                       and dst not in mgr.replicas):
+            return JSONResponse(
+                {"error": {"message": f"unknown replica (from={src!r}, "
+                           f"to={dst!r}); configured: "
+                           f"{sorted(mgr.replicas)}",
+                           "type": "invalid_request_error"}},
+                status_code=404)
+        try:
+            out = await mgr.migrate_from(src, to=dst)
+        except Exception as e:
+            return JSONResponse(
+                {"error": {"message": f"migration failed: {e}",
+                           "type": "proxy_error"}},
+                status_code=502)
+        return JSONResponse(out)
+
+    return app

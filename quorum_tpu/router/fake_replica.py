@@ -1,0 +1,263 @@
+"""A jax-free scripted replica process — the router's test/bench upstream.
+
+``python -m quorum_tpu.router.fake_replica --port 0`` serves a deterministic
+OpenAI-compatible surface over the bundled h11 server: completions are a
+pure function of the prompt (identical on every replica — the router bench's
+token-for-token pinning rides this), and a REAL
+:class:`~quorum_tpu.cache.prefix_store.PrefixStore` (tiny dummy payloads,
+one uint8 array per chunk) tracks conversation prefixes exactly the way an
+engine's host store does — same trie, same chunking, same LRU — so
+affinity-vs-random hit rates measured against fake replicas use the same
+store code paths as real ones, and ``GET/PUT /debug/prefix/chunks`` speaks
+the real migration wire format (``cache/prefix_wire.py``).
+
+Used by ``scripts/router_bench.py`` (fast mode), the chaos harness's
+replica-kill drill (a killable process with slow streams), and
+``tests/test_router.py``. Admin knobs for drills:
+
+  POST /admin/shed      /ready answers 503 from now on (rotation trigger)
+  POST /admin/recover   /ready answers 200 again
+
+Boot prints ``PORT=<bound port>`` to stdout (``--port 0`` → ephemeral) so a
+spawning parent can address it.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import hashlib
+from typing import Any, AsyncIterator
+
+import numpy as np
+
+from quorum_tpu import oai, sse
+from quorum_tpu.cache import prefix_wire
+from quorum_tpu.cache.prefix_store import PrefixStore
+from quorum_tpu.engine.tokenizer import ByteTokenizer
+from quorum_tpu.server.asgi import (
+    App,
+    JSONResponse,
+    Request,
+    Response,
+    StreamingResponse,
+)
+
+DEFAULT_CHUNK_TOKENS = 16
+DEFAULT_TOKENS = 8
+
+
+def deterministic_completion(prompt: str, n_tokens: int) -> list[str]:
+    """The scripted 'generation': a pure function of the prompt, so every
+    replica (and a single-replica baseline) emits identical tokens."""
+    digest = hashlib.sha256(prompt.encode()).digest()
+    return [f"w{digest[i % len(digest)]:02x}" + (" " if i + 1 < n_tokens
+                                                 else "")
+            for i in range(max(1, n_tokens))]
+
+
+class FakeReplicaState:
+    """One fake replica's store + counters (shared by its routes)."""
+
+    def __init__(self, name: str, chunk_tokens: int = DEFAULT_CHUNK_TOKENS,
+                 max_tokens: int = DEFAULT_TOKENS,
+                 chunk_delay: float = 0.0):
+        self.name = name
+        self.chunk_tokens = int(chunk_tokens)
+        self.max_tokens = int(max_tokens)
+        self.chunk_delay = float(chunk_delay)
+        self.tokenizer = ByteTokenizer(259)
+        self.store = PrefixStore(self.chunk_tokens, 1 << 24)
+        self.shedding = False
+        self.requests = 0
+        self.prefix_hits = 0
+        self.tokens_restored = 0
+
+    def _dummy_payloads(self, n_chunks: int) -> list[list[np.ndarray]]:
+        return [[np.zeros((1, 1, self.chunk_tokens), dtype=np.uint8)]
+                for _ in range(n_chunks)]
+
+    def observe(self, prompt_text: str, completion: str) -> int:
+        """Record the request against the store: a hit when the prompt's
+        prefix chain is already held (an earlier turn, or a migrated
+        seed), then retain prompt+completion — the engine's
+        snapshot-on-release, scripted. Returns matched tokens."""
+        self.requests += 1
+        ids = self.tokenizer.encode(prompt_text)
+        matched, _ = self.store.longest_match(ids)
+        if matched >= self.chunk_tokens:
+            self.prefix_hits += 1
+            self.tokens_restored += matched
+        full = ids + self.tokenizer.encode(completion)
+        n_chunks = len(full) // self.chunk_tokens
+        if n_chunks:
+            self.store.import_chain(full, self._dummy_payloads(n_chunks))
+        return matched
+
+
+def create_fake_replica_app(state: FakeReplicaState) -> App:
+    app = App()
+    app.state["fake"] = state
+
+    @app.route("POST", "/chat/completions", "/v1/chat/completions")
+    async def chat(request: Request) -> Response:
+        try:
+            body = await request.json()
+            if not isinstance(body, dict):
+                raise ValueError("body must be an object")
+        except Exception as e:
+            return JSONResponse(
+                {"error": {"message": f"Invalid JSON body: {e}",
+                           "type": "invalid_request_error"}},
+                status_code=400)
+        if state.shedding:
+            return JSONResponse(
+                {"error": {"message": "shedding (admin)",
+                           "type": "overloaded_error"}},
+                status_code=503, headers={"Retry-After": "1"})
+        messages = body.get("messages") or []
+        prompt = state.tokenizer.render_chat(
+            [m for m in messages if isinstance(m, dict)])
+        n = int(body.get("max_tokens") or state.max_tokens)
+        words = deterministic_completion(prompt, min(n, state.max_tokens))
+        completion = "".join(words)
+        matched = state.observe(prompt, completion)
+        model = body.get("model") or "fake"
+        if body.get("stream"):
+            return StreamingResponse(
+                _stream(model, words, matched))
+        resp = oai.completion(
+            content=completion, model=model,
+            usage={"prompt_tokens": len(prompt),
+                   "completion_tokens": len(words),
+                   "total_tokens": len(prompt) + len(words)})
+        resp["backend"] = state.name
+        return JSONResponse(resp, headers={
+            "X-Fake-Replica": state.name,
+            "X-Prefix-Matched": str(matched)})
+
+    async def _stream(model: str, words: list[str],
+                      matched: int) -> AsyncIterator[bytes]:
+        cid = f"chatcmpl-{state.name}"
+        yield sse.encode_event(
+            oai.chunk(id=cid, model=model, delta={"role": "assistant"}))
+        for w in words:
+            if state.chunk_delay:
+                await asyncio.sleep(state.chunk_delay)
+            yield sse.encode_event(
+                oai.chunk(id=cid, model=model, delta={"content": w}))
+        yield sse.encode_event(
+            oai.chunk(id=cid, model=model, delta={}, finish_reason="stop"))
+        yield sse.encode_done()
+
+    @app.route("GET", "/health", "/v1/health")
+    async def health(request: Request) -> Response:
+        return JSONResponse({"status": "healthy", "fake": True})
+
+    @app.route("GET", "/ready", "/v1/ready")
+    async def ready(request: Request) -> Response:
+        if state.shedding:
+            return JSONResponse(
+                {"status": "unready", "reason": "shedding"},
+                status_code=503, headers={"Retry-After": "1"})
+        return JSONResponse({"status": "ready"})
+
+    @app.route("POST", "/admin/shed", "/v1/admin/shed")
+    async def shed(request: Request) -> Response:
+        state.shedding = True
+        return JSONResponse({"shedding": True})
+
+    @app.route("POST", "/admin/recover", "/v1/admin/recover")
+    async def recover(request: Request) -> Response:
+        state.shedding = False
+        return JSONResponse({"shedding": False})
+
+    @app.route("GET", "/metrics", "/v1/metrics")
+    async def metrics(request: Request) -> Response:
+        n = state.name
+        lines = [
+            "# TYPE quorum_tpu_engine_requests_total counter",
+            f'quorum_tpu_engine_requests_total{{backend="{n}"}} '
+            f"{state.requests}",
+            "# TYPE quorum_tpu_engine_prefix_store_hits_total counter",
+            f'quorum_tpu_engine_prefix_store_hits_total{{backend="{n}"}} '
+            f"{state.prefix_hits}",
+            "# TYPE quorum_tpu_engine_prefix_store_restored_tokens_total "
+            "counter",
+            f"quorum_tpu_engine_prefix_store_restored_tokens_total"
+            f'{{backend="{n}"}} {state.tokens_restored}',
+            "# TYPE quorum_tpu_engine_prefix_store_bytes gauge",
+            f'quorum_tpu_engine_prefix_store_bytes{{backend="{n}"}} '
+            f"{state.store.bytes_held}",
+            "# TYPE quorum_tpu_engine_prefix_store_entries gauge",
+            f'quorum_tpu_engine_prefix_store_entries{{backend="{n}"}} '
+            f"{state.store.n_entries}",
+        ]
+        return Response(("\n".join(lines) + "\n").encode(),
+                        media_type="text/plain; version=0.0.4")
+
+    @app.route("GET", "/debug/prefix/chunks", "/v1/debug/prefix/chunks")
+    async def export_chunks(request: Request) -> Response:
+        blob = prefix_wire.serialize_chains(
+            state.store.export_chains(), state.chunk_tokens)
+        return Response(blob, media_type="application/octet-stream",
+                        headers={"X-Prefix-Chunk-Tokens":
+                                 str(state.chunk_tokens)})
+
+    @app.route("PUT", "/debug/prefix/chunks", "/v1/debug/prefix/chunks")
+    async def import_chunks(request: Request) -> Response:
+        try:
+            chunk_tokens, chains = prefix_wire.parse(await request.body())
+            if chunk_tokens != state.chunk_tokens:
+                raise prefix_wire.WireError(
+                    f"chunk_tokens={chunk_tokens} != "
+                    f"{state.chunk_tokens}")
+        except prefix_wire.WireError as e:
+            return JSONResponse(
+                {"error": {"message": str(e),
+                           "type": "invalid_request_error"}},
+                status_code=400)
+        imported = 0
+        for chain in chains:
+            imported += state.store.import_chain(chain.tokens,
+                                                 chain.payloads)
+        return JSONResponse({"chains": len(chains),
+                             "tokens_imported": imported,
+                             "store_entries": state.store.n_entries})
+
+    return app
+
+
+async def _serve(args) -> None:
+    from quorum_tpu.server.serve import start_server
+
+    state = FakeReplicaState(
+        args.name, chunk_tokens=args.chunk_tokens,
+        max_tokens=args.tokens, chunk_delay=args.chunk_delay)
+    app = create_fake_replica_app(state)
+    server = await start_server(app, args.host, args.port)
+    port = server.sockets[0].getsockname()[1]
+    print(f"PORT={port}", flush=True)
+    async with server:
+        await server.serve_forever()
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser(
+        description="deterministic jax-free fake replica")
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--port", type=int, default=0)
+    parser.add_argument("--name", default="fake")
+    parser.add_argument("--tokens", type=int, default=DEFAULT_TOKENS)
+    parser.add_argument("--chunk-tokens", type=int,
+                        default=DEFAULT_CHUNK_TOKENS)
+    parser.add_argument("--chunk-delay", type=float, default=0.0)
+    args = parser.parse_args()
+    try:
+        asyncio.run(_serve(args))
+    except KeyboardInterrupt:
+        pass
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,246 @@
+"""Per-replica state + the replica set manager (polling, rotation, migration).
+
+Each upstream engine cell is one :class:`Replica`: an
+:class:`~quorum_tpu.backends.http_backend.HttpBackend` for the data plane
+(pooled client, capped-exponential retries, Retry-After pacing — the PR 4
+machinery, reused not reinvented), a per-replica
+:class:`~quorum_tpu.breaker.Breaker` (repeated pre-stream failures take the
+replica out of contention until a cooldown probe lands), and an in-flight
+counter feeding the ring's bounded-load spill.
+
+:class:`ReplicaSet` owns membership: a background poller consumes each
+replica's ``GET /ready`` — the engine's truthful shedding signal — and a
+replica answering unready ROTATES OUT of the consistent-hash ring (its key
+ranges spill to its clockwise successors; everyone else's placement is
+untouched). If the rotating replica is still reachable (shedding, not
+dead), the poller migrates its hot prefixes first: fetch the serialized
+chunk chains (``GET /debug/prefix/chunks``), re-key each chain through the
+post-rotation ring, and seed the successors (``PUT``) so the conversations
+that spill arrive to a warm tier-1 store instead of paying cold prefill.
+A replica answering ready again rejoins the ring — and reclaims its key
+ranges, where its own store is still warmest.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+
+import httpx
+
+from quorum_tpu.backends.http_backend import HttpBackend
+from quorum_tpu.breaker import Breaker
+from quorum_tpu.cache import prefix_wire
+from quorum_tpu.observability import (
+    ROUTER_MIGRATED_BYTES,
+    ROUTER_MIGRATED_CHAINS,
+)
+from quorum_tpu.router import affinity
+from quorum_tpu.router.ring import BoundedLoadRing
+from quorum_tpu.telemetry.recorder import RECORDER
+
+logger = logging.getLogger(__name__)
+
+# Control-plane timeouts (data-plane calls carry the request's own budget).
+READY_TIMEOUT_S = 3.0
+MIGRATE_TIMEOUT_S = 30.0
+
+
+class Replica:
+    """One engine cell behind the router."""
+
+    def __init__(self, name: str, url: str, *, retries: int = 1,
+                 breaker: Breaker | None = None,
+                 client: httpx.AsyncClient | None = None):
+        self.name = name
+        self.url = url.rstrip("/")
+        self.backend = HttpBackend(name, url, model="", client=client,
+                                   retries=retries)
+        self.breaker = breaker or Breaker()
+        self.ready = True          # last /ready verdict (optimistic start)
+        self.reachable = True      # the last probe got ANY HTTP answer
+        self.inflight = 0          # router-side in-flight (bounded load)
+        self.requests = 0
+
+    def state(self) -> dict:
+        return {
+            "name": self.name,
+            "url": self.url,
+            "ready": self.ready,
+            "reachable": self.reachable,
+            "breaker": self.breaker.state,
+            "inflight": self.inflight,
+            "requests": self.requests,
+        }
+
+
+class ReplicaSet:
+    """Membership + placement + rotation for a set of replicas."""
+
+    def __init__(self, replicas: list[Replica], *,
+                 vnodes: int = 64, load_factor: float = 1.25,
+                 affinity_chunk: int = affinity.DEFAULT_AFFINITY_CHUNK,
+                 ready_interval: float = 2.0,
+                 migrate_on_rotation: bool = True,
+                 control_client: httpx.AsyncClient | None = None):
+        if not replicas:
+            raise ValueError("router needs at least one replica")
+        names = [r.name for r in replicas]
+        if len(set(names)) != len(names):
+            raise ValueError(f"duplicate replica names: {names}")
+        self.replicas: dict[str, Replica] = {r.name: r for r in replicas}
+        self.ring = BoundedLoadRing(vnodes=vnodes, load_factor=load_factor)
+        for r in replicas:
+            self.ring.add(r.name)
+        self.affinity_chunk = int(affinity_chunk)
+        self.ready_interval = float(ready_interval)
+        self.migrate_on_rotation = bool(migrate_on_rotation)
+        self._control = control_client or httpx.AsyncClient()
+        self._poll_task: asyncio.Task | None = None
+        self._transition_lock = asyncio.Lock()
+        self.n_migrations = 0
+
+    # ---- placement ---------------------------------------------------------
+
+    def loads(self) -> dict[str, int]:
+        return {name: r.inflight for name, r in self.replicas.items()}
+
+    def placement(self, key: int) -> tuple[str | None, list[str]]:
+        """``(affinity primary, candidate order)`` for a conversation key.
+        The primary is membership-pure (what the hit/miss accounting
+        compares against); the candidate order additionally folds in
+        bounded load."""
+        return (self.ring.primary(key),
+                self.ring.candidates(key, self.loads()))
+
+    # ---- readiness polling -------------------------------------------------
+
+    async def ensure_poller(self) -> None:
+        """Start the background /ready poller lazily (the app has no
+        lifespan hook under the bundled h11 server); idempotent, no-op
+        when polling is disabled (``ready_interval <= 0``)."""
+        if self.ready_interval <= 0:
+            return
+        if self._poll_task is None or self._poll_task.done():
+            self._poll_task = asyncio.get_running_loop().create_task(
+                self._poll_loop())
+
+    async def _poll_loop(self) -> None:
+        while True:
+            try:
+                await self.poll_once()
+            except Exception:
+                logger.exception("replica readiness poll failed")
+            await asyncio.sleep(self.ready_interval)
+
+    async def poll_once(self) -> None:
+        """One readiness sweep: probe every replica's /ready, rotate the
+        ring on transitions (unready → out + migrate; ready → back in)."""
+        async with self._transition_lock:
+            for r in list(self.replicas.values()):
+                try:
+                    resp = await self._control.get(
+                        f"{r.url}/ready", timeout=READY_TIMEOUT_S)
+                    now_ready = resp.status_code == 200
+                    reachable = True
+                except Exception:
+                    now_ready = False
+                    reachable = False
+                was_in = r.name in self.ring
+                r.reachable = reachable
+                r.ready = now_ready
+                if was_in and not now_ready:
+                    self.ring.remove(r.name)
+                    RECORDER.record("router-replica-out", loop="router",
+                                    replica=r.name, reachable=reachable)
+                    logger.warning(
+                        "replica %s rotated OUT of the ring (%s)", r.name,
+                        "shedding" if reachable else "unreachable")
+                    if reachable and self.migrate_on_rotation and \
+                            len(self.ring):
+                        try:
+                            await self.migrate_from(r.name)
+                        except Exception:
+                            logger.exception(
+                                "prefix migration from %s failed (best "
+                                "effort — spilled conversations prefill "
+                                "cold)", r.name)
+                elif not was_in and now_ready:
+                    self.ring.add(r.name)
+                    RECORDER.record("router-replica-in", loop="router",
+                                    replica=r.name)
+                    logger.info("replica %s rejoined the ring", r.name)
+
+    # ---- prefix migration --------------------------------------------------
+
+    async def migrate_from(self, name: str,
+                           to: str | None = None) -> dict:
+        """Move ``name``'s hot prefix chains to their post-rotation homes:
+        fetch the serialized store, re-key every chain through the CURRENT
+        ring (which no longer contains ``name`` when it was rotated out —
+        or pin everything to ``to``), and seed each target replica.
+        Best-effort by design: any failure loses warmth, never
+        correctness (the successor simply prefills cold)."""
+        src = self.replicas[name]
+        resp = await self._control.get(
+            f"{src.url}/debug/prefix/chunks", timeout=MIGRATE_TIMEOUT_S)
+        if resp.status_code != 200:
+            return {"migrated_chains": 0, "migrated_bytes": 0,
+                    "skipped": f"source export HTTP {resp.status_code}"}
+        blob = resp.content
+        chunk_tokens, chains = prefix_wire.parse(blob)
+        groups: dict[str, list] = {}
+        for chain in chains:
+            target = to or self.ring.primary(
+                affinity.chain_key(chain.tokens, self.affinity_chunk))
+            if target is None or target == name:
+                continue
+            groups.setdefault(target, []).append(chain)
+        moved_chains = 0
+        moved_bytes = 0
+        t0 = time.perf_counter()
+        for target, group in groups.items():
+            dst = self.replicas.get(target)
+            if dst is None:
+                continue
+            out = prefix_wire.serialize_chains(
+                [(c.tokens, c.payloads) for c in group], chunk_tokens)
+            try:
+                put = await self._control.put(
+                    f"{dst.url}/debug/prefix/chunks", content=out,
+                    headers={"Content-Type": "application/octet-stream"},
+                    timeout=MIGRATE_TIMEOUT_S)
+            except Exception:
+                logger.exception("prefix seed PUT to %s failed", target)
+                continue
+            if put.status_code == 200:
+                moved_chains += len(group)
+                moved_bytes += len(out)
+        dt = time.perf_counter() - t0
+        ROUTER_MIGRATED_BYTES.inc(moved_bytes)
+        ROUTER_MIGRATED_CHAINS.inc(moved_chains)
+        self.n_migrations += 1
+        RECORDER.record("router-migrate", loop="router", replica=name,
+                        chains=moved_chains, bytes=moved_bytes,
+                        targets=sorted(groups), seconds=round(dt, 4))
+        logger.info(
+            "migrated %d prefix chains (%d bytes) from %s to %s in %.3fs",
+            moved_chains, moved_bytes, name, sorted(groups), dt)
+        return {"migrated_chains": moved_chains,
+                "migrated_bytes": moved_bytes,
+                "targets": sorted(groups)}
+
+    # ---- teardown ----------------------------------------------------------
+
+    async def aclose(self) -> None:
+        if self._poll_task is not None:
+            self._poll_task.cancel()
+            try:
+                await self._poll_task
+            except (asyncio.CancelledError, Exception):
+                pass
+            self._poll_task = None
+        for r in self.replicas.values():
+            await r.backend.aclose()
+        await self._control.aclose()

@@ -1,0 +1,119 @@
+"""Bounded-load consistent hashing over replica names.
+
+The placement primitive of the router tier: conversation keys hash onto a
+ring of virtual nodes (many per replica, so key ranges are fine-grained),
+each key's *primary* is the first replica clockwise from its hash point,
+and a replica leaving the ring spills exactly its own key ranges onto the
+clockwise successors — every other conversation keeps its replica, which
+is the whole point (a naive ``hash % N`` remap would cold-start (N−1)/N of
+all conversations' prefix caches on every membership change).
+
+Bounded load (the consistent-hashing-with-bounded-loads construction,
+Mirrokni et al. — Google's Maglev/Vimeo production variant): a hot key
+range must not melt one replica while its neighbors idle, so a candidate
+already carrying more than ``load_factor ×`` the mean in-flight load is
+skipped and the key spills to the next candidate FOR THIS REQUEST ONLY —
+membership, and therefore every other key's placement, is untouched. The
+spill is a deliberate affinity miss under overload: a cold prefill beats
+queueing behind the hot spot.
+
+Pure data structure — no I/O, no clocks; the router's replica manager owns
+membership transitions and feeds in live loads.
+"""
+
+from __future__ import annotations
+
+import bisect
+import hashlib
+import math
+
+DEFAULT_VNODES = 64
+DEFAULT_LOAD_FACTOR = 1.25
+
+
+def hash_key(data: bytes) -> int:
+    """Stable 64-bit ring position for a key (blake2b — fast, stdlib,
+    uniform; NOT Python's hash(), which is salted per process)."""
+    return int.from_bytes(
+        hashlib.blake2b(data, digest_size=8).digest(), "big")
+
+
+class BoundedLoadRing:
+    """Consistent-hash ring with bounded-load candidate ordering."""
+
+    def __init__(self, vnodes: int = DEFAULT_VNODES,
+                 load_factor: float = DEFAULT_LOAD_FACTOR):
+        if vnodes < 1:
+            raise ValueError(f"vnodes must be >= 1, got {vnodes}")
+        if load_factor < 1.0:
+            raise ValueError(
+                f"load_factor must be >= 1.0, got {load_factor}")
+        self.vnodes = int(vnodes)
+        self.load_factor = float(load_factor)
+        self._points: list[tuple[int, str]] = []  # sorted (position, name)
+        self._names: set[str] = set()
+
+    def __len__(self) -> int:
+        return len(self._names)
+
+    def __contains__(self, name: str) -> bool:
+        return name in self._names
+
+    @property
+    def members(self) -> set[str]:
+        return set(self._names)
+
+    def add(self, name: str) -> None:
+        if name in self._names:
+            return
+        self._names.add(name)
+        for i in range(self.vnodes):
+            point = hash_key(f"{name}#{i}".encode())
+            bisect.insort(self._points, (point, name))
+
+    def remove(self, name: str) -> None:
+        if name not in self._names:
+            return
+        self._names.discard(name)
+        self._points = [(p, n) for p, n in self._points if n != name]
+
+    def primary(self, key: int) -> str | None:
+        """The replica ``key`` hashes to with membership alone — no load
+        bound, no failover. This is the affinity home the hit/miss
+        accounting compares against."""
+        if not self._points:
+            return None
+        i = bisect.bisect_right(self._points, (key, "￿"))
+        return self._points[i % len(self._points)][1]
+
+    def candidates(self, key: int,
+                   loads: dict[str, int] | None = None) -> list[str]:
+        """Every ring member, ordered for this key: the clockwise walk
+        from the key's hash point (primary first, then the successors its
+        range would spill to), with members past the bounded-load capacity
+        demoted to the tail — still eligible (a failover target of last
+        resort beats a 503) but only after every underloaded member.
+
+        ``loads`` is in-flight requests per replica; capacity is
+        ``ceil(load_factor × (total + 1) / n)`` counting the request being
+        placed, so with uniform load nothing is ever demoted."""
+        if not self._points:
+            return []
+        order: list[str] = []
+        seen: set[str] = set()
+        start = bisect.bisect_right(self._points, (key, "￿"))
+        n_points = len(self._points)
+        for off in range(n_points):
+            name = self._points[(start + off) % n_points][1]
+            if name not in seen:
+                seen.add(name)
+                order.append(name)
+                if len(order) == len(self._names):
+                    break
+        if not loads:
+            return order
+        total = sum(loads.get(n, 0) for n in order) + 1
+        cap = math.ceil(self.load_factor * total / len(order))
+        fits = [n for n in order if loads.get(n, 0) < cap]
+        over = [n for n in order if loads.get(n, 0) >= cap]
+        return fits + over

@@ -444,6 +444,86 @@ def create_app(
                 status_code=500)
         return JSONResponse({"profile_dir": out_dir, "seconds": seconds})
 
+    def _prefix_store_engine(reg: BackendRegistry, name: str | None):
+        """The engine whose host prefix store /debug/prefix/chunks serves:
+        ``?backend=`` selects by backend name; default is the first
+        store-backed engine in config order. None when no engine carries a
+        store."""
+        rows = [(n, e) for n, e in _distinct_engines(reg, "prefix_store")
+                if getattr(e, "prefix_store", None) is not None]
+        if name:
+            rows = [(n, e) for n, e in rows if n == name]
+        return rows[0] if rows else (None, None)
+
+    @app.route("GET", "/debug/prefix/chunks", "/v1/debug/prefix/chunks")
+    async def prefix_chunks_export(request: Request) -> Response:
+        """Serialize the host prefix store's restorable chunk chains (the
+        migration wire format, quorum_tpu/cache/prefix_wire.py) — the
+        router tier fetches this from a replica rotating out of the ring
+        and seeds its ring successors, so spilled conversations restore a
+        warm tier-1 prefix instead of paying cold prefill. ``?backend=``
+        selects among engines; ``?max_bytes=`` bounds the export."""
+        _, reg = await current()
+        name, engine = _prefix_store_engine(
+            reg, request.query_params.get("backend"))
+        if engine is None:
+            return JSONResponse(
+                {"error": {"message": "no engine with a host prefix store "
+                           "(prefix_store=host) is configured",
+                           "type": "invalid_request_error"}},
+                status_code=404)
+        raw_max = request.query_params.get("max_bytes")
+        max_bytes = None
+        if raw_max is not None:
+            # A caller who asked for a bound must GET a bound: an
+            # unparseable or non-positive value is a 400, never a silent
+            # full-store export (the whole point of the knob is capping
+            # payload size).
+            try:
+                max_bytes = int(raw_max)
+            except ValueError:
+                max_bytes = -1
+            if max_bytes < 1:
+                return JSONResponse(
+                    {"error": {"message": f"'max_bytes' must be a "
+                               f"positive integer, got {raw_max!r}",
+                               "type": "invalid_request_error"}},
+                    status_code=400)
+        blob = await asyncio.to_thread(engine.export_prefix_chunks,
+                                       max_bytes)
+        return Response(
+            blob, media_type="application/octet-stream",
+            headers={"X-Prefix-Chunk-Tokens":
+                     str(engine.prefix_store.chunk_tokens),
+                     "X-Prefix-Backend": name})
+
+    @app.route("PUT", "/debug/prefix/chunks", "/v1/debug/prefix/chunks")
+    async def prefix_chunks_import(request: Request) -> Response:
+        """Seed the host prefix store from a peer replica's export. The
+        engine validates the blob against its own cache layout (chunk
+        granularity, leaf count, per-leaf dtype/shape) — a mismatched blob
+        is a 400, never a poisoned store."""
+        _, reg = await current()
+        name, engine = _prefix_store_engine(
+            reg, request.query_params.get("backend"))
+        if engine is None:
+            return JSONResponse(
+                {"error": {"message": "no engine with a host prefix store "
+                           "(prefix_store=host) is configured",
+                           "type": "invalid_request_error"}},
+                status_code=404)
+        blob = await request.body()
+        try:
+            stats = await asyncio.to_thread(engine.import_prefix_chunks,
+                                            blob)
+        except ValueError as e:
+            return JSONResponse(
+                {"error": {"message": f"prefix-chunk import rejected: {e}",
+                           "type": "invalid_request_error"}},
+                status_code=400)
+        stats["backend"] = name
+        return JSONResponse(stats)
+
     @app.route("POST", "/chat/completions", "/v1/chat/completions")
     async def chat_completions(request: Request) -> Response:
         """Request-id + tracing + profiling wrapper around the dispatch

@@ -16,7 +16,13 @@ asserts the containment contract of docs/robustness.md:
     token-for-token against the pre-chaos baseline (fault machinery is
     inert when disarmed);
   - the HTTP backend retry ladder recovers from transient connect
-    errors / 5xx within its budget.
+    errors / 5xx within its budget;
+  - the router replica-kill drill (phase 6, docs/scaling.md): SIGKILL one
+    replica under load — the survivor's in-flight stream completes
+    untouched, the dead replica's requests fail over and complete
+    elsewhere within their deadlines, the /ready poller rotates the
+    corpse out of the ring, and with every replica dead the router sheds
+    503 + Retry-After instead of hanging.
 
 Exit codes: 0 = all checks passed, 1 = at least one failed, 2 = the harness
 itself hung (watchdog). ``tests/test_robustness.py`` runs the quick subset
@@ -86,6 +92,178 @@ def _flight_dump_check(label: str, needle: str) -> None:
         detail = f"site {needle!r} in none of {len(files)} dumps"
     check(f"{label}: flight-recorder dump holds the faulted site", ok,
           detail)
+
+
+def _spawn_fake_replica(name: str, *, chunk_delay: float = 0.0,
+                        tokens: int = 8):
+    """Spawn a killable jax-free fake replica process; returns
+    ``(proc, base_url)`` once it prints its bound port."""
+    import subprocess
+
+    env = dict(os.environ)
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "quorum_tpu.router.fake_replica",
+         "--name", name, "--port", "0",
+         "--chunk-delay", str(chunk_delay), "--tokens", str(tokens)],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+        text=True, env=env)
+    deadline = time.time() + 30.0
+    while time.time() < deadline:
+        line = proc.stdout.readline()
+        if not line:
+            break
+        if line.startswith("PORT="):
+            port = int(line.strip().split("=", 1)[1])
+            return proc, f"http://127.0.0.1:{port}"
+    proc.kill()
+    raise RuntimeError(f"fake replica {name} never bound a port")
+
+
+async def _router_kill_drill(check) -> None:
+    """Phase 6 body: two fake replica processes behind the real router
+    app; SIGKILL one mid-stream and assert the containment contract."""
+    import httpx
+
+    from quorum_tpu.router import affinity as aff
+    from quorum_tpu.router.app import RouterConfig, create_router_app
+    from quorum_tpu.telemetry.recorder import RECORDER
+
+    proc_a = proc_b = None
+    try:
+        proc_a, url_a = _spawn_fake_replica("kill-a", chunk_delay=0.05,
+                                            tokens=60)
+        proc_b, url_b = _spawn_fake_replica("kill-b", chunk_delay=0.05,
+                                            tokens=60)
+        rcfg = RouterConfig(
+            replicas=[("kill-a", url_a), ("kill-b", url_b)],
+            ready_interval=0.25, retries=1, timeout=20.0,
+            breaker_threshold=2, breaker_cooldown=0.5,
+            migrate_on_rotation=False)
+        router_app = create_router_app(rcfg)
+        mgr = router_app.state["replica_set"]
+
+        def body_keyed_to(target: str, *, stream: bool,
+                          max_tokens: int = 60, salt: str = "") -> dict:
+            """A conversation whose affinity primary is ``target``."""
+            for i in range(200):
+                msgs = [{"role": "user",
+                         "content": f"drill{salt} conversation {i}: "
+                                    "please answer at length"}]
+                key = aff.conversation_key({"messages": msgs},
+                                           rcfg.affinity_chunk)
+                if mgr.ring.primary(key) == target:
+                    return {"model": "m", "messages": msgs,
+                            "stream": stream, "max_tokens": max_tokens}
+            raise RuntimeError(f"no key found for {target}")
+
+        transport = httpx.ASGITransport(app=router_app)
+        async with httpx.AsyncClient(transport=transport,
+                                     base_url="http://router",
+                                     timeout=30.0) as rc:
+
+            async def consume_stream(body: dict) -> dict:
+                out = {"tokens": 0, "done": False, "error_chunks": 0,
+                       "routed": None}
+                async with rc.stream("POST", "/chat/completions",
+                                     json=body) as resp:
+                    out["status"] = resp.status_code
+                    out["routed"] = resp.headers.get("x-routed-to")
+                    async for line in resp.aiter_lines():
+                        if not line.startswith("data: "):
+                            continue
+                        data = line[len("data: "):]
+                        if data.strip() == "[DONE]":
+                            out["done"] = True
+                            continue
+                        ev = json.loads(data)
+                        choice = (ev.get("choices") or [{}])[0]
+                        delta = choice.get("delta") or {}
+                        if choice.get("finish_reason") == "error":
+                            out["error_chunks"] += 1
+                        elif delta.get("content"):
+                            out["tokens"] += 1
+                return out
+
+            # In-flight streams on BOTH replicas (~3s each at 60 tokens
+            # x 50ms), then SIGKILL replica A mid-stream.
+            # Keys computed BEFORE the kill: the poller may rotate the
+            # corpse out at any tick, after which no key maps to it.
+            queued_bodies = [
+                body_keyed_to("kill-a", stream=False, max_tokens=4,
+                              salt=f"q{i}")
+                for i in range(3)]
+            stream_a = asyncio.create_task(consume_stream(
+                body_keyed_to("kill-a", stream=True)))
+            stream_b = asyncio.create_task(consume_stream(
+                body_keyed_to("kill-b", stream=True)))
+            await asyncio.sleep(0.6)  # both streams well under way
+            proc_a.kill()
+            proc_a.wait()
+            # "Queued for A" requests arriving after the kill: they must
+            # fail over to B and complete within their deadline.
+            t0 = time.time()
+            queued = await asyncio.wait_for(asyncio.gather(
+                *(rc.post("/chat/completions", json=body)
+                  for body in queued_bodies)), timeout=15.0)
+            failover_wall = time.time() - t0
+            got_a = await asyncio.wait_for(stream_a, timeout=30.0)
+            got_b = await asyncio.wait_for(stream_b, timeout=30.0)
+            check("router kill: survivor stream unharmed",
+                  got_b["routed"] == "kill-b" and got_b["tokens"] == 60
+                  and got_b["done"] and got_b["error_chunks"] == 0,
+                  f"{got_b}")
+            check("router kill: killed stream errors, never hangs or "
+                  "double-delivers",
+                  got_a["routed"] == "kill-a" and got_a["tokens"] < 60
+                  and got_a["error_chunks"] == 1 and got_a["done"],
+                  f"{got_a}")
+            check("router kill: queued requests complete elsewhere in "
+                  "deadline",
+                  all(r.status_code == 200
+                      and r.headers.get("x-routed-to") == "kill-b"
+                      for r in queued) and failover_wall < 10.0,
+                  f"statuses={[r.status_code for r in queued]} "
+                  f"wall={failover_wall:.1f}s")
+            # The /ready poller rotates the corpse out of the ring.
+            poll_deadline = time.time() + 5.0
+            while time.time() < poll_deadline and "kill-a" in mgr.ring:
+                await asyncio.sleep(0.1)
+            check("router kill: dead replica rotated out of the ring",
+                  "kill-a" not in mgr.ring and "kill-b" in mgr.ring,
+                  f"ring={sorted(mgr.ring.members)}")
+            after = await rc.post(
+                "/chat/completions",
+                json=body_keyed_to("kill-b", stream=False, max_tokens=4))
+            check("router kill: post-rotation requests serve from the "
+                  "survivor", after.status_code == 200
+                  and after.headers.get("x-routed-to") == "kill-b")
+            events = json.dumps(RECORDER.snapshot())
+            check("router kill: failover visible on metrics + flight "
+                  "recorder",
+                  "router-failover" in events
+                  and "router-replica-out" in events)
+            # Kill the survivor too: the router must shed, never hang.
+            proc_b.kill()
+            proc_b.wait()
+            while time.time() < poll_deadline + 5.0 and len(mgr.ring):
+                await asyncio.sleep(0.1)
+            shed = await asyncio.wait_for(
+                rc.post("/chat/completions",
+                        json={"model": "m", "max_tokens": 4,
+                              "messages": [{"role": "user",
+                                            "content": "anyone alive?"}]}),
+                timeout=15.0)
+            check("router kill: all replicas dead -> 503 + Retry-After, "
+                  "no hang",
+                  shed.status_code == 503
+                  and "retry-after" in {k.lower() for k in shed.headers},
+                  f"status={shed.status_code}")
+            await mgr.aclose()
+    finally:
+        for proc in (proc_a, proc_b):
+            if proc is not None and proc.poll() is None:
+                proc.kill()
+                proc.wait()
 
 
 def _config() -> dict:
@@ -505,6 +683,19 @@ async def _run(quick: bool) -> None:
         check("http retry: injected transport fault recovered",
               result.status_code == 200)
         await hb.aclose()
+
+        # ---- phase 6: router replica-kill drill --------------------------
+        # The multi-replica tier's containment contract (docs/scaling.md):
+        # SIGKILL one replica under load — the survivor's in-flight stream
+        # is untouched, requests keyed to the dead replica fail over and
+        # complete elsewhere within their deadlines, the /ready poller
+        # rotates the corpse out of the ring, and with EVERY replica dead
+        # the router sheds 503 + Retry-After instead of hanging. Fake
+        # (jax-free, killable) replica processes keep the drill about the
+        # ROUTER's behavior, not engine boot time.
+        if not quick:
+            print("phase 6: router replica-kill", flush=True)
+            await _router_kill_drill(check)
 
     from quorum_tpu.engine.engine import shutdown_all_engines
 

@@ -1,0 +1,431 @@
+"""Router bench: prefix-affinity routing vs a random baseline at N replicas.
+
+``make router-bench`` measures what the router tier is FOR — converting
+extra replicas into prefix-cache hits instead of cold prefills:
+
+  - **fake legs** (N=2 and N=4, seconds): jax-free scripted replicas
+    (quorum_tpu/router/fake_replica.py) carrying a REAL PrefixStore each,
+    driven through the real router app over real sockets. Measures
+    affinity-vs-random prefix-hit rate with zero engine noise.
+  - **real leg** (N=2, minutes on CPU): subprocess replicas serving tiny
+    ``tpu://`` engines with ``prefix_store=host`` under slot churn
+    (conversations > slots — the regime where the host store carries the
+    hits), plus a dedicated single-replica baseline process for
+    token-for-token output pinning. ``--skip-real`` / ``--mode fake``
+    skips it.
+
+Per leg it reports aggregate tok/s, prefix-hit rate (replica-side
+``quorum_tpu_engine_prefix_store_hits_total`` deltas over the turns that
+COULD hit — everything after each conversation's first), and per-replica
+request spread; the affinity and random legs use disjoint conversation
+families so one leg's store warmth cannot subsidize the other.
+
+Acceptance (asserted, exit 1 on failure): affinity hit rate strictly above
+random at every N, and per-conversation outputs token-for-token identical
+to single-replica serving. ``tests/test_router_bench.py`` runs the fake
+leg as a fast smoke inside ``make verify``'s test tier.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import re
+import subprocess
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+os.environ.setdefault("QUORUM_TPU_COMPILE_CACHE", "0")
+
+import httpx  # noqa: E402
+
+REPLICA_BOOT_TIMEOUT_S = 240.0
+CONCURRENCY = 4
+
+ENGINE_URL = ("tpu://llama-tiny?seed=7&slots=2&queue=32&decode_chunk=4"
+              "&prefill_chunk=16&prefix_store=host&prefix_store_chunk=16"
+              "&max_seq=512&max_tokens=24")
+
+
+def conversation_opening(family: str, i: int) -> str:
+    """Distinct per-conversation opening, long enough to cover several
+    prefix chunks (the store only retains whole chunks)."""
+    return (f"[{family}/conv-{i:02d}] You are assisting with scenario "
+            f"number {i} of family {family}. The running context is a "
+            "long-lived support conversation whose history must be "
+            "retained across turns so the key-value prefix cache can "
+            "prove itself. Opening question: what should happen next?")
+
+
+async def _chat(client: httpx.AsyncClient, base: str, body: dict) -> dict:
+    r = await client.post(f"{base}/chat/completions", json=body,
+                          headers={"Authorization": "Bearer bench"},
+                          timeout=120.0)
+    if r.status_code != 200:
+        raise RuntimeError(f"chat HTTP {r.status_code}: {r.text[:300]}")
+    return r.json()
+
+
+async def drive_conversations(
+    client: httpx.AsyncClient, base: str, *, family: str,
+    n_conversations: int, turns: int, max_tokens: int, model: str,
+    concurrency: int = CONCURRENCY,
+) -> dict:
+    """Run the multi-turn conversation load; returns outputs + timing."""
+    sem = asyncio.Semaphore(concurrency)
+    outputs: dict[int, list[str]] = {}
+    total_tokens = 0
+
+    async def one(i: int) -> None:
+        nonlocal total_tokens
+        msgs = [{"role": "user", "content": conversation_opening(family, i)}]
+        outs = []
+        for t in range(turns):
+            async with sem:
+                resp = await _chat(client, base, {
+                    "model": model, "messages": msgs,
+                    "temperature": 0.0, "max_tokens": max_tokens})
+            content = resp["choices"][0]["message"]["content"]
+            outs.append(content)
+            total_tokens += (resp.get("usage") or {}).get(
+                "completion_tokens", 0)
+            msgs = msgs + [
+                {"role": "assistant", "content": content},
+                {"role": "user", "content": f"[{family}] follow-up {t}: "
+                                            "and after that?"}]
+        outputs[i] = outs
+
+    t0 = time.perf_counter()
+    await asyncio.gather(*(one(i) for i in range(n_conversations)))
+    wall = time.perf_counter() - t0
+    return {"outputs": outputs, "wall_s": wall,
+            "completion_tokens": total_tokens,
+            "tok_s": total_tokens / wall if wall > 0 else 0.0}
+
+
+_METRIC_RE = re.compile(
+    r'^(quorum_tpu_engine_[a-z_]+)\{backend="([^"]+)"\}\s+([0-9.eE+-]+)$')
+
+
+async def replica_metrics(client: httpx.AsyncClient, url: str) -> dict:
+    out: dict[str, float] = {}
+    r = await client.get(f"{url}/metrics", timeout=30.0)
+    for line in r.text.splitlines():
+        m = _METRIC_RE.match(line.strip())
+        if m:
+            out[m.group(1)] = out.get(m.group(1), 0.0) + float(m.group(3))
+    return out
+
+
+async def measure_leg(
+    client: httpx.AsyncClient, router_base: str, replica_urls: list[str],
+    *, family: str, n_conversations: int, turns: int, max_tokens: int,
+    model: str, concurrency: int = CONCURRENCY,
+) -> dict:
+    """One policy leg: drive the load through the router, report tok/s +
+    the replica-side prefix-hit rate over the eligible (non-first) turns."""
+    before = [await replica_metrics(client, u) for u in replica_urls]
+    run = await drive_conversations(
+        client, router_base, family=family,
+        n_conversations=n_conversations, turns=turns,
+        max_tokens=max_tokens, model=model, concurrency=concurrency)
+    after = [await replica_metrics(client, u) for u in replica_urls]
+    hits = sum(
+        a.get("quorum_tpu_engine_prefix_store_hits_total", 0.0)
+        - b.get("quorum_tpu_engine_prefix_store_hits_total", 0.0)
+        for a, b in zip(after, before))
+    requests = [
+        a.get("quorum_tpu_engine_requests_total",
+              a.get("quorum_tpu_engine_n_completed", 0.0))
+        - b.get("quorum_tpu_engine_requests_total",
+                b.get("quorum_tpu_engine_n_completed", 0.0))
+        for a, b in zip(after, before)]
+    eligible = n_conversations * (turns - 1)
+    return {
+        "tok_s": round(run["tok_s"], 2),
+        "wall_s": round(run["wall_s"], 3),
+        "completion_tokens": run["completion_tokens"],
+        "prefix_hits": int(hits),
+        "eligible_turns": eligible,
+        "hit_rate": round(hits / eligible, 4) if eligible else 0.0,
+        "requests_per_replica": [int(r) for r in requests],
+        "outputs": run["outputs"],
+    }
+
+
+# ---- fake mode (in-process replicas, real sockets) -------------------------
+
+
+async def _run_fake_async(n_replicas: int, *, n_conversations: int,
+                          turns: int, max_tokens: int) -> dict:
+    from quorum_tpu.router.app import RouterConfig, create_router_app
+    from quorum_tpu.router.fake_replica import (
+        FakeReplicaState,
+        create_fake_replica_app,
+    )
+    from quorum_tpu.server.serve import start_server
+
+    import random as _random
+
+    _random.seed(0)  # the random-policy leg is a REPRODUCIBLE baseline
+    out: dict = {"n_replicas": n_replicas}
+    legs = {}
+    for policy, family in (("affinity", "A"), ("random", "B")):
+        # Fresh replicas per leg: store warmth must not cross legs.
+        servers, urls = [], []
+        for i in range(n_replicas):
+            st = FakeReplicaState(f"fake-{i}", max_tokens=max_tokens)
+            srv = await start_server(
+                create_fake_replica_app(st), "127.0.0.1", 0)
+            servers.append(srv)
+            urls.append(
+                f"http://127.0.0.1:{srv.sockets[0].getsockname()[1]}")
+        # Single-replica pinning baseline: its own fresh fake replica.
+        base_state = FakeReplicaState("fake-single", max_tokens=max_tokens)
+        base_srv = await start_server(
+            create_fake_replica_app(base_state), "127.0.0.1", 0)
+        base_url = (
+            f"http://127.0.0.1:{base_srv.sockets[0].getsockname()[1]}")
+        cfg = RouterConfig(
+            replicas=[(f"fake-{i}", u) for i, u in enumerate(urls)],
+            policy=policy, ready_interval=0.0)
+        router_app = create_router_app(cfg)
+        router_srv = await start_server(router_app, "127.0.0.1", 0)
+        router_url = (
+            f"http://127.0.0.1:{router_srv.sockets[0].getsockname()[1]}")
+        try:
+            async with httpx.AsyncClient() as client:
+                # Serial turns: the fake legs measure PLACEMENT (hit
+                # rate), and serial driving keeps bounded-load spill out
+                # of the picture so the smoke is deterministic; the real
+                # leg keeps concurrency for an honest tok/s.
+                leg = await measure_leg(
+                    client, router_url, urls, family=family,
+                    n_conversations=n_conversations, turns=turns,
+                    max_tokens=max_tokens, model="fake", concurrency=1)
+                single = await drive_conversations(
+                    client, base_url, family=family,
+                    n_conversations=n_conversations, turns=turns,
+                    max_tokens=max_tokens, model="fake")
+        finally:
+            await app_close(router_app)
+            for srv in servers + [base_srv, router_srv]:
+                srv.close()
+        leg["outputs_pinned_vs_single"] = leg.pop(
+            "outputs") == single["outputs"]
+        legs[policy] = leg
+    out.update(legs)
+    out["affinity_gt_random"] = (
+        legs["affinity"]["hit_rate"] > legs["random"]["hit_rate"])
+    return out
+
+
+async def app_close(router_app) -> None:
+    mgr = router_app.state.get("replica_set")
+    if mgr is not None:
+        await mgr.aclose()
+
+
+def run_fake(n_replicas: int = 2, *, n_conversations: int = 8,
+             turns: int = 3, max_tokens: int = 8) -> dict:
+    """Entry point shared with tests/test_router_bench.py."""
+    return asyncio.run(_run_fake_async(
+        n_replicas, n_conversations=n_conversations, turns=turns,
+        max_tokens=max_tokens))
+
+
+# ---- real mode (subprocess tpu:// engine replicas) -------------------------
+
+
+def _spawn_replica(name: str, model: str) -> tuple[subprocess.Popen, str]:
+    """Spawn one real serving replica (tiny CPU engine, host prefix
+    store); returns (process, base url) once it prints PORT=."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               QUORUM_TPU_COMPILE_CACHE="0")
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    proc = subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__), "--serve-replica",
+         "--replica-name", name, "--replica-model", model],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+        text=True, env=env)
+    deadline = time.time() + REPLICA_BOOT_TIMEOUT_S
+    port = None
+    while time.time() < deadline:
+        line = proc.stdout.readline()
+        if not line:
+            break
+        if line.startswith("PORT="):
+            port = int(line.strip().split("=", 1)[1])
+            break
+    if port is None:
+        proc.kill()
+        raise RuntimeError(f"replica {name} never printed PORT=")
+    return proc, f"http://127.0.0.1:{port}"
+
+
+def serve_replica_main(name: str, model: str) -> None:
+    """Child entry (--serve-replica): a full serving app over one tiny
+    real engine, bound to an ephemeral port, PORT= printed for the
+    parent."""
+    from quorum_tpu.config import Config
+    from quorum_tpu.server.app import create_app
+    from quorum_tpu.server.serve import start_server
+
+    cfg = Config(raw={
+        "settings": {"timeout": 120},
+        "primary_backends": [
+            {"name": name, "url": ENGINE_URL, "model": model}],
+    })
+    app = create_app(cfg, watch_config=False)
+
+    async def _main() -> None:
+        server = await start_server(app, "127.0.0.1", 0)
+        print(f"PORT={server.sockets[0].getsockname()[1]}", flush=True)
+        async with server:
+            await server.serve_forever()
+
+    try:
+        asyncio.run(_main())
+    except KeyboardInterrupt:
+        pass
+
+
+async def _run_real_async(n_replicas: int, *, n_conversations: int,
+                          turns: int, max_tokens: int) -> dict:
+    from quorum_tpu.router.app import RouterConfig, create_router_app
+    from quorum_tpu.server.serve import start_server
+
+    model = "rb"
+    procs: list[subprocess.Popen] = []
+    out: dict = {"n_replicas": n_replicas}
+    try:
+        print(f"[router-bench] booting {n_replicas} real replicas + "
+              "1 baseline (tiny CPU engines; first compile dominates)",
+              flush=True)
+        replicas = []
+        for i in range(n_replicas):
+            proc, url = _spawn_replica(f"real-{i}", model)
+            procs.append(proc)
+            replicas.append((f"real-{i}", url))
+        base_proc, base_url = _spawn_replica("real-single", model)
+        procs.append(base_proc)
+
+        legs = {}
+        async with httpx.AsyncClient() as client:
+            # Warm every replica's XLA programs with a throwaway family
+            # BEFORE the measured legs — otherwise whichever leg runs
+            # first eats the cold compiles and its tok/s is meaningless.
+            for url in [u for _, u in replicas] + [base_url]:
+                await drive_conversations(
+                    client, url, family="W", n_conversations=2, turns=2,
+                    max_tokens=max_tokens, model=model)
+            for policy, family in (("affinity", "A"), ("random", "B")):
+                cfg = RouterConfig(replicas=replicas, policy=policy,
+                                   ready_interval=0.0)
+                router_app = create_router_app(cfg)
+                router_srv = await start_server(router_app, "127.0.0.1", 0)
+                router_url = ("http://127.0.0.1:"
+                              f"{router_srv.sockets[0].getsockname()[1]}")
+                try:
+                    leg = await measure_leg(
+                        client, router_url,
+                        [u for _, u in replicas], family=family,
+                        n_conversations=n_conversations, turns=turns,
+                        max_tokens=max_tokens, model=model)
+                    single = await drive_conversations(
+                        client, base_url, family=family,
+                        n_conversations=n_conversations, turns=turns,
+                        max_tokens=max_tokens, model=model)
+                finally:
+                    await app_close(router_app)
+                    router_srv.close()
+                leg["outputs_pinned_vs_single"] = leg.pop(
+                    "outputs") == single["outputs"]
+                legs[policy] = leg
+                print(f"[router-bench] real N={n_replicas} {policy}: "
+                      f"hit_rate={leg['hit_rate']} tok/s={leg['tok_s']} "
+                      f"pinned={leg['outputs_pinned_vs_single']}",
+                      flush=True)
+        out.update(legs)
+        out["affinity_gt_random"] = (
+            legs["affinity"]["hit_rate"] > legs["random"]["hit_rate"])
+    finally:
+        for proc in procs:
+            proc.kill()
+        for proc in procs:
+            proc.wait(timeout=30)
+    return out
+
+
+def run_real(n_replicas: int = 2, *, n_conversations: int = 8,
+             turns: int = 3, max_tokens: int = 16) -> dict:
+    return asyncio.run(_run_real_async(
+        n_replicas, n_conversations=n_conversations, turns=turns,
+        max_tokens=max_tokens))
+
+
+# ---- CLI --------------------------------------------------------------------
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(
+        description=__doc__.splitlines()[0])
+    parser.add_argument("--mode", choices=("fake", "real", "all"),
+                        default="all")
+    parser.add_argument("--skip-real", action="store_true",
+                        help="alias for --mode fake")
+    parser.add_argument("--conversations", type=int, default=8)
+    parser.add_argument("--turns", type=int, default=3)
+    parser.add_argument("--tokens", type=int, default=16)
+    parser.add_argument("--serve-replica", action="store_true",
+                        help=argparse.SUPPRESS)
+    parser.add_argument("--replica-name", default="replica",
+                        help=argparse.SUPPRESS)
+    parser.add_argument("--replica-model", default="rb",
+                        help=argparse.SUPPRESS)
+    args = parser.parse_args()
+
+    if args.serve_replica:
+        serve_replica_main(args.replica_name, args.replica_model)
+        return 0
+
+    mode = "fake" if args.skip_real else args.mode
+    out: dict = {}
+    failures = []
+    if mode in ("fake", "all"):
+        out["fake"] = {}
+        for n in (2, 4):
+            leg = run_fake(n, n_conversations=args.conversations,
+                           turns=args.turns, max_tokens=8)
+            out["fake"][f"n{n}"] = leg
+            print(f"[router-bench] fake N={n}: affinity hit_rate="
+                  f"{leg['affinity']['hit_rate']} vs random "
+                  f"{leg['random']['hit_rate']}", flush=True)
+            if not leg["affinity_gt_random"]:
+                failures.append(f"fake n{n}: affinity hit rate not above "
+                                "random")
+            if not leg["affinity"]["outputs_pinned_vs_single"]:
+                failures.append(f"fake n{n}: outputs diverged from "
+                                "single-replica serving")
+    if mode in ("real", "all"):
+        leg = run_real(2, n_conversations=args.conversations,
+                       turns=args.turns, max_tokens=args.tokens)
+        out["real"] = {"n2": leg}
+        if not leg["affinity_gt_random"]:
+            failures.append("real n2: affinity hit rate not above random")
+        if not leg["affinity"]["outputs_pinned_vs_single"]:
+            failures.append("real n2: outputs diverged from "
+                            "single-replica serving")
+    out["failures"] = failures
+    print(json.dumps(out), flush=True)
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

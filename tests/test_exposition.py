@@ -307,6 +307,18 @@ async def test_live_metrics_exposition_validates():
             in text)
     assert "# TYPE quorum_tpu_engine_breaker_state gauge" in text
 
+    # router-tier families (ISSUE 13, quorum_tpu/router/ — registered
+    # process-wide so `make metrics-check` covers them; on a serving
+    # replica they expose at zero, on the router process they carry the
+    # placement/failover/migration accounting)
+    for counter in ("quorum_tpu_router_requests_total",
+                    "quorum_tpu_router_affinity_hits_total",
+                    "quorum_tpu_router_affinity_misses_total",
+                    "quorum_tpu_router_failovers_total",
+                    "quorum_tpu_router_migrated_bytes_total",
+                    "quorum_tpu_router_migrated_chains_total"):
+        assert f"# TYPE {counter} counter" in text, counter
+
     # _count == +Inf bucket and bucket monotonicity for one family, by hand
     # (belt to the validator's braces)
     inf = count = None

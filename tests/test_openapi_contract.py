@@ -58,7 +58,7 @@ def test_document_paths_match_served_routes():
         "/chat/completions", "/completions", "/embeddings", "/health",
         "/ready", "/models", "/metrics", "/debug/traces",
         "/debug/traces/{request_id}", "/debug/engine/timeline",
-        "/debug/profile"}
+        "/debug/prefix/chunks", "/debug/profile"}
     assert [s["url"] for s in DOC["servers"]] == ["/", "/v1"]
     post = DOC["paths"]["/chat/completions"]["post"]
     assert set(post["responses"]) == {

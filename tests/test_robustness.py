@@ -199,7 +199,92 @@ async def test_http_retry_never_sleeps_past_deadline():
     assert time.perf_counter() - t0 < 2.0  # not 50 backoff sleeps
 
 
-async def test_http_stream_is_never_retried():
+async def test_http_stream_retries_before_first_byte():
+    """The streaming retry gap, pinned (docs/robustness.md): ``retries:``
+    applies only BEFORE the first byte is relayed. Connect errors and
+    pre-stream 5xx on streaming calls ARE retried — the router tier's
+    failover pacing leans on this — while an open 2xx stream never
+    retries (next test), so tokens cannot double-deliver."""
+    from quorum_tpu.backends.http_backend import HttpBackend
+
+    calls = {"n": 0}
+
+    def handler(req):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise httpx.ConnectError("refused")
+        if calls["n"] == 2:
+            return httpx.Response(503, json={"error": {
+                "message": "shedding", "type": "overloaded_error"}})
+        return httpx.Response(
+            200, headers={"content-type": "text/event-stream"},
+            content=(b'data: {"choices":[{"delta":{"content":"ok"}}]}\n\n'
+                     b"data: [DONE]\n\n"))
+
+    hb = HttpBackend(
+        "s", "http://u.test/v1", "m", retries=3,
+        client=httpx.AsyncClient(transport=httpx.MockTransport(handler)))
+    events = [e async for e in hb.stream({"messages": []}, AUTH, 10.0)]
+    assert calls["n"] == 3  # connect error + 503 both retried pre-stream
+    assert len(events) == 1
+    assert events[0]["choices"][0]["delta"]["content"] == "ok"
+
+
+async def test_http_stream_never_retries_after_first_byte():
+    """Once a 2xx stream is open, a mid-stream failure SURFACES — a
+    second attempt could double-deliver tokens already on the client's
+    wire. The upstream is called exactly once."""
+    from quorum_tpu.backends.base import BackendError
+    from quorum_tpu.backends.http_backend import HttpBackend
+
+    calls = {"n": 0}
+
+    class _Explodes(httpx.AsyncByteStream):
+        async def __aiter__(self):
+            yield b'data: {"choices":[{"delta":{"content":"tok"}}]}\n\n'
+            raise httpx.ReadError("connection reset mid-body")
+
+    def handler(req):
+        calls["n"] += 1
+        return httpx.Response(
+            200, headers={"content-type": "text/event-stream"},
+            stream=_Explodes())
+
+    hb = HttpBackend(
+        "s", "http://u.test/v1", "m", retries=3,
+        client=httpx.AsyncClient(transport=httpx.MockTransport(handler)))
+    got = []
+    with pytest.raises(BackendError):
+        async for e in hb.stream({"messages": []}, AUTH, 10.0):
+            got.append(e)
+    assert calls["n"] == 1  # never re-POSTed
+    assert len(got) == 1    # the relayed token arrived exactly once
+
+
+async def test_http_stream_error_keeps_retry_after_header():
+    """A pre-stream 503's Retry-After rides the BackendError (the header
+    contract, docs/robustness.md) — the router's terminal relay must pace
+    streaming clients exactly like non-streaming ones."""
+    from quorum_tpu.backends.base import BackendError
+    from quorum_tpu.backends.http_backend import HttpBackend
+
+    def handler(req):
+        return httpx.Response(
+            503, headers={"Retry-After": "7"},
+            json={"error": {"message": "shedding",
+                            "type": "overloaded_error"}})
+
+    hb = HttpBackend(
+        "s", "http://u.test/v1", "m",
+        client=httpx.AsyncClient(transport=httpx.MockTransport(handler)))
+    with pytest.raises(BackendError) as exc:
+        async for _ in hb.stream({"messages": []}, AUTH, 5.0):
+            pass
+    assert exc.value.status_code == 503
+    assert exc.value.headers.get("Retry-After") == "7"
+
+
+async def test_http_stream_no_retry_by_default():
     from quorum_tpu.backends.base import BackendError
     from quorum_tpu.backends.http_backend import HttpBackend
 
@@ -210,12 +295,78 @@ async def test_http_stream_is_never_retried():
         raise httpx.ConnectError("refused")
 
     hb = HttpBackend(
-        "s", "http://u.test/v1", "m", retries=3,
+        "s", "http://u.test/v1", "m",
         client=httpx.AsyncClient(transport=httpx.MockTransport(handler)))
     with pytest.raises(BackendError):
         async for _ in hb.stream({"messages": []}, AUTH, 5.0):
             pass
     assert calls["n"] == 1
+
+
+def test_retry_after_parses_both_rfc9110_forms():
+    """Satellite (ISSUE 13): Retry-After comes in delay-seconds AND
+    HTTP-date forms; the date form must parse (not silently read as 0.0)
+    and negative/past values clamp to 0 — the router paces failover on
+    this value."""
+    from email.utils import format_datetime
+    from datetime import datetime, timedelta, timezone
+
+    from quorum_tpu.backends.http_backend import HttpBackend
+
+    def resp(value: str | None):
+        headers = {} if value is None else {"Retry-After": value}
+        return httpx.Response(503, headers=headers)
+
+    # numeric form
+    assert HttpBackend._retry_after_s(resp("2")) == 2.0
+    assert HttpBackend._retry_after_s(resp("1.5")) == 1.5
+    assert HttpBackend._retry_after_s(resp("-3")) == 0.0  # clamped
+    # HTTP-date form: ~60s ahead parses to ~60s from now
+    future = datetime.now(timezone.utc) + timedelta(seconds=60)
+    got = HttpBackend._retry_after_s(resp(format_datetime(future,
+                                                          usegmt=True)))
+    assert 50.0 < got <= 61.0, got
+    # a date in the past clamps to 0 (no ask), as does garbage/absence
+    past = datetime.now(timezone.utc) - timedelta(seconds=60)
+    assert HttpBackend._retry_after_s(resp(format_datetime(past,
+                                                           usegmt=True))) == 0.0
+    assert HttpBackend._retry_after_s(resp("soonish")) == 0.0
+    assert HttpBackend._retry_after_s(resp(None)) == 0.0
+
+
+async def test_http_retry_honors_date_form_retry_after():
+    """The 5xx retry floor reads the HTTP-date form too: a 503 naming a
+    recovery window ~0.3s out is not re-POSTed inside it."""
+    from email.utils import format_datetime
+    from datetime import datetime, timedelta, timezone
+
+    from quorum_tpu.backends.http_backend import HttpBackend
+
+    calls = {"n": 0}
+
+    def handler(req: httpx.Request) -> httpx.Response:
+        calls["n"] += 1
+        if calls["n"] == 1:
+            when = datetime.now(timezone.utc) + timedelta(seconds=1)
+            return httpx.Response(
+                503, headers={"Retry-After": format_datetime(
+                    when, usegmt=True)},
+                json={"error": {"message": "shedding",
+                                "type": "overloaded_error"}})
+        return httpx.Response(200, json={
+            "choices": [{"message": {"role": "assistant",
+                                     "content": "ok"}}]})
+
+    hb = HttpBackend(
+        "polite", "http://u.test/v1", "m", retries=2,
+        client=httpx.AsyncClient(transport=httpx.MockTransport(handler)))
+    t0 = time.perf_counter()
+    result = await hb.complete({"messages": []}, AUTH, 10.0)
+    assert result.status_code == 200 and calls["n"] == 2
+    # waited at least most of the named window (date resolution is 1s,
+    # so the floor lands anywhere in (0, 1]; it must not re-POST
+    # immediately)
+    assert time.perf_counter() - t0 >= 0.05
 
 
 def test_config_parses_retries():

@@ -1,0 +1,702 @@
+"""Multi-replica router tier (quorum_tpu/router/, docs/scaling.md).
+
+Fast tier: ring/affinity/wire units, store export/import, and the router
+app end-to-end over jax-free fake replicas on real sockets (placement
+stability, failover, rotation, migration warmth, metrics). Slow tier: the
+prefix-migration round trip between two REAL engines — a chunk chain
+serialized from engine A and seeded into engine B produces a tier-hit
+restore on B with outputs pinned vs cold prefill — plus the server's
+GET/PUT /debug/prefix/chunks routes over a live tpu:// backend.
+"""
+
+import asyncio
+
+import httpx
+import numpy as np
+import pytest
+
+from quorum_tpu.cache import prefix_wire
+from quorum_tpu.cache.prefix_store import PrefixStore
+from quorum_tpu.router import affinity
+from quorum_tpu.router.app import RouterConfig, create_router_app
+from quorum_tpu.router.fake_replica import (
+    FakeReplicaState,
+    create_fake_replica_app,
+)
+from quorum_tpu.router.ring import BoundedLoadRing, hash_key
+
+slow = pytest.mark.slow
+
+
+# ---- ring -------------------------------------------------------------------
+
+
+def test_ring_placement_is_deterministic_and_spreads():
+    ring = BoundedLoadRing()
+    for n in ("a", "b", "c", "d"):
+        ring.add(n)
+    keys = [hash_key(f"conversation-{i}".encode()) for i in range(400)]
+    first = [ring.primary(k) for k in keys]
+    assert first == [ring.primary(k) for k in keys]  # deterministic
+    counts = {n: first.count(n) for n in ("a", "b", "c", "d")}
+    assert all(c > 0 for c in counts.values()), counts  # everyone serves
+
+
+def test_ring_remove_only_remaps_departed_keys():
+    ring = BoundedLoadRing()
+    for n in ("a", "b", "c", "d"):
+        ring.add(n)
+    keys = [hash_key(f"conversation-{i}".encode()) for i in range(400)]
+    before = {k: ring.primary(k) for k in keys}
+    ring.remove("c")
+    after = {k: ring.primary(k) for k in keys}
+    moved = [k for k in keys if before[k] != after[k]]
+    # every moved key belonged to the departed replica — nobody else's
+    # conversations cold-start (the consistent-hashing property)
+    assert moved and all(before[k] == "c" for k in moved)
+    assert all(after[k] != "c" for k in keys)
+    # rejoining restores the original placement exactly
+    ring.add("c")
+    assert {k: ring.primary(k) for k in keys} == before
+
+
+def test_ring_candidates_order_and_bounded_load():
+    ring = BoundedLoadRing(load_factor=1.25)
+    for n in ("a", "b", "c"):
+        ring.add(n)
+    key = hash_key(b"some conversation")
+    order = ring.candidates(key)
+    assert order[0] == ring.primary(key)
+    assert sorted(order) == ["a", "b", "c"]
+    # uniform load: nothing demoted
+    assert ring.candidates(key, {n: 2 for n in "abc"}) == order
+    # the primary far past capacity is demoted to the tail — the key
+    # spills for THIS request, membership untouched
+    hot = order[0]
+    loaded = ring.candidates(key, {n: (50 if n == hot else 0)
+                                   for n in "abc"})
+    assert loaded[-1] == hot and set(loaded) == set(order)
+    assert ring.primary(key) == hot
+
+
+def test_ring_validation():
+    with pytest.raises(ValueError):
+        BoundedLoadRing(vnodes=0)
+    with pytest.raises(ValueError):
+        BoundedLoadRing(load_factor=0.5)
+    assert BoundedLoadRing().candidates(123) == []
+
+
+# ---- affinity keys ----------------------------------------------------------
+
+
+def _turns(conv: str, n: int) -> list[dict]:
+    """n bodies of one growing conversation (client-appended history)."""
+    msgs = [{"role": "user", "content": conv}]
+    out = [{"messages": list(msgs)}]
+    for t in range(n - 1):
+        msgs = msgs + [{"role": "assistant", "content": f"answer {t}"},
+                       {"role": "user", "content": f"follow-up {t}"}]
+        out.append({"messages": list(msgs)})
+    return out
+
+
+def test_affinity_key_stable_across_turns():
+    for conv in ("hi", "a much longer opening question that spans "
+                 "well past one affinity chunk of byte tokens, with "
+                 "plenty of additional prose to be sure"):
+        keys = {affinity.conversation_key(b) for b in _turns(conv, 4)}
+        assert len(keys) == 1, conv
+
+
+def test_affinity_key_distinguishes_conversations():
+    keys = {affinity.conversation_key(
+        {"messages": [{"role": "user", "content": f"conversation {i}"}]})
+        for i in range(50)}
+    assert len(keys) == 50
+
+
+def test_affinity_system_prompt_rides_the_key():
+    sys_a = [{"role": "system", "content": "persona A"},
+             {"role": "user", "content": "same question"}]
+    sys_b = [{"role": "system", "content": "persona B"},
+             {"role": "user", "content": "same question"}]
+    assert (affinity.conversation_key({"messages": sys_a})
+            != affinity.conversation_key({"messages": sys_b}))
+
+
+def test_affinity_head_is_prefix_of_full_render_and_chain_key_aligns():
+    """The key'd head must be a byte-prefix of the full rendered prompt —
+    that is what lets an exported chunk chain re-key to the same replica
+    as the conversation that grew it (migration lands prefixes where the
+    next turn routes)."""
+    from quorum_tpu.engine.tokenizer import ByteTokenizer, render_chat
+
+    tok = ByteTokenizer(259)
+    for conv in ("hi",  # head far SHORTER than one affinity chunk
+                 "an opening question long enough to cover the "
+                 "affinity chunk comfortably, with extra prose "
+                 "padding out the line"):
+        bodies = _turns(conv, 3)
+        head = affinity.conversation_tokens(bodies[0])
+        for body in bodies:
+            full = tok.encode(render_chat(body["messages"]))
+            assert full[:len(head)] == head
+            # a store chain additionally carries generated tokens past
+            # the prompt — the key must still recover the head
+            chain = full + tok.encode("generated reply text")
+            assert (affinity.chain_key(chain)
+                    == affinity.conversation_key(body)), conv
+
+
+# ---- wire format ------------------------------------------------------------
+
+
+def _chains(c: int = 4):
+    mk = lambda tag: [np.full((2, 3, c), tag, np.int8),  # noqa: E731
+                      np.arange(c, dtype=np.float32).reshape(1, 1, c)]
+    return [
+        ([1, 2, 3, 4, 5, 6, 7, 8], [mk(1), mk(2)]),
+        ([9, 10, 11, 12], [mk(3)]),
+    ]
+
+
+def test_wire_round_trip():
+    chains = _chains()
+    blob = prefix_wire.serialize_chains(chains, 4)
+    chunk_tokens, parsed = prefix_wire.parse(blob)
+    assert chunk_tokens == 4 and len(parsed) == 2
+    for (toks, pays), chain in zip(chains, parsed):
+        assert chain.tokens == toks
+        assert len(chain.payloads) == len(pays)
+        for want, got in zip(pays, chain.payloads):
+            for w, g in zip(want, got):
+                assert w.dtype == g.dtype and w.shape == g.shape
+                np.testing.assert_array_equal(w, g)
+    # parsed arrays are copies, not views pinning the request body
+    assert parsed[0].payloads[0][0].flags.owndata
+    s = prefix_wire.stats(blob)
+    assert s["chains"] == 2 and s["chunks"] == 3 and s["tokens"] == 12
+
+
+def test_wire_rejects_malformed():
+    import json as _json
+
+    blob = prefix_wire.serialize_chains(_chains(), 4)
+    with pytest.raises(prefix_wire.WireError):
+        prefix_wire.parse(b"not a prefix payload")
+    # crafted manifests raise WireError (→ 400), never a bare KeyError
+    def crafted(chains):
+        manifest = _json.dumps({"version": 1, "chunk_tokens": 4,
+                                "chains": chains}).encode()
+        return (prefix_wire.MAGIC
+                + len(manifest).to_bytes(8, "big") + manifest)
+
+    for chains in ([{"tokens": [1, 2, 3, 4]}],  # payload-less chunks
+                   ["nonsense"],                # non-object chain
+                   [{"tokens": [1, 2, 3, 4], "chunks": "x"}]):
+        with pytest.raises(prefix_wire.WireError):
+            prefix_wire.parse(crafted(chains))
+    # a degenerate empty chain parses to nothing, harmlessly
+    assert prefix_wire.parse(crafted([{"tokens": []}]))[1][0].tokens == []
+    with pytest.raises(prefix_wire.WireError):
+        prefix_wire.parse(blob[:20])  # truncated manifest
+    # manifest length pointing past the payload
+    bad = blob[: len(prefix_wire.MAGIC)] + (1 << 40).to_bytes(8, "big")
+    with pytest.raises(prefix_wire.WireError):
+        prefix_wire.parse(bad)
+    # out-of-bounds array spec: truncate the payload region
+    with pytest.raises(prefix_wire.WireError):
+        prefix_wire.parse(blob[:-8])
+
+
+# ---- store export / import --------------------------------------------------
+
+
+def _payload(tag: int, c: int = 4):
+    return [np.full((1, 1, c), tag % 127, np.int8)]
+
+
+def test_store_export_chains_round_trips_through_import():
+    src = PrefixStore(chunk_tokens=4, max_bytes=1 << 20)
+    a = list(range(12))
+    b = [50, 51, 52, 53]
+    src.insert(a, 0, [_payload(1), _payload(2), _payload(3)])
+    src.insert(b, 0, [_payload(4)])
+    chains = src.export_chains()
+    assert sorted(len(t) for t, _ in chains) == [4, 12]
+    dst = PrefixStore(chunk_tokens=4, max_bytes=1 << 20)
+    for toks, pays in chains:
+        assert dst.import_chain(toks, pays) == len(toks)
+    assert dst.covered(a) == 12 and dst.covered(b) == 4
+    # import skips already-covered chunks (resident payloads win)
+    assert dst.import_chain(a, [_payload(9)] * 3) == 0
+
+
+def test_store_export_stops_at_evicted_ancestor():
+    """Chunks beyond an evicted ancestor are unmatchable — the export must
+    not ship bytes the importer could never restore."""
+    s = PrefixStore(chunk_tokens=4, max_bytes=1 << 20)
+    toks = list(range(12))
+    s.insert(toks, 0, [_payload(1), _payload(2), _payload(3)])
+    # evict the MIDDLE chunk by hand (the LRU normally drops tails first;
+    # a mid-chain gap models a partially re-validated chain)
+    node = s._root.children[tuple(toks[:4])].children[tuple(toks[4:8])]
+    s._lru.pop(id(node))
+    s.bytes_held -= node.entry.nbytes
+    node.entry = None
+    chains = s.export_chains()
+    assert [len(t) for t in (c[0] for c in chains)] == [4]
+
+
+def test_store_export_budget_and_lru_untouched():
+    s = PrefixStore(chunk_tokens=4, max_bytes=1 << 20)
+    s.insert(list(range(8)), 0, [_payload(1), _payload(2)])
+    order_before = list(s._lru)
+    assert s.export_chains(max_bytes=1) == []  # chain larger than budget
+    assert list(s._lru) == order_before  # export never touches recency
+
+
+def test_store_import_chain_validates_coverage():
+    s = PrefixStore(chunk_tokens=4, max_bytes=1 << 20)
+    with pytest.raises(ValueError):
+        s.import_chain(list(range(8)), [_payload(1)])  # 2 chunks, 1 payload
+    assert s.import_chain([1, 2], [_payload(1)]) == 0  # sub-chunk: nothing
+
+
+# ---- router config ----------------------------------------------------------
+
+
+def test_router_main_config_loading(tmp_path):
+    """``python -m quorum_tpu.router`` config resolution: YAML file,
+    --replicas override, CLI knob overrides."""
+    from quorum_tpu.router.__main__ import load_router_config
+
+    path = tmp_path / "router.yaml"
+    path.write_text(
+        "replicas:\n"
+        "  - {name: cell-a, url: 'http://a:8000'}\n"
+        "  - 'http://b:8000'\n"
+        "policy: affinity\n"
+        "ready_interval: 0.5\n")
+    cfg = load_router_config(str(path), None)
+    assert cfg.replicas == [("cell-a", "http://a:8000"),
+                            ("replica-1", "http://b:8000")]
+    assert cfg.ready_interval == 0.5
+    # --replicas overrides the file's list; knob overrides apply
+    cfg = load_router_config(str(path), "http://c:1,http://d:2",
+                             policy="random", retries=3)
+    assert [u for _, u in cfg.replicas] == ["http://c:1", "http://d:2"]
+    assert cfg.policy == "random" and cfg.retries == 3
+    with pytest.raises(ValueError):
+        load_router_config(None, None)  # no replicas anywhere
+
+
+def test_router_config_from_dict():
+    cfg = RouterConfig.from_dict({
+        "replicas": ["http://a:1", {"name": "bee", "url": "http://b:2"}],
+        "policy": "random", "affinity_chunk": 32, "retries": 2})
+    assert cfg.replicas == [("replica-0", "http://a:1"),
+                            ("bee", "http://b:2")]
+    assert cfg.policy == "random" and cfg.affinity_chunk == 32
+    with pytest.raises(ValueError):
+        RouterConfig(replicas=[("a", "http://a")], policy="round-robin")
+    with pytest.raises(ValueError):
+        RouterConfig(replicas=[])
+    with pytest.raises(ValueError):
+        RouterConfig.from_dict({"replicas": [{"name": "x"}]})  # no url
+
+
+# ---- router app over fake replicas (real sockets) ---------------------------
+
+
+class _Cluster:
+    """N fake replicas + the router app, all in the test's event loop."""
+
+    def __init__(self, n: int = 2, *, policy: str = "affinity",
+                 ready_interval: float = 0.0, retries: int = 1, **cfg_kw):
+        self.n = n
+        self.policy = policy
+        self.ready_interval = ready_interval
+        self.retries = retries
+        self.cfg_kw = cfg_kw
+        self.states: list[FakeReplicaState] = []
+        self.servers = []
+        self.urls: list[str] = []
+
+    async def __aenter__(self):
+        from quorum_tpu.server.serve import start_server
+
+        for i in range(self.n):
+            st = FakeReplicaState(f"r{i}")
+            srv = await start_server(
+                create_fake_replica_app(st), "127.0.0.1", 0)
+            self.states.append(st)
+            self.servers.append(srv)
+            self.urls.append(
+                f"http://127.0.0.1:{srv.sockets[0].getsockname()[1]}")
+        self.cfg = RouterConfig(
+            replicas=[(f"r{i}", u) for i, u in enumerate(self.urls)],
+            policy=self.policy, ready_interval=self.ready_interval,
+            retries=self.retries, **self.cfg_kw)
+        self.app = create_router_app(self.cfg)
+        self.mgr = self.app.state["replica_set"]
+        self.client = httpx.AsyncClient(
+            transport=httpx.ASGITransport(app=self.app),
+            base_url="http://router", timeout=30.0)
+        return self
+
+    async def __aexit__(self, *exc):
+        await self.client.aclose()
+        await self.mgr.aclose()
+        for srv in self.servers:
+            srv.close()
+
+    async def chat(self, messages, **kw):
+        return await self.client.post(
+            "/chat/completions",
+            json={"model": "m", "messages": messages, **kw})
+
+
+def _conv(i: int) -> list[dict]:
+    return [{"role": "user", "content": f"router test conversation {i}: "
+             "what is the opening move?"}]
+
+
+async def test_router_affinity_places_turns_together():
+    async with _Cluster(2) as c:
+        homes = {}
+        for i in range(8):
+            msgs = _conv(i)
+            r = await c.chat(msgs)
+            assert r.status_code == 200, r.text
+            homes[i] = r.headers["x-routed-to"]
+            for t in range(2):
+                msgs = msgs + [
+                    {"role": "assistant",
+                     "content": r.json()["choices"][0]["message"]["content"]},
+                    {"role": "user", "content": f"follow-up {t}"}]
+                r = await c.chat(msgs)
+                assert r.headers["x-routed-to"] == homes[i], (i, t)
+        assert len(set(homes.values())) == 2  # both replicas used
+        # replica-side truth: later turns hit the prefix store
+        assert sum(s.prefix_hits for s in c.states) >= 8
+
+
+async def test_router_streaming_passthrough():
+    async with _Cluster(2) as c:
+        async with c.client.stream(
+            "POST", "/chat/completions",
+            json={"model": "m", "stream": True, "messages": _conv(0)},
+        ) as resp:
+            assert resp.status_code == 200
+            assert resp.headers["x-routed-to"].startswith("r")
+            body = (await resp.aread()).decode()
+        frames = [ln for ln in body.splitlines() if ln.startswith("data: ")]
+        assert frames[-1] == "data: [DONE]"
+        # upstream's role chunk leads; its finish chunk precedes [DONE]
+        import json as _json
+
+        events = [_json.loads(f[6:]) for f in frames[:-1]]
+        assert events[0]["choices"][0]["delta"].get("role") == "assistant"
+        assert events[-1]["choices"][0]["finish_reason"] == "stop"
+        contents = [e["choices"][0]["delta"].get("content")
+                    for e in events[1:-1]]
+        assert all(contents)
+
+
+async def test_router_failover_to_next_candidate():
+    """A dead primary (connection refused) fails over pre-stream; the
+    request completes on the survivor and the failover is counted."""
+    from quorum_tpu.observability import ROUTER_FAILOVERS
+
+    async with _Cluster(2) as c:
+        # kill r0's listener; its port now refuses connections
+        c.servers[0].close()
+        await c.servers[0].wait_closed()
+        ok = dead = 0
+        for i in range(10):
+            before = ROUTER_FAILOVERS.value_of(replica="r0")
+            r = await c.chat(_conv(i))
+            assert r.status_code == 200, r.text
+            if r.headers["x-routed-to"] == "r1":
+                ok += 1
+            if ROUTER_FAILOVERS.value_of(replica="r0") > before:
+                dead += 1
+        assert ok == 10  # every request served by the survivor
+        assert dead >= 1  # at least one went through the failover path
+        # streaming fails over pre-first-byte too
+        async with c.client.stream(
+            "POST", "/chat/completions",
+            json={"model": "m", "stream": True, "messages": _conv(99)},
+        ) as resp:
+            assert resp.status_code == 200
+            assert resp.headers["x-routed-to"] == "r1"
+            assert b"[DONE]" in await resp.aread()
+
+
+async def test_router_breaker_opens_and_sheds_when_all_down():
+    async with _Cluster(2, breaker_threshold=2,
+                        breaker_cooldown=30.0) as c:
+        for srv in c.servers:
+            srv.close()
+            await srv.wait_closed()
+        # failure storm opens both breakers
+        for i in range(4):
+            r = await c.chat(_conv(i))
+            assert r.status_code >= 500
+        r = await c.chat(_conv(0))
+        assert r.status_code == 503
+        assert "retry-after" in {k.lower() for k in r.headers}
+        health = (await c.client.get("/health"))
+        assert health.status_code in (200, 503)
+
+
+async def test_router_ready_rotation_and_migration_warmth():
+    """A replica that sheds (/ready 503) rotates out; its prefix chains
+    migrate to the survivor, which then serves the spilled conversation
+    with a warm store hit."""
+    async with _Cluster(2, ready_interval=0.0) as c:
+        homes = {}
+        for i in range(8):
+            r = await c.chat(_conv(i))
+            homes[i] = r.headers["x-routed-to"]
+        shed = homes[[i for i in homes if homes[i] == "r0"][0]]
+        assert shed == "r0"
+        # admin-shed r0, then run one poll sweep by hand (interval 0 =
+        # no background poller; tests drive sweeps deterministically)
+        async with httpx.AsyncClient() as direct:
+            await direct.post(f"{c.urls[0]}/admin/shed")
+        await c.mgr.poll_once()
+        assert "r0" not in c.mgr.ring and "r1" in c.mgr.ring
+        assert c.mgr.n_migrations == 1
+        surv = c.states[1]
+        hits_before = surv.prefix_hits
+        for i in homes:
+            if homes[i] != "r0":
+                continue
+            r = await c.chat(_conv(i))
+            assert r.headers["x-routed-to"] == "r1"
+            assert int(r.headers["x-prefix-matched"]) > 0, i
+        assert surv.prefix_hits > hits_before
+        # recovery: replica rejoins on the next sweep and reclaims keys
+        async with httpx.AsyncClient() as direct:
+            await direct.post(f"{c.urls[0]}/admin/recover")
+        await c.mgr.poll_once()
+        assert "r0" in c.mgr.ring
+        i0 = [i for i in homes if homes[i] == "r0"][0]
+        r = await c.chat(_conv(i0))
+        assert r.headers["x-routed-to"] == "r0"
+
+
+async def test_router_streaming_inflight_never_leaks():
+    """The in-flight counter must return to zero on EVERY stream ending:
+    normal exhaustion, and an aclose() on a response generator whose body
+    never ran (a client that disconnected before the response started) —
+    the leak that would let bounded-load placement drift all traffic off
+    a healthy replica."""
+    async with _Cluster(2) as c:
+        # normal streaming completion
+        async with c.client.stream(
+            "POST", "/chat/completions",
+            json={"model": "m", "stream": True, "messages": _conv(1)},
+        ) as resp:
+            await resp.aread()
+        assert all(r.inflight == 0 for r in c.mgr.replicas.values())
+        # abandoned-before-start: drive the handler directly and close
+        # the response iterator without ever iterating it (what the ASGI
+        # server does when http.response.start fails on a gone client)
+        from quorum_tpu.server.asgi import Request, StreamingResponse
+
+        async def receive():
+            import json as _json
+
+            return {"type": "http.request",
+                    "body": _json.dumps(
+                        {"model": "m", "stream": True,
+                         "messages": _conv(2)}).encode(),
+                    "more_body": False}
+
+        scope = {"type": "http", "method": "POST",
+                 "path": "/chat/completions", "headers": []}
+        handler = c.app._routes[("POST", "/chat/completions")]
+        resp = await handler(Request(scope, receive))
+        assert isinstance(resp, StreamingResponse)
+        assert sum(r.inflight for r in c.mgr.replicas.values()) == 1
+        await resp.iterator.aclose()  # body never iterated
+        assert all(r.inflight == 0 for r in c.mgr.replicas.values())
+
+
+async def test_router_random_policy_ignores_affinity():
+    async with _Cluster(4, policy="random") as c:
+        seen = set()
+        for _ in range(12):
+            r = await c.chat(_conv(0))  # SAME conversation every time
+            seen.add(r.headers["x-routed-to"])
+        assert len(seen) > 1  # affinity would pin all 12 to one replica
+
+
+async def test_router_surfaces():
+    async with _Cluster(2) as c:
+        h = (await c.client.get("/health")).json()
+        assert h["status"] == "healthy" and len(h["replicas"]) == 2
+        assert (await c.client.get("/ready")).status_code == 200
+        m = (await c.client.get("/metrics")).text
+        from quorum_tpu.observability import validate_exposition
+
+        assert validate_exposition(m) == [], validate_exposition(m)[:3]
+        assert "quorum_tpu_router_replica_up" in m
+        assert "quorum_tpu_router_requests_total" in m
+        rr = (await c.client.get("/router/replicas")).json()
+        assert rr["policy"] == "affinity" and len(rr["replicas"]) == 2
+        # invalid JSON body → router's own 400, no replica involved
+        bad = await c.client.post("/chat/completions", content=b"nope")
+        assert bad.status_code == 400
+        # unknown migrate source → 404
+        r = await c.client.post("/router/migrate?from=nope")
+        assert r.status_code == 404
+
+
+async def test_router_admin_migrate_endpoint():
+    async with _Cluster(2) as c:
+        for i in range(8):
+            await c.chat(_conv(i))
+        src = "r0" if c.states[0].requests else "r1"
+        dst = "r1" if src == "r0" else "r0"
+        r = await c.client.post(f"/router/migrate?from={src}&to={dst}")
+        assert r.status_code == 200
+        out = r.json()
+        assert out["migrated_chains"] >= 1 and out["migrated_bytes"] > 0
+        assert out["targets"] == [dst]
+
+
+# ---- real-engine migration round trip (slow tier) ---------------------------
+
+
+@slow
+async def test_prefix_migration_round_trip_between_engines():
+    """The acceptance gate: a chunk chain exported from engine A and
+    seeded into engine B produces a tier-hit restore on B, with outputs
+    token-for-token identical to B's cold prefill of the same prompt."""
+    from quorum_tpu.engine.engine import InferenceEngine
+    from quorum_tpu.models import resolve_spec
+    from quorum_tpu.ops.sampling import SamplerConfig
+
+    spec = resolve_spec("llama-tiny", {"max_seq": "128"})
+    greedy = SamplerConfig(temperature=0.0)
+    chunk = 16
+
+    def mk():
+        return InferenceEngine(spec, decode_chunk=4, prefill_chunk=chunk,
+                               n_slots=1, prefix_store="host",
+                               prefix_store_chunk=chunk)
+
+    prompt = [(3 + i * 7) % (spec.vocab_size - 1) + 1 for i in range(24)]
+    eng_a, eng_b = mk(), mk()
+    ref = InferenceEngine(spec, decode_chunk=4, prefill_chunk=chunk,
+                          n_slots=1)
+    try:
+        gen1 = eng_a.generate(prompt, max_new_tokens=6, sampler=greedy,
+                              seed=1).token_ids
+        eng_a.drain_prefix_store()
+        blob = eng_a.export_prefix_chunks()
+        stats = prefix_wire.stats(blob)
+        assert stats["chains"] >= 1 and stats["chunk_tokens"] == chunk
+
+        got = eng_b.import_prefix_chunks(blob)
+        assert got["tokens_imported"] >= chunk, got
+        # churn B's only slot so the store — not tier-0 slot reuse —
+        # must serve the restore
+        eng_b.generate([9] * 30, max_new_tokens=4, sampler=greedy, seed=9)
+        turn2 = prompt + gen1 + [77, 78, 79, 80, 81]
+        got_b = eng_b.generate(turn2, max_new_tokens=6, sampler=greedy,
+                               seed=2).token_ids
+        assert eng_b.prefix_store_hits == 1  # the migrated chain HIT
+        cold = ref.generate(turn2, max_new_tokens=6, sampler=greedy,
+                            seed=2).token_ids
+        assert got_b == cold, "migrated restore changed the generation"
+
+        # a wrong-layout blob is rejected, never silently seeded
+        other = InferenceEngine(spec, decode_chunk=4, prefill_chunk=chunk,
+                                n_slots=1, prefix_store="host",
+                                prefix_store_chunk=2 * chunk)
+        try:
+            with pytest.raises(ValueError):
+                other.import_prefix_chunks(blob)
+        finally:
+            other.shutdown()
+    finally:
+        eng_a.shutdown()
+        eng_b.shutdown()
+        ref.shutdown()
+
+
+@slow
+async def test_prefix_chunk_http_routes():
+    """GET export → store clear → PUT import over the live server routes:
+    the wire survives the HTTP hop and the re-seeded store serves."""
+    from quorum_tpu.config import Config
+    from quorum_tpu.server.app import create_app
+
+    config = {
+        "settings": {"timeout": 60},
+        "primary_backends": [
+            {"name": "T",
+             "url": "tpu://llama-tiny?seed=3&slots=1&prefill_chunk=16"
+                    "&prefix_store=host&prefix_store_chunk=16"
+                    "&max_seq=128&max_tokens=8",
+             "model": "t"}],
+    }
+    auth = {"Authorization": "Bearer x"}
+    long_msg = "a conversation opener long enough to fill chunks " * 3
+    app = create_app(Config(raw=config), watch_config=False)
+    backend = app.state["registry"].get("T")
+    async with httpx.AsyncClient(
+            transport=httpx.ASGITransport(app=app),
+            base_url="http://testserver") as client:
+        r = await client.post(
+            "/chat/completions",
+            json={"model": "t", "max_tokens": 6,
+                  "messages": [{"role": "user", "content": long_msg}]},
+            headers=auth)
+        assert r.status_code == 200
+        backend.engine.drain_prefix_store()
+        resp = await client.get("/debug/prefix/chunks")
+        assert resp.status_code == 200
+        assert resp.headers["content-type"] == "application/octet-stream"
+        assert resp.headers["x-prefix-chunk-tokens"] == "16"
+        blob = resp.content
+        assert prefix_wire.stats(blob)["chains"] >= 1
+
+        backend.engine.prefix_store.clear()
+        put = await client.put("/debug/prefix/chunks", content=blob)
+        assert put.status_code == 200, put.text
+        body = put.json()
+        assert body["tokens_imported"] >= 16 and body["backend"] == "T"
+
+        bad = await client.put("/debug/prefix/chunks", content=b"garbage")
+        assert bad.status_code == 400
+        assert bad.json()["error"]["type"] == "invalid_request_error"
+
+        # ?max_bytes must bound or 400 — never a silent unbounded export
+        ok = await client.get("/debug/prefix/chunks?max_bytes=999999999")
+        assert ok.status_code == 200
+        for bad_val in ("0", "-5", "10MB", "1e6"):
+            r = await client.get(
+                f"/debug/prefix/chunks?max_bytes={bad_val}")
+            assert r.status_code == 400, bad_val
+
+
+async def test_prefix_chunk_routes_404_without_store():
+    from tests.conftest import make_client
+    from quorum_tpu.backends.fake import FakeBackend
+
+    config = {"settings": {"timeout": 5},
+              "primary_backends": [
+                  {"name": "F", "url": "http://f.example/v1",
+                   "model": "f"}]}
+    async with make_client(config, F=FakeBackend("F", text="x")) as client:
+        r = await client.get("/debug/prefix/chunks")
+        assert r.status_code == 404
+        r = await client.put("/debug/prefix/chunks", content=b"zz")
+        assert r.status_code == 404

@@ -1,0 +1,30 @@
+"""Fast smoke over scripts/router_bench.py's fake leg — the `make verify`
+wiring of the router-bench acceptance: 2 scripted replicas behind the real
+router, affinity's prefix-hit rate strictly above the random baseline, and
+per-conversation outputs token-for-token identical to single-replica
+serving. The full bench (`make router-bench`) adds N=4 and the real
+tiny-engine leg; this smoke runs the same entry point at toy scale."""
+
+import importlib.util
+import os
+import sys
+
+
+def _load_bench():
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "scripts", "router_bench.py")
+    spec = importlib.util.spec_from_file_location("router_bench", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_router_bench_fake_smoke():
+    rb = _load_bench()
+    out = rb.run_fake(2, n_conversations=6, turns=3, max_tokens=6)
+    assert out["affinity_gt_random"], (
+        out["affinity"]["hit_rate"], out["random"]["hit_rate"])
+    assert out["affinity"]["outputs_pinned_vs_single"]
+    assert out["random"]["outputs_pinned_vs_single"]
+    assert out["affinity"]["completion_tokens"] > 0
+    assert sum(out["affinity"]["requests_per_replica"]) == 6 * 3
