@@ -471,6 +471,7 @@ class TpuBackend:
         tp = int(opts.get("tp", 1))
         dp = int(opts.get("dp", 1))
         sp = int(opts.get("sp", 1))
+        pp = int(opts.get("pp", 1))
         zero_drain = _parse_bool_opt(
             "zero_drain", opts.get("zero_drain", "0"))
         if zero_drain and opts.get("disagg"):
@@ -483,21 +484,37 @@ class TpuBackend:
                 "disaggregated admissions already run on their own device "
                 "group with the ring at full depth — zero-drain is "
                 "structural there (drop one knob)")
+        if zero_drain and pp > 1:
+            # Same config-time discipline (the engine re-checks): the
+            # staged-injection write lands one stage's KV shard from
+            # outside the stage ring.
+            raise ValueError(
+                "pp>1 does not compose with zero_drain=1: use "
+                "disagg=P+D&pp=K (the handoff feeds stage-sharded rows) "
+                "or drop one knob")
         prefill_mesh = None
         if opts.get("disagg"):
             from quorum_tpu.parallel.mesh import disagg_meshes, parse_disagg
 
-            # Structural split into two disjoint device groups; the knob
-            # owns the mesh layout, so an explicit tp/dp/sp beside it is a
-            # contradiction (fail at config, never silently pick one).
+            # Structural split into two disjoint device groups. dp= stays
+            # a contradiction (groups are data-disjoint by construction —
+            # scale requests with the replica tier, docs/scaling.md);
+            # tp=/sp=/pp= became the INTRA-group factorization: tp shards
+            # weights+KV within both groups, sp scales the prefill group
+            # (sequence-parallel staging for 100k+-token admissions), pp
+            # stages the decode group's layers (models bigger than one
+            # group's HBM). group_mesh_configs rejects every non-factoring
+            # combination with the reason, at config time.
             n_p, n_d = parse_disagg(opts["disagg"])
-            if tp * dp * sp > 1:
+            if dp > 1:
                 raise ValueError(
-                    "disagg= builds its own per-group device meshes; "
-                    "tp=/dp=/sp= do not compose with it")
-            prefill_mesh, mesh = disagg_meshes(n_p, n_d)
-        elif tp * dp * sp > 1:
-            mesh = make_mesh(MeshConfig(dp=dp, sp=sp, tp=tp))
+                    "disagg= device groups are data-disjoint by "
+                    "construction; dp= does not compose with it (scale "
+                    "request throughput with the replica tier instead)")
+            prefill_mesh, mesh = disagg_meshes(
+                n_p, n_d, tp=tp if "tp" in opts else None, sp=sp, pp=pp)
+        elif tp * dp * sp * pp > 1:
+            mesh = make_mesh(MeshConfig(dp=dp, sp=sp, tp=tp, pp=pp))
         else:
             mesh = single_device_mesh()
         ckpt = opts.get("ckpt", "")

@@ -88,23 +88,43 @@ def fetch_to_host(payload) -> list[np.ndarray]:
     return [np.asarray(x) for x in leaves]
 
 
+def _is_replicated(sharding) -> bool:
+    """Fully-replicated check that degrades to True (— "an ordinary copy")
+    on shardings/objects that don't expose the property."""
+    try:
+        return bool(sharding.is_fully_replicated)
+    except Exception:
+        return True
+
+
 def transfer(chunk, sharding, *, record: bool = True):
     """Move a sliced chunk pytree onto ``sharding`` (typically the target
     group's replicated sharding) and block until it lands.
 
     The direct device→device route first: ``jax.device_put`` of the
     committed source arrays onto the target mesh — no host copy in the
-    dataflow the runtime has to honor. When the runtime rejects the direct
-    put (platforms without a cross-group transfer path), fall back to an
-    explicit host bounce — same bytes, one extra hop, never a failure mode.
+    dataflow the runtime has to honor. When either side is PARTITIONED
+    (per-group ``tp=`` sharding, an ``sp``-sharded staging cache) the same
+    put additionally reshards on the fly between the two groups' layouts —
+    labelled ``reshard`` so a deployment can see which handoffs pay the
+    re-layout. When the runtime rejects the direct put (platforms without
+    a cross-group transfer path, or a cross-mesh reshard it cannot
+    express), fall back to an explicit host bounce — same bytes, one extra
+    hop, never a failure mode.
     Returns ``(moved_pytree, n_bytes, seconds, route)`` with ``route`` one
-    of ``"device"`` / ``"host"``; bytes/seconds also land on the
+    of ``"direct"`` / ``"reshard"`` / ``"host-bounce"`` (the engine adds
+    the fourth, ``"resident"``, for zero-drain same-mesh injection);
+    bytes/seconds land on the route-labelled
     ``quorum_tpu_kv_handoff_{bytes,seconds}`` families when ``record``.
     """
     leaves, treedef = jax.tree.flatten(chunk)
     n_bytes = int(sum(x.nbytes for x in leaves))
     t0 = time.perf_counter()
-    route = "device"
+    route = "direct"
+    if not _is_replicated(sharding) or any(
+            not _is_replicated(getattr(x, "sharding", None))
+            for x in leaves):
+        route = "reshard"
     try:
         moved = [jax.device_put(x, sharding) for x in leaves]
         # qlint: allow-sync(handoff commit: the blocking wait IS the measured kv_handoff_seconds latency)
@@ -116,13 +136,13 @@ def transfer(chunk, sharding, *, record: bool = True):
         logger.warning(
             "direct device->device KV transfer rejected; bouncing %d bytes "
             "via host", n_bytes, exc_info=True)
-        route = "host"
+        route = "host-bounce"
         # qlint: allow-sync(host-bounce fallback: an explicit d2h+h2d copy, logged loudly above)
         moved = [jax.device_put(np.asarray(x), sharding) for x in leaves]
         # qlint: allow-sync(handoff commit: the blocking wait IS the measured kv_handoff_seconds latency)
         jax.block_until_ready(moved)
     dt = time.perf_counter() - t0
     if record:
-        obs.KV_HANDOFF_BYTES.inc(n_bytes)
-        obs.KV_HANDOFF_SECONDS.observe(dt)
+        obs.KV_HANDOFF_BYTES.inc(n_bytes, route=route)
+        obs.KV_HANDOFF_SECONDS.observe(dt, route=route)
     return jax.tree.unflatten(treedef, moved), n_bytes, dt, route

@@ -1036,17 +1036,31 @@ class InferenceEngine:
         # ring attention with the prompt sharded over the sp axis. Chunked
         # admission is disabled there — the ring IS the long-prompt answer
         # (O(T/sp) attention memory per device, one compiled program).
-        from quorum_tpu.parallel.mesh import AXIS_SP
+        from quorum_tpu.parallel.mesh import (AXIS_DP, AXIS_PP, AXIS_SP,
+                                              AXIS_TP)
 
         self._use_sp = dict(self.mesh.shape).get(AXIS_SP, 1) > 1
+        # Prefill-group sequence parallelism (disagg=P+D&sp=S): the STAGING
+        # cache shards its position axis over the prefill mesh's sp axis —
+        # a 100k-token admission's staged KV occupies O(max_seq/sp) HBM per
+        # prefill device, GSPMD partitioning the segment programs over the
+        # sequence blocks, while the decode group keeps its latency-shaped
+        # layout (the handoff reshards on the fly, route="reshard").
+        self.prefill_sp = (dict(self.prefill_mesh.shape).get(AXIS_SP, 1)
+                           if self.disagg else 1)
         if self.disagg:
-            if (self._use_sp
-                    or dict(self.prefill_mesh.shape).get(AXIS_SP, 1) > 1):
+            if self._use_sp:
                 raise ValueError(
-                    "disagg does not compose with sp>1: sequence-parallel "
-                    "serving disables chunked prefill, which every "
-                    "disaggregated admission rides (the staged KV hands "
-                    "off segment by segment)")
+                    "sp>1 in the decode group does not compose with "
+                    "disagg: sequence-parallel serving disables chunked "
+                    "prefill, which every disaggregated admission rides — "
+                    "under disagg, sp= shards the PREFILL group instead")
+            if self.prefill_sp > 1 and self.spec.max_seq % self.prefill_sp:
+                raise ValueError(
+                    f"prefill-group sp={self.prefill_sp} does not divide "
+                    f"max_seq={self.spec.max_seq}: the staging cache "
+                    "shards its position axis over sp — pick a dividing "
+                    "sp or pad max_seq")
             if self.prefill_chunk <= 0:
                 raise ValueError(
                     "disagg requires chunked prefill (prefill_chunk >= 16 "
@@ -1055,6 +1069,63 @@ class InferenceEngine:
                     "segment and register on the decode group — the "
                     "single-shot admit program samples its first token "
                     "inside prefill, on the wrong device group")
+        # Pipeline-staged decode (pp>1 on the decode mesh — colocated
+        # ``pp=K`` or the disagg decode group's ``disagg=P+D&pp=K``): stage
+        # s holds layers [s·L/pp, (s+1)·L/pp) and those layers' KV shard,
+        # and the slot batch splits into pp row groups that flow stage→
+        # stage as the pipeline's microbatches (parallel/pipeline.py
+        # staged_decode_chunk/_loop) — a model whose weight+KV footprint
+        # exceeds one group's HBM still serves with the ring full. Every
+        # invalid combination rejects HERE with the reason, at config time
+        # — never at first dispatch.
+        self.decode_pp = dict(self.mesh.shape).get(AXIS_PP, 1)
+        if self.decode_pp > 1:
+            npp = self.decode_pp
+            if zero_drain:
+                raise ValueError(
+                    "pp>1 does not compose with zero_drain=1: staged-"
+                    "injection admissions write one stage's KV shard from "
+                    "outside the stage ring — use disagg=P+D&pp=K (the "
+                    "handoff feeds stage-sharded rows) or drop one knob")
+            if self._use_sp:
+                raise ValueError(
+                    "pp>1 does not compose with sp>1 on the decode mesh: "
+                    "the staged row-group schedule owns the non-tp axes — "
+                    "under disagg, sp= shards the PREFILL group instead")
+            mesh_shape = dict(self.mesh.shape)
+            if mesh_shape.get(AXIS_TP, 1) > 1 or mesh_shape.get(AXIS_DP, 1) > 1:
+                # Same contract group_mesh_configs enforces for the disagg
+                # decode group: the staged shard_map partitions over pp
+                # only, so a tp/dp axis beside it would be silently
+                # replicated per stage (full weight+KV copy per device) —
+                # exactly the HBM blow-up pp exists to avoid.
+                raise ValueError(
+                    f"pipeline-staged decode runs tp=1/dp=1 within each "
+                    f"stage (pp={npp} with tp="
+                    f"{mesh_shape.get(AXIS_TP, 1)}, dp="
+                    f"{mesh_shape.get(AXIS_DP, 1)} on the decode mesh): "
+                    "make pp the whole group, or drop one knob")
+            if self.members > 1 or self.ensemble > 1:
+                raise ValueError(
+                    "pp>1 does not compose with members/ensemble engines: "
+                    "the staged decode program is not member-vmapped — run "
+                    "separate cells or drop one knob")
+            if self.spec_decode > 0:
+                raise ValueError(
+                    "pp>1 does not compose with spec_decode/spec_model: "
+                    "verify turns run the full layer stack in one program, "
+                    "which is exactly what a staged decode group cannot "
+                    "hold — drop one knob")
+            if self.spec.n_layers % npp:
+                raise ValueError(
+                    f"pp={npp} does not divide n_layers="
+                    f"{self.spec.n_layers}: stages hold equal contiguous "
+                    "layer shards — pick a dividing pp or pad the model")
+            if self.n_slots % npp:
+                raise ValueError(
+                    f"pp={npp} does not divide slots={self.n_slots}: the "
+                    "slot batch splits into pp row groups (the pipeline's "
+                    "microbatches) — pick slots as a multiple of pp")
         if sp_impl not in ("ring", "ulysses"):
             raise ValueError(
                 f"unknown sp_impl {sp_impl!r} (ring or ulysses)")
@@ -1240,11 +1311,16 @@ class InferenceEngine:
         self._util_fns: dict = {}
         self._init_device_state()
         if self.staged:
-            # Disagg: the staging cache lives on the prefill mesh. Zero-
-            # drain: same slot-batched layout on the decode mesh itself —
-            # reusing _cache_sh keeps one compiled zero-fill program.
-            self._stage_sh = (self._cache_sharding(self.prefill_mesh)
-                              if self.disagg else self._cache_sh)
+            # Disagg: the staging cache lives on the prefill mesh — with
+            # its position axis sharded over the prefill group's sp axis
+            # when sp>1 (a 100k-token admission's staged KV occupies
+            # O(max_seq/sp) HBM per prefill device; the handoff reshards
+            # to the decode group's layout on the fly). Zero-drain: same
+            # slot-batched layout on the decode mesh itself — reusing
+            # _cache_sh keeps one compiled zero-fill program.
+            self._stage_sh = (
+                self._cache_sharding(self.prefill_mesh, seq_shard=True)
+                if self.disagg else self._cache_sh)
             self._init_stage_state()
         # Handoff queue between the two scheduler loops (disagg): the
         # prefill loop appends transferred KV pieces (already resident on
@@ -1445,13 +1521,16 @@ class InferenceEngine:
         # bf16 weights alone are ~14 GB of a v5e's 16 GB HBM).
         return init_params_sharded(spec, mesh, seed)
 
-    def _cache_sharding(self, mesh: Mesh):
+    def _cache_sharding(self, mesh: Mesh, seq_shard: bool = False):
         """Slot-cache sharding for one device group — the decode mesh's
-        slot cache and the prefill mesh's staging cache share one layout
-        (that equality is what lets the handoff slice/write programs speak
-        a single chunk wire format)."""
+        slot cache and the prefill mesh's staging cache share one chunk
+        WIRE format even when their physical layouts differ (per-group
+        ``tp=``, an sp-sharded staging cache, a pp-staged decode cache:
+        the handoff reshards on the fly, kv_transfer route="reshard").
+        ``seq_shard`` shards the position axis over the mesh's sp axis —
+        the disagg prefill group's staging cache under ``sp>1``."""
         sh = kv_cache_sharding(mesh, self.spec.n_kv_heads,
-                               batch=self.n_slots)
+                               batch=self.n_slots, seq_shard=seq_shard)
         if self.kv_quant:
             # (values, scales): the scale array drops the head_dim axis.
             sh = (sh, NamedSharding(mesh, P(*tuple(sh.spec)[:4])))
@@ -2517,13 +2596,22 @@ class InferenceEngine:
         contract). Megachunk variants (``n_chunks`` > 1) live under their
         own "loop"-tagged keys, so a ``decode_loop=1`` engine can never
         compile one (the decode_loop=1 cache-key pin — same gating pattern
-        again)."""
+        again).
+
+        Pipeline-staged engines (``decode_pp`` > 1) prefix every decode
+        key with ``"pp"`` — their programs embed the staged shard_map
+        schedule, so they can never share a cache entry (or a budget
+        family) with the unstaged variants; every pp==1 engine's keys stay
+        byte-for-byte the pre-pp tuples (the no-sharding-knob disagg
+        cache-key pin in tests/test_disagg.py)."""
         if constrained:
             base = ("dfa", n_steps, want_lp, history, self._g_bucket)
         else:
             base = (n_steps, want_lp, history)
         if n_chunks > 1:
-            return ("loop", n_chunks) + base
+            base = ("loop", n_chunks) + base
+        if self.decode_pp > 1:
+            return ("pp",) + base
         return base
 
     def _decode_fn(self, n_steps: int, want_lp: bool, history: int,
@@ -2579,6 +2667,8 @@ class InferenceEngine:
         vocab = spec.vocab_size
         ens = self.ensemble
         mem = self.members
+        npp = self.decode_pp
+        mesh_pp = self.mesh
 
         def chunk_core(params, active, eos_s, ck, cv, token_s, lengths_s,
                        keys_s, temp_s, topp_s, topk_s, pp_s, fp_s, counts_s,
@@ -2673,7 +2763,34 @@ class InferenceEngine:
 
             carry0 = ((keys_s, counts_s, dfa_s) if constrained
                       else (keys_s, counts_s))
-            if n_chunks > 1:
+            if npp > 1:
+                # Pipeline-staged decode (decode_pp > 1): the same chunk/
+                # megachunk contracts scheduled as a row-group pipeline
+                # over the mesh's pp axis — stage s holds its L/pp layer
+                # shard + KV, rows flow stage→stage with one ppermute per
+                # tick, sampling (this very sample_fn, closed over as a
+                # replicated value) runs on the last stage
+                # (parallel/pipeline.py). members/ensemble/spec are
+                # rejected at config, so model_call is never needed here.
+                from quorum_tpu.parallel.pipeline import (
+                    staged_decode_chunk,
+                    staged_decode_loop,
+                )
+
+                if n_chunks > 1:
+                    (toks, n_valid, tok_end, live_end, budget_s, ck, cv,
+                     lengths_s, carry_out, aux) = staged_decode_loop(
+                        params, spec, mesh_pp, n_steps, n_chunks, token_s,
+                        lengths_s, live0, budget_s, eos_s, ck, cv,
+                        sample_fn, carry0, history=history, flash=flash)
+                else:
+                    (toks, _valid, n_valid, live_end, budget_s, ck, cv,
+                     lengths_s, carry_out, aux) = staged_decode_chunk(
+                        params, spec, mesh_pp, n_steps, token_s, lengths_s,
+                        live0, budget_s, eos_s, ck, cv, sample_fn, carry0,
+                        history=history, flash=flash)
+                    tok_end = toks[:, -1]
+            elif n_chunks > 1:
                 # Megachunk: C chunk bodies fused in one program with an
                 # all-dead early exit; toks [C, B, n_steps], n_valid
                 # [C, B], aux leaves [C, n_steps, ...] — the reap drains
@@ -3433,6 +3550,8 @@ class InferenceEngine:
                 # device counts and occupancy, plus the device↔device KV
                 # handoff accounting (quorum_tpu/cache/kv_transfer.py).
                 "disagg": 1 if self.disagg else 0,
+                "decode_pp": self.decode_pp,
+                "prefill_sp": self.prefill_sp,
                 "prefill_group_devices": (
                     int(self.prefill_mesh.devices.size) if self.disagg else 0),
                 "decode_group_devices": (
@@ -4513,6 +4632,7 @@ class InferenceEngine:
             # No rows to clamp: discard any dangling clamp stamp so the
             # idle gap until the next admission never reads as stall.
             self._note_admission_clamp(False)
+            self._note_stage_occupancy([])  # drained stages read 0
             self._drain_inflight()
             return
         # Depth-K pipelined decode: top the ring up (speculative verify
@@ -4775,6 +4895,20 @@ class InferenceEngine:
             return None
         return out + [-1] * (len(d) - len(out))
 
+    def _note_stage_occupancy(self, active) -> None:
+        """Per-stage decode occupancy for pipeline-staged engines
+        (``quorum_tpu_decode_stage_occupancy{stage=}``): stage g's rows are
+        the contiguous row group [g·S/pp, (g+1)·S/pp) — its microbatch
+        slot in the staged ring (docs/scaling.md). Refreshed on every
+        dispatch and on the idle transition; a no-op at pp==1 (the family
+        keeps its bare 0 sample)."""
+        if self.decode_pp <= 1:
+            return
+        sg = self._rows // self.decode_pp
+        for g in range(self.decode_pp):
+            n = sum(1 for i, _ in active if g * sg <= i < (g + 1) * sg)
+            obs.DECODE_STAGE_OCCUPANCY.set(n, stage=str(g))
+
     def _fill_inflight(self) -> None:
         target = self._target_depth()
         while len(self._inflight) < target:
@@ -4842,6 +4976,7 @@ class InferenceEngine:
             if depth > 0:
                 self.n_overlapped += 1
             obs.PIPELINE_DEPTH.set(len(self._inflight))
+            self._note_stage_occupancy(active)
 
     def _try_spec_dispatch(self, active, g: int, ahead: int,
                            depth: int) -> str:

@@ -521,13 +521,48 @@ def decode_step(
     resolves its backend's ``flash_decode=`` knob once and threads it
     through every decode program); ``None`` keeps the process-env gate
     (``flash_decode_mode()``) for direct callers and tests."""
-    b = token.shape[0]
-    flash_mode = flash_decode_mode() if flash is None else flash
-    x = _emb_rows(params["tok_emb"], token, jnp.dtype(spec.dtype))[:, None, :]  # [B,1,D]
+    x = decode_token_embed(params, spec, token, lengths)
+    x, cache_k, cache_v = decode_step_blocks(
+        params["blocks"], spec, x, lengths, cache_k, cache_v,
+        write_mask=write_mask, history=history, flash=flash)
+    x = _final_norm(params, spec, x)
+    return _unembed(params, spec, x[:, 0, :]), cache_k, cache_v
+
+
+def decode_token_embed(params: Params, spec: ModelSpec, token, lengths):
+    """Embed one decode step's tokens: ``[B] → [B, 1, D]`` (scaled, plus the
+    learned position embedding at each row's position when the spec uses
+    one). Shared by :func:`decode_step` and the pipeline-staged decode
+    path's stage 0 (parallel/pipeline.py)."""
+    x = _emb_rows(params["tok_emb"], token, jnp.dtype(spec.dtype))[:, None, :]
     if spec.emb_scale != 1.0:  # gemma scales embeddings by sqrt(d_model)
         x = x * jnp.asarray(spec.emb_scale, x.dtype)
     if spec.pos == "learned":
         x = x + params["pos_emb"][lengths][:, None, :].astype(x.dtype)
+    return x
+
+
+def decode_step_blocks(
+    blocks,
+    spec: ModelSpec,
+    x: jnp.ndarray,        # [B, 1, D] embedded hidden states
+    lengths: jnp.ndarray,  # [B] current token's position per row
+    cache_k: jnp.ndarray,  # [L', B, K, max_seq, hd] (L' = the layers given)
+    cache_v: jnp.ndarray,
+    write_mask: jnp.ndarray | None = None,
+    history: int | None = None,
+    flash: str | None = None,
+):
+    """The layer-scan core of :func:`decode_step` on pre-embedded hidden
+    states: per-row K/V write at ``lengths``, history-bounded read,
+    attention + MLP residual per layer — scanned over whatever layer slice
+    ``blocks``/``cache_[kv]`` carry. :func:`decode_step` runs it on the full
+    stack; the pipeline-staged decode path (parallel/pipeline.py) runs it
+    per stage on that stage's ``L/pp`` layer shard, which is what keeps the
+    two schedules' per-layer math identical. Returns
+    ``(x, cache_k, cache_v)`` with ``x`` still pre-final-norm."""
+    b = x.shape[0]
+    flash_mode = flash_decode_mode() if flash is None else flash
     cos, sin = rope_cos_sin_for(spec)
 
     def write_row(cache_row, new_row, idx, allow):
@@ -598,9 +633,8 @@ def decode_step(
         carry_x = carry_x + mlp
         return carry_x, (new_ck, new_cv)
 
-    x, (cache_k, cache_v) = lax.scan(body, x, (params["blocks"], cache_k, cache_v))
-    x = _final_norm(params, spec, x)
-    return _unembed(params, spec, x[:, 0, :]), cache_k, cache_v
+    x, (cache_k, cache_v) = lax.scan(body, x, (blocks, cache_k, cache_v))
+    return x, cache_k, cache_v
 
 
 def decode_chunk(

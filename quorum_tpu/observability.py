@@ -157,14 +157,24 @@ DECODE_LOOP_CHUNKS = METRICS.histogram(
 KV_HANDOFF_BYTES = METRICS.counter(
     "quorum_tpu_kv_handoff_bytes_total",
     "KV cache bytes handed off between device groups (prefill-group "
-    "staging -> decode-group slot; direct device->device, or the host "
-    "bounce fallback).")
+    "staging -> decode-group slot), labelled route= direct (same-layout "
+    "device->device put), reshard (either side partitioned: per-group tp= "
+    "or an sp-sharded staging cache, re-laid-out on the fly), host-bounce "
+    "(the explicit d2h+h2d fallback), or resident (zero-drain same-mesh "
+    "injection: 0 bytes cross any boundary).")
 KV_HANDOFF_SECONDS = METRICS.histogram(
     "quorum_tpu_kv_handoff_seconds",
     "One chunk-granular KV handoff between device groups (slice dispatch "
-    "to landed-on-target), blocking on the prefill scheduler thread.",
+    "to landed-on-target), blocking on the prefill scheduler thread; "
+    "route= labels as on quorum_tpu_kv_handoff_bytes_total.",
     buckets=(0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
              0.25, 0.5, 1.0, 2.5, 5.0))
+DECODE_STAGE_OCCUPANCY = METRICS.gauge(
+    "quorum_tpu_decode_stage_occupancy",
+    "Active decode rows per pipeline-staged row group (pp>1 engines: "
+    "group g's rows are stage g's microbatch slot in the staged ring — "
+    "docs/scaling.md). Bare sample stays 0 on unstaged engines; "
+    "last-writer-wins across engines sharing the process.")
 PREFILL_GROUP_ACTIVE = METRICS.gauge(
     "quorum_tpu_prefill_group_active",
     "In-flight chunked admissions occupying the prefill device group "

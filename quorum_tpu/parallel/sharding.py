@@ -17,7 +17,7 @@ from typing import Any, Mapping
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from quorum_tpu.parallel.mesh import AXIS_DP, AXIS_SP, AXIS_TP
+from quorum_tpu.parallel.mesh import AXIS_DP, AXIS_PP, AXIS_SP, AXIS_TP
 
 # Logical axis name → mesh axis (None = replicated).
 LOGICAL_RULES: dict[str, str | None] = {
@@ -31,7 +31,10 @@ LOGICAL_RULES: dict[str, str | None] = {
     "ff": AXIS_TP,         # MLP hidden
     "experts": AXIS_TP,    # expert parallelism shares the tp axis
     "vocab": AXIS_TP,
-    "layers": None,        # scanned-layer leading dim
+    # Scanned-layer leading dim: stage-sharded over pp (a no-op placement on
+    # every mesh whose pp axis is 1 — i.e. everything except the
+    # pipeline-staged decode group and the pp training mesh).
+    "layers": AXIS_PP,
     "pos": None,
 }
 
@@ -83,15 +86,28 @@ PARAM_LOGICAL_AXES: dict[str, tuple[str | None, ...]] = {
 KV_CACHE_AXES: tuple[str | None, ...] = ("layers", "batch", "kv_heads", "seq", "head_dim")
 
 
-def kv_cache_sharding(mesh: Mesh, n_kv_heads: int, batch: int | None = None) -> "NamedSharding":
+def kv_cache_sharding(mesh: Mesh, n_kv_heads: int, batch: int | None = None,
+                      *, seq_shard: bool = False) -> "NamedSharding":
     """KV-cache sharding that degrades gracefully for GQA: when the kv-head
     count doesn't divide the tp axis (e.g. 2 KV heads on tp=4), the head axis
-    is replicated — attention q·K still runs tp-sharded over query heads."""
+    is replicated — attention q·K still runs tp-sharded over query heads.
+
+    The leading layer axis shards over ``pp`` (a no-op except on the
+    pipeline-staged decode mesh, where each stage holds its own layers' KV —
+    the engine rejects ``pp`` that doesn't divide ``n_layers``).
+
+    ``seq_shard=True`` additionally shards the position axis over ``sp`` —
+    the disagg PREFILL group's staging cache under ``sp>1``: a 100k-token
+    admission's staged KV occupies O(max_seq/sp) HBM per device while the
+    decode group keeps its latency-shaped replicated-sequence layout (the
+    handoff reshards on the fly)."""
     axes = list(KV_CACHE_AXES)
     if n_kv_heads % mesh.shape[AXIS_TP] != 0:
         axes[2] = None
     if batch is not None and batch % mesh.shape[AXIS_DP] != 0:
         axes[1] = None
+    if seq_shard and mesh.shape[AXIS_SP] > 1:
+        axes[3] = "seq_shard"
     return logical_to_sharding(mesh, tuple(axes))
 # Activations: [batch, seq, model]
 ACT_AXES: tuple[str | None, ...] = ("batch", "seq", "model")

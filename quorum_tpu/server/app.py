@@ -326,7 +326,8 @@ def create_app(
                   "queue_limit", "decode_pipeline", "decode_loop",
                   "inflight_chunks",
                   "prefix_store_bytes", "prefix_store_entries",
-                  "disagg", "prefill_group_devices", "decode_group_devices",
+                  "disagg", "decode_pp", "prefill_sp",
+                  "prefill_group_devices", "decode_group_devices",
                   "prefill_group_active", "decode_group_active",
                   "zero_drain", "breaker_state")
         # One snapshot per distinct engine (_distinct_engines). Each

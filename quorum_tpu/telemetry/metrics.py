@@ -158,27 +158,45 @@ class Gauge:
 
     Process-wide last-writer-wins semantics (the scheduler threads of
     several engines share one family); fine for the depth-style gauges this
-    registry carries — they describe "now", not an accumulation."""
+    registry carries — they describe "now", not an accumulation.
+
+    ``set`` accepts labels (``set(3, stage="1")``) like :class:`Counter`'s
+    ``inc`` — each distinct label set is its own last-writer-wins series
+    under the family's one ``# TYPE`` line; label-less families keep their
+    single bare sample."""
 
     def __init__(self, name: str, help_text: str):
         self.name = name
         self.help = help_text
         self._lock = threading.Lock()
-        self._value = 0.0
+        self._series: dict[tuple[tuple[str, str], ...], float] = {(): 0.0}
 
-    def set(self, value: float) -> None:
+    def set(self, value: float, **labels: str) -> None:
+        key = tuple(sorted((k, str(v)) for k, v in labels.items()))
         with self._lock:
-            self._value = float(value)
+            self._series[key] = float(value)
 
     @property
     def value(self) -> float:
+        """The label-less series (the pre-label reading); labeled series
+        are read via :meth:`value_of`."""
         with self._lock:
-            return self._value
+            return self._series.get((), 0.0)
+
+    def value_of(self, **labels: str) -> float:
+        key = tuple(sorted((k, str(v)) for k, v in labels.items()))
+        with self._lock:
+            return self._series.get(key, 0.0)
 
     def expose(self) -> list[str]:
-        return [f"# HELP {self.name} {self.help}",
-                f"# TYPE {self.name} gauge",
-                f"{self.name} {_fmt_float(self.value)}"]
+        lines = [f"# HELP {self.name} {self.help}",
+                 f"# TYPE {self.name} gauge"]
+        with self._lock:
+            snap = dict(self._series)
+        for key in sorted(snap):
+            lines.append(f"{self.name}{_fmt_labels(key)} "
+                         f"{_fmt_float(snap[key])}")
+        return lines
 
 
 class MetricsRegistry:

@@ -52,12 +52,14 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 # The interference legs need >= 2 virtual CPU devices (one per disagg
-# group). Effective only before the first `import jax` — standalone runs;
-# under pytest the suite conftest already forces an 8-device mesh.
+# group) and the sharded legs >= 4 (disagg=2+2&tp=2 vs colocated tp=4 at
+# matched device count). Effective only before the first `import jax` —
+# standalone runs; under pytest the suite conftest already forces an
+# 8-device mesh.
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
-        _flags + " --xla_force_host_platform_device_count=2").strip()
+        _flags + " --xla_force_host_platform_device_count=4").strip()
 
 
 def run(tokens: int = 64, chunk: int = 4, depth: int = 4,
@@ -365,6 +367,78 @@ def interference(tokens: int = 64, chunk: int = 4, depth: int = 4,
     return out
 
 
+def sharded(tokens: int = 48, chunk: int = 4, depth: int = 2,
+            loop: int = 2, repeats: int = 2) -> dict:
+    """Per-group sharding under disagg (ISSUE 14): three arms at the SAME
+    device count (4) — colocated ``tp=4``, ``disagg=2+2&tp=2`` (both
+    groups tp-sharded, the handoff resharding between the two layouts on
+    the fly), and ``disagg=2+2&pp=2`` (the decode group pipeline-staged:
+    stage s holds L/pp layers + their KV shard, rows flow stage→stage
+    inside the fused megachunk scan). Reports per arm: decode tok/s,
+    handoff bytes/s across the group boundary, dispatch counts, and the
+    per-family device-seconds attribution (the staged arm's decode time
+    lives under the ``pp_*`` families) — tokens asserted identical across
+    all arms (sharding moves bytes, never samples)."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+
+    import jax
+
+    from quorum_tpu.engine.engine import InferenceEngine
+    from quorum_tpu.models.model_config import MODEL_PRESETS
+    from quorum_tpu.ops.sampling import SamplerConfig
+    from quorum_tpu.parallel.mesh import MeshConfig, disagg_meshes, make_mesh
+
+    if len(jax.devices()) < 4:
+        raise RuntimeError(
+            "the sharded legs need >= 4 virtual devices "
+            "(XLA_FLAGS=--xla_force_host_platform_device_count=4)")
+    spec = MODEL_PRESETS["llama-tiny"]
+    greedy = SamplerConfig(temperature=0.0)
+    prompt = [(3 + 5 * i) % spec.vocab_size for i in range(40)]
+    kw = dict(decode_chunk=chunk, decode_pipeline=depth, decode_loop=loop,
+              n_slots=2, prefill_chunk=16)
+    out: dict = {"sharded_tokens": tokens, "sharded_devices": 4}
+    streams: dict[str, list[int]] = {}
+    for tag in ("colocated_tp4", "disagg_tp2", "disagg_pp2"):
+        if tag == "colocated_tp4":
+            eng = InferenceEngine(
+                spec, make_mesh(MeshConfig(tp=4), jax.devices()[:4]), **kw)
+        elif tag == "disagg_tp2":
+            pm, dm = disagg_meshes(2, 2, tp=2)
+            eng = InferenceEngine(spec, dm, prefill_mesh=pm, **kw)
+        else:
+            pm, dm = disagg_meshes(2, 2, pp=2)
+            eng = InferenceEngine(spec, dm, prefill_mesh=pm, **kw)
+        eng.generate(prompt, max_new_tokens=tokens, sampler=greedy)  # warm
+        c0, b0 = eng.n_decode_chunks, eng.kv_handoff_bytes
+        walls = []
+        for _ in range(repeats):
+            t0 = time.perf_counter()
+            res = eng.generate(prompt, max_new_tokens=tokens, sampler=greedy)
+            walls.append(time.perf_counter() - t0)
+        streams[tag] = res.token_ids
+        wall = statistics.median(walls)
+        pre = f"sharded_{tag}"
+        out[f"{pre}_tok_s"] = round(tokens / wall, 1)
+        out[f"{pre}_dispatches_per_request"] = (
+            (eng.n_decode_chunks - c0) / repeats)
+        handoff_b = eng.kv_handoff_bytes - b0
+        out[f"{pre}_handoff_bytes_per_s"] = round(
+            handoff_b / max(1e-9, wall * repeats), 1)
+        out[f"{pre}_handoff_bytes"] = handoff_b
+        # Per-family device-seconds: the staged arm's decode time lives
+        # under pp_loop/pp_plain; the handoff halves under hslice/hput.
+        out[f"{pre}_device_seconds"] = eng.latency.snapshot()
+        if tag == "disagg_pp2":
+            out[f"{pre}_decode_pp"] = eng.decode_pp
+        eng.shutdown()
+    out["sharded_tokens_match"] = (
+        streams["colocated_tp4"] == streams["disagg_tp2"]
+        == streams["disagg_pp2"])
+    return out
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--tokens", type=int, default=64)
@@ -379,6 +453,9 @@ def main() -> int:
                     help="skip the speculative-decoding A/B legs")
     ap.add_argument("--skip-interference", action="store_true",
                     help="skip the colocated-vs-disagg interference legs")
+    ap.add_argument("--skip-sharded", action="store_true",
+                    help="skip the per-group-sharding legs (disagg+tp / "
+                         "staged-pp vs colocated tp at matched devices)")
     ap.add_argument("--only-interference", action="store_true",
                     help="run ONLY the interference legs (bench.py's "
                          "subprocess phase — the depth/megachunk sweep "
@@ -386,7 +463,21 @@ def main() -> int:
     ap.add_argument("--only-spec", action="store_true",
                     help="run ONLY the speculative A/B legs (bench.py's "
                          "subprocess phase)")
+    ap.add_argument("--only-sharded", action="store_true",
+                    help="run ONLY the per-group-sharding legs (bench.py's "
+                         "subprocess phase)")
     args = ap.parse_args()
+    if args.only_sharded:
+        try:
+            msh = sharded(args.tokens, args.chunk, args.depth, args.loop,
+                          args.repeats)
+        except RuntimeError as e:
+            msh = {"sharded_skipped": str(e)}
+            print(f"sharded legs skipped: {e}")
+        else:
+            _print_sharded(msh)
+        print(json.dumps(msh), flush=True)
+        return 0
     if args.only_spec:
         ms = spec(args.tokens, args.chunk, args.depth, args.spec_g)
         for leg in ("rep", "crep"):
@@ -484,8 +575,38 @@ def main() -> int:
               f"(drain-based arm: {mi['colocated_admission_stall_s']}s)")
         print(f"  token-for-token identical: "
               f"{mi['interference_tokens_match']}")
+    if not args.skip_sharded:
+        # A box with XLA_FLAGS preset to fewer than 4 virtual devices
+        # (the pre-sharded-leg setting was 2) banks the skip instead of
+        # losing every other leg's numbers to a crash before the final
+        # JSON line — the onchip_session discipline.
+        try:
+            msh = sharded(args.tokens, args.chunk, args.depth, args.loop,
+                          args.repeats)
+        except RuntimeError as e:
+            msh = {"sharded_skipped": str(e)}
+            print(f"sharded legs skipped: {e}")
+        else:
+            _print_sharded(msh)
+        m.update(msh)
     print(json.dumps(m), flush=True)
     return 0
+
+
+def _print_sharded(msh: dict) -> None:
+    print("per-group sharding under disagg (4 devices, matched count):")
+    for tag in ("colocated_tp4", "disagg_tp2", "disagg_pp2"):
+        pre = f"sharded_{tag}"
+        fams = msh.get(f"{pre}_device_seconds", {})
+        decode = ", ".join(
+            f"{f} p50 {s['p50_ms']}ms (n={s['count']})"
+            for f, s in sorted(fams.items())
+            if f in ("plain", "loop", "pp_plain", "pp_loop"))
+        print(f"  {tag:13}: {msh[f'{pre}_tok_s']} tok/s, "
+              f"{msh[f'{pre}_dispatches_per_request']:.1f} dispatches/req, "
+              f"{msh[f'{pre}_handoff_bytes_per_s']} handoff B/s "
+              f"({msh[f'{pre}_handoff_bytes']} B); {decode}")
+    print(f"  token-for-token identical: {msh['sharded_tokens_match']}")
 
 
 if __name__ == "__main__":

@@ -35,7 +35,7 @@ list; whatever the tunnel survives is kept:
      number that says int8 serving is quality-safe at the scale we ship.
 
 Usage: ``python scripts/onchip_session.py
-[--skip bench,ab,kvq,flash,megachunk,spec,disagg,zero_drain,profile,qq]``
+[--skip bench,ab,kvq,flash,megachunk,spec,disagg,sharded,zero_drain,profile,qq]``
 Each step is a subprocess with its own budget; a wedged step is recorded
 and skipped, never fatal. Results: ``ONCHIP.json`` (merged dict, one key
 prefix per step) + profile trace under ``profiles/``.
@@ -464,6 +464,37 @@ def main() -> None:
         else:
             bank({"disagg_skipped": "single-device host (disagg needs "
                                     ">= 2 devices for disjoint groups)"})
+    if "sharded" not in skip:
+        # Per-group tensor sharding under disagg (ISSUE 14): disagg=2+2&
+        # tp=2 vs colocated tp=4 at matched device count, at 7B, SEPARATE
+        # processes per arm (the mesh layout is structural). Needs >= 4
+        # devices for the matched-count comparison; a single v5e chip
+        # banks the skip rather than faking groups (same discipline as
+        # the disagg step — the device count is probed in a subprocess so
+        # the orchestrator never holds the TPU client).
+        try:
+            n_dev = int(subprocess.run(
+                [sys.executable, "-c",
+                 "import jax; print(len(jax.devices()))"],
+                capture_output=True, text=True, timeout=180,
+            ).stdout.strip() or 0)
+        except Exception:
+            n_dev = 0
+        if n_dev >= 4:
+            for arm, arm_url in (
+                    ("sharded_tp4", B7_URL + "&tp=4"),
+                    ("sharded_disagg_tp2",
+                     B7_URL + "&disagg=2+2&tp=2&prefill_chunk=256")):
+                b = fits(arm, 1500)
+                if b:
+                    bank(run_step(
+                        arm, [sys.executable, "-c", _SERVE_ONE, arm_url,
+                              "2", arm, "600"], budget=b))
+        else:
+            bank({"sharded_skipped": f"{n_dev} device(s): the matched-"
+                                     "count sharded A/B needs >= 4 (a "
+                                     "single chip has no group to shard "
+                                     "against)"})
     if "zero_drain" not in skip:
         # Zero-drain vs drain-based colocated at 7B (PERF.md §5 step 7b):
         # the SAME interference number as the disagg step, on ONE device

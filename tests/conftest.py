@@ -47,6 +47,17 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
+# Sharding-invariant RNG, process-wide and FIRST (before any traced random
+# op): on jax 0.4.x the non-partitionable default produces wrong values for
+# row-sharded random outputs on multi-axis meshes — the dryrun dp2·sp2·tp2
+# embed divergence. quorum_tpu.models.init flips it at import too; doing it
+# here as well guarantees every test module (even ones that never touch
+# models/) runs the same RNG semantics newer jax defaults to.
+try:
+    jax.config.update("jax_threefry_partitionable", True)
+except AttributeError:  # newer jax: flag retired, always partitionable
+    pass
+
 # Lowering-counter hook (quorum_tpu/analysis/compile_watch.py): registered
 # before any engine exists so compiles_total() covers the whole suite. The
 # warmed-engine zero-recompile sentinel in tests/test_qlint.py snapshots it

@@ -74,7 +74,10 @@ def test_disagg_url_knob_validation():
 
     for url, frag in [
         ("tpu://llama-tiny?disagg=4x4", "invalid disagg"),
-        ("tpu://llama-tiny?disagg=1+1&tp=2", "tp=/dp=/sp="),
+        # tp= composes with disagg now (the per-group factorization), but
+        # a non-factoring tp still rejects at config with the arithmetic
+        ("tpu://llama-tiny?disagg=1+1&tp=2", "does not factor"),
+        ("tpu://llama-tiny?disagg=1+1&dp=2", "dp= does not compose"),
         ("tpu://llama-tiny?disagg=1+1&prefill_chunk=0", "chunked prefill"),
         ("tpu://llama-tiny?disagg=9+9", "devices"),
         ("tpu://llama-tiny?disagg=1+1&spec_model=llama-tiny", "draft"),
@@ -172,6 +175,24 @@ def test_kv_handoff_fault_dooms_only_its_request(smoke_engines):
     assert _gen(eng_d, [3, 4, 5], seed=1) == base
     assert eng_d.n_rebuilds == rebuilds0  # staging survived: no rebuild
     assert eng_d.health()["prefill_scheduler_alive"]
+
+
+def test_disagg_no_knob_cache_keys_unchanged(smoke_engines):
+    """The no-sharding-knob disagg path keeps its exact pre-existing
+    program cache keys, byte for byte (ISSUE 14 acceptance): plain
+    3-tuple decode keys — never a "pp"-tagged staged variant — and only
+    the pre-existing admit-cache tags."""
+    eng_c, eng_d = smoke_engines
+    _gen(eng_d, [3, 4, 5], seed=1)
+    assert eng_d.decode_pp == 1 and eng_d.prefill_sp == 1
+    for k in eng_d._decode_cache:
+        assert isinstance(k, tuple) and len(k) == 3, k
+        assert (isinstance(k[0], int) and isinstance(k[1], bool)
+                and isinstance(k[2], int)), k
+    allowed_tags = {"seg", "register", "hslice", "hput"}
+    for k in eng_d._admit_cache:
+        tag = k if isinstance(k, str) else k[0]
+        assert tag in allowed_tags, k
 
 
 # ---- slow: the 4+4 acceptance legs at K=4·C=4 ------------------------------
@@ -289,6 +310,151 @@ def test_disagg_prefix_restore_pin():
                             seed=9330)
     eng_c = InferenceEngine(TINY, decode_chunk=4, n_slots=1,
                             prefill_chunk=16, seed=9330)
+    try:
+        conv = [(3 + 5 * i) % 500 for i in range(33)]
+        other = [(9 + 7 * i) % 500 for i in range(33)]
+        out1 = _gen(eng_d, conv, seed=4, n=6)
+        eng_d.drain_prefix_store()
+        _gen(eng_d, other, seed=5, n=6)  # churn the single slot
+        eng_d.drain_prefix_store()
+        follow = conv + out1 + [17, 19]
+        assert (_gen(eng_d, follow, seed=6, n=6)
+                == _gen(eng_c, follow, seed=6, n=6))
+        assert eng_d.prefix_store_hits >= 1
+        assert eng_d.prefix_store_tokens_restored > 0
+    finally:
+        eng_d.shutdown()
+        eng_c.shutdown()
+
+
+# ---- slow: the sharded legs — disagg=2+2&tp=2 vs colocated tp=2 ------------
+#
+# ISSUE 14 acceptance: per-group tensor sharding under disagg is
+# token-for-token identical to the colocated tp engine at the same
+# intra-group tp, across every acceptance leg — the differently-laid-out
+# meshes only change WHERE bytes live (the handoff reshards on the fly,
+# route="reshard"), never what gets sampled.
+
+
+@pytest.fixture(scope="module")
+def sharded_engines():
+    """disagg=2+2&tp=2 (both groups tp-sharded) vs a colocated tp=2 mesh
+    engine, both at decode_pipeline=4 × decode_loop=4."""
+    pm, dm = disagg_meshes(2, 2, tp=2)
+    kw = dict(decode_chunk=4, n_slots=2, decode_pipeline=4, decode_loop=4,
+              prefill_chunk=16, seed=9340)
+    import jax
+
+    eng_c = InferenceEngine(TINY, make_mesh(MeshConfig(tp=2),
+                                            jax.devices()[:2]), **kw)
+    eng_d = InferenceEngine(TINY, dm, prefill_mesh=pm, **kw)
+    yield eng_c, eng_d
+    eng_c.shutdown()
+    eng_d.shutdown()
+
+
+@pytest.mark.slow
+def test_disagg_tp_greedy_sampled_chunked_pin(sharded_engines):
+    eng_c, eng_d = sharded_engines
+    long_p = [(3 + 5 * i) % 500 for i in range(40)]
+    for prompt, sampler, seed in [([3, 4, 5], GREEDY, 0),
+                                  ([7, 8, 9], SAMPLED, 11),
+                                  (long_p, SAMPLED, 3)]:
+        assert (_gen(eng_d, prompt, seed=seed, n=12, sampler=sampler)
+                == _gen(eng_c, prompt, seed=seed, n=12, sampler=sampler))
+    assert eng_d.n_kv_handoffs > 0 and eng_d.kv_handoff_bytes > 0
+    # tp-sharded staging slices cross the group boundary via the on-the-
+    # fly reshard route (quorum_tpu_kv_handoff_bytes_total{route=})
+    from quorum_tpu import observability as obs
+
+    assert obs.KV_HANDOFF_BYTES.value_of(route="reshard") > 0
+
+
+@pytest.mark.slow
+def test_disagg_tp_eos_mid_chunk_pin(sharded_engines):
+    eng_c, eng_d = sharded_engines
+    probe = _gen(eng_c, [5, 6, 7], seed=2, n=12)
+    eos = next((t for i, t in enumerate(probe)
+                if i >= 4 and i % 4 != 3 and t not in probe[:i]), None)
+    assert eos is not None, probe
+    over0 = eng_d.n_overrun
+    r_d = eng_d.generate([5, 6, 7], max_new_tokens=12, sampler=SAMPLED,
+                         seed=2, eos_id=eos)
+    r_c = eng_c.generate([5, 6, 7], max_new_tokens=12, sampler=SAMPLED,
+                         seed=2, eos_id=eos)
+    assert r_d.token_ids == r_c.token_ids
+    assert r_d.finish_reason == r_c.finish_reason == "stop"
+    assert eng_d.n_overrun == over0
+
+
+@pytest.mark.slow
+def test_disagg_tp_constrained_pin():
+    """response_format JSON mode through the full backend at
+    disagg=2+2&tp=2 vs colocated tp=2 — byte for byte."""
+    from quorum_tpu.backends.tpu_backend import TpuBackend
+    from quorum_tpu.config import BackendSpec
+
+    def build(url):
+        return TpuBackend.from_spec(BackendSpec(name="t", url=url,
+                                                model="m"))
+
+    opts = ("n_kv_heads=4&seed=9350&decode_pipeline=4&decode_loop=4"
+            "&prefill_chunk=16&decode_chunk=4&slots=2")
+    b_d = build(f"tpu://llama-tiny?{opts}&disagg=2+2&tp=2")
+    b_c = build(f"tpu://llama-tiny?{opts}&tp=2")
+    body = {"model": "m", "max_tokens": 24, "temperature": 0.0, "seed": 3,
+            "messages": [{"role": "user", "content": "json please"}],
+            "response_format": {"type": "json_object"}}
+
+    async def run(b):
+        res = await b.complete(dict(body), {}, timeout=300)
+        return res.body["choices"][0]["message"]["content"]
+
+    assert asyncio.run(run(b_d)) == asyncio.run(run(b_c))
+    assert b_d.engine.n_constrained >= 1
+    assert b_d.engine.n_kv_handoffs > 0
+
+
+@pytest.mark.slow
+def test_disagg_tp_members_pin():
+    """members=2 on disagg=2+2&tp=2: each member's stream equals the
+    colocated tp=2 members engine's — the stacked tp-sharded staging
+    cache and the member-aware handoff address the right rows."""
+    import jax
+
+    pm, dm = disagg_meshes(2, 2, tp=2)
+    kw = dict(members=2, decode_chunk=4, n_slots=2, decode_pipeline=4,
+              decode_loop=4, prefill_chunk=16, seed=0)
+    eng_d = InferenceEngine(TINY, dm, prefill_mesh=pm, **kw)
+    eng_c = InferenceEngine(TINY, make_mesh(MeshConfig(tp=2),
+                                            jax.devices()[:2]), **kw)
+    try:
+        for m in range(2):
+            assert (_gen(eng_d, [3, 4, 5], seed=9, n=6, member=m)
+                    == _gen(eng_c, [3, 4, 5], seed=9, n=6, member=m))
+        assert eng_d.n_kv_handoffs > 0
+    finally:
+        eng_d.shutdown()
+        eng_c.shutdown()
+
+
+@pytest.mark.slow
+def test_disagg_tp_prefix_restore_pin():
+    """prefix_store=host on disagg=2+2&tp=2: the churn-evicted
+    conversation's follow-up restores host→(tp-sharded) staging, rides
+    the tail prefill, reshards across the handoff — and still equals a
+    cold colocated tp=2 prefill token for token."""
+    import jax
+
+    pm, dm = disagg_meshes(2, 2, tp=2)
+    eng_d = InferenceEngine(TINY, dm, prefill_mesh=pm, decode_chunk=4,
+                            n_slots=1, prefill_chunk=16,
+                            prefix_store="host", prefix_store_chunk=16,
+                            seed=9360)
+    eng_c = InferenceEngine(TINY, make_mesh(MeshConfig(tp=2),
+                                            jax.devices()[:2]),
+                            decode_chunk=4, n_slots=1, prefill_chunk=16,
+                            seed=9360)
     try:
         conv = [(3 + 5 * i) % 500 for i in range(33)]
         other = [(9 + 7 * i) % 500 for i in range(33)]

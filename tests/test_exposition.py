@@ -88,6 +88,32 @@ def test_histogram_expose_is_valid_and_cumulative():
     assert labeled["count"] == 1
 
 
+def test_labeled_gauge_and_route_series_expose_valid():
+    """Gauge labels (ISSUE 14: per-stage decode occupancy) — each label
+    set is its own last-writer-wins series under one TYPE line, the bare
+    series survives for unlabeled writers, and the whole family (plus a
+    route-labeled counter like kv_handoff_bytes) validates."""
+    from quorum_tpu.telemetry.metrics import Counter, Gauge
+
+    g = Gauge("demo_occupancy", "per-stage occupancy")
+    g.set(3, stage="0")
+    g.set(1, stage="1")
+    g.set(2, stage="1")  # last writer wins per series
+    lines = g.expose()
+    assert 'demo_occupancy{stage="0"} 3.0' in lines
+    assert 'demo_occupancy{stage="1"} 2.0' in lines
+    assert "demo_occupancy 0.0" in lines  # bare series retained
+    assert g.value_of(stage="0") == 3.0
+    assert g.value == 0.0
+    c = Counter("demo_bytes_total", "bytes by route")
+    c.inc(10, route="reshard")
+    c.inc(5, route="host-bounce")
+    assert c.value == 15.0
+    assert c.value_of(route="reshard") == 10.0
+    text = "\n".join(g.expose() + c.expose()) + "\n"
+    assert validate_exposition(text) == []
+
+
 def test_default_buckets_strictly_increase():
     assert list(DEFAULT_BUCKETS) == sorted(set(DEFAULT_BUCKETS))
 
@@ -240,11 +266,21 @@ async def test_live_metrics_exposition_validates():
     # engine block with the right kinds
     fam = "quorum_tpu_kv_handoff_seconds"
     assert f"# TYPE {fam} histogram" in text
-    assert f'{fam}_bucket{{le="+Inf"}}' in text
+    # route= label (ISSUE 14): a process whose engines moved KV exposes
+    # per-route series (direct/reshard/host-bounce/resident); a cold
+    # family exposes the bare triplet — either way one +Inf bucket per
+    # series, under the one TYPE line the validator already enforced
+    import re
+
+    assert re.search(
+        fam + r'_bucket\{(?:route="[a-z-]+",)?le="\+Inf"\}', text)
     assert f"{fam}_sum" in text and f"{fam}_count" in text
     assert "# TYPE quorum_tpu_kv_handoff_bytes_total counter" in text
     assert "# TYPE quorum_tpu_prefill_group_active gauge" in text
     assert "# TYPE quorum_tpu_decode_group_active gauge" in text
+    # per-stage decode occupancy (pipeline-staged decode, ISSUE 14): the
+    # gauge family is registered with its bare sample on unstaged engines
+    assert "# TYPE quorum_tpu_decode_stage_occupancy gauge" in text
     assert "# TYPE quorum_tpu_engine_disagg gauge" in text
     assert "# TYPE quorum_tpu_engine_prefill_group_devices gauge" in text
     assert "# TYPE quorum_tpu_engine_decode_group_devices gauge" in text

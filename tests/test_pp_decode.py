@@ -1,0 +1,406 @@
+"""Pipeline-staged decode (``pp=K``, ISSUE 14): serve models bigger than
+one device group's HBM.
+
+Fast tier: the config-rejection matrix (every invalid knob combination
+rejects at config time with the reason — never at first dispatch), the
+bit-for-bit parity of the staged chunk/megachunk programs against
+``decode_chunk``/``decode_loop``, a pp=2 engine pinned token-for-token
+against a single-device engine (with the staged program families under
+their own budget keys and the per-stage occupancy gauge live), and the
+synthetic HBM-budget acceptance: a model whose weight+KV footprint
+exceeds one group's budget still serves, because no stage holds more
+than its layer shard.
+
+Slow tier: disagg=1+2&pp=2 (the handoff feeding stage 0 of a staged
+decode group) and the ring-full dispatch-counter acceptance at
+``decode_pipeline=2 × decode_loop=2``.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from quorum_tpu import observability as obs
+from quorum_tpu.analysis import budget
+from quorum_tpu.engine.engine import InferenceEngine
+from quorum_tpu.models.model_config import resolve_spec
+from quorum_tpu.ops.sampling import SamplerConfig, sample_token_rows
+from quorum_tpu.parallel.mesh import (
+    MeshConfig,
+    disagg_meshes,
+    group_mesh_configs,
+    make_mesh,
+    single_device_mesh,
+)
+
+TINY = resolve_spec("llama-tiny", {"n_kv_heads": "4"})
+SAMPLED = SamplerConfig(temperature=0.8, top_p=0.9)
+GREEDY = SamplerConfig(temperature=0.0)
+
+
+def _gen(eng, prompt, seed=0, n=8, sampler=SAMPLED, **kw):
+    return eng.generate(prompt, max_new_tokens=n, sampler=sampler,
+                        seed=seed, **kw).token_ids
+
+
+# ---- fast: the config-rejection matrix -------------------------------------
+
+
+def test_group_mesh_config_rejections():
+    """Every invalid disagg-side factorization fails in
+    group_mesh_configs with the arithmetic, at config time."""
+    for kw, frag in [
+        (dict(tp=3), "does not factor"),        # non-divisible tp vs group
+        (dict(sp=3), "does not factor"),        # sp must divide prefill
+        (dict(pp=3), "does not factor"),        # pp must divide decode
+        (dict(tp=0), ">= 1"),
+        (dict(sp=0), ">= 1"),
+        (dict(pp=0), ">= 1"),
+    ]:
+        with pytest.raises(ValueError, match=frag):
+            group_mesh_configs(4, 4, **kw)
+    # pp shares the decode group with a >1 tp residue: staged decode runs
+    # tp=1 within each stage (prefill side factors fine here: 2 = 1x2)
+    with pytest.raises(ValueError, match="tp=1 within each stage"):
+        group_mesh_configs(2, 4, pp=2, tp=2)
+    # the factoring identities that must pass
+    pre, dec = group_mesh_configs(4, 4)
+    assert (pre.tp, dec.tp) == (4, 4)  # no knobs = whole-group tp
+    pre, dec = group_mesh_configs(4, 4, tp=4)
+    assert (pre.sp, pre.tp, dec.pp, dec.tp) == (1, 4, 1, 4)
+    pre, dec = group_mesh_configs(4, 2, sp=2, pp=2)
+    assert (pre.sp, pre.tp, dec.pp, dec.tp) == (2, 2, 2, 1)
+
+
+def test_engine_pp_rejections():
+    """The engine-side matrix: pp vs layer count / slot count, and the
+    combinations the staged schedule cannot express — each rejects at
+    construction with a one-line actionable error."""
+    mesh_pp = make_mesh(MeshConfig(pp=2), jax.devices()[:2])
+    with pytest.raises(ValueError, match="does not divide n_layers"):
+        InferenceEngine(resolve_spec("llama-tiny", {"n_layers": "3"}),
+                        make_mesh(MeshConfig(pp=2), jax.devices()[:2]))
+    with pytest.raises(ValueError, match="does not divide slots"):
+        InferenceEngine(TINY, mesh_pp, n_slots=3)
+    with pytest.raises(ValueError, match="zero_drain"):
+        InferenceEngine(TINY, mesh_pp, zero_drain=True, prefill_chunk=16)
+    with pytest.raises(ValueError, match="members/ensemble"):
+        InferenceEngine(TINY, mesh_pp, members=2)
+    with pytest.raises(ValueError, match="members/ensemble"):
+        InferenceEngine(TINY, mesh_pp, ensemble=2)
+    with pytest.raises(ValueError, match="spec_decode"):
+        InferenceEngine(TINY, mesh_pp, spec_decode=4)
+    with pytest.raises(ValueError, match="sp>1"):
+        InferenceEngine(TINY, make_mesh(MeshConfig(pp=2, sp=2),
+                                        jax.devices()[:4]))
+    # colocated pp beside tp/dp: the staged shard_map partitions over pp
+    # only — a tp/dp axis would be silently replicated per stage, the
+    # exact HBM blow-up pp exists to avoid (the disagg side pins the same
+    # contract via group_mesh_configs)
+    with pytest.raises(ValueError, match="tp=1/dp=1 within each stage"):
+        InferenceEngine(TINY, make_mesh(MeshConfig(pp=2, tp=2),
+                                        jax.devices()[:4]))
+    with pytest.raises(ValueError, match="tp=1/dp=1 within each stage"):
+        InferenceEngine(TINY, make_mesh(MeshConfig(pp=2, dp=2),
+                                        jax.devices()[:4]))
+
+
+def test_engine_disagg_sharding_rejections():
+    """disagg-side engine rejections: sp in the DECODE group, and a
+    prefill-group sp that does not divide max_seq."""
+    pm, dm = disagg_meshes(1, 2)
+    sp_decode = make_mesh(MeshConfig(sp=2), jax.devices()[1:3])
+    with pytest.raises(ValueError, match="PREFILL group"):
+        InferenceEngine(TINY, sp_decode,
+                        prefill_mesh=make_mesh(MeshConfig(tp=1),
+                                               jax.devices()[:1]),
+                        prefill_chunk=16)
+    # sp=3 cannot shard a 128-position staging cache evenly
+    pm2, dm2 = disagg_meshes(3, 1, sp=3)
+    with pytest.raises(ValueError, match="does not divide max_seq"):
+        InferenceEngine(TINY, dm2, prefill_mesh=pm2, prefill_chunk=16)
+
+
+def test_url_pp_rejections():
+    from quorum_tpu.backends.tpu_backend import TpuBackend
+    from quorum_tpu.config import BackendSpec
+
+    def build(url):
+        return TpuBackend.from_spec(
+            BackendSpec(name="t", url=url, model="m"))
+
+    for url, frag in [
+        ("tpu://llama-tiny?pp=2&zero_drain=1", "zero_drain"),
+        ("tpu://llama-tiny?n_layers=3&pp=2", "does not divide n_layers"),
+        ("tpu://llama-tiny?disagg=2+4&pp=2", "tp=1 within each stage"),
+        ("tpu://llama-tiny?disagg=2+2&dp=2", "dp= does not compose"),
+        ("tpu://llama-tiny?pp=2&sp=2", "sp>1"),
+        ("tpu://llama-tiny?pp=2&tp=2", "tp=1/dp=1 within each stage"),
+        ("tpu://llama-tiny?pp=2&dp=2", "tp=1/dp=1 within each stage"),
+        ("tpu://llama-tiny?pp=2&spec_decode=4", "spec_decode"),
+    ]:
+        with pytest.raises(ValueError, match=frag):
+            build(url)
+
+
+# ---- fast: staged program parity against decode_chunk/decode_loop ----------
+
+
+@pytest.fixture(scope="module")
+def parity_setup():
+    from quorum_tpu.models.init import init_params_sharded
+    from quorum_tpu.models.transformer import init_cache
+    from quorum_tpu.parallel.sharding import kv_cache_sharding
+
+    spec = resolve_spec("llama-tiny",
+                        {"n_kv_heads": "4", "n_layers": "4",
+                         "max_seq": "64"})
+    b = 4
+    mesh_pp = make_mesh(MeshConfig(pp=2), jax.devices()[:2])
+    mesh_one = single_device_mesh()
+
+    def build(mesh):
+        params = init_params_sharded(spec, mesh, seed=0)
+        sh = kv_cache_sharding(mesh, spec.n_kv_heads, batch=b)
+        ck, cv = jax.jit(lambda: init_cache(spec, batch=b),
+                         out_shardings=(sh, sh))()
+        return params, ck, cv
+
+    def sample_fn(logits, lv, carry):
+        # An engine-shaped sampler: penalties on the carry counts, a
+        # per-row RNG chain split once per token, and mixed aux leaves
+        # (a per-row logprob record + a per-step scalar).
+        keys, counts = carry
+        adj = logits - 0.1 * counts
+        split = jax.vmap(jax.random.split)(keys)
+        nxt = sample_token_rows(adj, split[:, 1],
+                                jnp.full((b,), 0.8, jnp.float32),
+                                jnp.full((b,), 0.9, jnp.float32),
+                                jnp.zeros((b,), jnp.int32))
+        counts = counts.at[jnp.arange(b), nxt].add(lv.astype(jnp.int32))
+        lp = jax.nn.log_softmax(adj)
+        s_lp = jnp.take_along_axis(lp, nxt[:, None], 1)[:, 0]
+        n_live = jnp.sum(lv.astype(jnp.int32))
+        return nxt, (split[:, 0], counts), (s_lp, n_live)
+
+    state = dict(
+        token=jnp.array([3, 4, 5, 6], jnp.int32),
+        lengths=jnp.array([1, 2, 1, 3], jnp.int32),
+        live=jnp.array([True, True, False, True]),
+        budget=jnp.array([8, 3, 5, 8], jnp.int32),
+        eos=jnp.array([-1, -1, -1, 7], jnp.int32),
+        keys=jax.vmap(jax.random.PRNGKey)(jnp.arange(b, dtype=jnp.uint32)),
+        counts=jnp.zeros((b, spec.vocab_size), jnp.int32),
+    )
+    return spec, mesh_pp, build, sample_fn, state
+
+
+def _trees_equal(a, b):
+    return all(
+        np.array_equal(np.asarray(x, np.float32), np.asarray(y, np.float32))
+        for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b)))
+
+
+def test_staged_chunk_bit_for_bit(parity_setup):
+    """staged_decode_chunk == decode_chunk on every output leaf: tokens,
+    n_valid, live/budget finish state, BOTH cache halves, lengths, the
+    sampler carry (per-row RNG chains split exactly once per token), and
+    the mixed-shape aux buffers — including a mid-chunk EOS row, a
+    budget-exhausted row, and a dead-at-entry row."""
+    from quorum_tpu.models.transformer import decode_chunk
+    from quorum_tpu.parallel.pipeline import staged_decode_chunk
+
+    spec, mesh_pp, build, sample_fn, st = parity_setup
+    p1, ck1, cv1 = build(single_device_mesh())
+    ref = jax.jit(lambda ck, cv, k, c: decode_chunk(
+        p1, spec, 4, st["token"], st["lengths"], st["live"], st["budget"],
+        st["eos"], ck, cv, sample_fn, (k, c), history=32))(
+        ck1, cv1, st["keys"], st["counts"])
+    p2, ck2, cv2 = build(mesh_pp)
+    got = jax.jit(lambda ck, cv, k, c: staged_decode_chunk(
+        p2, spec, mesh_pp, 4, st["token"], st["lengths"], st["live"],
+        st["budget"], st["eos"], ck, cv, sample_fn, (k, c), history=32))(
+        ck2, cv2, st["keys"], st["counts"])
+    assert _trees_equal(ref, got)
+
+
+def test_staged_loop_bit_for_bit(parity_setup):
+    """staged_decode_loop == decode_loop (the megachunk contract: leading
+    per-chunk axis, all-rows-finished early exit, carry passthrough)."""
+    from quorum_tpu.models.transformer import decode_loop
+    from quorum_tpu.parallel.pipeline import staged_decode_loop
+
+    spec, mesh_pp, build, sample_fn, st = parity_setup
+    p1, ck1, cv1 = build(single_device_mesh())
+    ref = jax.jit(lambda ck, cv, k, c: decode_loop(
+        p1, spec, 2, 4, st["token"], st["lengths"], st["live"],
+        st["budget"], st["eos"], ck, cv, sample_fn, (k, c), history=32))(
+        ck1, cv1, st["keys"], st["counts"])
+    p2, ck2, cv2 = build(mesh_pp)
+    got = jax.jit(lambda ck, cv, k, c: staged_decode_loop(
+        p2, spec, mesh_pp, 2, 4, st["token"], st["lengths"], st["live"],
+        st["budget"], st["eos"], ck, cv, sample_fn, (k, c), history=32))(
+        ck2, cv2, st["keys"], st["counts"])
+    assert _trees_equal(ref, got)
+
+
+# ---- fast: pp=2 engine pinned against the single-device engine -------------
+
+
+@pytest.fixture(scope="module")
+def pp_engines():
+    kw = dict(decode_chunk=4, n_slots=2, decode_pipeline=2, decode_loop=2,
+              prefill_chunk=16, seed=9500)
+    eng_1 = InferenceEngine(TINY, **kw)
+    eng_pp = InferenceEngine(TINY, make_mesh(MeshConfig(pp=2),
+                                             jax.devices()[:2]), **kw)
+    yield eng_1, eng_pp
+    eng_1.shutdown()
+    eng_pp.shutdown()
+
+
+def test_pp_engine_token_for_token(pp_engines):
+    """pp=2 serves greedy and sampled streams token-for-token identical
+    to the single-device engine, under the suite-wide transfer guard
+    (zero new blocking syncs on the token critical path)."""
+    eng_1, eng_pp = pp_engines
+    assert eng_pp.decode_pp == 2
+    assert eng_pp.transfer_guard == "disallow"  # conftest's runtime sentinel
+    for prompt, sampler, seed in [([3, 4, 5], GREEDY, 0),
+                                  ([7, 8, 9], SAMPLED, 11)]:
+        assert (_gen(eng_pp, prompt, seed=seed, sampler=sampler)
+                == _gen(eng_1, prompt, seed=seed, sampler=sampler))
+
+
+def test_pp_program_families_and_occupancy(pp_engines):
+    """Staged engines compile ONLY "pp"-tagged decode programs (their own
+    compile_budget.json families — never a cache entry shared with the
+    unstaged variants), the unstaged engine never compiles one, and the
+    per-stage occupancy gauge carries stage-labeled series."""
+    eng_1, eng_pp = pp_engines
+    _gen(eng_pp, [5, 6], seed=1)
+    fams_pp = budget.decode_families(eng_pp._decode_cache)
+    assert fams_pp and fams_pp <= {"pp_plain", "pp_loop"}, fams_pp
+    fams_1 = budget.decode_families(eng_1._decode_cache)
+    assert not any(f.startswith("pp") for f in fams_1), fams_1
+    assert all(k[0] == "pp" for k in eng_pp._decode_cache)
+    # stage-labeled occupancy series exist (values are last-writer-wins)
+    lines = obs.DECODE_STAGE_OCCUPANCY.expose()
+    assert any('stage="0"' in ln for ln in lines), lines
+    assert any('stage="1"' in ln for ln in lines), lines
+
+
+def test_pp_engine_ring_stays_full(pp_engines):
+    """Dispatch-counter acceptance: the staged engine keeps the
+    decode_pipeline=2 × decode_loop=2 ring full — dispatches overlap
+    (n_overlapped grows) and megachunks fuse (executed chunk segments
+    outnumber dispatches)."""
+    _, eng_pp = pp_engines
+    over0, chunks0, loops0 = (eng_pp.n_overlapped, eng_pp.n_decode_chunks,
+                              eng_pp.n_loop_chunks)
+    _gen(eng_pp, [3, 4, 5], seed=7, n=24)
+    _gen(eng_pp, [3, 4, 5], seed=7, n=24)  # warm programs: depth-2 ring
+    assert eng_pp.n_overlapped > over0
+    assert eng_pp.n_loop_chunks - loops0 > eng_pp.n_decode_chunks - chunks0
+
+
+# ---- fast: the synthetic HBM-budget acceptance ------------------------------
+
+
+def test_pp_serves_model_exceeding_one_group_budget():
+    """The tentpole claim, enforced synthetically: a model+cache footprint
+    BIGGER than one group's (synthetic) HBM budget serves on a pp=2 staged
+    mesh because every stage holds only its L/pp layer shard + that
+    shard's KV — max per-device bytes stays under the budget the total
+    breaks."""
+    spec = resolve_spec("llama-tiny", {"n_kv_heads": "4", "n_layers": "8"})
+    mesh_pp = make_mesh(MeshConfig(pp=2), jax.devices()[:2])
+    eng = InferenceEngine(spec, mesh_pp, decode_chunk=4, n_slots=2,
+                          prefill_chunk=16, seed=9510)
+    try:
+        arrs = jax.tree.leaves((eng.params, eng._ck, eng._cv))
+        total = sum(x.nbytes for x in arrs)
+        per_dev: dict = {}
+        for leaf in arrs:
+            for sh in leaf.addressable_shards:
+                per_dev[sh.device] = (per_dev.get(sh.device, 0)
+                                      + sh.data.nbytes)
+        assert len(per_dev) == 2
+        worst = max(per_dev.values())
+        # One group's synthetic HBM budget: big enough for any single
+        # stage, too small for the whole model — the configuration an
+        # unsharded group cannot hold but the staged engine serves.
+        group_budget = int(total * 0.75)
+        assert total > group_budget, (total, group_budget)
+        assert worst <= group_budget, (worst, group_budget, total)
+        out = _gen(eng, [3, 4, 5], seed=2, n=8)
+        assert len(out) == 8
+    finally:
+        eng.shutdown()
+
+
+# ---- slow: constrained decoding through the staged grammar path ------------
+
+
+@pytest.mark.slow
+def test_pp_constrained_pin():
+    """response_format JSON mode on a pp=2 staged engine equals the
+    single-device engine byte for byte — the grammar mask and DFA advance
+    ride the LAST stage's sampler inside the staged tick scan (the
+    pp_loop_dfa/pp_dfa program families)."""
+    import asyncio
+
+    from quorum_tpu.backends.tpu_backend import TpuBackend
+    from quorum_tpu.config import BackendSpec
+
+    def build(url):
+        return TpuBackend.from_spec(BackendSpec(name="t", url=url,
+                                                model="m"))
+
+    opts = ("n_kv_heads=4&seed=9530&decode_pipeline=2&decode_loop=2"
+            "&prefill_chunk=16&decode_chunk=4&slots=2")
+    b_pp = build(f"tpu://llama-tiny?{opts}&pp=2")
+    b_1 = build(f"tpu://llama-tiny?{opts}")
+    body = {"model": "m", "max_tokens": 24, "temperature": 0.0, "seed": 3,
+            "messages": [{"role": "user", "content": "json please"}],
+            "response_format": {"type": "json_object"}}
+
+    async def run_one(b):
+        res = await b.complete(dict(body), {}, timeout=300)
+        return res.body["choices"][0]["message"]["content"]
+
+    assert asyncio.run(run_one(b_pp)) == asyncio.run(run_one(b_1))
+    assert b_pp.engine.n_constrained >= 1
+    fams = budget.decode_families(b_pp.engine._decode_cache)
+    assert any("dfa" in f and f.startswith("pp") for f in fams), fams
+
+
+# ---- slow: disagg + staged decode group ------------------------------------
+
+
+@pytest.mark.slow
+def test_disagg_pp_staged_decode_group_pin():
+    """disagg=1+2&pp=2: the chunk-granular handoff feeds stage 0 of a
+    pipeline-staged decode group (resharding to the stage-sharded cache
+    on the fly) and the stream equals the single-device engine's token
+    for token."""
+    kw = dict(decode_chunk=4, n_slots=2, decode_pipeline=2, decode_loop=2,
+              prefill_chunk=16, seed=9520)
+    pm, dm = disagg_meshes(1, 2, pp=2)
+    eng_1 = InferenceEngine(TINY, **kw)
+    eng_dp = InferenceEngine(TINY, dm, prefill_mesh=pm, **kw)
+    try:
+        long_p = [(3 + 5 * i) % 500 for i in range(40)]
+        for prompt, sampler, seed in [([3, 4, 5], GREEDY, 0),
+                                      ([7, 8, 9], SAMPLED, 11),
+                                      (long_p, SAMPLED, 3)]:
+            assert (_gen(eng_dp, prompt, seed=seed, sampler=sampler)
+                    == _gen(eng_1, prompt, seed=seed, sampler=sampler))
+        assert eng_dp.n_kv_handoffs > 0
+        assert eng_dp.decode_pp == 2
+        fams = budget.decode_families(eng_dp._decode_cache)
+        assert fams and fams <= {"pp_plain", "pp_loop"}, fams
+    finally:
+        eng_1.shutdown()
+        eng_dp.shutdown()

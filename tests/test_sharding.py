@@ -35,12 +35,15 @@ def test_param_partition_specs_follow_megatron_rules():
     params = init_params(spec, seed=0)
     specs = param_partition_specs(params)
     blocks = specs["blocks"]
-    assert blocks["wq"] == P(None, None, "tp")     # project-in: shard output
-    assert blocks["wo"] == P(None, "tp", None)     # project-out: shard input
-    assert blocks["router"] == P(None, None, "tp")  # router over experts axis
-    assert blocks["moe_w_up"] == P(None, "tp", None, None)  # experts over tp (EP)
+    # The leading scanned-layer dim stage-shards over pp (a no-op placement
+    # on every mesh whose pp axis is 1; the pipeline-staged decode group's
+    # stages each hold L/pp layers — docs/scaling.md).
+    assert blocks["wq"] == P("pp", None, "tp")     # project-in: shard output
+    assert blocks["wo"] == P("pp", "tp", None)     # project-out: shard input
+    assert blocks["router"] == P("pp", None, "tp")  # router over experts axis
+    assert blocks["moe_w_up"] == P("pp", "tp", None, None)  # experts over tp (EP)
     assert specs["tok_emb"] == P("tp", None)       # vocab-sharded embedding
-    assert blocks["attn_norm_w"] == P(None, None)  # norms replicated
+    assert blocks["attn_norm_w"] == P("pp", None)  # norms replicated within a stage
 
 
 def _run(spec, params, mesh=None):
