@@ -95,7 +95,6 @@ The reference has no analog — its "backends" are HTTP calls
 from __future__ import annotations
 
 import contextlib
-import itertools
 import logging
 import os
 import queue
@@ -310,13 +309,6 @@ def _stacked_rows_call(mem: int, n_s: int, fn, params, ck, cv, *rows):
     return logits.reshape((mem * n_s,) + logits.shape[2:]), ck, cv
 
 
-# Monotonic fallback-rid source for engine-direct submissions (next() on a
-# count is atomic under the GIL). NOT id(req): CPython freelists reuse
-# addresses aggressively, and an aliased rid would conflate two unrelated
-# requests' flight-recorder timelines.
-_REQ_SEQ = itertools.count(1)
-
-
 def prefill_bucket(n: int, max_seq: int) -> int:
     """Smallest power-of-two ≥ n, clamped to [MIN_BUCKET, max_seq]."""
     b = MIN_BUCKET
@@ -406,13 +398,22 @@ class _Request:
         # happens inside a traced request context) rides along so the
         # scheduler thread can append queue-wait/prefill/decode spans to it.
         self.trace = obs.current_trace()
-        # Flight-recorder correlation id: the traced request's
-        # X-Request-Id, else a process-unique synthetic one — one id
+        # Flight-recorder correlation id: the traced request's W3C
+        # trace-id (the fleet plane's cross-tier key — router events,
+        # server spans, and these engine events all join on it), falling
+        # back to the request id for traces without one, and for
+        # engine-direct submissions a self-minted trace-id — one id
         # follows the request across the prefill and decode loops, which
         # is what makes the dual-loop (disagg) and staged-injection
         # (zero_drain) timelines correlatable.
-        self.rid = (self.trace.request_id if self.trace is not None
-                    else f"q{next(_REQ_SEQ)}")
+        if self.trace is not None:
+            self.rid = (getattr(self.trace, "trace_id", "")
+                        or self.trace.request_id)
+        else:
+            from quorum_tpu.telemetry import tracecontext
+
+            self.rid = tracecontext.new_trace_id()
+            obs.TRACE_PROPAGATED.inc(source="engine")
         self.t_submit = time.perf_counter()
         self.tspans: dict = {}  # span kind -> (last span, turn count)
         # Prompt-lookup drafting state: the running token history and an

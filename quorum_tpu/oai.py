@@ -270,6 +270,18 @@ def validate_request_body(body: dict[str, Any]) -> str | None:
             return f"Invalid value for 'timeout': {t!r} (must be > 0)"
     if "messages" in body and not isinstance(body["messages"], list):
         return "Invalid value for 'messages': must be an array"
+    # Cross-tier trace propagation (docs/observability.md "Fleet plane"):
+    # clients that cannot set headers may carry the W3C traceparent as a
+    # body knob. Consumed by the server (never forwarded); a malformed
+    # value is a 400, not a silently re-minted trace-id.
+    tp = body.get("traceparent")
+    if tp is not None:
+        from quorum_tpu.telemetry import tracecontext
+
+        if not isinstance(tp, str) or \
+                tracecontext.parse_traceparent(tp) is None:
+            return (f"Invalid value for 'traceparent': {tp!r} (W3C "
+                    "trace-context: 00-<32 hex>-<16 hex>-<2 hex flags>)")
     return None
 
 

@@ -335,6 +335,34 @@ ROUTER_MIGRATED_CHAINS = METRICS.counter(
     "quorum_tpu_router_migrated_chains_total",
     "Prefix chunk chains moved between replicas by rotation migration.")
 
+# Fleet observability plane (ISSUE 16, docs/observability.md "Fleet
+# plane"): cross-tier trace propagation, per-replica telemetry absorption,
+# and burn-aware placement. Registered process-wide like the other router
+# families — a serving replica reads them at zero.
+ROUTER_REPLICA_BURN = METRICS.gauge(
+    "quorum_tpu_router_replica_burn",
+    "Last absorbed SLO burn rate per replica and class (the router's "
+    "/ready poller pulls each replica's GET /debug/telemetry; stale "
+    "telemetry keeps the last reading but stops driving demotion).")
+ROUTER_BURN_DEMOTIONS = METRICS.counter(
+    "quorum_tpu_router_burn_demotions_total",
+    "Placements in which a replica was demoted to the candidate tail "
+    "because its interactive-class burn rate exceeded the router's "
+    "threshold (per-request reorder like bounded-load spill — membership "
+    "untouched, fail-open when telemetry is stale).")
+TELEMETRY_POLL_SECONDS = METRICS.histogram(
+    "quorum_tpu_telemetry_poll_seconds",
+    "One replica telemetry pull (GET /debug/telemetry inside the router's "
+    "/ready poll sweep), request to parsed snapshot.",
+    buckets=(0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+             0.25, 0.5, 1.0, 2.5, 5.0))
+TRACE_PROPAGATED = METRICS.counter(
+    "quorum_tpu_trace_propagated_total",
+    "Requests stamped with a W3C trace-id, by source: client = an "
+    "incoming traceparent was honored, router/server = this tier minted "
+    "one (none arrived), engine = an engine-direct submission self-minted "
+    "its flight-recorder correlation id.")
+
 # Engine flight recorder + per-family device-time attribution + SLO
 # accounting (quorum_tpu/telemetry/, docs/observability.md — ISSUE 12).
 # Decode-ring dispatches attribute dispatch→ready time (issue stamp to the
@@ -435,8 +463,16 @@ class RequestTrace:
     (context manager), ``phases`` (name → accumulated seconds), ``total``
     and ``log()`` keep the round-1 API."""
 
-    def __init__(self, request_id: str, mode: str = ""):
+    def __init__(self, request_id: str, mode: str = "",
+                 trace_id: str = "", span_id: str = ""):
         self.request_id = request_id
+        # W3C trace-context identity (telemetry/tracecontext.py): the
+        # 32-hex trace-id names this request across router, replica, and
+        # engine tiers (the flight-recorder rid), the 16-hex span-id names
+        # THIS server hop. Empty on untraced callers (engine-direct tests,
+        # non-chat endpoints) — the engine then self-mints.
+        self.trace_id = trace_id
+        self.span_id = span_id
         self._t0 = time.perf_counter()
         self.started_at = time.time()
         self._lock = threading.Lock()
@@ -577,6 +613,9 @@ class RequestTrace:
                 "spans": [s.to_dict() for s in spans],
                 "dropped_spans": self.dropped_spans,
             }
+            if self.trace_id:
+                out["trace_id"] = self.trace_id
+                out["span_id"] = self.span_id
             if self.meta:
                 out["meta"] = dict(self.meta)
         return out
@@ -599,6 +638,7 @@ class RequestTrace:
                 "tokens": self.n_tokens,
                 "sse_flushes": self.n_flushes,
                 "dropped_spans": self.dropped_spans,
+                **({"trace_id": self.trace_id} if self.trace_id else {}),
                 **({"meta": dict(self.meta)} if self.meta else {}),
             }
 

@@ -30,7 +30,6 @@ import asyncio
 import logging
 import random
 import time
-import uuid
 from dataclasses import dataclass, field
 from typing import Any, AsyncIterator
 
@@ -44,6 +43,7 @@ from quorum_tpu.observability import (
     ROUTER_AFFINITY_MISSES,
     ROUTER_FAILOVERS,
     ROUTER_REQUESTS,
+    TRACE_PROPAGATED,
 )
 from quorum_tpu.router import affinity
 from quorum_tpu.router.replica import Replica, ReplicaSet
@@ -54,13 +54,15 @@ from quorum_tpu.server.asgi import (
     Response,
     StreamingResponse,
 )
-from quorum_tpu.telemetry.recorder import RECORDER
+from quorum_tpu.telemetry import tracecontext
+from quorum_tpu.telemetry.recorder import RECORDER, merged_trace_events
 
 logger = logging.getLogger(__name__)
 
 # Response headers recomputed by this hop, never relayed from upstream.
 _PASSTHROUGH_SKIP = {"content-length", "content-type", "transfer-encoding",
-                     "content-encoding", "connection"}
+                     "content-encoding", "connection",
+                     "x-request-id", "traceparent"}
 
 
 class _StreamGuard:
@@ -112,6 +114,13 @@ class RouterConfig:
     breaker_threshold: int = 3
     breaker_window: float = 30.0
     breaker_cooldown: float = 5.0
+    # Burn-aware placement (docs/observability.md "Fleet plane"): demote
+    # a replica whose ``burn_class`` SLO burn rate (from its last
+    # /debug/telemetry snapshot, absorbed by the /ready poller) exceeds
+    # the threshold. <= 0 disables; stale telemetry always fails open.
+    burn_threshold: float = 0.5
+    burn_class: str = "interactive"
+    telemetry_max_age: float = 10.0
 
     def __post_init__(self) -> None:
         if self.policy not in ("affinity", "random"):
@@ -137,7 +146,8 @@ class RouterConfig:
             "policy", "affinity_chunk", "retries", "timeout",
             "ready_interval", "migrate_on_rotation", "vnodes",
             "load_factor", "breaker_threshold", "breaker_window",
-            "breaker_cooldown") if k in raw}
+            "breaker_cooldown", "burn_threshold", "burn_class",
+            "telemetry_max_age") if k in raw}
         return cls(replicas=replicas, **kwargs)
 
 
@@ -160,6 +170,9 @@ def build_replica_set(cfg: RouterConfig,
         affinity_chunk=cfg.affinity_chunk,
         ready_interval=cfg.ready_interval,
         migrate_on_rotation=cfg.migrate_on_rotation,
+        burn_threshold=cfg.burn_threshold,
+        burn_class=cfg.burn_class,
+        telemetry_max_age=cfg.telemetry_max_age,
         control_client=control_client)
 
 
@@ -186,6 +199,11 @@ def create_router_app(cfg: RouterConfig,
                 if k.lower() != "host"}
 
     def _shed_response() -> JSONResponse:
+        # The whole fleet refused a request — exactly the moment an
+        # operator wants the router's event ring on disk. dump() is
+        # rate-limited per reason, so a shed storm costs one artifact
+        # per QUORUM_TPU_FLIGHT_DUMP_INTERVAL, not one per request.
+        RECORDER.dump("router-all-dead")
         retry = max([r.breaker.retry_after()
                      for r in mgr.replicas.values()] or [1.0])
         return JSONResponse(
@@ -213,7 +231,6 @@ def create_router_app(cfg: RouterConfig,
     @app.route("POST", "/chat/completions", "/v1/chat/completions")
     async def chat_completions(request: Request) -> Response:
         await mgr.ensure_poller()
-        rid = f"req-{uuid.uuid4().hex[:16]}"
         try:
             body = await request.json()
             if not isinstance(body, dict):
@@ -223,6 +240,25 @@ def create_router_app(cfg: RouterConfig,
                 {"error": {"message": f"Invalid JSON body: {e}",
                            "type": "invalid_request_error"}},
                 status_code=400)
+        # Cross-tier trace identity (docs/observability.md "Fleet
+        # plane"): honor the caller's W3C traceparent (header, or the
+        # body knob for header-less clients), else mint. The trace-id IS
+        # the router's request id — one string joins the router's route
+        # events, every replica attempt, and the engines' dispatch/reap
+        # timeline, surviving failover (same trace-id, fresh span-id per
+        # hop).
+        parsed = tracecontext.parse_traceparent(
+            request.headers.get("traceparent"))
+        if parsed is None:
+            parsed = tracecontext.parse_traceparent(
+                body.get("traceparent"))
+        if parsed is not None:
+            trace_id = parsed[0]
+            TRACE_PROPAGATED.inc(source="client")
+        else:
+            trace_id = tracecontext.new_trace_id()
+            TRACE_PROPAGATED.inc(source="router")
+        rid = trace_id
         headers = _forward_headers(request)
         is_streaming = bool(body.get("stream", False))
         # The timeout knob is READ, not consumed — the replica's server
@@ -239,6 +275,7 @@ def create_router_app(cfg: RouterConfig,
 
         last_err: BackendError | None = None
         last_result = None
+        attempt = 0
         for name in candidates:
             r = mgr.replicas[name]
             if not r.breaker.allow():
@@ -246,6 +283,13 @@ def create_router_app(cfg: RouterConfig,
             remaining = deadline - time.monotonic()
             if remaining <= 0:
                 break
+            # One hop span per replica attempt: same trace-id all the
+            # way down, a fresh span-id on the wire each try — the
+            # replica's events nest under the attempt that reached it,
+            # and a failed-over request stays ONE trace.
+            attempt += 1
+            span_id, traceparent = tracecontext.child_traceparent(trace_id)
+            headers["traceparent"] = traceparent
             r.inflight += 1
             r.requests += 1
             decremented = [False]
@@ -269,12 +313,16 @@ def create_router_app(cfg: RouterConfig,
                     _score_affinity(primary, name)
                     RECORDER.record("router-route", rid=rid, loop="router",
                                     replica=name, stream=True,
-                                    affinity=bool(primary == name))
+                                    affinity=bool(primary == name),
+                                    span=span_id,
+                                    **({"failover": 1} if attempt > 1
+                                       else {}))
                     resp = StreamingResponse(_StreamGuard(
                         _passthrough(r, rid, first, stream), dec))
                     guard_owns = True
                     resp.headers["X-Routed-To"] = name
                     resp.headers["X-Request-Id"] = rid
+                    resp.headers["traceparent"] = traceparent
                     return resp
                 result = await r.backend.complete(body, headers, remaining)
                 if result.status_code >= 500:
@@ -285,7 +333,8 @@ def create_router_app(cfg: RouterConfig,
                     ROUTER_REQUESTS.inc(replica=name, outcome="failover")
                     RECORDER.record("router-failover", rid=rid,
                                     loop="router", replica=name,
-                                    status=result.status_code)
+                                    status=result.status_code,
+                                    span=span_id)
                     last_result = result
                     continue
                 r.breaker.record_success()
@@ -293,12 +342,16 @@ def create_router_app(cfg: RouterConfig,
                 _score_affinity(primary, name)
                 RECORDER.record("router-route", rid=rid, loop="router",
                                 replica=name, stream=False,
-                                affinity=bool(primary == name))
+                                affinity=bool(primary == name),
+                                span=span_id,
+                                **({"failover": 1} if attempt > 1
+                                   else {}))
                 resp_headers = {
                     k: v for k, v in result.headers.items()
                     if k.lower() not in _PASSTHROUGH_SKIP}
                 resp_headers["X-Routed-To"] = name
                 resp_headers["X-Request-Id"] = rid
+                resp_headers["traceparent"] = traceparent
                 return JSONResponse(result.body,
                                     status_code=result.status_code,
                                     headers=resp_headers)
@@ -310,13 +363,15 @@ def create_router_app(cfg: RouterConfig,
                     ROUTER_REQUESTS.inc(replica=name, outcome="ok")
                     resp_headers = dict(e.headers)
                     resp_headers["X-Routed-To"] = name
+                    resp_headers["traceparent"] = traceparent
                     return JSONResponse(e.body, status_code=e.status_code,
                                         headers=resp_headers)
                 r.breaker.record_failure()
                 ROUTER_FAILOVERS.inc(replica=name)
                 ROUTER_REQUESTS.inc(replica=name, outcome="failover")
                 RECORDER.record("router-failover", rid=rid, loop="router",
-                                replica=name, status=e.status_code)
+                                replica=name, status=e.status_code,
+                                span=span_id)
                 last_err = e
                 continue
             finally:
@@ -426,8 +481,91 @@ def create_router_app(cfg: RouterConfig,
             "affinity_chunk": cfg.affinity_chunk,
             "in_ring": sorted(mgr.ring.members),
             "migrations": mgr.n_migrations,
+            "burn_threshold": mgr.burn_threshold,
+            "burn_class": mgr.burn_class,
+            "burn_demoted": sorted(mgr.burn_demoted()),
+            "burn_demotions": mgr.n_burn_demotions,
             "replicas": [r.state() | {"in_ring": r.name in mgr.ring}
                          for r in mgr.replicas.values()],
+            "telemetry": mgr.telemetry.snapshot(),
+        })
+
+    @app.route("GET", "/debug/router/timeline",
+               "/v1/debug/router/timeline")
+    async def router_timeline(request: Request) -> Response:
+        """The router's OWN flight recorder: route/failover/stream-broken
+        events, ring rotations, migrations — every event carrying the
+        request's cross-tier trace-id as ``rid``. Same contract as a
+        replica's /debug/engine/timeline: default JSON, ``?format=
+        perfetto`` for Chrome trace-event output; also auto-dumped (rate-
+        limited) whenever the router sheds with every replica dead."""
+        fmt = request.query_params.get("format", "json")
+        if fmt in ("perfetto", "trace", "chrome"):
+            return JSONResponse({"displayTimeUnit": "ms",
+                                 "traceEvents": RECORDER.to_trace_events()})
+        if fmt != "json":
+            return JSONResponse(
+                {"error": {"message": f"unknown format {fmt!r} "
+                           "(json or perfetto)",
+                           "type": "invalid_request_error"}},
+                status_code=400)
+        return JSONResponse({
+            "clock": "perf_counter",
+            "capacity": RECORDER.capacity,
+            "recorded_total": RECORDER.total(),
+            "events": RECORDER.snapshot(),
+        })
+
+    @app.route("GET", "/debug/fleet/timeline",
+               "/v1/debug/fleet/timeline")
+    async def fleet_timeline(request: Request) -> Response:
+        """One timeline for the whole fleet: the router's recorder plus
+        every reachable replica's /debug/engine/timeline, each replica's
+        monotonic stamps shifted onto the router's clock by the offset
+        estimated from its telemetry polls (midpoint method — good to
+        half an RTT). Events join across tiers on the trace-id ``rid``:
+        follow one id from the router's route event through the serving
+        replica's dispatch/reap spans. ``?format=perfetto`` renders one
+        Perfetto process per tier member; default JSON returns the
+        merged, time-sorted event list with per-event ``process``."""
+        await mgr.ensure_poller()
+        rows = await mgr.fetch_timelines()
+        fmt = request.query_params.get("format", "json")
+        if fmt in ("perfetto", "trace", "chrome"):
+            groups = [("router", RECORDER.snapshot(), 0.0)]
+            groups += [(row["name"], row["events"], row["offset"] or 0.0)
+                       for row in rows]
+            return JSONResponse({"displayTimeUnit": "ms",
+                                 "traceEvents": merged_trace_events(groups)})
+        if fmt != "json":
+            return JSONResponse(
+                {"error": {"message": f"unknown format {fmt!r} "
+                           "(json or perfetto)",
+                           "type": "invalid_request_error"}},
+                status_code=400)
+        merged: list[dict] = []
+        for ev in RECORDER.snapshot():
+            merged.append({**ev, "process": "router"})
+        for row in rows:
+            offset = row["offset"] or 0.0
+            for ev in row["events"]:
+                if not isinstance(ev, dict):
+                    continue
+                shifted = dict(ev)
+                for key in ("t", "t_issue", "t_ready"):
+                    if isinstance(shifted.get(key), (int, float)):
+                        shifted[key] = round(shifted[key] + offset, 6)
+                shifted["process"] = row["name"]
+                merged.append(shifted)
+        merged.sort(key=lambda e: e.get("t", 0.0))
+        return JSONResponse({
+            "clock": "router perf_counter",
+            "replicas": [{"name": row["name"],
+                          "offset": row["offset"],
+                          "clock_aligned": row["clock_aligned"],
+                          "events": len(row["events"])}
+                         for row in rows],
+            "events": merged,
         })
 
     @app.route("POST", "/router/migrate", "/v1/router/migrate")

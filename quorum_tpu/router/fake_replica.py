@@ -18,6 +18,17 @@ replica-kill drill (a killable process with slow streams), and
   POST /admin/shed      /ready answers 503 from now on (rotation trigger)
   POST /admin/recover   /ready answers 200 again
 
+Fleet-plane surfaces (docs/observability.md) are scripted too: each state
+owns a PRIVATE :class:`~quorum_tpu.telemetry.recorder.FlightRecorder`
+(never the process singleton — in-process multi-replica tests would
+otherwise pool every replica's events in one ring), requests honor/echo
+W3C ``traceparent`` and record dispatch/reap events under the trace-id,
+``GET /debug/engine/timeline`` and ``GET /debug/telemetry`` serve the
+real endpoints' shapes, ``POST /admin/burn?class=&rate=`` scripts an SLO
+burn rate (burn-aware routing drills), and ``--clock-skew`` shifts the
+replica's reported monotonic clock AND its event stamps — so the
+router's clock-offset estimation has real skew to cancel.
+
 Boot prints ``PORT=<bound port>`` to stdout (``--port 0`` → ephemeral) so a
 spawning parent can address it.
 """
@@ -27,6 +38,7 @@ from __future__ import annotations
 import argparse
 import asyncio
 import hashlib
+import time
 from typing import Any, AsyncIterator
 
 import numpy as np
@@ -42,6 +54,8 @@ from quorum_tpu.server.asgi import (
     Response,
     StreamingResponse,
 )
+from quorum_tpu.telemetry import tracecontext
+from quorum_tpu.telemetry.recorder import FlightRecorder
 
 DEFAULT_CHUNK_TOKENS = 16
 DEFAULT_TOKENS = 8
@@ -61,17 +75,33 @@ class FakeReplicaState:
 
     def __init__(self, name: str, chunk_tokens: int = DEFAULT_CHUNK_TOKENS,
                  max_tokens: int = DEFAULT_TOKENS,
-                 chunk_delay: float = 0.0):
+                 chunk_delay: float = 0.0,
+                 clock_skew: float = 0.0):
         self.name = name
         self.chunk_tokens = int(chunk_tokens)
         self.max_tokens = int(max_tokens)
         self.chunk_delay = float(chunk_delay)
+        # Simulated monotonic-clock skew vs the host: added to the clock
+        # /debug/telemetry reports AND to every recorder stamp, so the
+        # router's offset estimate has something real to cancel (two
+        # in-process fakes with different skews exercise the alignment).
+        self.clock_skew = float(clock_skew)
         self.tokenizer = ByteTokenizer(259)
         self.store = PrefixStore(self.chunk_tokens, 1 << 24)
+        # Private ring — NEVER the process singleton: in-process
+        # multi-replica tests would pool every fake's events otherwise.
+        self.recorder = FlightRecorder(capacity=1024, enabled=True)
         self.shedding = False
+        # Scripted per-class SLO burn rates (POST /admin/burn) — what
+        # /debug/telemetry exports, what burn-aware routing drills on.
+        self.burn: dict[str, float] = {}
         self.requests = 0
         self.prefix_hits = 0
         self.tokens_restored = 0
+
+    def clock(self) -> float:
+        """This replica's (possibly skewed) monotonic clock."""
+        return time.perf_counter() + self.clock_skew
 
     def _dummy_payloads(self, n_chunks: int) -> list[list[np.ndarray]]:
         return [[np.zeros((1, 1, self.chunk_tokens), dtype=np.uint8)]
@@ -115,6 +145,18 @@ def create_fake_replica_app(state: FakeReplicaState) -> App:
                 {"error": {"message": "shedding (admin)",
                            "type": "overloaded_error"}},
                 status_code=503, headers={"Retry-After": "1"})
+        # Cross-tier trace identity, scripted like the real server:
+        # honor the router's traceparent (header first, body knob
+        # second), mint when absent, echo on the response, and stamp
+        # every recorder event with the trace-id — the fleet-timeline
+        # merge joins on it.
+        parsed = tracecontext.parse_traceparent(
+            request.headers.get("traceparent"))
+        if parsed is None:
+            parsed = tracecontext.parse_traceparent(
+                body.get("traceparent"))
+        trace_id = parsed[0] if parsed else tracecontext.new_trace_id()
+        span_id, traceparent = tracecontext.child_traceparent(trace_id)
         messages = body.get("messages") or []
         prompt = state.tokenizer.render_chat(
             [m for m in messages if isinstance(m, dict)])
@@ -123,9 +165,21 @@ def create_fake_replica_app(state: FakeReplicaState) -> App:
         completion = "".join(words)
         matched = state.observe(prompt, completion)
         model = body.get("model") or "fake"
+        t_issue = state.clock()
+        state.recorder.record("dispatch", rid=trace_id, engine=state.name,
+                              loop="decode", t=t_issue, family="fake",
+                              span=span_id)
         if body.get("stream"):
-            return StreamingResponse(
-                _stream(model, words, matched))
+            resp = StreamingResponse(
+                _stream(model, words, matched, trace_id, t_issue))
+            resp.headers["X-Fake-Replica"] = state.name
+            resp.headers["traceparent"] = traceparent
+            return resp
+        t_ready = state.clock()
+        state.recorder.record("reap", rid=trace_id, engine=state.name,
+                              loop="decode", t=t_ready, t_issue=t_issue,
+                              t_ready=t_ready, family="fake", depth=0,
+                              tokens=len(words))
         resp = oai.completion(
             content=completion, model=model,
             usage={"prompt_tokens": len(prompt),
@@ -134,21 +188,36 @@ def create_fake_replica_app(state: FakeReplicaState) -> App:
         resp["backend"] = state.name
         return JSONResponse(resp, headers={
             "X-Fake-Replica": state.name,
-            "X-Prefix-Matched": str(matched)})
+            "X-Prefix-Matched": str(matched),
+            "traceparent": traceparent})
 
-    async def _stream(model: str, words: list[str],
-                      matched: int) -> AsyncIterator[bytes]:
+    async def _stream(model: str, words: list[str], matched: int,
+                      trace_id: str, t_issue: float,
+                      ) -> AsyncIterator[bytes]:
         cid = f"chatcmpl-{state.name}"
         yield sse.encode_event(
             oai.chunk(id=cid, model=model, delta={"role": "assistant"}))
-        for w in words:
-            if state.chunk_delay:
-                await asyncio.sleep(state.chunk_delay)
+        sent = 0
+        try:
+            for w in words:
+                if state.chunk_delay:
+                    await asyncio.sleep(state.chunk_delay)
+                yield sse.encode_event(
+                    oai.chunk(id=cid, model=model, delta={"content": w}))
+                sent += 1
             yield sse.encode_event(
-                oai.chunk(id=cid, model=model, delta={"content": w}))
-        yield sse.encode_event(
-            oai.chunk(id=cid, model=model, delta={}, finish_reason="stop"))
-        yield sse.encode_done()
+                oai.chunk(id=cid, model=model, delta={},
+                          finish_reason="stop"))
+            yield sse.encode_done()
+        finally:
+            # Reap lands however the stream ends — a killed/broken
+            # stream still leaves its span in the ring (the chaos drill
+            # asserts the failed-over trace-id appears on the survivor).
+            t_ready = state.clock()
+            state.recorder.record(
+                "reap", rid=trace_id, engine=state.name, loop="decode",
+                t=t_ready, t_issue=t_issue, t_ready=t_ready,
+                family="fake", depth=0, tokens=sent)
 
     @app.route("GET", "/health", "/v1/health")
     async def health(request: Request) -> Response:
@@ -171,6 +240,67 @@ def create_fake_replica_app(state: FakeReplicaState) -> App:
     async def recover(request: Request) -> Response:
         state.shedding = False
         return JSONResponse({"shedding": False})
+
+    @app.route("POST", "/admin/burn", "/v1/admin/burn")
+    async def admin_burn(request: Request) -> Response:
+        """Script an SLO burn rate: ``?class=interactive&rate=0.9`` makes
+        /debug/telemetry report it until overwritten (rate <= 0 clears) —
+        the burn-aware-routing drill's lever."""
+        cls = request.query_params.get("class", "interactive")
+        raw = request.query_params.get("rate", "")
+        try:
+            rate = float(raw)
+        except ValueError:
+            return JSONResponse(
+                {"error": {"message": f"'rate' must be a number, got "
+                           f"{raw!r}", "type": "invalid_request_error"}},
+                status_code=400)
+        if rate <= 0:
+            state.burn.pop(cls, None)
+        else:
+            state.burn[cls] = rate
+        return JSONResponse({"burn": dict(state.burn)})
+
+    @app.route("GET", "/debug/telemetry", "/v1/debug/telemetry")
+    async def telemetry(request: Request) -> Response:
+        """The real server's /debug/telemetry shape, with scripted burn
+        and (optionally) a skewed clock sample."""
+        return JSONResponse({
+            "clock": state.clock(),
+            "time": time.time(),
+            "status": "degraded" if state.shedding else "healthy",
+            "slo": {cls: {"burn_rate": rate, "stages": {}}
+                    for cls, rate in state.burn.items()},
+            "queue_depth": 0,
+            "breaker": {state.name: "closed"},
+            "latency": {},
+            "prefix_store_bytes": state.store.bytes_held,
+        })
+
+    @app.route("GET", "/debug/engine/timeline",
+               "/v1/debug/engine/timeline")
+    async def timeline(request: Request) -> Response:
+        """The private recorder, in the real endpoint's JSON/perfetto
+        forms — what the router's /debug/fleet/timeline fetches."""
+        fmt = request.query_params.get("format", "json")
+        if fmt in ("perfetto", "trace", "chrome"):
+            return JSONResponse(
+                {"displayTimeUnit": "ms",
+                 "traceEvents": state.recorder.to_trace_events()})
+        if fmt != "json":
+            return JSONResponse(
+                {"error": {"message": f"unknown format {fmt!r} "
+                           "(json or perfetto)",
+                           "type": "invalid_request_error"}},
+                status_code=400)
+        return JSONResponse({
+            "clock": "perf_counter",
+            "capacity": state.recorder.capacity,
+            "recorded_total": state.recorder.total(),
+            "events": state.recorder.snapshot(),
+            "device_time": {},
+            "slo": {},
+        })
 
     @app.route("GET", "/metrics", "/v1/metrics")
     async def metrics(request: Request) -> Response:
@@ -233,7 +363,8 @@ async def _serve(args) -> None:
 
     state = FakeReplicaState(
         args.name, chunk_tokens=args.chunk_tokens,
-        max_tokens=args.tokens, chunk_delay=args.chunk_delay)
+        max_tokens=args.tokens, chunk_delay=args.chunk_delay,
+        clock_skew=args.clock_skew)
     app = create_fake_replica_app(state)
     server = await start_server(app, args.host, args.port)
     port = server.sockets[0].getsockname()[1]
@@ -252,6 +383,9 @@ def main() -> None:
     parser.add_argument("--chunk-tokens", type=int,
                         default=DEFAULT_CHUNK_TOKENS)
     parser.add_argument("--chunk-delay", type=float, default=0.0)
+    parser.add_argument("--clock-skew", type=float, default=0.0,
+                        help="simulated monotonic-clock skew (seconds) on "
+                             "telemetry + recorder stamps")
     args = parser.parse_args()
     try:
         asyncio.run(_serve(args))

@@ -33,11 +33,15 @@ from quorum_tpu.backends.http_backend import HttpBackend
 from quorum_tpu.breaker import Breaker
 from quorum_tpu.cache import prefix_wire
 from quorum_tpu.observability import (
+    ROUTER_BURN_DEMOTIONS,
     ROUTER_MIGRATED_BYTES,
     ROUTER_MIGRATED_CHAINS,
+    ROUTER_REPLICA_BURN,
+    TELEMETRY_POLL_SECONDS,
 )
 from quorum_tpu.router import affinity
 from quorum_tpu.router.ring import BoundedLoadRing
+from quorum_tpu.router.telemetry_view import TelemetryView
 from quorum_tpu.telemetry.recorder import RECORDER
 
 logger = logging.getLogger(__name__)
@@ -45,6 +49,7 @@ logger = logging.getLogger(__name__)
 # Control-plane timeouts (data-plane calls carry the request's own budget).
 READY_TIMEOUT_S = 3.0
 MIGRATE_TIMEOUT_S = 30.0
+TIMELINE_TIMEOUT_S = 10.0  # recorder snapshots can be ~1 MB of JSON
 
 
 class Replica:
@@ -83,6 +88,9 @@ class ReplicaSet:
                  affinity_chunk: int = affinity.DEFAULT_AFFINITY_CHUNK,
                  ready_interval: float = 2.0,
                  migrate_on_rotation: bool = True,
+                 burn_threshold: float = 0.5,
+                 burn_class: str = "interactive",
+                 telemetry_max_age: float = 10.0,
                  control_client: httpx.AsyncClient | None = None):
         if not replicas:
             raise ValueError("router needs at least one replica")
@@ -96,6 +104,15 @@ class ReplicaSet:
         self.affinity_chunk = int(affinity_chunk)
         self.ready_interval = float(ready_interval)
         self.migrate_on_rotation = bool(migrate_on_rotation)
+        # Burn-aware placement (docs/observability.md "Fleet plane"): a
+        # replica whose ``burn_class`` SLO burn rate exceeds
+        # ``burn_threshold`` (fraction of scored objectives breached over
+        # the replica's sliding window) is demoted per placement;
+        # ``burn_threshold <= 0`` disables the behavior entirely.
+        self.burn_threshold = float(burn_threshold)
+        self.burn_class = str(burn_class)
+        self.telemetry = TelemetryView(max_age_s=telemetry_max_age)
+        self.n_burn_demotions = 0
         self._control = control_client or httpx.AsyncClient()
         self._poll_task: asyncio.Task | None = None
         self._transition_lock = asyncio.Lock()
@@ -106,13 +123,38 @@ class ReplicaSet:
     def loads(self) -> dict[str, int]:
         return {name: r.inflight for name, r in self.replicas.items()}
 
+    def burn_demoted(self) -> set[str]:
+        """Ring members whose ``burn_class`` burn rate, per the LAST
+        absorbed telemetry, exceeds the threshold. Fail-open: absent or
+        stale telemetry (``None`` burn) never demotes — a replica that
+        stops exporting telemetry keeps plain bounded-load routing, it
+        does not lose placements to an observability outage."""
+        if self.burn_threshold <= 0:
+            return set()
+        demoted: set[str] = set()
+        for name in self.ring.members:
+            rate = self.telemetry.burn_rate(name, self.burn_class)
+            if rate is not None and rate > self.burn_threshold:
+                demoted.add(name)
+        return demoted
+
     def placement(self, key: int) -> tuple[str | None, list[str]]:
         """``(affinity primary, candidate order)`` for a conversation key.
         The primary is membership-pure (what the hit/miss accounting
         compares against); the candidate order additionally folds in
-        bounded load."""
-        return (self.ring.primary(key),
-                self.ring.candidates(key, self.loads()))
+        bounded load and SLO-burn demotion (both per-request reorderings
+        — membership, and every other key's placement, untouched)."""
+        demoted = self.burn_demoted()
+        candidates = self.ring.candidates(key, self.loads(),
+                                          demoted=demoted)
+        for name in demoted:
+            # Counted per placement in which the replica actually lost
+            # its position — only when it would otherwise have been a
+            # candidate at all.
+            if name in candidates:
+                self.n_burn_demotions += 1
+                ROUTER_BURN_DEMOTIONS.inc(replica=name)
+        return (self.ring.primary(key), candidates)
 
     # ---- readiness polling -------------------------------------------------
 
@@ -171,6 +213,66 @@ class ReplicaSet:
                     RECORDER.record("router-replica-in", loop="router",
                                     replica=r.name)
                     logger.info("replica %s rejoined the ring", r.name)
+                if reachable:
+                    await self._pull_telemetry(r)
+
+    async def _pull_telemetry(self, r: Replica) -> None:
+        """Absorb one replica's /debug/telemetry into the view. Strictly
+        best-effort: replicas predating the endpoint (404) or timing out
+        just leave their entry to go stale — burn demotion then fails
+        open and the fleet timeline falls back to raw timebases."""
+        t0 = time.perf_counter()
+        try:
+            resp = await self._control.get(
+                f"{r.url}/debug/telemetry", timeout=READY_TIMEOUT_S)
+            t1 = time.perf_counter()
+            if resp.status_code != 200:
+                return
+            body = resp.json()
+        except Exception:
+            return
+        TELEMETRY_POLL_SECONDS.observe(t1 - t0)
+        self.telemetry.absorb(r.name, body, t0, t1)
+        for cls, rate in self.telemetry.burn_rates(r.name).items():
+            ROUTER_REPLICA_BURN.set(rate, replica=r.name, slo_class=cls)
+
+    # ---- fleet timeline ----------------------------------------------------
+
+    async def fetch_timelines(self) -> list[dict]:
+        """Pull every reachable replica's flight-recorder snapshot
+        (``GET /debug/engine/timeline``) for the fleet-timeline merge.
+        Returns one row per replica that answered:
+        ``{"name", "events", "offset", "clock_aligned"}`` — ``offset``
+        is the TelemetryView's clock-offset estimate (router
+        perf_counter − replica perf_counter; None when telemetry is
+        stale, in which case the merger leaves that replica's events on
+        their raw timebase and flags ``clock_aligned: false``).
+        Best-effort per replica: one slow or dead replica costs its own
+        rows, never the merge."""
+        rows: list[dict] = []
+        for name, r in sorted(self.replicas.items()):
+            if not r.reachable:
+                continue
+            try:
+                resp = await self._control.get(
+                    f"{r.url}/debug/engine/timeline",
+                    timeout=TIMELINE_TIMEOUT_S)
+                if resp.status_code != 200:
+                    continue
+                body = resp.json()
+            except Exception:
+                continue
+            events = body.get("events") if isinstance(body, dict) else None
+            if not isinstance(events, list):
+                continue
+            offset = self.telemetry.offset(name)
+            rows.append({
+                "name": name,
+                "events": events,
+                "offset": offset,
+                "clock_aligned": offset is not None,
+            })
+        return rows
 
     # ---- prefix migration --------------------------------------------------
 

@@ -87,7 +87,8 @@ class BoundedLoadRing:
         return self._points[i % len(self._points)][1]
 
     def candidates(self, key: int,
-                   loads: dict[str, int] | None = None) -> list[str]:
+                   loads: dict[str, int] | None = None,
+                   demoted: set[str] | None = None) -> list[str]:
         """Every ring member, ordered for this key: the clockwise walk
         from the key's hash point (primary first, then the successors its
         range would spill to), with members past the bounded-load capacity
@@ -96,7 +97,15 @@ class BoundedLoadRing:
 
         ``loads`` is in-flight requests per replica; capacity is
         ``ceil(load_factor × (total + 1) / n)`` counting the request being
-        placed, so with uniform load nothing is ever demoted."""
+        placed, so with uniform load nothing is ever demoted.
+
+        ``demoted`` names members to push behind every non-demoted one —
+        the burn-aware placement hook (docs/observability.md): a replica
+        whose SLO burn exceeds the router's threshold loses first-choice
+        placements exactly like an overloaded one, per request, with
+        membership untouched. Applied after the load bound, preserving
+        relative order within each partition, so a replica both overloaded
+        AND burning sinks to the very tail."""
         if not self._points:
             return []
         order: list[str] = []
@@ -110,10 +119,14 @@ class BoundedLoadRing:
                 order.append(name)
                 if len(order) == len(self._names):
                     break
-        if not loads:
-            return order
-        total = sum(loads.get(n, 0) for n in order) + 1
-        cap = math.ceil(self.load_factor * total / len(order))
-        fits = [n for n in order if loads.get(n, 0) < cap]
-        over = [n for n in order if loads.get(n, 0) >= cap]
-        return fits + over
+        if loads:
+            total = sum(loads.get(n, 0) for n in order) + 1
+            cap = math.ceil(self.load_factor * total / len(order))
+            fits = [n for n in order if loads.get(n, 0) < cap]
+            over = [n for n in order if loads.get(n, 0) >= cap]
+            order = fits + over
+        if demoted:
+            keep = [n for n in order if n not in demoted]
+            burn = [n for n in order if n in demoted]
+            order = keep + burn
+        return order

@@ -1,0 +1,142 @@
+"""Per-replica telemetry absorbed by the router's /ready poller.
+
+The fleet plane's router-side state (docs/observability.md "Fleet
+plane"): every poll of a live replica also pulls ``GET /debug/telemetry``
+— per-class SLO burn, queue depth, breaker state, latency models, and a
+sample of the replica's ``perf_counter`` clock — and absorbs it here.
+Two consumers read the view:
+
+  - **burn-aware placement** (`ReplicaSet.placement`): a replica whose
+    interactive-class burn rate exceeds the router's ``burn_threshold``
+    is demoted to the tail of the candidate order — per-request
+    reordering exactly like bounded-load demotion, membership untouched.
+  - **fleet-timeline merging** (`GET /debug/fleet/timeline`): each
+    replica's flight-recorder stamps ride its own monotonic clock; the
+    estimated ``offset`` (router perf_counter minus replica perf_counter,
+    midpoint method over the poll's request/response stamps) aligns them
+    onto the router's timebase.
+
+Staleness-bounded and fail-open by design: entries older than
+``max_age_s`` (default 10 s — a few poll intervals) answer ``None`` for
+everything, and a ``None`` burn rate never demotes. A replica that stops
+answering telemetry quietly returns to plain bounded-load routing — the
+observability plane must not become a novel way to shed healthy
+capacity.
+
+jax-free (imported by the router tier, which never loads jax).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any
+
+
+class TelemetryView:
+    """Staleness-bounded map of replica name -> last absorbed telemetry."""
+
+    def __init__(self, max_age_s: float = 10.0):
+        self.max_age_s = float(max_age_s)
+        self._lock = threading.Lock()
+        # name -> {"snapshot": dict, "offset": float, "rtt": float,
+        #          "at": monotonic stamp of absorption}
+        self._entries: dict[str, dict[str, Any]] = {}
+
+    def absorb(self, name: str, snapshot: dict, t0: float,
+               t1: float) -> None:
+        """Fold in one replica's /debug/telemetry body. ``t0``/``t1`` are
+        the router's ``perf_counter`` immediately before/after the HTTP
+        round trip; the replica sampled its own clock somewhere inside
+        that window, so the midpoint estimates the cross-process offset
+        to within half the RTT (good to well under a millisecond on
+        loopback — tighter than any engine dispatch we'd want to order).
+        """
+        if not isinstance(snapshot, dict):
+            return
+        clock = snapshot.get("clock")
+        offset = None
+        if isinstance(clock, (int, float)):
+            offset = (t0 + t1) / 2.0 - float(clock)
+        with self._lock:
+            self._entries[name] = {
+                "snapshot": snapshot,
+                "offset": offset,
+                "rtt": max(0.0, t1 - t0),
+                "at": time.monotonic(),
+            }
+
+    def forget(self, name: str) -> None:
+        with self._lock:
+            self._entries.pop(name, None)
+
+    def _fresh_entry(self, name: str) -> dict[str, Any] | None:
+        with self._lock:
+            entry = self._entries.get(name)
+        if entry is None:
+            return None
+        if time.monotonic() - entry["at"] > self.max_age_s:
+            return None
+        return entry
+
+    def fresh(self, name: str) -> bool:
+        """True while ``name`` has telemetry young enough to act on."""
+        return self._fresh_entry(name) is not None
+
+    def get(self, name: str) -> dict | None:
+        """The last absorbed snapshot, or None when absent/stale."""
+        entry = self._fresh_entry(name)
+        return entry["snapshot"] if entry is not None else None
+
+    def burn_rate(self, name: str, slo_class: str) -> float | None:
+        """``slo_class``'s burn rate on ``name`` — None (never a zero:
+        the caller must fail open, and 0.0 would read as 'measured
+        healthy') when telemetry is absent, stale, or shapeless."""
+        snapshot = self.get(name)
+        if snapshot is None:
+            return None
+        try:
+            rate = snapshot["slo"][slo_class]["burn_rate"]
+        except (KeyError, TypeError):
+            return None
+        return float(rate) if isinstance(rate, (int, float)) else None
+
+    def burn_rates(self, name: str) -> dict[str, float]:
+        """All classes' burn rates on ``name`` (empty when stale) — the
+        gauge-export helper."""
+        snapshot = self.get(name)
+        if snapshot is None:
+            return {}
+        slo = snapshot.get("slo")
+        if not isinstance(slo, dict):
+            return {}
+        out: dict[str, float] = {}
+        for cls, row in slo.items():
+            rate = row.get("burn_rate") if isinstance(row, dict) else None
+            if isinstance(rate, (int, float)):
+                out[str(cls)] = float(rate)
+        return out
+
+    def offset(self, name: str) -> float | None:
+        """Estimated (router clock − replica clock), or None when
+        absent/stale/unestimable — the fleet-timeline merger then leaves
+        that replica's events on its raw timebase rather than inventing
+        an alignment."""
+        entry = self._fresh_entry(name)
+        return entry["offset"] if entry is not None else None
+
+    def snapshot(self) -> dict[str, dict]:
+        """Debug export: per-replica absorbed state with freshness."""
+        now = time.monotonic()
+        with self._lock:
+            entries = dict(self._entries)
+        return {
+            name: {
+                "age_s": round(now - entry["at"], 3),
+                "fresh": now - entry["at"] <= self.max_age_s,
+                "offset": entry["offset"],
+                "rtt": round(entry["rtt"], 6),
+                "telemetry": entry["snapshot"],
+            }
+            for name, entry in entries.items()
+        }

@@ -38,6 +38,7 @@ from quorum_tpu import oai, sse
 from quorum_tpu.observability import (
     FLIGHT_RECORDER_EVENTS,
     METRICS,
+    TRACE_PROPAGATED,
     TRACES,
     ProfilerBusy,
     RequestTrace,
@@ -47,6 +48,7 @@ from quorum_tpu.observability import (
     use_trace,
 )
 from quorum_tpu.telemetry import slo as slo_mod
+from quorum_tpu.telemetry import tracecontext
 from quorum_tpu.telemetry.recorder import RECORDER
 from quorum_tpu.backends.base import Backend, BackendError
 from quorum_tpu.backends.registry import BackendRegistry, build_registry
@@ -408,6 +410,43 @@ def create_app(
             "slo": slo_mod.SLO.snapshot(),
         })
 
+    @app.route("GET", "/debug/telemetry", "/v1/debug/telemetry")
+    async def debug_telemetry(request: Request) -> Response:
+        """Compact telemetry snapshot for the fleet plane
+        (docs/observability.md): per-class SLO burn, queue depth, breaker
+        state, per-family latency models, prefix-store footprint, and a
+        sample of this process's monotonic clock. The router's /ready
+        poller absorbs one of these per replica per poll into its
+        ``TelemetryView`` — burn-aware placement and fleet-timeline clock
+        alignment both read from it — so this must stay CHEAP (no jax,
+        no device sync; everything here is host-side counters)."""
+        _, reg = await current()
+        status, checks = _engine_health()
+        queue_depth = sum(int(row.get("pending", 0) or 0)
+                          for row in checks)
+        breakers = {row["backend"]: row.get("breaker", "closed")
+                    for row in checks}
+        latency = {name: engine.latency.snapshot()
+                   for name, engine in _distinct_engines(reg, "latency")}
+        prefix_store_bytes = 0
+        for _name, engine in _distinct_engines(reg, "prefix_store"):
+            store = getattr(engine, "prefix_store", None)
+            if store is not None:
+                prefix_store_bytes += int(store.bytes_held or 0)
+        return JSONResponse({
+            # perf_counter sample: the fleet-timeline merger estimates
+            # this process's clock offset from (poll request, response,
+            # this sample) — same timebase as every flight-recorder "t".
+            "clock": time.perf_counter(),
+            "time": time.time(),
+            "status": status,
+            "slo": slo_mod.SLO.snapshot(),
+            "queue_depth": queue_depth,
+            "breaker": breakers,
+            "latency": latency,
+            "prefix_store_bytes": prefix_store_bytes,
+        })
+
     @app.route("POST", "/debug/profile", "/v1/debug/profile")
     async def debug_profile(request: Request) -> Response:
         """On-demand whole-process jax device profile
@@ -533,9 +572,35 @@ def create_app(
         /metrics histograms). For SSE the trace/profiler scope must cover
         the *stream* — the device work happens while the ASGI server drives
         the iterator, after this handler returns — so the scope is closed
-        from the iterator's finally, not here."""
+        from the iterator's finally, not here.
+
+        Cross-tier trace propagation (docs/observability.md "Fleet
+        plane"): a W3C ``traceparent`` from the caller (header, or body
+        knob for header-less clients — ``Request.body()`` caches, so the
+        peek costs nothing extra) is honored — its trace-id becomes the
+        flight-recorder correlation key for every engine event this
+        request causes, and the router's route/failover events carry the
+        same id. No (valid) traceparent → this tier mints one. Either
+        way the response echoes ``traceparent`` so callers can join
+        their logs to the fleet timeline."""
         rid = f"req-{uuid.uuid4().hex[:16]}"
-        trace = TRACES.start(RequestTrace(rid))
+        parsed = tracecontext.parse_traceparent(
+            request.headers.get("traceparent"))
+        if parsed is None:
+            with contextlib.suppress(Exception):
+                raw = await request.json()
+                if isinstance(raw, dict):
+                    parsed = tracecontext.parse_traceparent(
+                        raw.get("traceparent"))
+        if parsed is not None:
+            trace_id = parsed[0]
+            TRACE_PROPAGATED.inc(source="client")
+        else:
+            trace_id = tracecontext.new_trace_id()
+            TRACE_PROPAGATED.inc(source="server")
+        span_id = tracecontext.new_span_id()
+        trace = TRACES.start(RequestTrace(rid, trace_id=trace_id,
+                                          span_id=span_id))
         scope = contextlib.ExitStack()
         scope.enter_context(maybe_profile(rid))
         try:
@@ -553,6 +618,9 @@ def create_app(
             finish_request_trace(trace, status=500)
             raise
         response.headers.setdefault("X-Request-Id", rid)
+        response.headers.setdefault(
+            "traceparent", tracecontext.format_traceparent(trace_id,
+                                                           span_id))
         if isinstance(response, StreamingResponse):
             response.iterator = _finish_scope_after(
                 sse.instrument_stream(response.iterator, trace),
@@ -613,6 +681,11 @@ def create_app(
                 {"error": {"message": "No valid backends configured", "type": "configuration_error"}},
                 status_code=500,
             )
+
+        # Trace identity rides the RequestTrace (stamped by the wrapper);
+        # the body knob was only a carrier for header-less clients — never
+        # forwarded (upstreams would reject an unknown field).
+        body.pop("traceparent", None)
 
         is_streaming = bool(body.get("stream", False))
         is_parallel = cfg.parallel_enabled(len(reg))

@@ -243,4 +243,74 @@ class FlightRecorder:
             return None
 
 
+def merged_trace_events(
+        groups: list[tuple[str, list[dict], float]]) -> list[dict]:
+    """Fleet-timeline export: several processes' recorder SNAPSHOTS (the
+    dict form ``FlightRecorder.snapshot`` emits / ``GET
+    /debug/engine/timeline`` serves) merged into one Chrome trace-event
+    stream. Each group is ``(process_name, events, offset_s)`` — one
+    Perfetto *process* per group (the router, then one per replica), with
+    ``offset_s`` added to every stamp so all groups land on ONE timebase
+    (the router estimates each replica's offset from its telemetry
+    polls; an unestimable offset is passed as 0.0, leaving that replica
+    on its raw clock). Mirrors :meth:`FlightRecorder.to_trace_events`:
+    reaped dispatches with ``t_issue`` become complete ("X") slices,
+    everything else an instant ("i"); request-id correlation — the fleet
+    plane's cross-tier trace-id — rides ``args.rid``."""
+    tids: dict[tuple[int, str], int] = {}
+    meta: list[dict] = []
+    out: list[dict] = []
+
+    def tid_of(pid: int, track: str) -> int:
+        t = tids.get((pid, track))
+        if t is None:
+            t = sum(1 for (p, _) in tids if p == pid) + 1
+            tids[(pid, track)] = t
+            meta.append({"ph": "M", "name": "thread_name", "pid": pid,
+                         "tid": t, "args": {"name": track}})
+        return t
+
+    for pid0, (pname, events, offset) in enumerate(groups):
+        pid = pid0 + 1
+        meta.append({"ph": "M", "name": "process_name", "pid": pid,
+                     "tid": 0, "args": {"name": pname or f"proc-{pid}"}})
+        for ev in events:
+            if not isinstance(ev, dict):
+                continue
+            kind = str(ev.get("kind") or "event")
+            try:
+                t = float(ev.get("t", 0.0)) + offset
+            except (TypeError, ValueError):
+                continue
+            args = {k: v for k, v in ev.items() if k not in ("t", "kind")}
+            engine = str(ev.get("engine") or "")
+            if kind in _SPAN_KINDS and "t_issue" in ev:
+                try:
+                    t_issue = float(ev["t_issue"]) + offset
+                    t_ready = float(ev.get("t_ready") or ev["t"]) + offset
+                except (TypeError, ValueError):
+                    continue
+                track = "ring[%d]" % int(ev.get("depth", 0) or 0)
+                if engine:
+                    track = f"{engine} {track}"
+                out.append({
+                    "ph": "X", "name": str(ev.get("family") or kind),
+                    "cat": "dispatch", "pid": pid,
+                    "tid": tid_of(pid, track),
+                    "ts": round(t_issue * 1e6, 3),
+                    "dur": round(max(0.0, t_ready - t_issue) * 1e6, 3),
+                    "args": args,
+                })
+                continue
+            track = str(ev.get("loop") or "events")
+            if engine:
+                track = f"{engine}/{track}"
+            out.append({
+                "ph": "i", "s": "t", "name": kind, "cat": kind,
+                "pid": pid, "tid": tid_of(pid, track),
+                "ts": round(t * 1e6, 3), "args": args,
+            })
+    return meta + out
+
+
 RECORDER = FlightRecorder()

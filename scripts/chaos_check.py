@@ -266,6 +266,138 @@ async def _router_kill_drill(check) -> None:
                 proc.wait()
 
 
+async def _fleet_trace_drill(check) -> None:
+    """Phase 7 body: trace continuity through failover, fleet-wide.
+
+    Two fake replica processes behind the real router; SIGKILL one while
+    a stream is in flight, then send a request keyed to the corpse. The
+    failed-over request's W3C trace-id must name it in the router's own
+    timeline (failover + serving hop), in the SURVIVOR's flight
+    recorder, and in the merged /debug/fleet/timeline — one id, three
+    processes (docs/observability.md "Fleet plane")."""
+    import httpx
+
+    from quorum_tpu.router import affinity as aff
+    from quorum_tpu.router.app import RouterConfig, create_router_app
+    from quorum_tpu.telemetry.recorder import RECORDER
+
+    proc_a = proc_b = None
+    try:
+        proc_a, url_a = _spawn_fake_replica("trace-a", chunk_delay=0.05,
+                                            tokens=60)
+        proc_b, url_b = _spawn_fake_replica("trace-b", chunk_delay=0.05,
+                                            tokens=60)
+        rcfg = RouterConfig(
+            replicas=[("trace-a", url_a), ("trace-b", url_b)],
+            ready_interval=0.25, retries=1, timeout=20.0,
+            breaker_threshold=2, breaker_cooldown=0.5,
+            migrate_on_rotation=False)
+        router_app = create_router_app(rcfg)
+        mgr = router_app.state["replica_set"]
+
+        def body_keyed_to(target: str, *, stream: bool,
+                          max_tokens: int = 60) -> dict:
+            for i in range(200):
+                msgs = [{"role": "user",
+                         "content": f"trace conversation {i}: "
+                                    "please answer at length"}]
+                key = aff.conversation_key({"messages": msgs},
+                                           rcfg.affinity_chunk)
+                if mgr.ring.primary(key) == target:
+                    return {"model": "m", "messages": msgs,
+                            "stream": stream, "max_tokens": max_tokens}
+            raise RuntimeError(f"no key found for {target}")
+
+        transport = httpx.ASGITransport(app=router_app)
+        async with httpx.AsyncClient(transport=transport,
+                                     base_url="http://router",
+                                     timeout=30.0) as rc:
+            # one poll sweep up front: telemetry (and clock offsets) for
+            # both replicas while both are alive
+            await mgr.poll_once()
+            failover_body = body_keyed_to("trace-a", stream=False,
+                                          max_tokens=4)
+
+            async def consume(body: dict) -> None:
+                async with rc.stream("POST", "/chat/completions",
+                                     json=body) as resp:
+                    async for _line in resp.aiter_lines():
+                        pass
+
+            stream_a = asyncio.create_task(consume(
+                body_keyed_to("trace-a", stream=True)))
+            await asyncio.sleep(0.6)  # stream well under way
+            proc_a.kill()
+            proc_a.wait()
+            failed_over = await asyncio.wait_for(
+                rc.post("/chat/completions", json=failover_body),
+                timeout=15.0)
+            await asyncio.wait_for(stream_a, timeout=30.0)
+            trace_id = failed_over.headers.get("x-request-id", "")
+            check("fleet trace: failed-over request serves from the "
+                  "survivor with a 32-hex trace-id",
+                  failed_over.status_code == 200
+                  and failed_over.headers.get("x-routed-to") == "trace-b"
+                  and len(trace_id) == 32,
+                  f"status={failed_over.status_code} rid={trace_id!r}")
+            tp = failed_over.headers.get("traceparent", "")
+            check("fleet trace: response traceparent carries the same "
+                  "trace-id", tp.startswith(f"00-{trace_id}-"), tp)
+
+            # 1/3 — router timeline: failed attempt on the corpse, serving
+            # hop on the survivor marked failover=1, distinct spans
+            mine = [ev for ev in RECORDER.snapshot()
+                    if ev.get("rid") == trace_id]
+            failed = [ev for ev in mine
+                      if ev["kind"] == "router-failover"]
+            routed = [ev for ev in mine if ev["kind"] == "router-route"]
+            check("fleet trace: router timeline joins failover + serving "
+                  "hop on the trace-id",
+                  bool(failed) and bool(routed)
+                  and failed[0].get("replica") == "trace-a"
+                  and routed[0].get("replica") == "trace-b"
+                  and routed[0].get("failover") == 1
+                  and routed[0].get("span") != failed[0].get("span"),
+                  f"failover={failed} route={routed}")
+
+            # 2/3 — the survivor's own recorder saw the same trace-id
+            async with httpx.AsyncClient(timeout=10.0) as direct:
+                tl = (await direct.get(
+                    f"{url_b}/debug/engine/timeline")).json()
+            surv = [ev for ev in tl.get("events", [])
+                    if ev.get("rid") == trace_id]
+            check("fleet trace: survivor's recorder carries the "
+                  "trace-id",
+                  {"dispatch", "reap"} <= {ev["kind"] for ev in surv},
+                  f"kinds={sorted({ev['kind'] for ev in surv})}")
+
+            # 3/3 — the merged fleet timeline joins both processes on it
+            fleet = (await rc.get("/debug/fleet/timeline")).json()
+            merged = [ev for ev in fleet["events"]
+                      if ev.get("rid") == trace_id]
+            procs = {ev.get("process") for ev in merged}
+            aligned = {row["name"]: row.get("clock_aligned")
+                       for row in fleet.get("replicas", [])}
+            check("fleet trace: merged fleet timeline joins router + "
+                  "survivor on the trace-id, clock-aligned",
+                  procs == {"router", "trace-b"}
+                  and aligned.get("trace-b") is True,
+                  f"procs={sorted(p or '?' for p in procs)} "
+                  f"aligned={aligned}")
+            stamps = [ev["t"] for ev in merged]
+            check("fleet trace: aligned events sit within one request's "
+                  "duration",
+                  bool(stamps) and max(stamps) - min(stamps) < 5.0,
+                  f"spread={max(stamps) - min(stamps):.3f}s"
+                  if stamps else "no events")
+            await mgr.aclose()
+    finally:
+        for proc in (proc_a, proc_b):
+            if proc is not None and proc.poll() is None:
+                proc.kill()
+                proc.wait()
+
+
 def _config() -> dict:
     return {
         "settings": {"timeout": 30},
@@ -696,6 +828,15 @@ async def _run(quick: bool) -> None:
         if not quick:
             print("phase 6: router replica-kill", flush=True)
             await _router_kill_drill(check)
+
+        # ---- phase 7: fleet trace continuity through failover ------------
+        # One W3C trace-id across three processes (docs/observability.md
+        # "Fleet plane"): kill a replica mid-stream, fail a request over,
+        # and find its trace-id in the router's timeline, the survivor's
+        # flight recorder, and the merged /debug/fleet/timeline.
+        if not quick:
+            print("phase 7: fleet trace continuity", flush=True)
+            await _fleet_trace_drill(check)
 
     from quorum_tpu.engine.engine import shutdown_all_engines
 

@@ -243,11 +243,13 @@ def run_fake(n_replicas: int = 2, *, n_conversations: int = 8,
 # ---- real mode (subprocess tpu:// engine replicas) -------------------------
 
 
-def _spawn_replica(name: str, model: str) -> tuple[subprocess.Popen, str]:
+def _spawn_replica(name: str, model: str,
+                   extra_env: dict | None = None
+                   ) -> tuple[subprocess.Popen, str]:
     """Spawn one real serving replica (tiny CPU engine, host prefix
     store); returns (process, base url) once it prints PORT=."""
     env = dict(os.environ, JAX_PLATFORMS="cpu",
-               QUORUM_TPU_COMPILE_CACHE="0")
+               QUORUM_TPU_COMPILE_CACHE="0", **(extra_env or {}))
     env.pop("PALLAS_AXON_POOL_IPS", None)
     proc = subprocess.Popen(
         [sys.executable, os.path.abspath(__file__), "--serve-replica",
@@ -310,7 +312,15 @@ async def _run_real_async(n_replicas: int, *, n_conversations: int,
               flush=True)
         replicas = []
         for i in range(n_replicas):
-            proc, url = _spawn_replica(f"real-{i}", model)
+            # real-0 gets a microsecond interactive TTFT/gap target: the
+            # fleet leg saturates ITS interactive burn with real scored
+            # requests (no fake telemetry) to drive burn-aware demotion.
+            # Observational only — the measured legs' requests carry no
+            # deadline, classify as batch, and never touch these targets.
+            extra = ({"QUORUM_TPU_SLO_TTFT_INTERACTIVE_S": "0.000001",
+                      "QUORUM_TPU_SLO_GAP_INTERACTIVE_S": "0.000001"}
+                     if i == 0 else None)
+            proc, url = _spawn_replica(f"real-{i}", model, extra_env=extra)
             procs.append(proc)
             replicas.append((f"real-{i}", url))
         base_proc, base_url = _spawn_replica("real-single", model)
@@ -355,12 +365,119 @@ async def _run_real_async(n_replicas: int, *, n_conversations: int,
         out.update(legs)
         out["affinity_gt_random"] = (
             legs["affinity"]["hit_rate"] > legs["random"]["hit_rate"])
+
+        # ---- fleet observability leg (docs/observability.md) ---------
+        # Same live replicas: (1) one sampled request's trace-id must
+        # name it across the router's route event, the serving replica's
+        # spans, and the engine's dispatch/reap in the MERGED fleet
+        # timeline; (2) saturating real-0's interactive burn with real
+        # scored requests must measurably cost it placements — demotion
+        # counter up, every family-G request served by real-1, outputs
+        # still token-for-token identical to single-replica serving.
+        async with httpx.AsyncClient() as client:
+            out["fleet"] = await _fleet_leg(
+                client, replicas, base_url, model=model,
+                max_tokens=max_tokens)
+            print(f"[router-bench] real N={n_replicas} fleet: "
+                  f"{json.dumps(out['fleet'])}", flush=True)
     finally:
         for proc in procs:
             proc.kill()
         for proc in procs:
             proc.wait(timeout=30)
     return out
+
+
+async def _fleet_leg(client: httpx.AsyncClient,
+                     replicas: list[tuple[str, str]], base_url: str, *,
+                     model: str, max_tokens: int) -> dict:
+    from quorum_tpu.router.app import RouterConfig, create_router_app
+    from quorum_tpu.server.serve import start_server
+
+    # burn_threshold 0.4: a saturated replica's interactive window is
+    # all-breached TTFT (+ gap when sampled) against good deadlines —
+    # burn lands in [0.5, 0.67], comfortably above.
+    cfg = RouterConfig(replicas=replicas, policy="affinity",
+                       ready_interval=0.0, burn_threshold=0.4)
+    router_app = create_router_app(cfg)
+    mgr = router_app.state["replica_set"]
+    router_srv = await start_server(router_app, "127.0.0.1", 0)
+    router_url = (
+        f"http://127.0.0.1:{router_srv.sockets[0].getsockname()[1]}")
+    leg: dict = {}
+    try:
+        await mgr.poll_once()  # absorb telemetry + clock offsets
+
+        # (1) trace continuity: sample one request through the router
+        r = await client.post(
+            f"{router_url}/chat/completions",
+            json={"model": model, "temperature": 0.0,
+                  "max_tokens": max_tokens,
+                  "messages": [{"role": "user", "content":
+                                conversation_opening("T", 0)}]},
+            headers={"Authorization": "Bearer bench"}, timeout=120.0)
+        trace_id = r.headers.get("x-request-id", "")
+        served_by = r.headers.get("x-routed-to", "")
+        fleet = (await client.get(
+            f"{router_url}/debug/fleet/timeline", timeout=30.0)).json()
+        # per-request events carry rid; the engine's batched
+        # dispatch/reap carry the member list in rids
+        mine = [ev for ev in fleet["events"]
+                if ev.get("rid") == trace_id
+                or trace_id in (ev.get("rids") or [])]
+        kinds_by_proc: dict[str, set] = {}
+        for ev in mine:
+            kinds_by_proc.setdefault(ev["process"], set()).add(ev["kind"])
+        leg["sampled_trace_id"] = trace_id
+        leg["trace_kinds_by_process"] = {
+            p: sorted(k) for p, k in kinds_by_proc.items()}
+        leg["trace_joined"] = (
+            r.status_code == 200 and len(trace_id) == 32
+            and "router-route" in kinds_by_proc.get("router", set())
+            and {"admit", "dispatch", "reap"} <= kinds_by_proc.get(
+                served_by, set()))
+
+        # (2) burn saturation: real interactive streams at real-0 breach
+        # its microsecond TTFT/gap targets; its scored burn demotes it
+        burn_url = dict(replicas)["real-0"]
+        for i in range(6):
+            resp = await client.post(
+                f"{burn_url}/chat/completions",
+                json={"model": model, "temperature": 0.0, "timeout": 5,
+                      "stream": True, "max_tokens": 4,
+                      "messages": [{"role": "user", "content":
+                                    conversation_opening("S", i)}]},
+                headers={"Authorization": "Bearer bench"}, timeout=120.0)
+            resp.raise_for_status()
+        tele = (await client.get(f"{burn_url}/debug/telemetry",
+                                 timeout=30.0)).json()
+        leg["real0_interactive_burn"] = (
+            tele["slo"].get("interactive") or {}).get("burn_rate")
+        await mgr.poll_once()
+        demotions_before = mgr.n_burn_demotions
+        leg["burn_demoted"] = sorted(mgr.burn_demoted())
+        routed_through = await measure_leg(
+            client, router_url, [u for _, u in replicas], family="G",
+            n_conversations=4, turns=2, max_tokens=max_tokens,
+            model=model)
+        single = await drive_conversations(
+            client, base_url, family="G", n_conversations=4, turns=2,
+            max_tokens=max_tokens, model=model)
+        leg["burn_demotions"] = mgr.n_burn_demotions - demotions_before
+        # the demoted replica lost every placement: real-1 served all
+        leg["requests_per_replica"] = routed_through[
+            "requests_per_replica"]
+        real0_idx = [n for n, _ in replicas].index("real-0")
+        leg["demoted_lost_placements"] = (
+            leg["burn_demotions"] > 0
+            and routed_through["requests_per_replica"][real0_idx] == 0)
+        leg["outputs_pinned_vs_single"] = (
+            routed_through["outputs"] == single["outputs"])
+        del routed_through["outputs"]
+    finally:
+        await app_close(router_app)
+        router_srv.close()
+    return leg
 
 
 def run_real(n_replicas: int = 2, *, n_conversations: int = 8,
@@ -422,6 +539,17 @@ def main() -> int:
         if not leg["affinity"]["outputs_pinned_vs_single"]:
             failures.append("real n2: outputs diverged from "
                             "single-replica serving")
+        fleet = leg.get("fleet", {})
+        if not fleet.get("trace_joined"):
+            failures.append("real n2 fleet: sampled trace-id not joined "
+                            "across router + replica + engine in the "
+                            "merged timeline")
+        if not fleet.get("demoted_lost_placements"):
+            failures.append("real n2 fleet: burn-saturated replica did "
+                            "not measurably lose placements")
+        if not fleet.get("outputs_pinned_vs_single"):
+            failures.append("real n2 fleet: outputs diverged under burn "
+                            "demotion")
     out["failures"] = failures
     print(json.dumps(out), flush=True)
     return 1 if failures else 0

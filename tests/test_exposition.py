@@ -352,8 +352,16 @@ async def test_live_metrics_exposition_validates():
                     "quorum_tpu_router_affinity_misses_total",
                     "quorum_tpu_router_failovers_total",
                     "quorum_tpu_router_migrated_bytes_total",
-                    "quorum_tpu_router_migrated_chains_total"):
+                    "quorum_tpu_router_migrated_chains_total",
+                    "quorum_tpu_router_burn_demotions_total",
+                    "quorum_tpu_trace_propagated_total"):
         assert f"# TYPE {counter} counter" in text, counter
+
+    # fleet-plane families (ISSUE 16): burn gauge absorbed from replica
+    # telemetry and the telemetry-poll latency histogram
+    assert "# TYPE quorum_tpu_router_replica_burn gauge" in text
+    assert ("# TYPE quorum_tpu_telemetry_poll_seconds histogram"
+            in text)
 
     # _count == +Inf bucket and bucket monotonicity for one family, by hand
     # (belt to the validator's braces)

@@ -1,0 +1,535 @@
+"""The fleet observability plane (docs/observability.md "Fleet plane").
+
+Cross-tier trace propagation (one W3C trace-id from the router through a
+replica's server into engine events, surviving failover), per-replica
+telemetry export absorbed into the router's staleness-bounded
+TelemetryView, burn-aware placement demotion, and the merged fleet
+timeline with cross-process clock alignment. Everything here runs over
+jax-free fake replicas on real sockets — the real-engine leg lives in
+scripts/router_bench.py and the chaos harness.
+"""
+
+import asyncio
+import threading
+import time
+
+import httpx
+import pytest
+
+from quorum_tpu.router import affinity
+from quorum_tpu.router.app import RouterConfig, create_router_app
+from quorum_tpu.router.fake_replica import (
+    FakeReplicaState,
+    create_fake_replica_app,
+)
+from quorum_tpu.router.ring import BoundedLoadRing, hash_key
+from quorum_tpu.router.telemetry_view import TelemetryView
+from quorum_tpu.telemetry import tracecontext
+from quorum_tpu.telemetry.recorder import RECORDER, merged_trace_events
+
+
+# ---- trace-context primitives -----------------------------------------------
+
+
+def test_traceparent_round_trip():
+    tid, sid = tracecontext.new_trace_id(), tracecontext.new_span_id()
+    assert len(tid) == 32 and len(sid) == 16
+    header = tracecontext.format_traceparent(tid, sid)
+    assert tracecontext.parse_traceparent(header) == (tid, sid)
+    child_sid, child_header = tracecontext.child_traceparent(tid)
+    assert child_sid != sid
+    assert tracecontext.parse_traceparent(child_header) == (tid, child_sid)
+
+
+def test_traceparent_rejects_malformed():
+    tid, sid = "ab" * 16, "cd" * 8
+    good = f"00-{tid}-{sid}-01"
+    assert tracecontext.parse_traceparent(good) == (tid, sid)
+    assert tracecontext.parse_traceparent(good.upper()) == (tid, sid)
+    for bad in (None, "", 42, "junk", f"01-{tid}-{sid}-01",
+                f"00-{tid}-{sid}", f"00-{tid[:-1]}-{sid}-01",
+                f"00-{'0' * 32}-{sid}-01",       # zero trace-id
+                f"00-{tid}-{'0' * 16}-01",       # zero span-id
+                f"00-{tid}-{sid}-zz-extra"):
+        assert tracecontext.parse_traceparent(bad) is None, bad
+
+
+def test_engine_direct_requests_self_mint_a_trace_id():
+    """A _Request built outside any traced context (engine.generate from
+    a script) mints its own 32-hex rid — engine timelines stay
+    correlatable even without a server above them — while one built
+    inside a traced context inherits the trace-id."""
+    from quorum_tpu.engine.engine import _Request
+    from quorum_tpu.observability import (
+        TRACE_PROPAGATED,
+        RequestTrace,
+        use_trace,
+    )
+    from quorum_tpu.ops.sampling import SamplerConfig
+
+    def mk():
+        return _Request([1, 2, 3], 4, SamplerConfig(), 0, None,
+                        threading.Event(), 4)
+
+    before = TRACE_PROPAGATED.value_of(source="engine")
+    req = mk()
+    assert len(req.rid) == 32 and int(req.rid, 16) != 0
+    assert TRACE_PROPAGATED.value_of(source="engine") == before + 1
+    tid = tracecontext.new_trace_id()
+    with use_trace(RequestTrace("req-x", trace_id=tid, span_id="a" * 16)):
+        assert mk().rid == tid
+    # a trace WITHOUT a trace-id (legacy caller) falls back to its id
+    with use_trace(RequestTrace("req-y")):
+        assert mk().rid == "req-y"
+
+
+# ---- telemetry view ---------------------------------------------------------
+
+
+def _snapshot(clock: float, burn: dict[str, float] | None = None) -> dict:
+    return {"clock": clock,
+            "slo": {cls: {"burn_rate": rate, "stages": {}}
+                    for cls, rate in (burn or {}).items()}}
+
+
+def test_telemetry_view_offset_estimation():
+    view = TelemetryView(max_age_s=10.0)
+    t0 = time.perf_counter()
+    t1 = t0 + 0.010
+    # replica clock runs 5 s ahead: offset ≈ midpoint − (midpoint + 5)
+    view.absorb("r0", _snapshot((t0 + t1) / 2 + 5.0), t0, t1)
+    assert view.fresh("r0")
+    assert view.offset("r0") == pytest.approx(-5.0, abs=1e-6)
+    # shapeless clock → no offset, snapshot still served
+    view.absorb("r1", {"slo": {}}, t0, t1)
+    assert view.offset("r1") is None and view.get("r1") is not None
+
+
+def test_telemetry_view_staleness_and_fail_open():
+    view = TelemetryView(max_age_s=0.05)
+    view.absorb("r0", _snapshot(time.perf_counter(),
+                                {"interactive": 0.9}), 0.0, 0.0)
+    assert view.burn_rate("r0", "interactive") == pytest.approx(0.9)
+    time.sleep(0.08)
+    # stale: EVERYTHING answers None/empty — the fail-open contract
+    assert not view.fresh("r0")
+    assert view.get("r0") is None
+    assert view.burn_rate("r0", "interactive") is None
+    assert view.burn_rates("r0") == {}
+    assert view.offset("r0") is None
+    snap = view.snapshot()
+    assert snap["r0"]["fresh"] is False
+    # never-seen replica: None, not a KeyError
+    assert view.burn_rate("ghost", "interactive") is None
+    # malformed burn shapes: None, never a crash or a zero
+    view.absorb("r0", {"clock": 1.0, "slo": {"interactive": "broken"}},
+                0.0, 0.0)
+    assert view.burn_rate("r0", "interactive") is None
+
+
+# ---- burn demotion in the ring ----------------------------------------------
+
+
+def test_ring_candidates_demoted_partition():
+    ring = BoundedLoadRing()
+    for n in ("a", "b", "c", "d"):
+        ring.add(n)
+    key = hash_key(b"burning conversation")
+    base = ring.candidates(key)
+    hot = base[0]
+    out = ring.candidates(key, demoted={hot})
+    # same membership, demoted member at the tail, others keep order
+    assert sorted(out) == sorted(base)
+    assert out[-1] == hot
+    assert out[:-1] == [n for n in base if n != hot]
+    # demotion composes with bounded load: overloaded AND burning sinks
+    # below a merely-overloaded member
+    loads = {n: (50 if n in base[:2] else 0) for n in base}
+    combined = ring.candidates(key, loads, demoted={base[0]})
+    assert combined[-1] == base[0] and combined[-2] == base[1]
+    # empty/None demoted set: unchanged
+    assert ring.candidates(key, demoted=set()) == base
+    assert ring.candidates(key, demoted=None) == base
+
+
+# ---- router cluster over fake replicas --------------------------------------
+
+
+class _Cluster:
+    """N fake replicas + the router app (real sockets, test event loop)."""
+
+    def __init__(self, n: int = 2, *, ready_interval: float = 0.0,
+                 state_kw: list[dict] | None = None, **cfg_kw):
+        self.n = n
+        self.ready_interval = ready_interval
+        self.state_kw = state_kw or [{} for _ in range(n)]
+        self.cfg_kw = cfg_kw
+        self.states: list[FakeReplicaState] = []
+        self.servers = []
+        self.urls: list[str] = []
+
+    async def __aenter__(self):
+        from quorum_tpu.server.serve import start_server
+
+        for i in range(self.n):
+            st = FakeReplicaState(f"r{i}", **self.state_kw[i])
+            srv = await start_server(
+                create_fake_replica_app(st), "127.0.0.1", 0)
+            self.states.append(st)
+            self.servers.append(srv)
+            self.urls.append(
+                f"http://127.0.0.1:{srv.sockets[0].getsockname()[1]}")
+        self.cfg = RouterConfig(
+            replicas=[(f"r{i}", u) for i, u in enumerate(self.urls)],
+            ready_interval=self.ready_interval, **self.cfg_kw)
+        self.app = create_router_app(self.cfg)
+        self.mgr = self.app.state["replica_set"]
+        self.client = httpx.AsyncClient(
+            transport=httpx.ASGITransport(app=self.app),
+            base_url="http://router", timeout=30.0)
+        return self
+
+    async def __aexit__(self, *exc):
+        await self.client.aclose()
+        await self.mgr.aclose()
+        for srv in self.servers:
+            srv.close()
+
+    async def chat(self, messages, headers=None, **kw):
+        return await self.client.post(
+            "/chat/completions",
+            json={"model": "m", "messages": messages, **kw},
+            headers=headers)
+
+
+def _conv(i: int) -> list[dict]:
+    return [{"role": "user", "content": f"fleet conversation {i}: "
+             "what is the opening move?"}]
+
+
+def _events_for(rid: str, events: list[dict]) -> list[dict]:
+    return [ev for ev in events if ev.get("rid") == rid]
+
+
+async def test_router_mints_and_propagates_trace_id():
+    async with _Cluster(2) as c:
+        r = await c.chat(_conv(0))
+        assert r.status_code == 200
+        parsed = tracecontext.parse_traceparent(r.headers["traceparent"])
+        assert parsed is not None
+        trace_id = parsed[0]
+        # the trace-id IS the router's request id
+        assert r.headers["x-request-id"] == trace_id
+        served_by = r.headers["x-routed-to"]
+        # router's recorder: the route event carries the trace-id
+        routed = [ev for ev in _events_for(trace_id, RECORDER.snapshot())
+                  if ev["kind"] == "router-route"]
+        assert routed and routed[-1]["replica"] == served_by
+        assert "failover" not in routed[-1]
+        assert len(routed[-1]["span"]) == 16
+        # replica's recorder: dispatch + reap joined on the SAME id
+        state = c.states[int(served_by[1:])]
+        kinds = {ev["kind"]
+                 for ev in _events_for(trace_id, state.recorder.snapshot())}
+        assert kinds == {"dispatch", "reap"}
+
+
+async def test_router_honors_client_traceparent():
+    async with _Cluster(2) as c:
+        tid = tracecontext.new_trace_id()
+        header = tracecontext.format_traceparent(tid, "ab" * 8)
+        r = await c.chat(_conv(1), headers={"traceparent": header})
+        got_tid, got_span = tracecontext.parse_traceparent(
+            r.headers["traceparent"])
+        assert got_tid == tid          # same trace
+        assert got_span != "ab" * 8    # fresh hop span
+        assert r.headers["x-request-id"] == tid
+        # body knob works for header-less clients
+        tid2 = tracecontext.new_trace_id()
+        r = await c.chat(
+            _conv(2),
+            traceparent=tracecontext.format_traceparent(tid2, "cd" * 8))
+        assert r.headers["x-request-id"] == tid2
+        # a malformed header is ignored → minted, never trusted
+        r = await c.chat(_conv(3), headers={"traceparent": "garbage"})
+        minted = r.headers["x-request-id"]
+        assert len(minted) == 32 and minted != tid
+
+
+async def test_failover_keeps_trace_id_with_new_hop_span():
+    async with _Cluster(2) as c:
+        # a conversation whose affinity home is r0
+        body = None
+        for i in range(64):
+            cand = {"messages": _conv(100 + i)}
+            key = affinity.conversation_key(cand, c.cfg.affinity_chunk)
+            if c.mgr.ring.primary(key) == "r0":
+                body = cand["messages"]
+                break
+        assert body is not None
+        # kill r0's listener: the attempt on it fails pre-stream
+        c.servers[0].close()
+        await c.servers[0].wait_closed()
+        r = await c.chat(body)
+        assert r.status_code == 200
+        assert r.headers["x-routed-to"] == "r1"
+        trace_id = r.headers["x-request-id"]
+        events = _events_for(trace_id, RECORDER.snapshot())
+        failed = [ev for ev in events if ev["kind"] == "router-failover"]
+        routed = [ev for ev in events if ev["kind"] == "router-route"]
+        assert failed and failed[0]["replica"] == "r0"
+        assert routed and routed[0]["replica"] == "r1"
+        # same trace-id end to end; the serving hop is marked failover
+        # and rides a DIFFERENT span than the failed attempt
+        assert routed[0]["failover"] == 1
+        assert routed[0]["span"] != failed[0]["span"]
+        # the survivor's recorder saw the same trace-id
+        assert _events_for(trace_id, c.states[1].recorder.snapshot())
+
+
+async def test_streaming_carries_traceparent():
+    async with _Cluster(2) as c:
+        async with c.client.stream(
+            "POST", "/chat/completions",
+            json={"model": "m", "stream": True, "messages": _conv(5)},
+        ) as resp:
+            assert resp.status_code == 200
+            tid, _ = tracecontext.parse_traceparent(
+                resp.headers["traceparent"])
+            assert resp.headers["x-request-id"] == tid
+            await resp.aread()
+        served = resp.headers["x-routed-to"]
+        state = c.states[int(served[1:])]
+        kinds = {ev["kind"]
+                 for ev in _events_for(tid, state.recorder.snapshot())}
+        assert kinds == {"dispatch", "reap"}
+
+
+# ---- telemetry poll + burn-aware placement ----------------------------------
+
+
+async def test_poller_absorbs_telemetry_and_burn_demotes():
+    from quorum_tpu.observability import (
+        ROUTER_BURN_DEMOTIONS,
+        ROUTER_REPLICA_BURN,
+    )
+
+    async with _Cluster(2, burn_threshold=0.5) as c:
+        await c.mgr.poll_once()
+        # telemetry absorbed for both; no burn scripted → nobody demoted
+        assert c.mgr.telemetry.fresh("r0") and c.mgr.telemetry.fresh("r1")
+        assert c.mgr.telemetry.offset("r0") is not None
+        assert c.mgr.burn_demoted() == set()
+        # script r0 burning its interactive budget, re-poll
+        async with httpx.AsyncClient() as direct:
+            resp = await direct.post(
+                f"{c.urls[0]}/admin/burn?class=interactive&rate=0.9")
+            assert resp.status_code == 200
+        await c.mgr.poll_once()
+        assert c.mgr.burn_demoted() == {"r0"}
+        assert ROUTER_REPLICA_BURN.value_of(
+            replica="r0", slo_class="interactive") == pytest.approx(0.9)
+        # every placement now ranks r0 last; the demotion is counted
+        before = ROUTER_BURN_DEMOTIONS.value_of(replica="r0")
+        for i in range(12):
+            key = affinity.conversation_key({"messages": _conv(200 + i)},
+                                            c.cfg.affinity_chunk)
+            _, candidates = c.mgr.placement(key)
+            assert candidates[-1] == "r0"
+        assert ROUTER_BURN_DEMOTIONS.value_of(replica="r0") == before + 12
+        # membership untouched: r0 is still in the ring, still primary
+        # for its key ranges
+        assert "r0" in c.mgr.ring
+        # requests route to the healthy sibling
+        r = await c.chat(_conv(201))
+        assert r.headers["x-routed-to"] == "r1"
+        # burn below threshold → back to normal placement
+        async with httpx.AsyncClient() as direct:
+            await direct.post(
+                f"{c.urls[0]}/admin/burn?class=interactive&rate=0.1")
+        await c.mgr.poll_once()
+        assert c.mgr.burn_demoted() == set()
+
+
+async def test_burn_demotion_fails_open_on_stale_telemetry():
+    async with _Cluster(2, burn_threshold=0.5,
+                        telemetry_max_age=0.05) as c:
+        async with httpx.AsyncClient() as direct:
+            await direct.post(
+                f"{c.urls[0]}/admin/burn?class=interactive&rate=0.9")
+        await c.mgr.poll_once()
+        assert c.mgr.burn_demoted() == {"r0"}
+        # telemetry ages out → the demotion evaporates (fail-open), even
+        # though the replica is still burning
+        await asyncio.sleep(0.08)
+        assert c.mgr.burn_demoted() == set()
+        key = affinity.conversation_key({"messages": _conv(300)},
+                                        c.cfg.affinity_chunk)
+        _, candidates = c.mgr.placement(key)
+        assert sorted(candidates) == ["r0", "r1"]
+        # threshold <= 0 disables demotion outright
+        c.mgr.burn_threshold = 0.0
+        await c.mgr.poll_once()
+        assert c.mgr.burn_demoted() == set()
+
+
+# ---- fleet timeline ---------------------------------------------------------
+
+
+async def test_fleet_timeline_aligns_skewed_clocks():
+    """Two replicas with multi-second clock skews: after the router's
+    offset correction, one request's router event and its serving
+    replica's dispatch/reap land within a real-request's duration of
+    each other — and every trace-id's replica events sit between no
+    earlier than its route decision minus an RTT."""
+    skews = [{"clock_skew": 5.0}, {"clock_skew": -3.0}]
+    async with _Cluster(2, state_kw=skews) as c:
+        await c.mgr.poll_once()
+        for name, skew in (("r0", 5.0), ("r1", -3.0)):
+            offset = c.mgr.telemetry.offset(name)
+            assert offset == pytest.approx(-skew, abs=0.5), name
+        rids = []
+        for i in range(6):
+            r = await c.chat(_conv(400 + i))
+            rids.append(r.headers["x-request-id"])
+        resp = await c.client.get("/debug/fleet/timeline")
+        assert resp.status_code == 200
+        body = resp.json()
+        assert body["clock"] == "router perf_counter"
+        by_name = {row["name"]: row for row in body["replicas"]}
+        assert by_name["r0"]["clock_aligned"] is True
+        events = body["events"]
+        assert events == sorted(events, key=lambda e: e.get("t", 0.0))
+        for rid in rids:
+            mine = _events_for(rid, events)
+            procs = {ev["process"] for ev in mine}
+            assert "router" in procs and len(procs) == 2, rid
+            # aligned: all of one request's events within a second,
+            # despite ±5 s of raw skew
+            stamps = [ev["t"] for ev in mine]
+            assert max(stamps) - min(stamps) < 1.0, rid
+            route = [ev for ev in mine if ev["kind"] == "router-route"]
+            reap = [ev for ev in mine if ev["kind"] == "reap"]
+            assert route and reap
+            assert reap[0]["t_ready"] >= reap[0]["t_issue"]
+        # perfetto export: one process per tier member, rid in args
+        resp = await c.client.get("/debug/fleet/timeline?format=perfetto")
+        trace = resp.json()
+        names = {m["args"]["name"] for m in trace["traceEvents"]
+                 if m.get("ph") == "M" and m["name"] == "process_name"}
+        assert names == {"router", "r0", "r1"}
+        assert any(ev.get("args", {}).get("rid") == rids[0]
+                   for ev in trace["traceEvents"])
+        bad = await c.client.get("/debug/fleet/timeline?format=nope")
+        assert bad.status_code == 400
+
+
+async def test_router_timeline_endpoint():
+    async with _Cluster(2) as c:
+        r = await c.chat(_conv(500))
+        rid = r.headers["x-request-id"]
+        resp = await c.client.get("/debug/router/timeline")
+        body = resp.json()
+        assert body["clock"] == "perf_counter"
+        assert body["capacity"] >= 16
+        assert _events_for(rid, body["events"])
+        pf = (await c.client.get(
+            "/debug/router/timeline?format=perfetto")).json()
+        assert pf["displayTimeUnit"] == "ms"
+        assert (await c.client.get(
+            "/debug/router/timeline?format=bogus")).status_code == 400
+
+
+def test_merged_trace_events_applies_offsets():
+    groups = [
+        ("router", [{"t": 10.0, "kind": "router-route", "rid": "t1",
+                     "loop": "router"}], 0.0),
+        ("r0", [{"t": 14.0, "kind": "reap", "rid": "t1", "engine": "r0",
+                 "loop": "decode", "t_issue": 13.5, "t_ready": 14.0,
+                 "family": "fake"}], -3.4),
+    ]
+    out = merged_trace_events(groups)
+    slices = [ev for ev in out if ev.get("ph") == "X"]
+    instants = [ev for ev in out if ev.get("ph") == "i"]
+    assert len(slices) == 1 and len(instants) == 1
+    # offsets land both events on one timebase (µs)
+    assert instants[0]["ts"] == pytest.approx(10.0 * 1e6)
+    assert slices[0]["ts"] == pytest.approx((13.5 - 3.4) * 1e6)
+    assert slices[0]["dur"] == pytest.approx(0.5 * 1e6)
+    assert slices[0]["args"]["rid"] == "t1"
+    procs = {m["args"]["name"] for m in out
+             if m.get("ph") == "M" and m["name"] == "process_name"}
+    assert procs == {"router", "r0"}
+    # malformed events are skipped, never a crash
+    assert merged_trace_events(
+        [("x", [{"t": "bad", "kind": "k"}, "junk"], 0.0)])
+
+
+# ---- replica-tier surfaces --------------------------------------------------
+
+
+async def test_fake_replica_telemetry_shape():
+    async with _Cluster(1) as c:
+        async with httpx.AsyncClient() as direct:
+            body = (await direct.get(
+                f"{c.urls[0]}/debug/telemetry")).json()
+            assert isinstance(body["clock"], float)
+            assert body["status"] == "healthy"
+            assert body["slo"] == {} and body["queue_depth"] == 0
+            assert "prefix_store_bytes" in body
+            # bad burn knob → 400
+            r = await direct.post(f"{c.urls[0]}/admin/burn?rate=lots")
+            assert r.status_code == 400
+
+
+def test_server_telemetry_and_traceparent(monkeypatch):
+    """The real server tier: /debug/telemetry serves the snapshot shape
+    and /chat/completions accepts + echoes traceparent (header and body
+    knob), with the trace carrying the trace-id."""
+    from quorum_tpu.backends.fake import FakeBackend
+    from tests.conftest import make_client
+
+    async def run():
+        config = {"settings": {"timeout": 5},
+                  "primary_backends": [
+                      {"name": "F", "url": "http://f.example/v1",
+                       "model": "f"}]}
+        async with make_client(config,
+                               F=FakeBackend("F", text="x")) as client:
+            body = (await client.get("/debug/telemetry")).json()
+            assert "clock" in body and "slo" in body
+            assert body["status"] in ("healthy", "degraded", "unhealthy")
+            tid = tracecontext.new_trace_id()
+            header = tracecontext.format_traceparent(tid, "ef" * 8)
+            r = await client.post(
+                "/chat/completions",
+                json={"model": "f",
+                      "messages": [{"role": "user", "content": "hi"}]},
+                headers={"Authorization": "Bearer k",
+                         "traceparent": header})
+            assert r.status_code == 200
+            got, _ = tracecontext.parse_traceparent(
+                r.headers["traceparent"])
+            assert got == tid
+            # body knob: consumed (never forwarded) and honored
+            tid2 = tracecontext.new_trace_id()
+            r = await client.post(
+                "/chat/completions",
+                json={"model": "f", "traceparent":
+                      tracecontext.format_traceparent(tid2, "ab" * 8),
+                      "messages": [{"role": "user", "content": "hi"}]},
+                headers={"Authorization": "Bearer k"})
+            assert r.status_code == 200
+            got2, _ = tracecontext.parse_traceparent(
+                r.headers["traceparent"])
+            assert got2 == tid2
+            # malformed body knob → ONE 400 up front
+            r = await client.post(
+                "/chat/completions",
+                json={"model": "f", "traceparent": "junk",
+                      "messages": [{"role": "user", "content": "hi"}]},
+                headers={"Authorization": "Bearer k"})
+            assert r.status_code == 400
+            assert "traceparent" in r.json()["error"]["message"]
+
+    asyncio.run(run())

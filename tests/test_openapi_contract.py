@@ -53,12 +53,19 @@ def check(name: str, instance) -> None:
 
 def test_document_paths_match_served_routes():
     """The doc's path set IS the served surface (each under both the ""
-    and "/v1" servers — app.py registers both prefixes)."""
-    assert set(DOC["paths"]) == {
+    and "/v1" servers — app.py registers both prefixes). Paths flagged
+    ``x-router-only: true`` are served by the router process
+    (quorum_tpu/router/app.py), not by replicas — the replica partition
+    below is what a serving replica exposes."""
+    router_only = {p for p, item in DOC["paths"].items()
+                   if item.get("x-router-only")}
+    assert router_only == {"/debug/router/timeline",
+                           "/debug/fleet/timeline"}
+    assert set(DOC["paths"]) - router_only == {
         "/chat/completions", "/completions", "/embeddings", "/health",
         "/ready", "/models", "/metrics", "/debug/traces",
         "/debug/traces/{request_id}", "/debug/engine/timeline",
-        "/debug/prefix/chunks", "/debug/profile"}
+        "/debug/prefix/chunks", "/debug/profile", "/debug/telemetry"}
     assert [s["url"] for s in DOC["servers"]] == ["/", "/v1"]
     post = DOC["paths"]["/chat/completions"]["post"]
     assert set(post["responses"]) == {
@@ -203,6 +210,9 @@ async def test_live_aux_endpoints_conform():
         bad_fmt = await client.get("/debug/engine/timeline?format=nope")
         assert bad_fmt.status_code == 400
         check("ErrorResponse", bad_fmt.json())
+        telemetry = await client.get("/debug/telemetry")
+        assert telemetry.status_code == 200
+        check("TelemetrySnapshot", telemetry.json())
         # On-demand profile: a tiny capture conforms; out-of-range 400s;
         # a concurrent request hits the single-flight 409 (exercised via
         # the shared profiler lock in tests/test_telemetry.py).
