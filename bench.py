@@ -856,6 +856,32 @@ def run_spec_phase(budget: int = 900) -> dict:
     return {k: got[k] for k in keep if k in got}
 
 
+def run_paged_phase(budget: int = 900) -> dict:
+    """Paged-KV rows-per-chip A/B (ISSUE 17, docs/tpu_backends.md): peak
+    concurrently-resident rows dense vs ``kv_pages=1`` at a FIXED cache
+    position budget on a short-stream mix, tokens asserted identical —
+    scripts/hostpath_bench.py's measurement, run in a SUBPROCESS (fresh
+    engines, no program-cache bleed). Gate with
+    ``QUORUM_TPU_BENCH_PAGED=0``."""
+    if os.environ.get("QUORUM_TPU_BENCH_PAGED", "1") == "0":
+        return {}
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    script = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "scripts", "hostpath_bench.py")
+    got = _run_json_subprocess(
+        [sys.executable, script, "--only-paged"], "paged", budget, env)
+    keep = ("paged_streams", "paged_pool_pages", "paged_page_size",
+            "paged_dense_rows", "paged_dense_peak_rows",
+            "paged_paged_peak_rows", "paged_dense_completed",
+            "paged_paged_completed", "paged_dense_wall_s",
+            "paged_paged_wall_s", "paged_peak_page_occupancy",
+            "paged_rows_per_chip_ratio", "paged_tokens_match",
+            "paged_error")
+    return {k: got[k] for k in keep if k in got}
+
+
 def _last_json_line(stdout: "str | None") -> "dict | None":
     """Latest parseable JSON object line. Malformed brace-prefixed lines are
     skipped, not fatal: a timed-out child's captured stdout can end mid-line,
@@ -1268,6 +1294,9 @@ async def main() -> None:
         # Speculative-decoding A/B (ISSUE 10): acceptance / tok-s /
         # dispatch counts spec on vs off, repetitive + constrained legs.
         b7.update(run_spec_phase())
+        # Paged-KV rows-per-chip A/B (ISSUE 17): dense vs kv_pages=1 at a
+        # fixed cache position budget on a short-stream mix.
+        b7.update(run_paged_phase())
         await phase12_main(b7)
         return
 

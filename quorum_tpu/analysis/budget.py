@@ -58,6 +58,25 @@ def classify_decode_key(key) -> str:
             else:
                 fam = "pp_plain"
             return _check_len("decode_cache", fam, key)
+        if key[0] == "paged":
+            # Paged-KV decode variants (kv_pages=1): the dense key with a
+            # leading "paged" tag — table-gather attention can never share
+            # a compiled program with its rectangular twin. pp and
+            # spec_model are rejected under kv_pages, so the paged families
+            # are exactly the non-pp, non-spec_loop dense set.
+            rest = key[1:]
+            if rest and rest[0] == "loop":
+                fam = ("paged_loop_dfa" if len(rest) > 2 and rest[2] == "dfa"
+                       else "paged_loop")
+            elif rest and rest[0] in ("dfa", "verify", "dfa_verify"):
+                fam = "paged_" + rest[0]
+            elif rest and all(isinstance(x, (int, bool)) for x in rest):
+                fam = "paged_plain"
+            else:
+                raise UnbudgetedProgramKey(
+                    f"decode_cache key {key!r} has the 'paged' tag but "
+                    "matches no paged family")
+            return _check_len("decode_cache", fam, key)
         if key[0] == "loop":
             fam = "loop_dfa" if len(key) > 2 and key[2] == "dfa" else "loop"
             return _check_len("decode_cache", fam, key)

@@ -41,6 +41,26 @@ URL grammar:  ``tpu://<model-id>?<spec overrides>&<engine options>``
                    (prefill_chunk >= 16); does not compose with disagg=
                    (zero-drain is structural there). See
                    docs/tpu_backends.md for the interaction matrix
+  kv_pages=0|1     paged KV slot memory (default 0): the dense
+                   [n_slots, max_seq] cache rectangle becomes a page pool
+                   + per-row page table — rows hold pages proportional to
+                   their actual length, so short-stream mixes fit many
+                   more concurrent rows in the same HBM, and tier-0
+                   prefix reuse becomes refcounted page ALIASING
+                   (copy-on-write boundary page) instead of byte copies.
+                   Admission reserves a row's full span up front: pool
+                   exhaustion sheds at admission (503 + Retry-After),
+                   never mid-stream. Structural (part of the engine cache
+                   key); composes with kv_quant=int8, members=M, tp= and
+                   prompt-lookup spec_decode; rejected with pp>1,
+                   ensemble>1, sp>1 and draft-model speculation. See
+                   docs/tpu_backends.md for the interaction matrix
+  kv_page_size=    tokens per KV page (default: prefill_chunk, else
+                   min(64, max_seq)); power of two dividing max_seq
+  kv_pool_pages=   physical pages in the pool (default:
+                   n_slots × max_seq / page_size — the dense
+                   rectangle's worth; set lower to oversubscribe slots
+                   against actual lengths)
   tp=, dp=, sp=    mesh shape (default: single device); sp>1 runs admission
   sp_impl=         sp>1 attention strategy: "ring" (default — O(S/sp)
                    memory, KV blocks ppermute the ICI ring) or "ulysses"
@@ -552,6 +572,14 @@ class TpuBackend:
                 "prefix_cache", opts.get("prefix_cache", "1")),
             ensemble=int(opts.get("ensemble", 1)),
             sp_impl=opts.get("sp_impl", "ring"),
+            # Paged KV slot memory (structural: part of the engine cache
+            # key — a dense URL never shares a paged engine). Geometry
+            # validation (power-of-two page size dividing max_seq, pool
+            # floor) lives in the engine, which knows the resolved spec.
+            kv_pages=_parse_bool_opt(
+                "kv_pages", opts.get("kv_pages", "0")),
+            kv_page_size=int(opts.get("kv_page_size", 0)),
+            kv_pool_pages=int(opts.get("kv_pool_pages", 0)),
         )
         store = str(opts.get("prefix_store", "")).strip().lower()
         if store in ("", "0", "none", "off"):

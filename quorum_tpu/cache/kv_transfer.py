@@ -37,15 +37,35 @@ import numpy as np
 from jax import lax
 
 from quorum_tpu import observability as obs
+from quorum_tpu.cache.paging import (
+    kv_is_paged,
+    paged_slice_rows,
+    paged_write_rows,
+)
 
 logger = logging.getLogger(__name__)
+
+
+def _any_paged(cache) -> bool:
+    return (kv_is_paged(cache)
+            or (isinstance(cache, tuple)
+                and any(kv_is_paged(c) for c in cache)))
 
 
 def slice_rows(cache, row, start, n: int, *, stacked: bool, n_slots: int):
     """Slice ``n`` cache positions of flat row ``row`` starting at ``start``
     out of a cache pytree (pure; call under jit). Returns the chunk pytree
     in the ``[L, K, n, …]`` wire layout. Non-donating by design — snapshot
-    and handoff both READ a live cache."""
+    and handoff both READ a live cache. Paged caches (``PagedKV`` sides)
+    gather through the page table into the SAME wire layout, so every
+    consumer — snapshot, restore, handoff — is layout-blind."""
+    if _any_paged(cache):
+        def take_paged(c):
+            return paged_slice_rows(c, row, start, n,
+                                    stacked=stacked, n_slots=n_slots)
+        if kv_is_paged(cache):
+            return take_paged(cache)
+        return tuple(take_paged(c) for c in cache)
 
     def take(a):
         if stacked:
@@ -64,7 +84,15 @@ def write_rows(cache, chunk, row, start, *, stacked: bool, n_slots: int):
     """Write a ``[L, K, n, …]`` chunk pytree into positions
     [start, start+n) of flat row ``row`` (pure; call under jit with the
     cache donated — the restore/handoff write is a cache mutation like any
-    other)."""
+    other). Paged caches scatter through the page table (the row's pages
+    must be reserved — admission pre-reserves the full span)."""
+    if _any_paged(cache):
+        def put_paged(c, h):
+            return paged_write_rows(c, h, row, start,
+                                    stacked=stacked, n_slots=n_slots)
+        if kv_is_paged(cache):
+            return put_paged(cache, chunk)
+        return tuple(put_paged(c, h) for c, h in zip(cache, chunk))
 
     def put(a, h):
         if stacked:

@@ -124,6 +124,13 @@ from quorum_tpu.breaker import (  # noqa: F401  (constants re-exported)
 from quorum_tpu.telemetry.latency import LatencyModel
 from quorum_tpu.telemetry.recorder import RECORDER as FLIGHT
 from quorum_tpu.cache import kv_transfer
+from quorum_tpu.cache.paging import (
+    PageAllocator,
+    PagedKV,
+    init_paged_cache,
+    paged_copy_page,
+    validate_page_config,
+)
 from quorum_tpu.cache.prefix_store import (
     DEFAULT_PREFIX_STORE_BYTES,
     PrefixStore,
@@ -147,7 +154,11 @@ from quorum_tpu.ops.sampling import (
     sample_token_rows,
 )
 from quorum_tpu.parallel.mesh import single_device_mesh
-from quorum_tpu.parallel.sharding import kv_cache_sharding, shard_pytree
+from quorum_tpu.parallel.sharding import (
+    kv_cache_sharding,
+    paged_kv_sharding,
+    shard_pytree,
+)
 
 enable_persistent_compile_cache()  # restart compiles become disk reads
 compile_watch.install()  # count XLA compiles (quorum_tpu_recompiles_total)
@@ -856,6 +867,24 @@ _GUARDED_BY = {
     # racing double-classify writes the same value).
     "_dispatch_seq": {"owner": ["_next_seq"]},
     "_family_cache": {"owner": ["_family_of"]},
+    # paged KV bookkeeping (kv_pages=1): the refcounted allocator, the
+    # host page-table mirror + its dirty flag, and the per-slot-group
+    # claim counts all mutate under the scheduler lock (submit shed /
+    # prefill-loop reservation / decode-loop release all touch them);
+    # the device UPLOAD of the mirror happens outside the lock on the
+    # thread that owns the decode cache (_paged_sync_table).
+    # The _paged_* helpers are documented "caller holds _cond" (claim /
+    # reclaim / release run inside the callers' lock scopes);
+    # _init_device_state rebuilds everything before any thread can race.
+    "_page_alloc": {"lock": "_cond"},
+    "_table_np": {"lock": "_cond", "holders": [
+        "_init_device_state", "_paged_reclaim", "_paged_claim",
+        "_paged_release_row"]},
+    "_table_dirty": {"lock": "_cond", "holders": [
+        "_init_device_state", "_paged_reclaim", "_paged_claim",
+        "_paged_release_row"]},
+    "_page_claims": {"lock": "_cond", "holders": [
+        "_init_device_state", "_paged_claim", "_paged_release_row"]},
 }
 
 
@@ -899,6 +928,9 @@ class InferenceEngine:
         prefill_mesh: Mesh | None = None,
         transfer_guard: str | None = None,
         zero_drain: bool = False,
+        kv_pages: bool = False,
+        kv_page_size: int = 0,
+        kv_pool_pages: int = 0,
     ):
         self.spec = spec.validate()
         self.mesh = mesh or single_device_mesh()
@@ -1204,6 +1236,69 @@ class InferenceEngine:
                     "(ring attention inside the member vmap)")
             if params is not None:
                 raise ValueError(_CKPT_MEMBERS_ERROR)
+        # Paged KV slot memory (tpu://…&kv_pages=1, docs/tpu_backends.md):
+        # the dense [L, n_slots, K, max_seq, hd] rectangle becomes a page
+        # pool [L, P, K, page_size, hd] plus a per-row on-device page table
+        # — rows allocate pages only as they grow, so slot count is no
+        # longer pinned by the worst-case sequence, and tier-0 prefix reuse
+        # becomes page ALIASING (refcounted, copy-on-write boundary page)
+        # instead of byte copies. The page table is host-owned
+        # (self._table_np, scheduler thread) and uploaded whole at
+        # admission/release boundaries — never inside the decode hot loop.
+        self.kv_pages = bool(kv_pages)
+        self.kv_page_size = 0
+        self.kv_pool_pages = 0
+        self._page_alloc: PageAllocator | None = None
+        if self.kv_pages:
+            if self.decode_pp > 1:
+                raise ValueError(
+                    "kv_pages=1 does not compose with pp>1: the staged "
+                    "decode schedule shards the cache's layer axis across "
+                    "stages, and the page pool's layer axis would need a "
+                    "per-stage page table — drop one knob")
+            if self.ensemble > 1:
+                raise ValueError(
+                    "kv_pages=1 does not compose with ensemble>1: the "
+                    "consensus decode averages logits inside a program "
+                    "that assumes one shared history window per row — "
+                    "stacked members=M compose; ensemble does not (yet)")
+            if draft_spec is not None:
+                raise ValueError(
+                    "kv_pages=1 does not compose with a draft model "
+                    "(spec_model=/spec_ckpt=): the draft runtime keeps its "
+                    "own dense cache and the fused draft→verify scan would "
+                    "mix layouts in one program — prompt-lookup "
+                    "spec_decode composes")
+            if self._use_sp:
+                raise ValueError(
+                    "kv_pages=1 does not compose with sp>1: ring attention "
+                    "shards the position axis, which the page-table "
+                    "indirection scatters — drop one knob")
+            ps = int(kv_page_size)
+            if not ps:
+                ps = self.prefill_chunk or min(64, self.spec.max_seq)
+            validate_page_config(self.spec.max_seq, ps)
+            self.kv_page_size = ps
+            mp = self.spec.max_seq // ps
+            n_data = int(kv_pool_pages) or self.n_slots * mp
+            if n_data < 1:
+                raise ValueError(
+                    f"kv_pool_pages={kv_pool_pages} must be >= 1")
+            self.kv_pool_pages = n_data
+            # Host-side page accounting (scheduler thread): refcounted
+            # allocator + retained-chain LRU, and the [n_slots, max_pages]
+            # page-table mirror uploaded to device on change.
+            self._page_alloc = PageAllocator(n_data, ps)
+            self._table_np = np.zeros((self.n_slots, mp), np.int32)
+            # Live-claim count per SLOT GROUP (s = flat_row % n_slots). On a
+            # stacked engine the M member copies of slot s share ONE page
+            # chain — page ids index each member's own pool copy, so the
+            # same chain addresses M independent streams; the chain releases
+            # when the last member's claim drops.
+            self._page_claims = [0] * self.n_slots
+            self._table_dirty = False
+            self.kv_page_alias_hits = 0
+            self.kv_page_cow_copies = 0
         # Automatic prefix caching (zero-copy): each slot remembers the token
         # sequence whose K/V its cache rows still hold; a new request admits
         # into the free slot with the longest common prefix and prefills only
@@ -1306,6 +1401,22 @@ class InferenceEngine:
             if self.disagg else (self.params if self.zero_drain else None))
         self._cache_sh = self._cache_sharding(self.mesh)
         self._rep = NamedSharding(self.mesh, P())
+        # Host-side wire-format contract (prefix-store snapshot/restore and
+        # cross-replica chunk import): the chunk pytree STRUCTURE and the
+        # per-leaf (shape-sans-position-axis, dtype) specs, derived from the
+        # model spec rather than the live cache — under kv_pages the cache
+        # pytree is pool+table, not the [L, K, n, …] wire layout the store
+        # speaks (kv_transfer's paged arms gather/scatter to/from the same
+        # wire format, so everything downstream stays layout-blind).
+        _L, _K, _hd = (self.spec.n_layers, self.spec.n_kv_heads,
+                       self.spec.head_dim)
+        if self.kv_quant:
+            self._wire_leaf = [((_L, _K, _hd), np.dtype(np.int8)),
+                               ((_L, _K), np.dtype(np.float32))] * 2
+            self._wire_def = jax.tree.structure(((0, 1), (2, 3)))
+        else:
+            self._wire_leaf = [((_L, _K, _hd), jnp.dtype(self.spec.dtype))] * 2
+            self._wire_def = jax.tree.structure((0, 1))
         # Cached jit wrappers for the rebuild-path utility programs (the
         # zero-fills): a fresh jax.jit per failure-containment rebuild
         # would recompile them (qlint: recompile/jit-immediate-call).
@@ -1319,9 +1430,17 @@ class InferenceEngine:
             # to the decode group's layout on the fly). Zero-drain: same
             # slot-batched layout on the decode mesh itself — reusing
             # _cache_sh keeps one compiled zero-fill program.
+            # Staging caches stay DENSE rectangles even under kv_pages=1:
+            # segment programs write sequential positions of one slot, where
+            # the rectangle is already tight, and the handoff wire format is
+            # layout-blind — paging pays off only in the long-lived decode
+            # cache where rows of wildly different lengths coexist.
             self._stage_sh = (
-                self._cache_sharding(self.prefill_mesh, seq_shard=True)
-                if self.disagg else self._cache_sh)
+                self._cache_sharding(self.prefill_mesh, seq_shard=True,
+                                     paged=False)
+                if self.disagg
+                else (self._cache_sharding(self.mesh, paged=False)
+                      if self.kv_pages else self._cache_sh))
             self._init_stage_state()
         # Handoff queue between the two scheduler loops (disagg): the
         # prefill loop appends transferred KV pieces (already resident on
@@ -1501,13 +1620,14 @@ class InferenceEngine:
                 spec, mesh, [seed + i for i in range(stacked)],
                 quant=self.quant)
         if params is not None:
-            out = shard_pytree(mesh, params)
+            out = shard_pytree(mesh, params, n_kv_heads=spec.n_kv_heads)
             if self.quant == "int8":
                 # Requantize in place: inputs donated, each bf16 leaf's
                 # buffer dies at its quantize op (models/quant.py).
                 from quorum_tpu.models.quant import quantize_params_sharded
 
-                out = quantize_params_sharded(out, mesh)
+                out = quantize_params_sharded(
+                    out, mesh, n_kv_heads=spec.n_kv_heads)
             return out
         if self.quant == "int8":
             # Init + quantize fused in one program: the bf16 weights are
@@ -1522,14 +1642,37 @@ class InferenceEngine:
         # bf16 weights alone are ~14 GB of a v5e's 16 GB HBM).
         return init_params_sharded(spec, mesh, seed)
 
-    def _cache_sharding(self, mesh: Mesh, seq_shard: bool = False):
+    def _cache_sharding(self, mesh: Mesh, seq_shard: bool = False,
+                        paged: bool | None = None):
         """Slot-cache sharding for one device group — the decode mesh's
         slot cache and the prefill mesh's staging cache share one chunk
         WIRE format even when their physical layouts differ (per-group
         ``tp=``, an sp-sharded staging cache, a pp-staged decode cache:
         the handoff reshards on the fly, kv_transfer route="reshard").
         ``seq_shard`` shards the position axis over the mesh's sp axis —
-        the disagg prefill group's staging cache under ``sp>1``."""
+        the disagg prefill group's staging cache under ``sp>1``.
+        ``paged`` selects the page-pool layout (defaults to the engine's
+        ``kv_pages``); staging caches pass ``paged=False`` — they stay
+        dense rectangles, the wire format is layout-blind either way."""
+        if paged is None:
+            paged = self.kv_pages
+        if paged:
+            # Page pool [L, P, K, ps, hd]: page axis never shards (a row's
+            # chain scatters across it); table replicated — it's tiny
+            # ([S, max_pages] int32) and every device gathers through it.
+            pool_sh = paged_kv_sharding(mesh, self.spec.n_kv_heads)
+            if self.kv_quant:
+                # (values, scales): the scale array drops head_dim.
+                pool_sh = (pool_sh,
+                           NamedSharding(mesh, P(*tuple(pool_sh.spec)[:4])))
+            table_sh = NamedSharding(mesh, P())
+            sh = PagedKV(pool_sh, table_sh)
+            if self.members > 1:
+                sh = jax.tree.map(
+                    lambda s: NamedSharding(
+                        mesh, P(*((None,) + tuple(s.spec)))),
+                    sh, is_leaf=lambda x: isinstance(x, NamedSharding))
+            return sh
         sh = kv_cache_sharding(mesh, self.spec.n_kv_heads,
                                batch=self.n_slots, seq_shard=seq_shard)
         if self.kv_quant:
@@ -1554,6 +1697,14 @@ class InferenceEngine:
         materialization or transfer of the multi-GB buffer.
         """
         self._ck, self._cv = self._zero_cache(self._cache_sh)
+        if self.kv_pages:
+            # The zero-fill points every table entry at the sink page: all
+            # host page accounting restarts from empty (rebuilds drop every
+            # slot, so no chain survives to re-adopt).
+            self._page_alloc.reset()
+            self._table_np[:] = 0
+            self._page_claims = [0] * self.n_slots
+            self._table_dirty = False
         s = self._rows
         rep = self._rep
         self._token = jax.device_put(np.zeros((s,), np.int32), rep)
@@ -1600,8 +1751,24 @@ class InferenceEngine:
     def _zero_cache(self, shardings):
         """Compiled zero-fill of one slot-batched cache onto ``shardings``
         — no host-side materialization or transfer of the multi-GB buffer.
-        Used for the decode cache and (under disagg) the staging cache."""
+        Used for the decode cache and (under disagg) the staging cache.
+        A PagedKV sharding tree selects the page-pool layout instead —
+        staging caches always pass the dense shardings."""
         stacked = max(self.ensemble, self.members)
+        if isinstance(shardings, PagedKV):
+            def zero_paged():
+                return init_paged_cache(
+                    self.spec, batch=self.n_slots,
+                    n_pages=self.kv_pool_pages,
+                    page_size=self.kv_page_size, kv_quant=self.kv_quant,
+                    members=self.members if self.members > 1 else None)
+
+            key = ("zero_cache", id(shardings))
+            fn = self._util_fns.get(key)
+            if fn is None:
+                fn = self._util_fns[key] = jax.jit(
+                    zero_paged, out_shardings=(shardings, shardings))
+            return fn()
 
         def zero_cache():
             ck, cv = init_cache(self.spec, batch=self.n_slots,
@@ -1633,6 +1800,224 @@ class InferenceEngine:
         staging buffers (:meth:`_contain_prefill_failure`) — decode-group
         state is never touched on that path."""
         self._sck, self._scv = self._zero_cache(self._stage_sh)
+
+    # ---- paged KV bookkeeping (kv_pages=1) --------------------------------
+    #
+    # Host half of the paged layout: admission reserves a row's FULL page
+    # span up front (prompt + budget + spec-decode overshoot), so the
+    # device table for a live row never changes mid-decode and pool
+    # exhaustion sheds at admission instead of OOMing a running stream.
+    # Allocator / mirror mutations run under _cond; the device upload and
+    # the COW boundary-page copies run OUTSIDE the lock on the thread that
+    # owns the decode cache (_paged_install / _paged_sync_table).
+
+    def _paged_note_occupancy(self) -> None:
+        """Refresh the pool-occupancy gauges after an allocator mutation
+        (claim / release / reclaim). Last-writer-wins across engines
+        sharing the process, like the other engine gauges."""
+        a = self._page_alloc
+        obs.KV_PAGES_ALLOCATED.set(a.allocated_pages)
+        obs.KV_PAGES_FREE.set(a.free_pages)
+
+    def _paged_need(self, n_prompt: int, budget: int) -> int:
+        """Pages covering every position a request could ever write:
+        prompt, generation budget, plus the speculative-verify overshoot
+        (a verify turn writes up to spec_decode+1 positions past the
+        accepted length before the rollback masks them)."""
+        need_t = min(self.spec.max_seq,
+                     n_prompt + budget + self.spec_decode + 1)
+        return self._page_alloc.pages_for(need_t)
+
+    def _paged_fits(self, row: int, req: "_Request") -> bool:
+        """Whether a claim of ``row`` for ``req`` can succeed after LRU
+        reclaim — the admission head-of-line check (caller holds _cond).
+        Conservative: ignores prefix sharing, which only lowers the fresh
+        page count."""
+        a = self._page_alloc
+        sg = row % self.n_slots
+        n_need = self._paged_need(len(req.prompt_ids), req.budget)
+        if self._page_claims[sg]:
+            chain = a.chain(sg) or []
+            n_need -= len(chain)
+        return (n_need <= a.free_pages
+                + a.reclaimable_pages(protect=(sg,)))
+
+    def _paged_reclaim(self, n: int, protect=()) -> bool:
+        """Evict least-recently-retained chains until ``n`` pages are free
+        (caller holds _cond). Evicted rows lose their advertised resident
+        prefix — the KV bytes are gone, so a tier-0 hit on them would
+        splice garbage."""
+        a = self._page_alloc
+        while a.free_pages < n:
+            victim = a.evict_lru(protect=protect)
+            if victim is None:
+                return False
+            if not self._page_claims[victim]:
+                for m in range(self.members):
+                    self._resident[m * self.n_slots + victim] = []
+                self._table_np[victim, :] = 0
+            self._table_dirty = True
+        return True
+
+    def _paged_claim(self, row: int, req: "_Request", reuse: int):
+        """Reserve flat row ``row``'s full page span for ``req`` (caller
+        holds _cond). Returns ``(reuse, cow_pairs)`` — the possibly-clamped
+        tier-0 reuse length and the boundary-page copy-on-write (dst, src)
+        pairs ``_paged_install`` must run before the admission's first
+        segment — or None when the pool can't cover the span even after
+        reclaim (the admission waits).
+
+        Tier-0 reuse SHARES the slot's retained chain (refcount bump; the
+        donor entry stays, so N requests forking one prefix each alias the
+        same pages); a partially-filled boundary page is replaced by a COW
+        copy so the new tenant's suffix writes never leak into the shared
+        original. On stacked engines (members>1) reuse is forced to 0: the
+        M member copies of a slot group share one chain, and per-member
+        content lineage across re-claims isn't tracked — correctness over
+        aliasing there."""
+        a = self._page_alloc
+        sg = row % self.n_slots
+        ps = self.kv_page_size
+        n_need = self._paged_need(len(req.prompt_ids), req.budget)
+        cow: list[tuple[int, int]] = []
+        if self.members > 1:
+            reuse = 0
+        if self._page_claims[sg]:
+            # Co-tenant (stacked engines): the slot group's chain is live
+            # in every member's pool copy — extend it if this member needs
+            # more pages; appending never disturbs existing entries.
+            chain = a.chain(sg) or []
+            extra = n_need - len(chain)
+            if extra > 0:
+                if not self._paged_reclaim(extra, protect=(sg,)):
+                    return None
+                fresh = a.alloc(extra)
+                if fresh is None:  # pragma: no cover - reclaim guarantees
+                    return None
+                base = len(chain)
+                a.extend(sg, fresh)
+                self._table_np[sg, base:base + extra] = fresh
+                self._table_dirty = True
+            self._page_claims[sg] += 1
+            self._paged_note_occupancy()
+            return 0, cow
+        held = a.retained_chain(sg)
+        if reuse and (held is None or len(held) * ps < reuse):
+            reuse = 0
+        p_keep = a.pages_for(reuse)
+        partial = bool(reuse % ps)
+        n_new = n_need - p_keep + (1 if partial else 0)
+        fresh: list[int] = []
+        if n_new > 0:
+            if not self._paged_reclaim(n_new, protect=(sg,)):
+                return None
+            got = a.alloc(n_new)
+            if got is None:  # pragma: no cover - reclaim guarantees
+                return None
+            fresh = got
+        keep = a.share(held[:p_keep]) if p_keep else []
+        a.touch(sg)
+        if partial:
+            # The boundary page is only partially reused: the tenant's
+            # suffix writes land inside it, so it must be a private copy.
+            repl = fresh.pop()
+            cow.append((repl, keep[-1]))
+            a.free([keep[-1]])
+            keep[-1] = repl
+        chain = keep + fresh
+        a.assign(sg, chain)
+        self._table_np[sg, :] = 0
+        self._table_np[sg, :len(chain)] = chain
+        self._table_dirty = True
+        self._page_claims[sg] = 1
+        if reuse:
+            self.kv_page_alias_hits += 1
+            obs.KV_PAGE_ALIAS_HITS.inc()
+        self._paged_note_occupancy()
+        return reuse, cow
+
+    def _paged_release_row(self, row: int) -> None:
+        """Drop one live claim on ``row``'s slot group (caller holds _cond);
+        when the last claim goes, retain the chain prefix covering the
+        resident tokens as a prefix-reuse donor (MRU end of the LRU) and
+        zero the mirror's tail. No-op on dense engines."""
+        if not self.kv_pages:
+            return
+        a = self._page_alloc
+        sg = row % self.n_slots
+        if not self._page_claims[sg]:
+            return
+        self._page_claims[sg] -= 1
+        if self._page_claims[sg]:
+            return
+        keep = (0 if self.members > 1 else len(self._resident[sg]))
+        chain = a.chain(sg) or []
+        a.release(sg, keep_tokens=keep)
+        kept = min(a.pages_for(keep), len(chain))
+        if len(chain) > kept:
+            self._table_np[sg, kept:len(chain)] = 0
+            self._table_dirty = True
+        self._paged_note_occupancy()
+
+    def _page_copy_fn(self):
+        """Jitted physical page copy (all layers/members at once) — the
+        copy-on-write program behind prefix aliasing. One admit-cache
+        entry, key ``("page_copy",)`` (compile-budget family page_copy)."""
+        fn = self._admit_cache.get(("page_copy",))
+        if fn is not None:
+            return fn
+        stacked = self.members > 1
+
+        def cp(ck, cv, dst, src):
+            return (paged_copy_page(ck, dst, src, stacked=stacked),
+                    paged_copy_page(cv, dst, src, stacked=stacked))
+
+        fn = jax.jit(cp, donate_argnames=("ck", "cv"))
+        self._admit_cache[("page_copy",)] = fn
+        return fn
+
+    def _paged_sync_table(self) -> None:
+        """Upload the host page-table mirror into both decode-cache sides
+        when dirty. Runs OUTSIDE _cond on the thread that owns the decode
+        cache (scheduler thread; under disagg the decode loop, from
+        _drain_handoffs before the first paged injection) — never in the
+        decode hot loop. A stale device table is always safe: live rows'
+        entries are immutable mid-decode, and a released row's leftovers
+        are masked dead."""
+        if not self.kv_pages:
+            return
+        with self._cond:
+            if not self._table_dirty:
+                return
+            tab = self._table_np.copy()
+            self._table_dirty = False
+        lead = (((self.members,) if self.members > 1 else ())
+                + (self.spec.n_layers,))
+        full = np.ascontiguousarray(np.broadcast_to(tab, lead + tab.shape))
+        sh = self._cache_sh.table if isinstance(self._cache_sh, PagedKV) \
+            else None
+        # qlint: allow-sync(page-table upload: a few KiB host→device at admission/release boundaries, off the decode hot loop by design)
+        t_k = jax.device_put(full, sh)
+        # qlint: allow-sync(page-table upload: second side — K and V carry separate table buffers so donation stays sound)
+        t_v = jax.device_put(full.copy(), sh)
+        self._ck = PagedKV(self._ck.pool, t_k)
+        self._cv = PagedKV(self._cv.pool, t_v)
+
+    def _paged_install(self, cow) -> None:
+        """Device half of a paged claim: run the COW boundary-page copies,
+        then upload the table mirror — called outside _cond on the
+        decode-cache owner thread, strictly before the admission's first
+        cache write. Data flow orders everything: the admission program
+        consumes both the copied pool and the new table arrays."""
+        for dst, src in cow:
+            t0 = time.perf_counter()
+            self._ck, self._cv = self._page_copy_fn()(
+                self._ck, self._cv, np.int32(dst), np.int32(src))
+            self._observe_device_time("page_copy",
+                                      time.perf_counter() - t0)
+            self.kv_page_cow_copies += 1
+            obs.KV_PAGE_COW_COPIES.inc()
+        self._paged_sync_table()
 
     # ---- compiled programs ------------------------------------------------
 
@@ -2061,13 +2446,13 @@ class InferenceEngine:
             raise ValueError(
                 f"payload chunk_tokens={chunk_tokens} does not match this "
                 f"engine's prefix_store_chunk={c}")
-        # Expected per-leaf chunk spec, from the decode cache's own leaves:
-        # a [L, S, K, T, …] cache leaf snapshots as [L, K, c, …] chunks
-        # (kv_transfer.slice_rows wire layout, position on axis 2).
+        # Expected per-leaf chunk spec from the engine's wire contract:
+        # [L, K, c, …] chunks (kv_transfer.slice_rows wire layout, position
+        # on axis 2) — spec-derived, so dense and paged caches validate the
+        # same format.
         expected = [
-            ((a.shape[0], a.shape[2], c) + tuple(a.shape[4:]),
-             np.dtype(a.dtype))
-            for a in jax.tree.leaves((self._ck, self._cv))
+            (shp[:2] + (c,) + shp[2:], np.dtype(dt))
+            for shp, dt in self._wire_leaf
         ]
         for chain in chains:
             for arrays in chain.payloads:
@@ -2130,8 +2515,7 @@ class InferenceEngine:
                            axis=2)[:, :, slot_reuse - lo * c: r - lo * c]
             for j in range(n_leaves)
         ]
-        host = jax.tree.unflatten(
-            jax.tree.structure((self._ck, self._cv)), cat)
+        host = jax.tree.unflatten(self._wire_def, cat)
         return r, host
 
     def _restore_into(self, slot: int, start: int, n: int, host,
@@ -2277,6 +2661,11 @@ class InferenceEngine:
                 continue
             if kind == "kv":
                 try:
+                    # Paged decode cache: the claim's table entries must be
+                    # on device before this injection scatters through them
+                    # (no-op when clean, and always on THIS loop — the
+                    # decode-cache owner).
+                    self._paged_sync_table()
                     with self._attr_time("hput"):
                         self._ck, self._cv = self._handoff_write_fn(n)(
                             self._ck, self._cv, chunk,
@@ -2356,6 +2745,20 @@ class InferenceEngine:
         FLIGHT.record("stage-admit", rid=req.rid, engine=self._tag,
                       loop="prefill" if self.disagg else "decode",
                       slot=slot, restored=offset)
+        if self.kv_pages:
+            # Reserve the decode slot's page span NOW, host-only (allocator
+            # + mirror under _cond — legal on the prefill thread); the
+            # decode loop uploads the table before the first injection.
+            with self._cond:
+                claim = self._paged_claim(slot, req, 0)
+            if claim is None:
+                # Can't happen after _start_admissions' fits-check (only
+                # this thread claims; other threads only release) — contain
+                # defensively rather than corrupt page accounting.
+                self._contain_prefill_failure(
+                    [req], RuntimeError("kv page claim failed after "
+                                        "passing the fits check"))
+                return
         with self._cond:
             self._claimed.add(slot)
             self._resident[slot] = []
@@ -2613,6 +3016,13 @@ class InferenceEngine:
             base = ("loop", n_chunks) + base
         if self.decode_pp > 1:
             return ("pp",) + base
+        if self.kv_pages:
+            # Paged-layout programs gather K/V through the page table —
+            # structurally different HLO, so they live under "paged"-tagged
+            # keys (their own compile-budget families); every kv_pages=0
+            # engine's keys stay byte-for-byte the dense tuples (the
+            # dense cache-key pin in tests/test_paged_kv.py).
+            return ("paged",) + base
         return base
 
     def _decode_fn(self, n_steps: int, want_lp: bool, history: int,
@@ -3080,8 +3490,12 @@ class InferenceEngine:
     def _verify_key(self, g: int, want_lp: bool, history: int,
                     constrained: bool):
         if constrained:
-            return ("dfa_verify", g, want_lp, history, self._g_bucket)
-        return ("verify", g, want_lp, history)
+            key = ("dfa_verify", g, want_lp, history, self._g_bucket)
+        else:
+            key = ("verify", g, want_lp, history)
+        # Same tagging rule as _decode_key: paged-layout verify programs
+        # are structurally different HLO, dense keys stay byte-identical.
+        return ("paged",) + key if self.kv_pages else key
 
     def _verify_fn(self, g: int, history: int, want_lp: bool = False,
                    tstates: int = 0):
@@ -3494,6 +3908,19 @@ class InferenceEngine:
                 raise QueueFullError(
                     f"engine admission queue full ({self.max_pending} waiting)"
                 )
+            if (self.kv_pages
+                    and self._paged_need(len(prompt), budget)
+                    > self.kv_pool_pages):
+                # The request's full page span exceeds the POOL, not just
+                # its current occupancy: no amount of waiting admits it.
+                # Shed now (503 + Retry-After at the server) — transient
+                # exhaustion instead keeps the request pending until live
+                # releases return pages. Never a mid-stream OOM: admission
+                # reserves the whole span up front.
+                raise QueueFullError(
+                    f"request span of {self._paged_need(len(prompt), budget)}"
+                    f" pages exceeds the kv page pool "
+                    f"({self.kv_pool_pages} pages)")
             self._pending.append(req)
             self.n_requests += 1
             # notify_all: under disagg TWO scheduler loops wait on _cond,
@@ -3574,6 +4001,20 @@ class InferenceEngine:
                 "rebuilds_total": self.n_rebuilds,
                 "deadline_exceeded_total": self.n_deadline_exceeded,
                 "breaker_state": self.breaker.state_code,
+                # Paged KV slot memory (tpu://…&kv_pages=1): pool occupancy
+                # and the prefix-aliasing economics — tier-0 hits that
+                # installed page REFERENCES instead of copying bytes, and
+                # the boundary pages that did get a COW copy.
+                "kv_pages": 1 if self.kv_pages else 0,
+                "kv_page_size": self.kv_page_size,
+                "kv_pages_allocated": (
+                    self._page_alloc.allocated_pages if self.kv_pages else 0),
+                "kv_pages_free": (
+                    self._page_alloc.free_pages if self.kv_pages else 0),
+                "kv_page_alias_hits_total": (
+                    self.kv_page_alias_hits if self.kv_pages else 0),
+                "kv_page_cow_copies_total": (
+                    self.kv_page_cow_copies if self.kv_pages else 0),
             }
 
     def health(self) -> dict:
@@ -3834,6 +4275,11 @@ class InferenceEngine:
                 slot, lcp = self._pick_slot(self._pending[0].prompt_ids)
                 if slot is None:
                     return
+                if self.kv_pages and not self._paged_fits(
+                        slot, self._pending[0]):
+                    # Head-of-line waits for pages (FIFO preserved): live
+                    # releases return pages and wake the scheduler.
+                    return
                 req = self._pending.pop(0)
             if req.cancel.is_set():
                 self.n_cancelled += 1
@@ -3861,6 +4307,21 @@ class InferenceEngine:
             # max_seq, where the clamped start silently corrupts valid
             # cache rows (see __init__'s chunk-alignment invariant).
             reuse = self._reuse_len(lcp, len(req.prompt_ids))
+            if self.kv_pages:
+                with self._cond:
+                    claim = self._paged_claim(slot, req, reuse)
+                if claim is None:
+                    # Can't happen after the fits-check above (one claiming
+                    # thread on a non-staged engine) — contain defensively
+                    # rather than corrupt page accounting.
+                    self._contain_admission_failure(
+                        [req], RuntimeError("kv page claim failed after "
+                                            "passing the fits check"))
+                    continue
+                reuse, cow = claim
+                # COW copies + table upload land before the admission's
+                # first cache write (same thread, data-flow ordered).
+                self._paged_install(cow)
             restore = self._store_lookup(req.prompt_ids, reuse)
             if restore is not None:
                 n_restore, host = restore
@@ -3899,7 +4360,10 @@ class InferenceEngine:
                 except Exception as e:
                     # This request's own prefill failed: doom it alone
                     # (escalating only if the shared device state went with
-                    # it) and keep admitting the rest of the queue.
+                    # it) and keep admitting the rest of the queue. The
+                    # slot never activated, so its page claim unwinds here.
+                    with self._cond:
+                        self._paged_release_row(slot)
                     self._contain_admission_failure([req], e)
 
     def _common_free_row(self, members) -> int | None:
@@ -3967,6 +4431,11 @@ class InferenceEngine:
                     if reuse or r.grammar is not None or self.staged or (
                             self.prefill_chunk
                             and len(r.prompt_ids) > self.prefill_chunk):
+                        if self.kv_pages:
+                            claim = self._paged_claim(slot, r, reuse)
+                            if claim is None:
+                                continue  # this member waits for pages
+                            reuse = claim[0]  # forced 0 on stacked engines
                         if reuse:
                             self.prefix_hits += 1
                             self.prefix_tokens_saved += reuse
@@ -3995,8 +4464,29 @@ class InferenceEngine:
                             break
                     if row is None:
                         return  # no head has a usable row this iteration
+                    if self.kv_pages:
+                        # One claim per group member: the slot group's chain
+                        # is shared (page ids index each member's own pool
+                        # copy), sized by the largest need, released when
+                        # the last member's claim drops.
+                        n_claimed = 0
+                        for r in group.values():
+                            if self._paged_claim(row, r, 0) is None:
+                                break
+                            n_claimed += 1
+                        if n_claimed < len(group):
+                            for _ in range(n_claimed):
+                                self._paged_release_row(row)
+                            return  # the group waits for pages
                     for r in group.values():
                         self._pending.remove(r)
+            if self.kv_pages and not self.staged:
+                # Fresh claims above dirtied the table mirror; upload it
+                # before the admission's first cache write (this thread
+                # owns the decode cache; reuse is 0 so there is no COW).
+                # Staged engines defer the upload to the decode loop
+                # (_drain_handoffs), which owns the decode cache there.
+                self._paged_sync_table()
             if (admit_chunked is not None
                     and admit_chunked.req.grammar is not None
                     and not self.staged):
@@ -4021,7 +4511,12 @@ class InferenceEngine:
                 except Exception as e:
                     # The coalesced group's own prefill failed: doom only
                     # its members (other members' active streams continue
-                    # unless the shared state was consumed).
+                    # unless the shared state was consumed). No slot went
+                    # live, so the group's page claims unwind here.
+                    if self.kv_pages:
+                        with self._cond:
+                            for _ in group:
+                                self._paged_release_row(row)
                     self._contain_admission_failure(list(group.values()), e)
             # chunked admissions advance in _step_admissions_members; loop
             # to route any further heads
@@ -4049,6 +4544,12 @@ class InferenceEngine:
             if req.cancel.is_set():
                 self.n_cancelled += 1
                 req.out.put(("end", None))
+                if self.kv_pages:
+                    # The coalesced claim in _start_admissions_members took
+                    # one claim per group member; a member skipped here never
+                    # reaches _release_slot, so drop its claim now.
+                    with self._cond:
+                        self._paged_release_row(m * n_s + row)
                 continue
             self._note_admitted(req)
             n = len(req.prompt_ids)
@@ -4110,6 +4611,12 @@ class InferenceEngine:
             if not self._emit(req, int(firsts[m])):
                 with self._cond:
                     self._slots[flat] = req
+            elif self.kv_pages:
+                # Done on the first token: the slot never activates, so
+                # _release_slot will not run for this member — drop the
+                # page claim taken at coalesced-admission time.
+                with self._cond:
+                    self._paged_release_row(flat)
 
     def _seg_fn_members(self, bucket: int, history: int):
         """Jitted member-coalesced prompt segment: each member advances its
@@ -4370,6 +4877,13 @@ class InferenceEngine:
             if adm in self._admitting:
                 self._admitting.remove(adm)
             self._claimed.discard(adm.slot)
+            if self.kv_pages and self._slots[adm.slot] is None:
+                # Dead admission (cancel/deadline/failure): the claim never
+                # became a live stream, so its pages unwind here — the
+                # partial prefill stays retained for reuse. (On the success
+                # path _finish_admission activates the slot first, so this
+                # branch is skipped and the claim lives until release.)
+                self._paged_release_row(adm.slot)
             if self.disagg:
                 # A discarded claim is admission capacity the (possibly
                 # sleeping) prefill loop can use — and either loop may be
@@ -4430,6 +4944,12 @@ class InferenceEngine:
         if not done:
             with self._cond:
                 self._slots[slot] = req
+        elif self.kv_pages:
+            # Finished on its first token: the slot never went live, so
+            # retire the page claim here (retaining the prompt's pages as
+            # a prefix-reuse donor, like any other release).
+            with self._cond:
+                self._paged_release_row(slot)
 
     def _sweep_cancelled(self) -> None:
         """Release rows whose cancel event is set (client gone, stop string
@@ -5257,12 +5777,25 @@ class InferenceEngine:
                 1 for _, r in c.active if r.grammar is not None)
         if c.n_chunks > 1:
             meta["chunks"] = c.n_chunks
+        if self.kv_pages:
+            # Per-turn page footprint on the decode span: how many pool
+            # pages this request's row actually holds (vs the dense
+            # layout's implicit max_seq/page_size rectangle).
+            with self._cond:
+                chains = {i: len(self._page_alloc.chain(i % self.n_slots)
+                                 or ()) for i, _ in c.active}
+            meta_pages = chains
+        else:
+            meta_pages = None
         for i, req in c.active:
             if self._slots[i] is req or i in done:
+                extra = (dict(pages=meta_pages[i])
+                         if meta_pages is not None else {})
                 self._turn_span(req, "decode", t0, t1, steps=c.n_steps,
                                 occupancy=len(c.active), history=c.history,
                                 depth=c.depth,
-                                inflight=round(t0 - c.t0, 6), **meta)
+                                inflight=round(t0 - c.t0, 6),
+                                **meta, **extra)
         if done:
             with self._cond:
                 for i, req in c.active:
@@ -5283,6 +5816,7 @@ class InferenceEngine:
         device→host snapshot, so it survives the slot being reclaimed."""
         self._slots[i] = None
         self._resident[i] = req.hist[:-1]
+        self._paged_release_row(i)
         if self.disagg:
             # A freed decode slot is what the (possibly sleeping) prefill
             # loop waits on to admit its next pending request.
@@ -5637,6 +6171,9 @@ def get_engine(
     sp_impl: str = "ring",
     prefill_mesh: Mesh | None = None,
     zero_drain: bool = False,
+    kv_pages: bool = False,
+    kv_page_size: int = 0,
+    kv_pool_pages: int = 0,
 ) -> InferenceEngine:
     """Engines are keyed by weight identity (spec, seed, mesh, quant,
     ensemble, members, draft model) plus the cache representation (kv_quant)
@@ -5680,7 +6217,14 @@ def get_engine(
            # admission routing exist (or not) at construction, and a
            # drain-based URL must never silently serve zero-drain (or
            # vice versa — the cache-key pin tests depend on it).
-           bool(zero_drain))
+           bool(zero_drain),
+           # Paged KV is structural: the cache LAYOUT (page pool + table
+           # vs dense rectangle) exists at construction, so a dense URL
+           # must never share a paged engine — and the page geometry is
+           # part of the identity for the same reason n_slots would be if
+           # it reshaped the cache.
+           (bool(kv_pages), int(kv_page_size), int(kv_pool_pages))
+           if kv_pages else None)
     with _ENGINES_LOCK:
         eng = _ENGINES.get(key)
         if eng is None:
@@ -5702,6 +6246,8 @@ def get_engine(
                 draft_spec=draft_spec, draft_seed=draft_seed,
                 draft_params=draft_params, sp_impl=sp_impl,
                 prefill_mesh=prefill_mesh, zero_drain=zero_drain,
+                kv_pages=kv_pages, kv_page_size=kv_page_size,
+                kv_pool_pages=kv_pool_pages,
             )
             _ENGINES[key] = eng
         else:
@@ -5734,6 +6280,9 @@ def get_engine_from_ckpt(
     sp_impl: str = "ring",
     prefill_mesh: Mesh | None = None,
     zero_drain: bool = False,
+    kv_pages: bool = False,
+    kv_page_size: int = 0,
+    kv_pool_pages: int = 0,
 ) -> InferenceEngine:
     """Engine over a local HF checkpoint; keyed by (resolved path, mesh,
     draft checkpoint) so N backends pointing at one checkpoint with the
@@ -5766,7 +6315,9 @@ def get_engine_from_ckpt(
            tuple(map(str, mesh.devices.flat)),
            tuple(map(str, prefill_mesh.devices.flat))
            if prefill_mesh is not None else None,
-           bool(zero_drain))
+           bool(zero_drain),
+           (bool(kv_pages), int(kv_page_size), int(kv_pool_pages))
+           if kv_pages else None)
     with _ENGINES_LOCK:
         eng = _ENGINES.get(key)
         if eng is None:
@@ -5792,6 +6343,8 @@ def get_engine_from_ckpt(
                 draft_spec=draft_spec, draft_params=draft_params,
                 sp_impl=sp_impl, prefill_mesh=prefill_mesh,
                 zero_drain=zero_drain,
+                kv_pages=kv_pages, kv_page_size=kv_page_size,
+                kv_pool_pages=kv_pool_pages,
             )
             _ENGINES[key] = eng
         else:

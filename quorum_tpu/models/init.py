@@ -117,7 +117,7 @@ def init_params_sharded(spec: ModelSpec, mesh, seed: int = 0) -> Params:
     from quorum_tpu.parallel.sharding import param_shardings
 
     shapes = jax.eval_shape(lambda: init_params(spec, seed))
-    shardings = param_shardings(mesh, shapes)
+    shardings = param_shardings(mesh, shapes, n_kv_heads=spec.n_kv_heads)
     return jax.jit(
         lambda: init_params(spec, seed), out_shardings=shardings
     )()
@@ -150,7 +150,8 @@ def init_params_ensemble_sharded(
         return params
 
     shapes = jax.eval_shape(build, keys)
-    shardings = param_shardings(mesh, shapes, lead_axes=1)
+    shardings = param_shardings(mesh, shapes, lead_axes=1,
+                                n_kv_heads=spec.n_kv_heads)
     return jax.jit(build, out_shardings=shardings)(keys)
 
 

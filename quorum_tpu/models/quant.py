@@ -154,7 +154,8 @@ def quantize_params(params: Mapping[str, Any]) -> dict[str, Any]:
     return walk(dict(params))
 
 
-def quantize_params_sharded(params: Mapping[str, Any], mesh) -> dict[str, Any]:
+def quantize_params_sharded(params: Mapping[str, Any], mesh,
+                            n_kv_heads: int | None = None) -> dict[str, Any]:
     """Quantize on-device in ONE compiled program, outputs sharded like the
     bf16 originals (q8 inherits the parent spec; size-1 scale dims replicate).
 
@@ -164,7 +165,7 @@ def quantize_params_sharded(params: Mapping[str, Any], mesh) -> dict[str, Any]:
     from quorum_tpu.parallel.sharding import param_shardings
 
     shapes = jax.eval_shape(quantize_params, params)
-    shardings = param_shardings(mesh, shapes)
+    shardings = param_shardings(mesh, shapes, n_kv_heads=n_kv_heads)
     return jax.jit(
         quantize_params, out_shardings=shardings, donate_argnums=0
     )(params)
@@ -187,9 +188,10 @@ def init_params_quantized_sharded(spec, mesh, seed: int = 0) -> dict[str, Any]:
 
     if jax.default_backend() == "cpu":
         return quantize_params_sharded(
-            init_params_sharded(spec, mesh, seed), mesh)
+            init_params_sharded(spec, mesh, seed), mesh,
+            n_kv_heads=spec.n_kv_heads)
     shapes = jax.eval_shape(lambda: quantize_params(init_params(spec, seed)))
-    shardings = param_shardings(mesh, shapes)
+    shardings = param_shardings(mesh, shapes, n_kv_heads=spec.n_kv_heads)
     return jax.jit(
         lambda: quantize_params(init_params(spec, seed)),
         out_shardings=shardings,

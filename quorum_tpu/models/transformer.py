@@ -34,6 +34,15 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from quorum_tpu.cache.paging import (
+    kv_is_paged,
+    page_read,
+    page_read_row,
+    page_write_multi,
+    page_write_prefill,
+    page_write_seg,
+    page_write_step,
+)
 from quorum_tpu.models.model_config import ModelSpec
 from quorum_tpu.models.quant import is_quantized, qeinsum
 from quorum_tpu.ops.attention import (
@@ -70,7 +79,11 @@ Params = dict[str, Any]
 
 
 def kv_is_q8(cache) -> bool:
-    """True when a cache side uses the int8 (q8, scale) representation."""
+    """True when a cache side uses the int8 (q8, scale) representation —
+    dense tuples and paged pools alike (a PagedKV's int8-ness lives in its
+    pool leaf)."""
+    if kv_is_paged(cache):
+        return cache.is_q8
     return isinstance(cache, tuple)
 
 
@@ -203,14 +216,18 @@ def _moe_mlp_grouped(x, block, spec: ModelSpec, token_mask=None):
         ranks, jnp.minimum(e_p, e - 1)[:, None], axis=1)[:, 0]
 
     # expert buffers of token rows: scatter pick→(expert, rank); overflow
-    # picks (rank ≥ C) drop out of the scatter; unfilled rows point at a
-    # zero row appended to the token matrix.
+    # picks (rank ≥ C) drop out of the scatter; unfilled rows gather a
+    # clamped in-bounds row and are zeroed by the mask below. (Not the
+    # concatenate-a-zero-row + out-of-bounds-index idiom: gathering from a
+    # concat of a batch-sharded token matrix with a replicated pad row
+    # miscompiles under GSPMD on jax 0.4.x — the partitioned gather reads
+    # the wrong shard — which was the PR 16 "MoE EP divergence" quarantine.)
     pick_buf = jnp.full((e, cap), p, jnp.int32)
     pick_buf = pick_buf.at[e_p, c_p].set(
         jnp.arange(p, dtype=jnp.int32), mode="drop")
     tok_buf = jnp.where(pick_buf < p, pick_buf // k, n)
-    xf_ext = jnp.concatenate([xf, jnp.zeros((1, d), xf.dtype)])
-    expert_in = xf_ext[tok_buf]                    # [E,C,D] gather
+    expert_in = (xf[jnp.minimum(tok_buf, n - 1)]
+                 * (tok_buf < n).astype(xf.dtype)[..., None])  # [E,C,D] gather
 
     gate = qeinsum("ecd,edf->ecf", expert_in, block["moe_w_gate"])
     up = qeinsum("ecd,edf->ecf", expert_in, block["moe_w_up"])
@@ -292,6 +309,9 @@ def _prefill_write(cache, value, cache_row, write_gate):
             new = jnp.where(write_gate, new, old)
         return lax.dynamic_update_slice(arr, new, idx)
 
+    if kv_is_paged(cache):
+        max_seq = cache.page_size * cache.table.shape[-1]
+        return page_write_prefill(cache, value, cache_row, write_gate, max_seq)
     if kv_is_q8(cache):
         c8, cs = cache
         q8, s = _kv_quantize(value)
@@ -449,6 +469,9 @@ def prefill_segment(
                 new = jnp.where(write_gate, new, old)
             return lax.dynamic_update_slice(arr, new, idx)
 
+        if kv_is_paged(cache):
+            return page_write_seg(cache, value, slot, offset, write_gate,
+                                  spec.max_seq)
         if kv_is_q8(cache):
             c8, cs = cache
             q8, s = _kv_quantize(value)
@@ -459,6 +482,8 @@ def prefill_segment(
     def seg_read(cache, dtype):
         # the slot's history window [1, K, hist, hd]; int8 caches dequantize
         # the bounded window (cold path — decode uses the native-int8 dot)
+        if kv_is_paged(cache):
+            return page_read_row(cache, slot, hist, dtype)
         if kv_is_q8(cache):
             c8, cs = cache
             row8 = lax.dynamic_slice(
@@ -577,6 +602,8 @@ def decode_step_blocks(
 
     def step_write(cache, value):
         # value [B, K, 1, hd] at each row's own position
+        if kv_is_paged(cache):
+            return page_write_step(cache, value, lengths, allow, spec.max_seq)
         if kv_is_q8(cache):
             c8, cs = cache
             q8, s = _kv_quantize(value)
@@ -585,6 +612,13 @@ def decode_step_blocks(
         return write(cache, value.astype(cache.dtype), lengths, allow)
 
     def step_read(cache):
+        if kv_is_paged(cache):
+            # Gather the history window's pages into the dense [B, K, hist,
+            # hd] layout — attention (int8 / flash / XLA) runs unchanged on
+            # the gathered window.
+            hist = (history if history is not None and history < spec.max_seq
+                    else spec.max_seq)
+            return page_read(cache, hist)
         if history is not None and history < spec.max_seq:
             # Read only the prefix that can hold valid entries (the write
             # above landed at lengths < history). The mask ki < lengths+1
@@ -854,6 +888,11 @@ def decode_multi(
     write = jax.vmap(write_row, in_axes=(0, 0, 0, 0))
 
     def multi_write(cache, value):
+        if kv_is_paged(cache):
+            # OOB positions drop exactly — subsumes clamp_writes (the dense
+            # path's roll trick exists only because dynamic_update_slice
+            # clamps its start backwards; a page scatter has no start).
+            return page_write_multi(cache, value, lengths, allow, spec.max_seq)
         if kv_is_q8(cache):
             c8, cs = cache
             q8, s = _kv_quantize(value)
@@ -862,6 +901,9 @@ def decode_multi(
         return write(cache, value.astype(cache.dtype), lengths, allow)
 
     def multi_read(cache, dtype):
+        if kv_is_paged(cache):
+            r = page_read(cache, hist)
+            return _kv_dequant(r[0], r[1], dtype) if kv_is_q8(cache) else r
         if kv_is_q8(cache):
             return _kv_dequant(
                 lax.slice_in_dim(cache[0], 0, hist, axis=2),

@@ -169,6 +169,33 @@ KV_HANDOFF_SECONDS = METRICS.histogram(
     "route= labels as on quorum_tpu_kv_handoff_bytes_total.",
     buckets=(0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
              0.25, 0.5, 1.0, 2.5, 5.0))
+# Paged KV slot memory (tpu://…&kv_pages=1, docs/tpu_backends.md): the
+# dense [n_slots, max_seq] rectangle becomes a refcounted page pool + a
+# per-row page table. Pool occupancy is the capacity story (rows admit
+# while pages remain, not while worst-case rectangles remain); the alias/
+# COW pair is the prefix-reuse economics — a tier-0 hit installs page
+# REFERENCES (zero KV bytes moved), and only a partially-reused boundary
+# page pays a one-page copy-on-write.
+KV_PAGES_ALLOCATED = METRICS.gauge(
+    "quorum_tpu_kv_pages_allocated",
+    "KV pool pages currently referenced by a live or retained chain "
+    "(kv_pages=1 engines; 0/absent on dense layouts). Last-writer-wins "
+    "across engines sharing the process, like the other engine gauges.")
+KV_PAGES_FREE = METRICS.gauge(
+    "quorum_tpu_kv_pages_free",
+    "KV pool pages on the free list (kv_pages=1 engines). "
+    "free + allocated == kv_pool_pages.")
+KV_PAGE_ALIAS_HITS = METRICS.counter(
+    "quorum_tpu_kv_page_alias_hits_total",
+    "Tier-0 prefix hits served by page ALIASING under kv_pages=1: the "
+    "admission installed refcounted references to the donor's pages "
+    "instead of copying KV bytes (kv_handoff_bytes stays 0 for these).")
+KV_PAGE_COW_COPIES = METRICS.counter(
+    "quorum_tpu_kv_page_cow_copies_total",
+    "Copy-on-write boundary-page copies under kv_pages=1: a reused "
+    "prefix ended mid-page, so the partially-shared page was copied "
+    "(one page) before the new tenant's suffix writes. Full pages "
+    "alias by reference and never pay this.")
 DECODE_STAGE_OCCUPANCY = METRICS.gauge(
     "quorum_tpu_decode_stage_occupancy",
     "Active decode rows per pipeline-staged row group (pp>1 engines: "

@@ -109,6 +109,21 @@ def kv_cache_sharding(mesh: Mesh, n_kv_heads: int, batch: int | None = None,
     if seq_shard and mesh.shape[AXIS_SP] > 1:
         axes[3] = "seq_shard"
     return logical_to_sharding(mesh, tuple(axes))
+
+
+def paged_kv_sharding(mesh: Mesh, n_kv_heads: int) -> "NamedSharding":
+    """Page-pool sharding for the paged KV layout (``kv_pages=1``):
+    ``[layers, pages, kv_heads, page_size, head_dim]``. The physical page
+    axis never shards — pages are the allocation unit and a row's chain
+    scatters arbitrarily across the pool, so a sharded page axis would turn
+    every table gather into a cross-device shuffle. kv_heads shard over tp
+    with the same GQA degrade rule as :func:`kv_cache_sharding`; the layer
+    axis shards over pp (rejected >1 by the engine under kv_pages, so in
+    practice a no-op kept for shape symmetry)."""
+    axes: list = ["layers", None, "kv_heads", None, None]
+    if n_kv_heads % mesh.shape[AXIS_TP] != 0:
+        axes[2] = None
+    return logical_to_sharding(mesh, tuple(axes))
 # Activations: [batch, seq, model]
 ACT_AXES: tuple[str | None, ...] = ("batch", "seq", "model")
 # Token ids: [batch, seq]
@@ -116,18 +131,33 @@ TOKEN_AXES: tuple[str | None, ...] = ("batch", "seq")
 
 
 def param_partition_specs(
-    params: Mapping[str, Any], lead_axes: int = 0
+    params: Mapping[str, Any], lead_axes: int = 0,
+    *, replicate_kv_heads: bool = False
 ) -> dict[str, Any]:
     """PartitionSpec pytree matching a parameter pytree (same nesting).
 
     ``lead_axes`` prepends that many replicated dims to every leaf's spec —
     used for member-stacked ensemble params ``[M, …]`` (the member axis is
-    vmapped, never sharded)."""
+    vmapped, never sharded).
+
+    ``replicate_kv_heads`` replicates every leaf whose logical axes include
+    ``kv_heads`` (wk/wv/bk/bv). The kv projection's output dim is the *flat*
+    ``K·hd``, so ``_fit_spec``'s divisibility check can't see head
+    boundaries: 2 KV heads × hd=16 on tp=4 passes (32 % 4 == 0) but shards
+    each KV head across two devices. Sub-head-sharded kv projections
+    miscompile under GSPMD on jax 0.4.x for batch-1 prefill (the engine's
+    slot-mode admission path) — wrong logits, deterministic, mesh-dependent
+    (dp=2×tp=4 yes, tp=4 no) — which was half of the PR 16 "MoE EP
+    divergence" quarantine. Replicating mirrors ``kv_cache_sharding``'s GQA
+    degrade rule: when kv heads don't divide tp, whole-head sharding is
+    impossible and sharding half a head buys nothing."""
 
     def spec_for(name: str) -> P:
         axes = PARAM_LOGICAL_AXES.get(name)
         if axes is None:
             return P()  # unknown leaf → replicate
+        if replicate_kv_heads and "kv_heads" in axes:
+            axes = tuple(None if a == "kv_heads" else a for a in axes)
         return P(*((None,) * lead_axes + tuple(logical_to_spec(axes))))
 
     def walk(tree: Mapping[str, Any]) -> dict[str, Any]:
@@ -165,9 +195,16 @@ def _fit_spec(spec: P, shape: tuple[int, ...], mesh: Mesh) -> P:
 
 
 def param_shardings(
-    mesh: Mesh, params: Mapping[str, Any], lead_axes: int = 0
+    mesh: Mesh, params: Mapping[str, Any], lead_axes: int = 0,
+    n_kv_heads: int | None = None,
 ) -> dict[str, Any]:
-    specs = param_partition_specs(params, lead_axes)
+    """Shardings for a param pytree; pass ``n_kv_heads`` so GQA kv
+    projections degrade to replicated (whole leaf) when the head count
+    doesn't divide tp — see :func:`param_partition_specs`."""
+    replicate_kv = (n_kv_heads is not None
+                    and n_kv_heads % mesh.shape[AXIS_TP] != 0)
+    specs = param_partition_specs(params, lead_axes,
+                                  replicate_kv_heads=replicate_kv)
     return jax.tree.map(
         lambda x, s: None if x is None else NamedSharding(mesh, _fit_spec(s, x.shape, mesh)),
         dict(params),
@@ -176,9 +213,10 @@ def param_shardings(
     )
 
 
-def shard_pytree(mesh: Mesh, params: Mapping[str, Any]) -> dict[str, Any]:
+def shard_pytree(mesh: Mesh, params: Mapping[str, Any],
+                 n_kv_heads: int | None = None) -> dict[str, Any]:
     """Place a host/param pytree onto the mesh with the standard TP layout."""
-    shardings = param_shardings(mesh, params)
+    shardings = param_shardings(mesh, params, n_kv_heads=n_kv_heads)
     return jax.tree.map(
         lambda x, s: x if x is None else jax.device_put(x, s),
         dict(params),

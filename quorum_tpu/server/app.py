@@ -331,7 +331,9 @@ def create_app(
                   "disagg", "decode_pp", "prefill_sp",
                   "prefill_group_devices", "decode_group_devices",
                   "prefill_group_active", "decode_group_active",
-                  "zero_drain", "breaker_state")
+                  "zero_drain", "breaker_state",
+                  "kv_pages", "kv_page_size",
+                  "kv_pages_allocated", "kv_pages_free")
         # One snapshot per distinct engine (_distinct_engines). Each
         # family's TYPE line appears exactly once, with all its samples
         # grouped — the Prometheus text format rejects repeated TYPE lines.

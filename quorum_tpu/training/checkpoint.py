@@ -70,7 +70,7 @@ def _abstract_params(spec: ModelSpec, mesh: Mesh) -> Any:
     from quorum_tpu.parallel.sharding import param_shardings
 
     shapes = jax.eval_shape(lambda: init_params(spec, 0))
-    shardings = param_shardings(mesh, shapes)
+    shardings = param_shardings(mesh, shapes, n_kv_heads=spec.n_kv_heads)
     return jax.tree.map(
         lambda s, sh: (None if s is None
                        else jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=sh)),

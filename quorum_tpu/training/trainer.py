@@ -132,7 +132,8 @@ def train_init(
     elementwise over params, so GSPMD keeps them aligned).
     """
     opt = optimizer or make_optimizer()
-    params = shard_pytree(mesh, init_params(spec, seed))
+    params = shard_pytree(mesh, init_params(spec, seed),
+                          n_kv_heads=spec.n_kv_heads)
     opt_state = jax.jit(opt.init)(params)
     # jit collapses fully-replicated outputs (adam count, moments of
     # replicated params) to SingleDeviceSharding; pin those back to a

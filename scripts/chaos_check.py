@@ -651,6 +651,48 @@ async def _run(quick: bool) -> None:
             _flight_dump_check("kv_handoff", "engine.kv_handoff")
             deng.shutdown()
 
+            # ---- phase 4b2: same drill under the PAGED decode cache ------
+            # kv_pages=1 reshapes the handoff's decode side: the staged
+            # admission pre-reserves the row's page span on the prefill
+            # thread and the decode loop uploads the table before the hput
+            # scatter. A failed handoff must unwind the page CLAIM too —
+            # a leaked claim would strand pool pages until restart (the
+            # bystander/follow-up checks would then shed or hang).
+            print("phase 4b2: disagg kv handoff (paged)", flush=True)
+            peng = InferenceEngine(
+                tiny, dm, prefill_mesh=pm, decode_chunk=4, n_slots=2,
+                prefill_chunk=16, seed=77, kv_pages=True)
+            pbase = peng.generate([3, 4, 5], max_new_tokens=6,
+                                  sampler=samp).token_ids
+            check("paged handoff: disagg output matches dense twin",
+                  pbase == base, f"{pbase} != {base}")
+            faults.reset_counts()
+            faults.arm("engine.kv_handoff", times=1)
+            bad = peng.submit([5, 6, 7], max_new_tokens=6, sampler=samp)
+            err = None
+            try:
+                list(peng.stream_results(bad))
+            except Exception as e:
+                err = e
+            faults.disarm()
+            check("paged handoff: fault fired",
+                  faults.fired("engine.kv_handoff") >= 1)
+            check("paged handoff: failed handoff dooms its own request",
+                  isinstance(err, faults.FaultInjected), repr(err))
+            follow = peng.generate([3, 4, 5], max_new_tokens=6,
+                                   sampler=samp).token_ids
+            check("paged handoff: follow-up matches baseline",
+                  follow == base, f"{follow} != {base}")
+            with peng._cond:
+                leaked = [i for i, c in enumerate(peng._page_claims) if c]
+            check("paged handoff: no leaked page claims", not leaked,
+                  f"slot groups with live claims: {leaked}")
+            pm_ = peng.metrics()
+            check("paged handoff: pool accounting consistent",
+                  pm_["kv_pages_allocated"] + pm_["kv_pages_free"]
+                  == peng.kv_pool_pages)
+            peng.shutdown()
+
         # ---- phase 4c: speculative verify fault site ---------------------
         # A spec_decode engine beside the main one (the main engine's
         # deadline/breaker phases count on engine.decode dispatches, so it

@@ -439,6 +439,93 @@ def sharded(tokens: int = 48, chunk: int = 4, depth: int = 2,
     return out
 
 
+def paged(tokens: int = 8, streams: int = 24, page_size: int = 16,
+          pool_pages: int = 32) -> dict:
+    """Rows-per-chip at FIXED KV HBM (ISSUE 17, the paged-layout headline):
+    dense vs ``kv_pages=1`` on a short-stream mix, same position budget.
+
+    The budget is ``pool_pages × page_size`` cache positions. The dense
+    rectangle spends it on ``budget // max_seq`` slots — every row pays
+    ``max_seq`` whether it uses it or not — while the paged engine spends
+    it on a page pool and admits as many rows as their ACTUAL spans fit
+    (each short stream here spans ≲ 2 pages). Reports per arm: peak
+    concurrently-resident rows, completed streams, wall time, and for the
+    paged arm the peak page occupancy — with every stream's tokens
+    asserted identical to its dense twin (capacity, never semantics).
+    The acceptance gate: peak paged rows ≥ 4× the dense slot count."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+
+    from quorum_tpu.engine.engine import InferenceEngine
+    from quorum_tpu.models.model_config import MODEL_PRESETS
+    from quorum_tpu.ops.sampling import SamplerConfig
+
+    spec = MODEL_PRESETS["llama-tiny"]
+    greedy = SamplerConfig(temperature=0.0)
+    positions = pool_pages * page_size
+    dense_slots = max(1, positions // spec.max_seq)
+    # Short streams: ~10-token prompts + the decode budget span ≲ 2 pages,
+    # so the pool admits pool_pages // 2 of them at once.
+    paged_slots = max(dense_slots, pool_pages // 2)
+    prompts = [[(3 + 7 * i + j) % (spec.vocab_size - 1) + 1
+                for j in range(8 + (i % 3))] for i in range(streams)]
+    out: dict = {"paged_streams": streams, "paged_pool_pages": pool_pages,
+                 "paged_page_size": page_size,
+                 "paged_dense_rows": dense_slots}
+    results: dict[str, dict[int, list[int]]] = {}
+    for tag, kw in (("dense", dict(n_slots=dense_slots)),
+                    ("paged", dict(n_slots=paged_slots, kv_pages=True,
+                                   kv_page_size=page_size,
+                                   kv_pool_pages=pool_pages))):
+        eng = InferenceEngine(spec, decode_chunk=4, prefill_chunk=16, **kw)
+        eng.generate(prompts[0], max_new_tokens=tokens,
+                     sampler=greedy)  # warm-up
+        peak = {"rows": 0, "pages": 0}
+        stop = threading.Event()
+
+        def poll():
+            while not stop.is_set():
+                with eng._cond:
+                    rows = sum(1 for r in eng._slots if r is not None)
+                    pages = (eng._page_alloc.allocated_pages
+                             if eng.kv_pages else 0)
+                peak["rows"] = max(peak["rows"], rows)
+                peak["pages"] = max(peak["pages"], pages)
+                time.sleep(0.0005)
+
+        outs: dict[int, list[int]] = {}
+
+        def one(i: int) -> None:
+            outs[i] = [t for t in eng.generate_stream(
+                prompts[i], max_new_tokens=tokens, sampler=greedy, seed=i)]
+
+        poller = threading.Thread(target=poll, daemon=True)
+        poller.start()
+        t0 = time.perf_counter()
+        ths = [threading.Thread(target=one, args=(i,))
+               for i in range(streams)]
+        for t in ths:
+            t.start()
+        for t in ths:
+            t.join()
+        wall = time.perf_counter() - t0
+        stop.set()
+        poller.join()
+        results[tag] = outs
+        out[f"paged_{tag}_peak_rows"] = peak["rows"]
+        out[f"paged_{tag}_completed"] = len(outs)
+        out[f"paged_{tag}_wall_s"] = round(wall, 3)
+        if eng.kv_pages:
+            out["paged_peak_page_occupancy"] = round(
+                peak["pages"] / pool_pages, 3)
+        eng.shutdown()
+    out["paged_rows_per_chip_ratio"] = round(
+        out["paged_paged_peak_rows"] / max(1, out["paged_dense_peak_rows"]),
+        2)
+    out["paged_tokens_match"] = results["dense"] == results["paged"]
+    return out
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--tokens", type=int, default=64)
@@ -466,7 +553,17 @@ def main() -> int:
     ap.add_argument("--only-sharded", action="store_true",
                     help="run ONLY the per-group-sharding legs (bench.py's "
                          "subprocess phase)")
+    ap.add_argument("--skip-paged", action="store_true",
+                    help="skip the paged-KV rows-per-chip legs")
+    ap.add_argument("--only-paged", action="store_true",
+                    help="run ONLY the paged-KV rows-per-chip legs "
+                         "(bench.py's subprocess phase)")
     args = ap.parse_args()
+    if args.only_paged:
+        mp = paged()
+        _print_paged(mp)
+        print(json.dumps(mp), flush=True)
+        return 0
     if args.only_sharded:
         try:
             msh = sharded(args.tokens, args.chunk, args.depth, args.loop,
@@ -589,8 +686,26 @@ def main() -> int:
         else:
             _print_sharded(msh)
         m.update(msh)
+    if not args.skip_paged:
+        mp = paged()
+        _print_paged(mp)
+        m.update(mp)
     print(json.dumps(m), flush=True)
     return 0
+
+
+def _print_paged(mp: dict) -> None:
+    print(f"paged KV rows-per-chip (fixed {mp['paged_pool_pages']}-page "
+          f"HBM budget, {mp['paged_streams']} short streams):")
+    print(f"  dense rectangle: {mp['paged_dense_rows']} rows, peak "
+          f"resident {mp['paged_dense_peak_rows']}, "
+          f"wall {mp['paged_dense_wall_s']}s")
+    print(f"  kv_pages=1     : peak resident {mp['paged_paged_peak_rows']}"
+          f", page occupancy {mp['paged_peak_page_occupancy']:.0%}, "
+          f"wall {mp['paged_paged_wall_s']}s")
+    print(f"  rows/chip: {mp['paged_rows_per_chip_ratio']:.1f}x "
+          f"(gate: >= 4x), token-for-token identical: "
+          f"{mp['paged_tokens_match']}")
 
 
 def _print_sharded(msh: dict) -> None:

@@ -35,7 +35,7 @@ list; whatever the tunnel survives is kept:
      number that says int8 serving is quality-safe at the scale we ship.
 
 Usage: ``python scripts/onchip_session.py
-[--skip bench,ab,kvq,flash,megachunk,spec,disagg,sharded,zero_drain,profile,qq]``
+[--skip bench,ab,kvq,flash,megachunk,spec,disagg,sharded,zero_drain,kv_pages,profile,qq]``
 Each step is a subprocess with its own budget; a wedged step is recorded
 and skipped, never fatal. Results: ``ONCHIP.json`` (merged dict, one key
 prefix per step) + profile trace under ``profiles/``.
@@ -505,6 +505,24 @@ def main() -> None:
         for arm, arm_url in (
                 ("zero_drain_off", B7_URL),
                 ("zero_drain_on", B7_URL + "&zero_drain=1")):
+            b = fits(arm, 1500)
+            if b:
+                bank(run_step(
+                    arm, [sys.executable, "-c", _SERVE_ONE, arm_url, "2",
+                          arm, "600"], budget=b))
+    if "kv_pages" not in skip:
+        # Paged-KV A/B (kv_pages=1 vs dense, PERF.md §5 step 7c):
+        # SEPARATE processes per arm (kv_pages is structural and the
+        # dense arm must compile the exact pre-existing programs — the
+        # cache-key pin tests/test_paged_kv.py enforces). The CPU bench
+        # (make hostpath-bench --only-paged) already pins 4.0× resident
+        # rows per chip at a fixed position budget with tokens identical;
+        # this arm measures the gather-through-table tax per decode step
+        # at 7B, where the dense path's contiguous cache reads become
+        # page-indexed reads. Single chip, no device-count probe.
+        for arm, arm_url in (
+                ("kv_pages_off", B7_URL),
+                ("kv_pages_on", B7_URL + "&kv_pages=1")):
             b = fits(arm, 1500)
             if b:
                 bank(run_step(

@@ -75,19 +75,13 @@ def test_mesh_engine_slots_not_divisible_by_dp():
     assert _gen(eng_m, 1, [5, 6, 7]) == _gen(eng_1, 1, [5, 6, 7])
 
 
-# Quarantined (PR 16): same root cause as test_sharding.py::
-# test_moe_expert_parallel_matches_single_device — first logged at PR 14
-# as order-dependent, but a standalone single-test run now fails
-# deterministically (sharded output diverges from the single-device
-# reference at the third generated token), so it is the EP=4 MoE compute
-# itself, not suite pollution. Dense engine-on-mesh tests above still
-# pass. xfail, not skip: a fix shows up as XPASS the moment it lands.
-@pytest.mark.xfail(
-    strict=False,
-    reason="MoE engine on a dp=2,tp=4 mesh diverges from the "
-           "single-device engine (same EP bug as test_sharding's MoE "
-           "case); reproduces standalone — tracked in ROADMAP.md "
-           "(quarantined PR 16)")
+# De-quarantined (PR 17): the engine-path divergence was TWO stacked GSPMD
+# miscompiles — the MoE concat-gather bug (see test_sharding.py) plus a
+# second, MoE-independent one: batch-1 prefill (the engine's slot-mode
+# admission) with the kv projection sharded at sub-head granularity
+# (n_kv_heads=2 on tp=4 → half a KV head per device) produces wrong logits
+# on dp=2×tp=4. Fixed by the GQA degrade rule in parallel/sharding.py:
+# wk/wv replicate when n_kv_heads % tp != 0, mirroring kv_cache_sharding.
 def test_moe_engine_on_mesh_matches_single_device():
     """Grouped sparse-MoE prefill (scatter/gather dispatch) + dense-MoE
     decode must survive GSPMD on a dp×tp(=ep) mesh inside the full engine

@@ -131,8 +131,10 @@ def _config():
             # decode_loop=2 so the megachunk knob rides the config path
             # the exposition's engine block reports.
             {"name": "LLM1",
+             # kv_pages=1 so the paged-pool gauge/counter families
+             # (ISSUE 17) ride the same live exposition.
              "url": "tpu://llama-tiny?seed=3&slots=2&prefix_store=host"
-                    "&decode_loop=2",
+                    "&decode_loop=2&kv_pages=1",
              "model": "t"},
         ],
     }
@@ -175,6 +177,18 @@ async def test_live_metrics_exposition_validates():
         assert f"# TYPE {fam} histogram" in text, fam
         assert f'{fam}_bucket{{le="+Inf"}}' in text, fam
         assert f"{fam}_sum" in text and f"{fam}_count" in text, fam
+
+    # paged-KV pool observability (ISSUE 17): occupancy gauges + the
+    # alias/COW counters, and the engine block's paged config/pool keys
+    # mapped as gauges (a counter-typed pool level could never go down)
+    for fam, typ in (("quorum_tpu_kv_pages_allocated", "gauge"),
+                     ("quorum_tpu_kv_pages_free", "gauge"),
+                     ("quorum_tpu_kv_page_alias_hits_total", "counter"),
+                     ("quorum_tpu_kv_page_cow_copies_total", "counter"),
+                     ("quorum_tpu_engine_kv_pages", "gauge"),
+                     ("quorum_tpu_engine_kv_pages_free", "gauge")):
+        assert f"# TYPE {fam} {typ}" in text, fam
+    assert 'quorum_tpu_engine_kv_pages{backend="LLM1"} 1' in text
     # request duration is labeled by status class (2xx here)
     assert "# TYPE quorum_tpu_request_duration_seconds histogram" in text
     assert ('quorum_tpu_request_duration_seconds_bucket'

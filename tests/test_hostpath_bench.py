@@ -23,7 +23,7 @@ into every suite run), and pins the dispatch accounting the bench reports:
     printed number — wall-clock on a shared CI core flakes)
 """
 
-from scripts.hostpath_bench import interference, run, sharded, spec
+from scripts.hostpath_bench import interference, paged, run, sharded, spec
 
 
 def test_hostpath_bench_counters():
@@ -119,3 +119,22 @@ def test_sharded_bench_smoke():
     for tag in ("colocated_tp4", "disagg_tp2", "disagg_pp2"):
         assert m[f"sharded_{tag}_tok_s"] > 0
         assert m[f"sharded_{tag}_dispatches_per_request"] > 0
+
+
+def test_paged_bench_smoke():
+    """The paged-KV rows-per-chip legs (ISSUE 17): at a fixed cache
+    position budget the paged engine keeps strictly more short streams
+    resident than the dense rectangle's slot count, fills the page pool,
+    and every stream's tokens match its dense twin (the >= 4x ratio is
+    the bench's printed acceptance gate; the suite asserts the ordering
+    — peak concurrency sampling on a shared CI core flakes)."""
+    m = paged(tokens=8, streams=24, page_size=16, pool_pages=32)
+    assert m["paged_tokens_match"] is True
+    assert m["paged_dense_completed"] == m["paged_paged_completed"] == 24
+    # the fixed budget buys the dense arm max_seq-sized rows only
+    assert m["paged_dense_peak_rows"] <= m["paged_dense_rows"]
+    # strictly more rows resident at once under paging, pool never over-
+    # committed (admission pre-reserves each row's whole span)
+    assert m["paged_paged_peak_rows"] > m["paged_dense_rows"]
+    assert m["paged_rows_per_chip_ratio"] >= 2.0
+    assert 0.0 < m["paged_peak_page_occupancy"] <= 1.0

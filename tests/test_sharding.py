@@ -71,22 +71,11 @@ def test_tp_dp_sharded_matches_single_device():
     np.testing.assert_allclose(d_sh, d_1, rtol=2e-2, atol=2e-2)
 
 
-# Quarantined (PR 16): first observed at PR 14 as an order-dependent flake,
-# but it now reproduces standalone — a single-test run (`pytest
-# tests/test_sharding.py::test_moe_expert_parallel_matches_single_device`)
-# fails deterministically on the 8-device CPU mesh, with sharded MoE logits
-# diverging far beyond tolerance (max rel diff ~2e3), so the ordering
-# hypothesis is dead: the EP=4 gather path itself disagrees with the
-# single-device reference. Dense sharding (test_tp_dp_sharded_matches_
-# single_device, test_full_tp8_sharding) still matches, isolating the bug
-# to the experts-over-tp branch. xfail (not skip) so the suite records the
-# moment a fix lands; strict=False tolerates any residual run-to-run
-# nondeterminism in expert routing.
-@pytest.mark.xfail(
-    strict=False,
-    reason="MoE expert-parallel (experts over tp) diverges from the "
-           "single-device reference on the virtual CPU mesh; reproduces "
-           "standalone — tracked in ROADMAP.md (quarantined PR 16)")
+# De-quarantined (PR 17): the PR 16 divergence was a GSPMD miscompile in
+# the grouped dispatch's expert-buffer gather (a gather from a concat of a
+# dp-sharded token matrix with a replicated pad row reads the wrong shard
+# on jax 0.4.x) — fixed in models/transformer.py by the clamp-index+mask
+# formulation.
 def test_moe_expert_parallel_matches_single_device():
     spec = resolve_spec("mixtral-tiny")  # 4 experts over tp=4
     mesh = make_mesh(MeshConfig(dp=2, tp=4))
