@@ -882,6 +882,36 @@ def run_paged_phase(budget: int = 900) -> dict:
     return {k: got[k] for k in keep if k in got}
 
 
+def run_qos_phase(budget: int = 900) -> dict:
+    """QoS scheduler A/B (ISSUE 18, docs/scheduling.md): interactive TTFT
+    p50/p99 under a batch-churn backlog, FIFO vs ``qos=1`` (WFQ admission
+    + mid-decode preemption), vs the uncontended solo floor, plus the
+    batch-throughput cost and preemption/replay counters —
+    scripts/hostpath_bench.py's measurement, run in a SUBPROCESS (fresh
+    engines, no program-cache bleed). Gate with ``QUORUM_TPU_BENCH_QOS=0``."""
+    if os.environ.get("QUORUM_TPU_BENCH_QOS", "1") == "0":
+        return {}
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    script = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "scripts", "hostpath_bench.py")
+    got = _run_json_subprocess(
+        [sys.executable, script, "--only-qos"], "qos", budget, env)
+    keep = ("qos_arrivals", "qos_churn_threads", "qos_churn_tokens",
+            "qos_solo_ttft_p50_ms", "qos_solo_ttft_p99_ms",
+            "qos_fifo_interactive_ttft_p50_ms",
+            "qos_fifo_interactive_ttft_p99_ms",
+            "qos_qos_interactive_ttft_p50_ms",
+            "qos_qos_interactive_ttft_p99_ms",
+            "qos_fifo_churn_streams", "qos_fifo_churn_tok_s",
+            "qos_qos_churn_streams", "qos_qos_churn_tok_s",
+            "qos_preemptions", "qos_preempted_tokens",
+            "qos_replayed_tokens", "qos_ttft_p99_ratio",
+            "qos_batch_degradation", "qos_error")
+    return {k: got[k] for k in keep if k in got}
+
+
 def _last_json_line(stdout: "str | None") -> "dict | None":
     """Latest parseable JSON object line. Malformed brace-prefixed lines are
     skipped, not fatal: a timed-out child's captured stdout can end mid-line,
@@ -1297,6 +1327,9 @@ async def main() -> None:
         # Paged-KV rows-per-chip A/B (ISSUE 17): dense vs kv_pages=1 at a
         # fixed cache position budget on a short-stream mix.
         b7.update(run_paged_phase())
+        # QoS scheduler A/B (ISSUE 18): interactive TTFT under batch
+        # churn, FIFO vs qos=1 (WFQ + preemption), vs the solo floor.
+        b7.update(run_qos_phase())
         await phase12_main(b7)
         return
 

@@ -114,6 +114,19 @@ URL grammar:  ``tpu://<model-id>?<spec overrides>&<engine options>``
                    chunks, so a long admission can't stall active streams
   queue=           admission queue bound (default 128); a full queue rejects
                    with 503 instead of growing without limit
+  qos=0|1          QoS scheduler (default 0 = FIFO, docs/scheduling.md):
+                   weighted-fair admission across priority classes
+                   (interactive/batch/background — the 'priority' body
+                   knob, else derived from deadline headroom), earliest-
+                   deadline-headroom-first within a class, predictive
+                   infeasible-deadline shed (503 + honest Retry-After),
+                   and mid-decode preemption: an interactive admission
+                   with no free slot parks a lower-class resident row at
+                   a reap boundary and resumes it later token-for-token
+                   identical (deterministic replay — no extra device
+                   programs). NOT structural: pure host policy, outside
+                   the engine cache key; qos=0/qos=1 URLs share one
+                   engine with opt-in winning
   spec_decode=G    speculative decoding (default 0 = off): speculative
                    dispatches verify up to G draft tokens PER ROW in one
                    multi-token forward — accepted runs advance G+1 tokens
@@ -580,6 +593,11 @@ class TpuBackend:
                 "kv_pages", opts.get("kv_pages", "0")),
             kv_page_size=int(opts.get("kv_page_size", 0)),
             kv_pool_pages=int(opts.get("kv_pool_pages", 0)),
+            # QoS scheduler (docs/scheduling.md). NOT structural: pure
+            # host-side policy, deliberately outside the engine cache key
+            # (pre-QoS keys stay byte-identical; qos=0 and qos=1 URLs
+            # share one engine, opt-in winning).
+            qos=_parse_bool_opt("qos", opts.get("qos", "0")),
         )
         store = str(opts.get("prefix_store", "")).strip().lower()
         if store in ("", "0", "none", "off"):
@@ -835,6 +853,11 @@ class TpuBackend:
             "frequency_penalty": fp,
             "logit_bias": self._bias_row(body.get("logit_bias")),
             "grammar": grammar,
+            # QoS scheduling knobs (docs/scheduling.md) — validated at the
+            # proxy edge (oai.validate_request_body) and re-checked by
+            # engine.submit; inert unless the engine runs qos=1.
+            "priority": body.get("priority"),
+            "tenant": body.get("tenant"),
         }
 
     def _plan_grammar(self, rf: Any):
@@ -939,6 +962,8 @@ class TpuBackend:
             member=self.member,
             deadline=deadline,
             grammar=plan["grammar"],
+            priority=plan.get("priority"),
+            tenant=plan.get("tenant"),
         )
 
     def _lp_entry(self, tid: int, record, top_n: int) -> dict[str, Any]:
@@ -1061,9 +1086,11 @@ class TpuBackend:
         try:
             reqs = [self._submit_choice(plan, i, cancels[i], deadline)
                     for i in range(plan["n"])]
-        except QueueFullError:
+        except QueueFullError as e:
             cancel_all()  # release any choices already admitted
-            raise _overloaded(self.name) from None
+            raise _overloaded(
+                self.name, why=str(e) or "admission queue full",
+                retry_after=getattr(e, "retry_after", 1.0)) from None
         except EngineBreakerOpen as e:
             cancel_all()
             raise _breaker_open(self.name, e) from None
@@ -1428,9 +1455,11 @@ class TpuBackend:
             try:
                 reqs = [self._submit_choice(plans[i], 0, cancels[i], deadline)
                         for i in range(len(plans))]
-            except QueueFullError:
+            except QueueFullError as e:
                 cancel_all()
-                raise _overloaded(self.name) from None
+                raise _overloaded(
+                    self.name, why=str(e) or "admission queue full",
+                    retry_after=getattr(e, "retry_after", 1.0)) from None
             except EngineBreakerOpen as e:
                 cancel_all()
                 raise _breaker_open(self.name, e) from None
@@ -1575,9 +1604,11 @@ class TpuBackend:
         try:
             reqs = [self._submit_choice(plan, i, cancels[i], engine_deadline)
                     for i in range(n)]
-        except QueueFullError:
+        except QueueFullError as e:
             cancel_all()  # release any choices already admitted
-            raise _overloaded(self.name) from None
+            raise _overloaded(
+                self.name, why=str(e) or "admission queue full",
+                retry_after=getattr(e, "retry_after", 1.0)) from None
         except EngineBreakerOpen as e:
             cancel_all()
             raise _breaker_open(self.name, e) from None

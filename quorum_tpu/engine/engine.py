@@ -159,6 +159,12 @@ from quorum_tpu.parallel.sharding import (
     paged_kv_sharding,
     shard_pytree,
 )
+from quorum_tpu.sched import (
+    PRIORITY_CLASSES,
+    CostModel,
+    PreemptionController,
+    SchedPolicy,
+)
 
 enable_persistent_compile_cache()  # restart compiles become disk reads
 compile_watch.install()  # count XLA compiles (quorum_tpu_recompiles_total)
@@ -352,11 +358,14 @@ class _Request:
         "pp", "fp", "bias_row", "want_lp", "lp", "hist", "ngram", "member",
         "trace", "t_submit", "tspans", "deadline", "expired", "grammar",
         "g_start", "dfa_host", "n_inflight", "spec_state", "rid",
+        "priority", "tenant", "sched_class", "n_preempts", "replay",
+        "preempt_flag", "t_admit",
     )
 
     def __init__(self, prompt_ids, budget, sampler: SamplerConfig, seed, eos_id,
                  cancel, chunk_hint, pp=0.0, fp=0.0, bias_row=None, want_lp=-1,
-                 member=0, deadline=None, grammar=None):
+                 member=0, deadline=None, grammar=None, priority=None,
+                 tenant=None):
         self.prompt_ids = prompt_ids
         self.budget = budget
         self.temperature = sampler.temperature
@@ -404,6 +413,21 @@ class _Request:
         # tokens, optimistic local DFA state). None = no continuation; any
         # rejection at reap resets it.
         self.spec_state: "tuple | None" = None
+        # QoS scheduler state (quorum_tpu/sched/, docs/scheduling.md): the
+        # explicit priority knob + tenant id, the resolved dispatch class
+        # (assigned in _submit), how many times this request has been
+        # preempted (budget against livelock), the replay list of already-
+        # delivered tokens a resumed victim must regenerate (None when not
+        # resuming), the park-me flag set under _cond by the admission
+        # side and honored by the decode loop's _sweep_preemptions, and
+        # the last admission stamp (the cost model's service clock).
+        self.priority = priority
+        self.tenant = tenant
+        self.sched_class = "batch"
+        self.n_preempts = 0
+        self.replay: "list[int] | None" = None
+        self.preempt_flag = False
+        self.t_admit: "float | None" = None
         self.lp: list = []
         # Request-scoped tracing: the server's trace (when this submission
         # happens inside a traced request context) rides along so the
@@ -436,6 +460,36 @@ class _Request:
             (prompt_ids[n - 2], prompt_ids[n - 1]): n - 1
             for n in range(2, len(prompt_ids))
         }
+
+    def begin_replay(self) -> int:
+        """Park this request for a preemption resume: rewind every piece of
+        host state to the as-submitted request and record the already-
+        delivered tokens as the replay expectation. Re-admission then rides
+        the ORDINARY admission machinery (prefix reuse, chunked segments,
+        staged zero-drain injection — no preemption-specific device
+        program), and because the token sequence is a pure function of
+        (prompt, seed, sampler) — one RNG split per emitted token on every
+        path, including speculative verify — the resumed row regenerates
+        the delivered tokens bit for bit; ``_emit``'s replay guard swallows
+        them (byte-comparing each against the expectation) and the stream
+        continues where it left off. Returns the parked token count."""
+        generated = self.hist[len(self.prompt_ids):]
+        # A second preemption mid-replay must expect the FULL delivered
+        # sequence again: what was already re-swallowed plus the remainder.
+        already = self.replay or []
+        self.replay = generated + already
+        self.hist = list(self.prompt_ids)
+        self.ngram = {
+            (self.prompt_ids[n - 2], self.prompt_ids[n - 1]): n - 1
+            for n in range(2, len(self.prompt_ids))
+        }
+        self.dfa_host = self.grammar.start if self.grammar is not None else 0
+        self.spec_state = None
+        self.emitted = 0
+        self.n_inflight = 0
+        self.n_preempts += 1
+        self.t_admit = None
+        return len(generated)
 
     @property
     def spec_draft_ok(self) -> bool:
@@ -842,6 +896,10 @@ _GUARDED_BY = {
     # loop(s) — and under disagg BOTH loops plus the snapshot worker
     "_pending": {"lock": "_cond"},
     "_slots": {"lock": "_cond", "holders": ["_release_slot"]},
+    # QoS preemption flags: appended by whichever loop runs admissions
+    # (colocated decode / disagg prefill), drained by the decode loop's
+    # _sweep_preemptions — the only _slots mutator that acts on them.
+    "_preempt_pending": {"lock": "_cond"},
     "_admitting": {"lock": "_cond"},
     "_claimed": {"lock": "_cond"},
     "_handoffs": {"lock": "_cond"},
@@ -931,6 +989,7 @@ class InferenceEngine:
         kv_pages: bool = False,
         kv_page_size: int = 0,
         kv_pool_pages: int = 0,
+        qos: bool = False,
     ):
         self.spec = spec.validate()
         self.mesh = mesh or single_device_mesh()
@@ -1488,6 +1547,23 @@ class InferenceEngine:
         self._admitting: list[_Admission] = []
         self._claimed: set[int] = set()  # slots held by in-progress admissions
         self._cond = threading.Condition()
+        # QoS scheduler (tpu://…&qos=1 — quorum_tpu/sched/,
+        # docs/scheduling.md): weighted-fair admission ordering + victim
+        # selection, both pure host-side policy objects. The cost model is
+        # ALWAYS live (it is the engine's one shed-decision point and its
+        # EWMAs feed /debug/telemetry), but predictive sheds, non-FIFO
+        # picks, and preemption all require qos — off, the engine's
+        # observable scheduling behavior is byte-identical to pre-QoS.
+        self.qos = bool(qos)
+        self._policy = SchedPolicy()
+        self._preempt = PreemptionController()
+        self.cost_model = CostModel(self.latency)
+        # (row, victim, beneficiary) park orders awaiting the decode
+        # loop's next reap boundary (_sweep_preemptions).
+        self._preempt_pending: "list[tuple[int, _Request, _Request]]" = []
+        self.n_preemptions = 0
+        self.n_preempted_tokens = 0
+        self.n_replayed_tokens = 0
         # Monotonic counters for /metrics (written on the scheduler/submit
         # paths; reads are snapshots, exactness across a race is not needed).
         self.n_requests = 0
@@ -1839,8 +1915,13 @@ class InferenceEngine:
         if self._page_claims[sg]:
             chain = a.chain(sg) or []
             n_need -= len(chain)
-        return (n_need <= a.free_pages
-                + a.reclaimable_pages(protect=(sg,)))
+            return (n_need <= a.free_pages
+                    + a.reclaimable_pages(protect=(sg,)))
+        # A fresh claim of this slot group may drop (or reuse) the group's
+        # OWN retained donor, so its sole-reference pages count as
+        # available too — protect nothing. Without this, a donor holding
+        # most of the pool wedges its own slot's next admission forever.
+        return n_need <= a.free_pages + a.reclaimable_pages()
 
     def _paged_reclaim(self, n: int, protect=()) -> bool:
         """Evict least-recently-retained chains until ``n`` pages are free
@@ -1907,15 +1988,30 @@ class InferenceEngine:
         p_keep = a.pages_for(reuse)
         partial = bool(reuse % ps)
         n_new = n_need - p_keep + (1 if partial else 0)
+        # Share the reuse prefix BEFORE any donor drop or reclaim: the
+        # bump keeps those pages out of the free list whatever happens to
+        # the donor entry below.
+        keep = a.share(held[:p_keep]) if p_keep else []
+        if n_new > a.free_pages:
+            # The slot group's own retained donor is a legitimate page
+            # source for its own re-claim (the kept prefix survives via
+            # the share above); without this drop, a donor holding most
+            # of the pool wedges this slot's next admission forever —
+            # _paged_fits counts these pages, so the claim must be able
+            # to free them.
+            a.drop_retained(sg)
         fresh: list[int] = []
         if n_new > 0:
             if not self._paged_reclaim(n_new, protect=(sg,)):
+                if keep:
+                    a.free(keep)
                 return None
             got = a.alloc(n_new)
             if got is None:  # pragma: no cover - reclaim guarantees
+                if keep:
+                    a.free(keep)
                 return None
             fresh = got
-        keep = a.share(held[:p_keep]) if p_keep else []
         a.touch(sg)
         if partial:
             # The boundary page is only partially reused: the tenant's
@@ -3765,6 +3861,8 @@ class InferenceEngine:
         member: int = 0,  # stacked-members engine: which weight set serves this
         deadline: float | None = None,  # absolute time.monotonic() deadline
         grammar=None,  # CompiledGrammar: constrained decoding (structured output)
+        priority: str | None = None,  # dispatch class (sched.PRIORITY_CLASSES)
+        tenant: str | None = None,  # tenant id for weighted-fair admission
     ) -> _Request | None:
         """Enqueue a generation and return its handle (``None`` when there is
         nothing to generate). Raises :class:`QueueFullError` *synchronously*
@@ -3779,7 +3877,11 @@ class InferenceEngine:
         handle's ``lp`` list carries one ``(logprob, top_ids, top_lps)``
         record per yielded token. Penalties follow the OpenAI contract
         (presence: flat once a token has been generated; frequency: scaled
-        by its count), applied over this request's generated tokens."""
+        by its count), applied over this request's generated tokens.
+        ``priority`` pins the QoS dispatch class (one of
+        ``sched.PRIORITY_CLASSES``; default: derived from deadline headroom)
+        and ``tenant`` names the weighted-fair accounting bucket — both
+        inert unless the engine was built with ``qos=True``."""
         return self._submit(
             prompt_ids,
             max_new_tokens=max_new_tokens,
@@ -3795,6 +3897,8 @@ class InferenceEngine:
             member=member,
             deadline=deadline,
             grammar=grammar,
+            priority=priority,
+            tenant=tenant,
         )
 
     def stream_results(self, req: _Request | None) -> Iterator[int]:
@@ -3847,12 +3951,16 @@ class InferenceEngine:
     def _submit(self, prompt_ids, *, max_new_tokens, sampler, seed, eos_id,
                 cancel, decode_chunk, pp=0.0, fp=0.0, bias_row=None,
                 want_lp=-1, member=0, deadline=None,
-                grammar=None) -> _Request | None:
+                grammar=None, priority=None, tenant=None) -> _Request | None:
         spec = self.spec
         if not 0 <= member < self.members:
             raise ValueError(
                 f"member {member} out of range for a {self.members}-member "
                 "engine")
+        if priority is not None and priority not in PRIORITY_CLASSES:
+            raise ValueError(
+                f"priority must be one of {PRIORITY_CLASSES}, "
+                f"got {priority!r}")
         if grammar is not None:
             # Constrained decoding preconditions, checked synchronously so a
             # misconfiguration is a clean rejection, not a wedged stream:
@@ -3887,46 +3995,57 @@ class InferenceEngine:
             cancel if cancel is not None else threading.Event(),
             decode_chunk,
             pp=pp, fp=fp, bias_row=bias_row, want_lp=want_lp, member=member,
-            deadline=deadline, grammar=grammar,
+            deadline=deadline, grammar=grammar, priority=priority,
+            tenant=tenant,
         )
         now = time.monotonic()
-        if deadline is not None and now >= deadline:
-            # Already expired at submission: shed synchronously — queueing
-            # it would only burn a scheduler sweep to reach the same 503.
-            # The counter bump takes _cond: this path runs on arbitrary
-            # caller threads, racing the scheduler's own increments.
-            with self._cond:
-                self.n_deadline_exceeded += 1
-            obs.DEADLINE_EXCEEDED.inc(stage="queue")
-            raise DeadlineExceeded("queue")
-        if not self.breaker.allow(now):
-            raise EngineBreakerOpen(self.breaker.retry_after(now))
+        req.sched_class = self._policy.classify(priority, deadline, now)
+        # Every shed decision — deadline-expired, breaker, queue capacity,
+        # pool span, and (qos) the predictive infeasible-deadline shed —
+        # routes through the cost model: ONE decision point, one
+        # Retry-After heuristic (docs/scheduling.md).
+        shed = self.cost_model.presubmit(now=now, deadline=deadline,
+                                         breaker=self.breaker)
+        if shed is not None:
+            self._raise_shed(shed)
         with self._cond:
             if self._stop:
                 raise RuntimeError("engine has been shut down")
-            if len(self._pending) >= self.max_pending:
-                raise QueueFullError(
-                    f"engine admission queue full ({self.max_pending} waiting)"
-                )
-            if (self.kv_pages
-                    and self._paged_need(len(prompt), budget)
-                    > self.kv_pool_pages):
-                # The request's full page span exceeds the POOL, not just
-                # its current occupancy: no amount of waiting admits it.
-                # Shed now (503 + Retry-After at the server) — transient
-                # exhaustion instead keeps the request pending until live
-                # releases return pages. Never a mid-stream OOM: admission
-                # reserves the whole span up front.
-                raise QueueFullError(
-                    f"request span of {self._paged_need(len(prompt), budget)}"
-                    f" pages exceeds the kv page pool "
-                    f"({self.kv_pool_pages} pages)")
+            shed = self.cost_model.queue_check(
+                now=now, deadline=deadline, n_pending=len(self._pending),
+                max_pending=self.max_pending, qos=self.qos,
+                page_need=(self._paged_need(len(prompt), budget)
+                           if self.kv_pages else 0),
+                pool_pages=self.kv_pool_pages if self.kv_pages else 0)
+            if shed is not None:
+                # _cond is an RLock underneath — _raise_shed's counter bump
+                # re-enters it safely.
+                self._raise_shed(shed)
             self._pending.append(req)
             self.n_requests += 1
             # notify_all: under disagg TWO scheduler loops wait on _cond,
             # and waking only one could leave the admission loop asleep.
             self._cond.notify_all()
         return req
+
+    def _raise_shed(self, shed) -> None:
+        """Map a cost-model :class:`~quorum_tpu.sched.ShedDecision` onto the
+        engine's exception contract. Deadline sheds count/stage exactly like
+        the pre-QoS inline check (stage ``queue`` — the engine never served
+        the request); capacity sheds carry the model's Retry-After hint on
+        the exception for the HTTP layer."""
+        if shed.kind == "deadline":
+            # The counter bump takes _cond: this path runs on arbitrary
+            # caller threads, racing the scheduler's own increments.
+            with self._cond:
+                self.n_deadline_exceeded += 1
+            obs.DEADLINE_EXCEEDED.inc(stage="queue")
+            raise DeadlineExceeded("queue")
+        if shed.kind == "breaker":
+            raise EngineBreakerOpen(shed.retry_after)
+        err = QueueFullError(shed.detail)
+        err.retry_after = shed.retry_after
+        raise err
 
     def metrics(self) -> dict:
         """Scheduler/capacity snapshot for the server's /metrics endpoint."""
@@ -4015,6 +4134,15 @@ class InferenceEngine:
                     self.kv_page_alias_hits if self.kv_pages else 0),
                 "kv_page_cow_copies_total": (
                     self.kv_page_cow_copies if self.kv_pages else 0),
+                # QoS scheduler (tpu://…&qos=1): mid-decode preemptions,
+                # the delivered tokens they parked (regenerated on resume),
+                # the regenerated tokens the replay guard swallowed, and
+                # the cost model's predictive infeasible-deadline sheds.
+                "qos": 1 if self.qos else 0,
+                "preemptions_total": self.n_preemptions,
+                "preempted_tokens_total": self.n_preempted_tokens,
+                "replayed_tokens_total": self.n_replayed_tokens,
+                "predictive_sheds_total": self.cost_model.n_predictive_sheds,
             }
 
     def health(self) -> dict:
@@ -4127,6 +4255,7 @@ class InferenceEngine:
                     return
             try:
                 self._sweep_deadlines()
+                self._sweep_preemptions()
                 if self.disagg:
                     # The deferred decode-side state work the colocated
                     # loop runs inside _start_admissions.
@@ -4201,7 +4330,13 @@ class InferenceEngine:
         runs on the PREFILL loop; the rid correlates it with the decode
         loop's register/reap events)."""
         now = time.perf_counter()
-        obs.QUEUE_WAIT.observe(now - req.t_submit)
+        req.t_admit = now
+        if req.n_preempts == 0:
+            # A resumed victim's submit→admit gap includes its previous
+            # service time — not a queue wait; keep it out of the histogram
+            # and the cost model's drain estimate.
+            obs.QUEUE_WAIT.observe(now - req.t_submit)
+            self.cost_model.observe_queue_wait(now - req.t_submit)
         FLIGHT.record("admit", rid=req.rid, engine=self._tag,
                       loop="prefill" if self.disagg else "decode",
                       queue_wait_s=round(now - req.t_submit, 6))
@@ -4272,15 +4407,32 @@ class InferenceEngine:
             with self._cond:
                 if not self._pending:
                     return
-                slot, lcp = self._pick_slot(self._pending[0].prompt_ids)
+                # FIFO with qos off (index 0 — byte-identical to the
+                # pre-QoS engine); else the policy's WFQ pick: least
+                # virtual time among backlogged classes, earliest deadline
+                # headroom within the class (sched/policy.py).
+                idx = (0 if not self.qos or len(self._pending) <= 1
+                       else self._policy.pick(self._pending,
+                                              time.monotonic()))
+                head = self._pending[idx]
+                slot, lcp = self._pick_slot(head.prompt_ids)
                 if slot is None:
+                    # Every row busy: with qos on, a strictly-lower-class
+                    # resident row may be flagged for parking so this
+                    # admission gets a slot at the next reap boundary.
+                    self._maybe_flag_preemption_locked(head)
                     return
-                if self.kv_pages and not self._paged_fits(
-                        slot, self._pending[0]):
-                    # Head-of-line waits for pages (FIFO preserved): live
-                    # releases return pages and wake the scheduler.
+                if self.kv_pages and not self._paged_fits(slot, head):
+                    # Head-of-line waits for pages (admission order
+                    # preserved): live releases return pages and wake the
+                    # scheduler. Under qos a lower-class row's claim is
+                    # itself a page source — parking it both frees a slot
+                    # and returns its non-shared pages to the pool.
+                    self._maybe_flag_preemption_locked(head)
                     return
-                req = self._pending.pop(0)
+                req = self._pending.pop(idx)
+                if self.qos:
+                    self._policy.charge(req)
             if req.cancel.is_set():
                 self.n_cancelled += 1
                 req.out.put(("end", None))
@@ -4419,7 +4571,11 @@ class InferenceEngine:
                     return
                 heads: list[_Request] = []
                 seen: set[int] = set()
-                for r in self._pending:
+                # Per-member heads follow the policy order under qos (WFQ
+                # across classes, headroom within) and FIFO otherwise.
+                src = (self._policy.order(self._pending, time.monotonic())
+                       if self.qos else self._pending)
+                for r in src:
                     if r.member not in seen:
                         seen.add(r.member)
                         heads.append(r)
@@ -4440,6 +4596,8 @@ class InferenceEngine:
                             self.prefix_hits += 1
                             self.prefix_tokens_saved += reuse
                         self._pending.remove(r)
+                        if self.qos:
+                            self._policy.charge(r)
                         self._note_admitted(r)
                         self._claimed.add(slot)
                         self._resident[slot] = r.prompt_ids[:reuse]
@@ -4480,6 +4638,8 @@ class InferenceEngine:
                             return  # the group waits for pages
                     for r in group.values():
                         self._pending.remove(r)
+                        if self.qos:
+                            self._policy.charge(r)
             if self.kv_pages and not self.staged:
                 # Fresh claims above dirtied the table mirror; upload it
                 # before the admission's first cache write (this thread
@@ -4992,18 +5152,22 @@ class InferenceEngine:
         ``decode`` — a 504, the work is lost). Runs on the scheduler thread,
         so it cannot race the cancel sweep's own releases."""
         now = time.monotonic()
-
-        def expired(r: _Request) -> bool:
-            return (r.deadline is not None and now > r.deadline
-                    and not r.cancel.is_set())
+        # The cost model owns the ONE expiry predicate (sched/cost.py) —
+        # the submit-time shed and this sweep cannot drift apart.
+        expired = self.cost_model.expired
 
         with self._cond:
-            shed = [r for r in self._pending if expired(r)]
+            shed = [r for r in self._pending if expired(r, now)]
             for r in shed:
                 self._pending.remove(r)
-            late_adm = [a for a in self._admitting if expired(a.req)]
+            late_adm = [a for a in self._admitting if expired(a.req, now)]
             late_active = [(i, r) for i, r in enumerate(self._slots)
-                           if r is not None and expired(r)]
+                           if r is not None and expired(r, now)]
+            if self.qos:
+                depths = self._policy.queue_depths(self._pending)
+        if self.qos:
+            for cls, n in depths.items():
+                obs.SCHED_QUEUE_DEPTH.set(n, **{"class": cls})
         for r in shed:
             self._expire(r, "queue")
         for a in late_adm:
@@ -5025,6 +5189,94 @@ class InferenceEngine:
             with self._cond:
                 if self._slots[i] is r:
                     self._release_slot(i, r)
+
+    def _maybe_flag_preemption_locked(self, head: _Request) -> None:
+        """The picked admission found no usable slot: with QoS on, flag ONE
+        strictly-lower-class resident row for parking. Caller holds _cond;
+        the actual park happens on the decode loop's next reap boundary
+        (:meth:`_sweep_preemptions` — every ``_slots`` mutation that
+        touches live device state stays on that thread's turn order).
+
+        Gated to plain engines (members == 1, ensemble == 1): stacked and
+        quorum rows co-batch one logical request across weight sets, and
+        parking a single member's row would desynchronize the set."""
+        if not self.qos or head.cancel.is_set() or head.preempt_flag:
+            return
+        if self.members != 1 or self.ensemble != 1:
+            return
+        if any(b is head for _, _, b in self._preempt_pending):
+            return  # one outstanding park order per beneficiary
+        picked = self._preempt.pick_victim(head, self._slots, 0, self._rows)
+        if picked is None:
+            return
+        row, victim = picked
+        victim.preempt_flag = True
+        self._preempt_pending.append(  # qlint: allow-unguarded(the _locked suffix is the contract: both callers sit inside _start_admissions' `with self._cond:` scope — the lint's scope walker only sees the enclosing def)
+            (row, victim, head))
+        self._cond.notify_all()
+
+    def _sweep_preemptions(self) -> None:
+        """Execute queued park orders at this reap boundary (decode
+        scheduler thread). Parking IS the ordinary release path: the
+        victim's K/V prefix stays slot-resident (dense) or parked as
+        retained page references (kv_pages=1), a host prefix store
+        additionally snapshots it, and in-flight chunks that still carry
+        the row drop its tokens as overrun (``_slots[i] is not req``) — no
+        quiesce, no new device program. The victim then re-enters the
+        pending queue with resume credit; ``begin_replay`` + ``_emit``'s
+        replay guard make the resumed stream token-for-token identical to
+        an unpreempted run (docs/scheduling.md)."""
+        if not self.qos:
+            return
+        with self._cond:
+            if not self._preempt_pending:
+                return
+            work = list(self._preempt_pending)
+            self._preempt_pending.clear()
+        for row, victim, ben in work:
+            try:
+                faults.fire("engine.preempt")
+                with self._cond:
+                    if self._slots[row] is not victim \
+                            or victim.cancel.is_set():
+                        # Finished/cancelled/expired since flagging: the
+                        # park order is moot.
+                        victim.preempt_flag = False
+                        continue
+                    self._release_slot(row, victim)
+                    parked = victim.begin_replay()
+                    victim.preempt_flag = False
+                    # Head of the queue: within its class the resume
+                    # credit already wins, and FIFO engines never reach
+                    # here (qos gate above).
+                    self._pending.insert(0, victim)
+                    self.n_preemptions += 1
+                    self.n_preempted_tokens += parked
+                    self._cond.notify_all()
+                obs.PREEMPTIONS.inc(**{"class": victim.sched_class})
+                obs.PREEMPTED_TOKENS.inc(parked)
+                FLIGHT.record("preempt", rid=victim.rid, engine=self._tag,
+                              loop="decode", row=row,
+                              victim_class=victim.sched_class,
+                              beneficiary=ben.rid, parked_tokens=parked)
+            except Exception as e:
+                # Fault mid-park (chaos: engine.preempt): the victim alone
+                # is doomed — error frame, cancel, release; the beneficiary
+                # and every other stream proceed untouched, and the pool /
+                # page accounting stays exact because the release path is
+                # the same one a finished stream takes.
+                with self._cond:
+                    victim.preempt_flag = False
+                    if self._slots[row] is victim:
+                        self._release_slot(row, victim)
+                    if victim in self._pending:
+                        self._pending.remove(victim)
+                    self.n_failures += 1
+                victim.out.put(("err", e))
+                victim.cancel.set()
+                FLIGHT.record("preempt-fault", rid=victim.rid,
+                              engine=self._tag, loop="decode", row=row,
+                              error=f"{type(e).__name__}: {e}"[:200])
 
     def _device_state_ok(self) -> bool:
         """Whether the donated per-slot device state survived the last
@@ -5817,6 +6069,10 @@ class InferenceEngine:
         self._slots[i] = None
         self._resident[i] = req.hist[:-1]
         self._paged_release_row(i)
+        if req.t_admit is not None:
+            # Whole-occupancy wall time feeds the cost model's service
+            # EWMA (the predictive shed's drain estimate).
+            self.cost_model.observe_service(time.perf_counter() - req.t_admit)
         if self.disagg:
             # A freed decode slot is what the (possibly sleeping) prefill
             # loop waits on to admit its next pending request.
@@ -5991,11 +6247,32 @@ class InferenceEngine:
         return cont + [cont[-1]] * (g - len(cont))
 
     def _emit(self, req: _Request, tok: int) -> bool:
-        """Deliver one token; returns True when the request just finished."""
+        """Deliver one token; returns True when the request just finished.
+
+        Preemption replay (``req.replay`` non-None): the resumed row is
+        regenerating tokens the consumer already received. Each one is
+        byte-compared against the recorded expectation and swallowed —
+        host state (hist, n-gram index, DFA shadow) advances exactly as on
+        first delivery, but nothing reaches ``out`` and nothing counts as
+        a new token. A mismatch means the determinism contract broke
+        (token sequence = f(prompt, seed, sampler)); the stream fails
+        loudly rather than silently forking the delivered text."""
         if req.cancel.is_set():
             self.n_cancelled += 1
             req.out.put(("end", None))
             return True
+        replaying = req.replay is not None
+        if replaying:
+            expect = req.replay.pop(0)
+            if not req.replay:
+                req.replay = None
+            if tok != expect:
+                req.replay = None
+                req.out.put(("err", RuntimeError(
+                    f"preemption replay diverged at position {req.emitted}: "
+                    f"regenerated token {tok} != delivered token {expect}")))
+                req.cancel.set()
+                return True
         req.emitted += 1
         hist = req.hist
         hist.append(tok)
@@ -6006,6 +6283,12 @@ class InferenceEngine:
             # filter; a masked-sampled token is always allowed, so a dead
             # transition here means the shadow lost sync — park unknown.
             req.dfa_host = int(req.grammar.trans[req.dfa_host, tok])
+        if replaying:
+            # Already delivered before the preemption: swallowed, not
+            # re-queued, not re-counted (a replayed token never ends the
+            # stream — a terminal token would have ended it back then).
+            self.n_replayed_tokens += 1
+            return False
         self.n_tokens += 1
         req.out.put(("tok", tok))
         if req.eos_id is not None and tok == req.eos_id:
@@ -6174,6 +6457,7 @@ def get_engine(
     kv_pages: bool = False,
     kv_page_size: int = 0,
     kv_pool_pages: int = 0,
+    qos: bool = False,
 ) -> InferenceEngine:
     """Engines are keyed by weight identity (spec, seed, mesh, quant,
     ensemble, members, draft model) plus the cache representation (kv_quant)
@@ -6190,7 +6474,12 @@ def get_engine(
     ``prefix_cache`` are NOT structural: a shared engine runs with the
     maximum draft length any of its backends requested, and a
     ``prefix_cache=0`` from ANY backend disables reuse on the shared engine
-    (an explicit opt-out wins over a sharing default)."""
+    (an explicit opt-out wins over a sharing default). ``qos`` is not
+    structural either — the scheduler policy is pure host state, no device
+    program or cache layout depends on it, so it stays OUT of the key
+    (qos=0 and qos=1 URLs share one engine, and pre-QoS cache keys are
+    byte-identical); an explicit ``qos=1`` from any backend enables the
+    policy on the shared engine (opt-in wins, mirroring prefix_cache)."""
     import os
 
     if draft_ckpt and draft_spec is not None:
@@ -6247,13 +6536,14 @@ def get_engine(
                 draft_params=draft_params, sp_impl=sp_impl,
                 prefill_mesh=prefill_mesh, zero_drain=zero_drain,
                 kv_pages=kv_pages, kv_page_size=kv_page_size,
-                kv_pool_pages=kv_pool_pages,
+                kv_pool_pages=kv_pool_pages, qos=qos,
             )
             _ENGINES[key] = eng
         else:
             eng.spec_decode = max(eng.spec_decode,
                                   max(0, min(spec_decode, 16)))
             eng.prefix_cache = eng.prefix_cache and bool(prefix_cache)
+            eng.qos = eng.qos or bool(qos)  # an explicit opt-in wins
         return eng
 
 
@@ -6283,6 +6573,7 @@ def get_engine_from_ckpt(
     kv_pages: bool = False,
     kv_page_size: int = 0,
     kv_pool_pages: int = 0,
+    qos: bool = False,
 ) -> InferenceEngine:
     """Engine over a local HF checkpoint; keyed by (resolved path, mesh,
     draft checkpoint) so N backends pointing at one checkpoint with the
@@ -6344,11 +6635,12 @@ def get_engine_from_ckpt(
                 sp_impl=sp_impl, prefill_mesh=prefill_mesh,
                 zero_drain=zero_drain,
                 kv_pages=kv_pages, kv_page_size=kv_page_size,
-                kv_pool_pages=kv_pool_pages,
+                kv_pool_pages=kv_pool_pages, qos=qos,
             )
             _ENGINES[key] = eng
         else:
             eng.spec_decode = max(eng.spec_decode,
                                   max(0, min(spec_decode, 16)))
             eng.prefix_cache = eng.prefix_cache and bool(prefix_cache)
+            eng.qos = eng.qos or bool(qos)  # an explicit opt-in wins
         return eng

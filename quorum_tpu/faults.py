@@ -20,6 +20,7 @@ rejects it so a typo'd chaos case cannot silently test nothing):
   ``engine.decode``           decode-chunk dispatch (the batched hot path)
   ``engine.snapshot``         prefix-store snapshot worker fetch/insert
   ``engine.kv_handoff``       disaggregated prefill→decode KV chunk handoff
+  ``engine.preempt``          QoS mid-decode preemption parking turn
   ``http.request``            HTTP backend non-streaming request I/O
   ``http.stream``             HTTP backend streaming request I/O
 """
@@ -36,6 +37,7 @@ SITES = (
     "engine.verify",
     "engine.snapshot",
     "engine.kv_handoff",
+    "engine.preempt",
     "http.request",
     "http.stream",
 )

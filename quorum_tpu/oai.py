@@ -268,6 +268,20 @@ def validate_request_body(body: dict[str, Any]) -> str | None:
             return f"Invalid value for 'timeout': {t!r} (seconds, a number)"
         if not math.isfinite(float(t)) or float(t) <= 0:
             return f"Invalid value for 'timeout': {t!r} (must be > 0)"
+    # QoS scheduling knobs (docs/scheduling.md): 'priority' pins the
+    # dispatch class (default: derived from deadline headroom) and 'tenant'
+    # names the weighted-fair accounting bucket. Both consumed by the tpu
+    # backend; inert on engines without qos=1.
+    prio = body.get("priority")
+    if prio is not None and prio not in ("interactive", "batch",
+                                         "background"):
+        return (f"Invalid value for 'priority': {prio!r} (interactive, "
+                "batch, or background)")
+    tenant = body.get("tenant")
+    if tenant is not None and (
+            not isinstance(tenant, str) or not tenant or len(tenant) > 64):
+        return (f"Invalid value for 'tenant': {tenant!r} (a non-empty "
+                "string of at most 64 characters)")
     if "messages" in body and not isinstance(body["messages"], list):
         return "Invalid value for 'messages': must be an array"
     # Cross-tier trace propagation (docs/observability.md "Fleet plane"):

@@ -417,6 +417,24 @@ SLO_BREACHED = METRICS.counter(
     "quorum_tpu_slo_breached_total",
     "Requests that breached the stage's objective for their SLO class "
     "(class=interactive|batch, stage=ttft|inter_token|deadline).")
+# QoS scheduler (quorum_tpu/sched/, docs/scheduling.md): mid-decode
+# preemptions by VICTIM class, the generated tokens parked at preemption
+# (regenerated deterministically on resume), and the pending-queue depth
+# per priority class (refreshed each scheduler turn).
+PREEMPTIONS = METRICS.counter(
+    "quorum_tpu_preemptions_total",
+    "Mid-decode preemptions by victim class (class=batch|background): a "
+    "lower-class row parked at a reap boundary so a higher-class "
+    "admission could take its slot (qos=1 engines only).")
+PREEMPTED_TOKENS = METRICS.counter(
+    "quorum_tpu_preempted_tokens_total",
+    "Generated tokens parked at preemption — already delivered to their "
+    "consumers, regenerated token-for-token on resume (the replay the "
+    "engine byte-checks against the delivered stream).")
+SCHED_QUEUE_DEPTH = METRICS.gauge(
+    "quorum_tpu_sched_queue_depth",
+    "Pending admissions by priority class "
+    "(class=interactive|batch|background).")
 # Flight-recorder self-accounting: current ring depth (refreshed on
 # /metrics scrapes) and events overwritten by the bounded ring.
 FLIGHT_RECORDER_EVENTS = METRICS.gauge(

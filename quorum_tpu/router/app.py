@@ -54,7 +54,7 @@ from quorum_tpu.server.asgi import (
     Response,
     StreamingResponse,
 )
-from quorum_tpu.telemetry import tracecontext
+from quorum_tpu.telemetry import slo, tracecontext
 from quorum_tpu.telemetry.recorder import RECORDER, merged_trace_events
 
 logger = logging.getLogger(__name__)
@@ -220,7 +220,25 @@ def create_router_app(cfg: RouterConfig,
             random.shuffle(members)
             return None, members
         key = affinity.conversation_key(body, cfg.affinity_chunk)
-        return mgr.placement(key)
+        return mgr.placement(key, slo_class=_request_slo_class(body))
+
+    def _request_slo_class(body: dict) -> str | None:
+        """The request's SLO scoring class for burn-aware placement
+        (docs/scheduling.md): the explicit 'priority' body knob mapped
+        onto the SLO plane's two classes, else derived from the request's
+        timeout exactly like the replicas' own scoring — so the router
+        avoids replicas burning the objective THIS request will be scored
+        against. None (no knob, no timeout) keeps the configured
+        burn_class floor only."""
+        prio = body.get("priority")
+        if isinstance(prio, str) and prio:
+            from quorum_tpu.sched import to_slo_class
+
+            return to_slo_class(prio)
+        t = body.get("timeout")
+        if isinstance(t, (int, float)) and not isinstance(t, bool) and t > 0:
+            return slo.classify(float(t))
+        return None
 
     def _score_affinity(primary: str | None, served_by: str) -> None:
         if primary is not None and served_by == primary:

@@ -123,28 +123,42 @@ class ReplicaSet:
     def loads(self) -> dict[str, int]:
         return {name: r.inflight for name, r in self.replicas.items()}
 
-    def burn_demoted(self) -> set[str]:
-        """Ring members whose ``burn_class`` burn rate, per the LAST
-        absorbed telemetry, exceeds the threshold. Fail-open: absent or
-        stale telemetry (``None`` burn) never demotes — a replica that
-        stops exporting telemetry keeps plain bounded-load routing, it
-        does not lose placements to an observability outage."""
+    def burn_demoted(self, slo_class: str | None = None) -> set[str]:
+        """Ring members whose burn rate, per the LAST absorbed telemetry,
+        exceeds the threshold. Scored classes are the UNION of the
+        configured ``burn_class`` and the request's own SLO class
+        (``slo_class`` — the QoS dispatch class mapped onto the SLO
+        plane's two scoring classes): an interactive-burning replica is
+        demoted for everyone (the configured floor), and a batch request
+        additionally avoids replicas burning their batch objective.
+        Fail-open: absent or stale telemetry (``None`` burn) never
+        demotes — a replica that stops exporting telemetry keeps plain
+        bounded-load routing, it does not lose placements to an
+        observability outage."""
         if self.burn_threshold <= 0:
             return set()
+        classes = {self.burn_class}
+        if slo_class:
+            classes.add(slo_class)
         demoted: set[str] = set()
         for name in self.ring.members:
-            rate = self.telemetry.burn_rate(name, self.burn_class)
-            if rate is not None and rate > self.burn_threshold:
-                demoted.add(name)
+            for cls in classes:
+                rate = self.telemetry.burn_rate(name, cls)
+                if rate is not None and rate > self.burn_threshold:
+                    demoted.add(name)
+                    break
         return demoted
 
-    def placement(self, key: int) -> tuple[str | None, list[str]]:
+    def placement(self, key: int,
+                  slo_class: str | None = None) -> tuple[str | None, list[str]]:
         """``(affinity primary, candidate order)`` for a conversation key.
         The primary is membership-pure (what the hit/miss accounting
         compares against); the candidate order additionally folds in
         bounded load and SLO-burn demotion (both per-request reorderings
-        — membership, and every other key's placement, untouched)."""
-        demoted = self.burn_demoted()
+        — membership, and every other key's placement, untouched).
+        ``slo_class`` widens burn demotion to the request's own class
+        (see :meth:`burn_demoted`)."""
+        demoted = self.burn_demoted(slo_class)
         candidates = self.ring.candidates(key, self.loads(),
                                           demoted=demoted)
         for name in demoted:

@@ -1,0 +1,29 @@
+"""QoS scheduling (docs/scheduling.md): priority classes + weighted-fair
+admission ordering (:mod:`policy`), the single shed-decision point
+(:mod:`cost`), and the mid-decode preemption controller (:mod:`preempt`).
+
+The subsystem is pure host-side policy: it reorders which pending request
+the engine admits next, decides rejections at submit time, and chooses
+preemption victims — it never adds a device program (the snapshot /
+restore / staged-injection families the engine already compiles are what
+a parked victim resumes through; analysis/compile_budget.json pins this).
+"""
+
+from quorum_tpu.sched.cost import CostModel, ShedDecision
+from quorum_tpu.sched.policy import (
+    PRIORITY_CLASSES,
+    SchedPolicy,
+    class_rank,
+    to_slo_class,
+)
+from quorum_tpu.sched.preempt import PreemptionController
+
+__all__ = [
+    "CostModel",
+    "PRIORITY_CLASSES",
+    "PreemptionController",
+    "SchedPolicy",
+    "ShedDecision",
+    "class_rank",
+    "to_slo_class",
+]

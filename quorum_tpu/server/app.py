@@ -333,7 +333,8 @@ def create_app(
                   "prefill_group_active", "decode_group_active",
                   "zero_drain", "breaker_state",
                   "kv_pages", "kv_page_size",
-                  "kv_pages_allocated", "kv_pages_free")
+                  "kv_pages_allocated", "kv_pages_free",
+                  "qos")
         # One snapshot per distinct engine (_distinct_engines). Each
         # family's TYPE line appears exactly once, with all its samples
         # grouped — the Prometheus text format rejects repeated TYPE lines.
@@ -435,6 +436,22 @@ def create_app(
             store = getattr(engine, "prefix_store", None)
             if store is not None:
                 prefix_store_bytes += int(store.bytes_held or 0)
+        # QoS scheduler plane (docs/scheduling.md): cost-model EWMAs and
+        # shed counters per distinct engine, plus the per-class pending
+        # breakdown — all host-side counters, same cost rule as above.
+        sched = {}
+        for name, engine in _distinct_engines(reg, "cost_model"):
+            cm = getattr(engine, "cost_model", None)
+            if cm is None:
+                continue
+            entry = dict(cm.snapshot())
+            entry["qos"] = bool(getattr(engine, "qos", False))
+            policy = getattr(engine, "_policy", None)
+            if policy is not None:
+                with engine._cond:
+                    entry["queue_depths"] = policy.queue_depths(
+                        engine._pending)
+            sched[name] = entry
         return JSONResponse({
             # perf_counter sample: the fleet-timeline merger estimates
             # this process's clock offset from (poll request, response,
@@ -447,6 +464,7 @@ def create_app(
             "breaker": breakers,
             "latency": latency,
             "prefix_store_bytes": prefix_store_bytes,
+            "sched": sched,
         })
 
     @app.route("POST", "/debug/profile", "/v1/debug/profile")

@@ -38,6 +38,7 @@ import json
 import os
 import sys
 import tempfile
+import threading
 import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
@@ -396,6 +397,148 @@ async def _fleet_trace_drill(check) -> None:
             if proc is not None and proc.poll() is None:
                 proc.kill()
                 proc.wait()
+
+
+async def _qos_preemption_drill(check) -> None:
+    """Phase 8 (docs/scheduling.md): the preemption contract under fault.
+
+    Own app on a dedicated qos=1 engine — slots=1 so an interactive
+    arrival NEVER finds a free slot (the preemption path is the only way
+    in), kv_pages=1 so the drill also audits page accounting across
+    park/resume. Three checks:
+
+      1. an interactive arrival mid-decode preempts the batch resident
+         and admits (the beneficiary finishes first);
+      2. the parked victim's stream is token-for-token identical to its
+         solo (uncontended) run — the preemption contract;
+      3. with ``engine.preempt`` armed, the park fault dooms ONLY the
+         victim: the beneficiary still admits and completes, the next
+         request is clean, and the page pool drains to zero (no leaked
+         pages from the half-parked row).
+    """
+    import queue as _queue
+
+    from quorum_tpu import faults
+    from quorum_tpu.config import Config
+    from quorum_tpu.server.app import create_app
+
+    cfg = {
+        "settings": {"timeout": 60},
+        "primary_backends": [{
+            "name": "Q",
+            # d_model=96 ≠ the main engine's 128: a distinct cache key,
+            # so this drill never flips qos on the shared phase-0 engine.
+            "url": ("tpu://llama-tiny?d_model=96&max_seq=128"
+                    "&slots=1&queue=8&decode_chunk=4&max_tokens=64"
+                    "&qos=1&kv_pages=1&kv_page_size=16"),
+            "model": "chaos-qos",
+        }],
+    }
+    app = create_app(Config(raw=cfg), watch_config=False)
+    backend = app.state["registry"].get("Q")
+    eng = backend.engine
+    check("qos: engine flag set via URL opt", bool(eng.qos))
+    tok = backend.tokenizer
+    victim_ids = tok.encode("the quick brown fox jumps over")
+    bene_ids = tok.encode("hello there")
+
+    def run_solo(ids, n, *, priority=None):
+        req = eng.submit(list(ids), max_new_tokens=n, seed=5,
+                         eos_id=None, priority=priority)
+        return list(eng.stream_results(req))
+
+    def drain_async(req, sink):
+        try:
+            for t in eng.stream_results(req):
+                sink.append(t)
+        except Exception:
+            # Armed arm: the doomed victim's stream raises FaultInjected
+            # here — the drill inspects the err frame / short stream
+            # directly, so the thread just exits quietly.
+            pass
+
+    solo = run_solo(victim_ids, 48)
+    check("qos: solo baseline nonempty", len(solo) > 0)
+
+    async def drill(label, armed):
+        if armed:
+            faults.reset_counts()
+            faults.arm("engine.preempt", times=1)
+        # The tiny model decodes its whole 48-token budget in tens of
+        # milliseconds: on a loaded core the victim can finish before the
+        # interactive arrival's admission attempt ever flags it. Retry
+        # the attempt until a preemption (or the armed fault) is actually
+        # observed — every attempt still checks the full contract.
+        for attempt in range(5):
+            before = eng.n_preemptions
+            victim = eng.submit(list(victim_ids), max_new_tokens=48,
+                                seed=5, eos_id=None, priority="batch")
+            got: list[int] = []
+            th = threading.Thread(target=drain_async, args=(victim, got),
+                                  daemon=True)
+            th.start()
+            # The victim must be mid-decode when the interactive request
+            # lands, or there is nothing to preempt.
+            deadline_t = time.time() + 30
+            while victim.emitted < 6 and time.time() < deadline_t:
+                await asyncio.sleep(0.01)
+            bene = eng.submit(list(bene_ids), max_new_tokens=8, seed=9,
+                              eos_id=None, priority="interactive")
+            bene_got = list(await asyncio.to_thread(
+                lambda: list(eng.stream_results(bene))))
+            await asyncio.to_thread(th.join, 60)
+            hit = (faults.fired("engine.preempt") >= 1 if armed
+                   else eng.n_preemptions > before)
+            if hit:
+                break
+        if armed:
+            faults.disarm()
+            check("qos: preempt fault fired",
+                  faults.fired("engine.preempt") >= 1)
+            # The fault lands between flag and park: the victim alone is
+            # doomed (an err frame ended its stream mid-generation).
+            err = None
+            try:
+                while True:
+                    kind, val = victim.out.get_nowait()
+                    if kind == "err":
+                        err = val
+            except _queue.Empty:
+                pass
+            check("qos: faulted park dooms only the victim",
+                  err is not None or len(got) < len(solo),
+                  f"err={err!r} got={len(got)}/{len(solo)}")
+        else:
+            check("qos: preemption occurred",
+                  eng.n_preemptions == before + 1,
+                  f"preemptions {before}->{eng.n_preemptions}")
+            check("qos: victim stream token-exact across park/resume",
+                  got == solo, f"lens {len(got)} vs {len(solo)}")
+        check(f"qos: beneficiary admitted and completed ({label})",
+              len(bene_got) == 8, f"got {len(bene_got)}")
+
+    await drill("clean", armed=False)
+    await drill("faulted", armed=True)
+
+    # Post-drill hygiene: a fresh request is clean, and page accounting
+    # is exact — allocated pages are retained prefix donors only (live
+    # claims all zero, pool conserved); a conservation miss means the
+    # faulted park lost a row's pages (the exact-accounting half of the
+    # phase).
+    again = run_solo(victim_ids, 48)
+    check("qos: next request after fault matches solo", again == solo)
+    m = eng.metrics()
+    with eng._cond:
+        live_claims = sum(eng._page_claims)
+    check("qos: page accounting exact (no leaked pages or claims)",
+          m.get("kv_pages_allocated", 0) + m.get("kv_pages_free", 0)
+          == eng.kv_pool_pages and live_claims == 0,
+          f"allocated={m.get('kv_pages_allocated')} "
+          f"free={m.get('kv_pages_free')} pool={eng.kv_pool_pages} "
+          f"claims={live_claims}")
+    check("qos: preemption metrics exported",
+          m.get("qos") == 1 and m.get("preemptions_total", 0) >= 1
+          and m.get("preempted_tokens_total", 0) >= 1)
 
 
 def _config() -> dict:
@@ -879,6 +1022,15 @@ async def _run(quick: bool) -> None:
         if not quick:
             print("phase 7: fleet trace continuity", flush=True)
             await _fleet_trace_drill(check)
+
+        # ---- phase 8: QoS preemption under fault -------------------------
+        # The qos=1 scheduler's contract (docs/scheduling.md): a
+        # mid-decode park is token-exact for the victim, admits the
+        # beneficiary, and a fault AT the park point (engine.preempt)
+        # dooms only the victim with page accounting exact afterwards.
+        if not quick:
+            print("phase 8: qos preemption", flush=True)
+            await _qos_preemption_drill(check)
 
     from quorum_tpu.engine.engine import shutdown_all_engines
 

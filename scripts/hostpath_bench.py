@@ -30,8 +30,14 @@ decode ring and interleaves its prefill segments between decode chunks,
 while the disagg engine prefills on its own device group and hands the KV
 off device→device, so the streaming gaps stay flat.
 
+The **qos leg** (`--only-qos`, docs/scheduling.md) is the scheduler's
+A/B: interactive TTFT p50/p99 under a batch-churn backlog, FIFO vs
+``qos=1`` (WFQ admission + mid-decode preemption), against an
+uncontended solo floor, plus the batch-throughput cost and the
+preemption/replay counters.
+
 Usage:  python scripts/hostpath_bench.py [--tokens N] [--chunk C]
-        [--depth K] [--loop C] [--skip-interference]
+        [--depth K] [--loop C] [--skip-interference] [--skip-qos]
 Prints one human-readable block and one machine-parsable JSON line.
 ``make hostpath-bench`` runs it; tests/test_hostpath_bench.py is the suite's
 smoke over the same entry points.
@@ -526,6 +532,119 @@ def paged(tokens: int = 8, streams: int = 24, page_size: int = 16,
     return out
 
 
+def qos(tokens: int = 24, churn: int = 3, arrivals: int = 8) -> dict:
+    """QoS scheduler A/B (ISSUE 18, docs/scheduling.md): interactive TTFT
+    under a batch backlog, FIFO vs ``qos=1``, on one llama-tiny engine.
+
+    Both arms run the SAME mixed load — ``churn`` threads submitting
+    ``priority="batch"`` streams of ``tokens`` tokens back-to-back, with
+    ``arrivals`` sequential ``priority="interactive"`` requests measured
+    for TTFT (submit → first token). The FIFO arm queues each interactive
+    arrival behind whole batch generations; the qos arm admits it past
+    the backlog (WFQ order) and, with every slot busy, parks a batch
+    resident (mid-decode preemption — victims resume token-exactly, the
+    contract tests/test_sched.py pins). Reports per arm: interactive
+    TTFT p50/p99, batch churn throughput (the degradation cost), and for
+    the qos arm the preemption/replay counters. A solo (uncontended)
+    TTFT floor anchors the comparison."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+
+    from quorum_tpu.engine.engine import InferenceEngine
+    from quorum_tpu.models.model_config import MODEL_PRESETS
+    from quorum_tpu.ops.sampling import SamplerConfig
+
+    spec = MODEL_PRESETS["llama-tiny"]
+    greedy = SamplerConfig(temperature=0.0)
+    iprompt = [17, 23, 31, 47, 53]
+
+    def churn_ids(i: int) -> list[int]:
+        return [(5 + 3 * i + j) % (spec.vocab_size - 1) + 1
+                for j in range(10)]
+
+    def pct(xs: list[float], p: float) -> float:
+        xs = sorted(xs)
+        return round(xs[min(len(xs) - 1, int(p * len(xs)))], 1)
+
+    def ttft_one(eng, prio: "str | None") -> float:
+        t0 = time.perf_counter()
+        req = eng.submit(list(iprompt), max_new_tokens=4, sampler=greedy,
+                         seed=1, priority=prio)
+        it = eng.stream_results(req)
+        next(it, None)
+        ttft_ms = (time.perf_counter() - t0) * 1000.0
+        for _ in it:
+            pass
+        return ttft_ms
+
+    def wait_backlog(eng, budget_s: float = 2.0) -> None:
+        """Admit the next interactive arrival against a FORMED backlog
+        (every slot batch-resident): both arms measure the same contended
+        moment instead of racing the churn threads' re-submit gap."""
+        t_end = time.perf_counter() + budget_s
+        while time.perf_counter() < t_end:
+            with eng._cond:
+                if all(r is not None for r in eng._slots):
+                    return
+            time.sleep(0.001)
+
+    out: dict = {"qos_arrivals": arrivals, "qos_churn_threads": churn,
+                 "qos_churn_tokens": tokens}
+    for tag, qos_on in (("fifo", False), ("qos", True)):
+        eng = InferenceEngine(spec, n_slots=2, decode_chunk=4,
+                              prefill_chunk=16, qos=qos_on)
+        eng.generate(iprompt, max_new_tokens=4, sampler=greedy)  # warm
+        eng.generate(churn_ids(0), max_new_tokens=tokens, sampler=greedy)
+        if not qos_on:
+            solo = [ttft_one(eng, None) for _ in range(arrivals)]
+            out["qos_solo_ttft_p50_ms"] = pct(solo, 0.5)
+            out["qos_solo_ttft_p99_ms"] = pct(solo, 0.99)
+        stop = threading.Event()
+        done = {"streams": 0, "tokens": 0}
+
+        def churn_loop(k: int) -> None:
+            i = k
+            while not stop.is_set():
+                req = eng.submit(churn_ids(i), max_new_tokens=tokens,
+                                 sampler=greedy, seed=i, priority="batch")
+                n = sum(1 for _ in eng.stream_results(req))
+                done["streams"] += 1
+                done["tokens"] += n
+                i += churn
+        ths = [threading.Thread(target=churn_loop, args=(k,), daemon=True)
+               for k in range(churn)]
+        t0 = time.perf_counter()
+        for t in ths:
+            t.start()
+        time.sleep(0.3)  # let the backlog form: both slots batch-resident
+        ttfts = []
+        for _ in range(arrivals):
+            wait_backlog(eng)
+            ttfts.append(ttft_one(eng, "interactive"))
+            time.sleep(0.05)
+        stop.set()
+        for t in ths:
+            t.join(30)
+        wall = time.perf_counter() - t0
+        out[f"qos_{tag}_interactive_ttft_p50_ms"] = pct(ttfts, 0.5)
+        out[f"qos_{tag}_interactive_ttft_p99_ms"] = pct(ttfts, 0.99)
+        out[f"qos_{tag}_churn_streams"] = done["streams"]
+        out[f"qos_{tag}_churn_tok_s"] = round(done["tokens"] / wall, 1)
+        if qos_on:
+            m = eng.metrics()
+            out["qos_preemptions"] = m["preemptions_total"]
+            out["qos_preempted_tokens"] = m["preempted_tokens_total"]
+            out["qos_replayed_tokens"] = m["replayed_tokens_total"]
+        eng.shutdown()
+    out["qos_ttft_p99_ratio"] = round(
+        out["qos_fifo_interactive_ttft_p99_ms"]
+        / max(1e-9, out["qos_qos_interactive_ttft_p99_ms"]), 2)
+    out["qos_batch_degradation"] = round(
+        out["qos_qos_churn_tok_s"]
+        / max(1e-9, out["qos_fifo_churn_tok_s"]), 2)
+    return out
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--tokens", type=int, default=64)
@@ -558,7 +677,17 @@ def main() -> int:
     ap.add_argument("--only-paged", action="store_true",
                     help="run ONLY the paged-KV rows-per-chip legs "
                          "(bench.py's subprocess phase)")
+    ap.add_argument("--skip-qos", action="store_true",
+                    help="skip the QoS scheduler A/B legs")
+    ap.add_argument("--only-qos", action="store_true",
+                    help="run ONLY the QoS scheduler A/B legs (bench.py's "
+                         "subprocess phase)")
     args = ap.parse_args()
+    if args.only_qos:
+        mq = qos()
+        _print_qos(mq)
+        print(json.dumps(mq), flush=True)
+        return 0
     if args.only_paged:
         mp = paged()
         _print_paged(mp)
@@ -690,6 +819,10 @@ def main() -> int:
         mp = paged()
         _print_paged(mp)
         m.update(mp)
+    if not args.skip_qos:
+        mq = qos()
+        _print_qos(mq)
+        m.update(mq)
     print(json.dumps(m), flush=True)
     return 0
 
@@ -706,6 +839,26 @@ def _print_paged(mp: dict) -> None:
     print(f"  rows/chip: {mp['paged_rows_per_chip_ratio']:.1f}x "
           f"(gate: >= 4x), token-for-token identical: "
           f"{mp['paged_tokens_match']}")
+
+
+def _print_qos(mq: dict) -> None:
+    print(f"qos scheduler A/B ({mq['qos_churn_threads']}-thread batch "
+          f"churn, {mq['qos_arrivals']} interactive arrivals):")
+    print(f"  solo floor : interactive TTFT p50 "
+          f"{mq['qos_solo_ttft_p50_ms']} ms, p99 "
+          f"{mq['qos_solo_ttft_p99_ms']} ms (uncontended)")
+    for tag, label in (("fifo", "fifo (qos=0)"), ("qos", "qos=1      ")):
+        print(f"  {label}: interactive TTFT p50 "
+              f"{mq[f'qos_{tag}_interactive_ttft_p50_ms']} ms, p99 "
+              f"{mq[f'qos_{tag}_interactive_ttft_p99_ms']} ms; batch "
+              f"{mq[f'qos_{tag}_churn_tok_s']} tok/s "
+              f"({mq[f'qos_{tag}_churn_streams']} streams)")
+    print(f"  p99 fifo/qos: {mq['qos_ttft_p99_ratio']:.2f}x (higher = qos "
+          f"insulates better); batch cost: "
+          f"{mq['qos_batch_degradation']:.2f}x of fifo throughput; "
+          f"preemptions {mq['qos_preemptions']} "
+          f"({mq['qos_preempted_tokens']} tokens parked, "
+          f"{mq['qos_replayed_tokens']} replayed token-exactly)")
 
 
 def _print_sharded(msh: dict) -> None:

@@ -528,6 +528,24 @@ def main() -> None:
                 bank(run_step(
                     arm, [sys.executable, "-c", _SERVE_ONE, arm_url, "2",
                           arm, "600"], budget=b))
+    if "qos" not in skip:
+        # QoS scheduler A/B (kv_pages off, PERF.md §5 step 7d): qos=1 vs
+        # FIFO at 7B under mixed-class load, SINGLE chip, SEPARATE
+        # processes per arm (qos is host policy — same programs both arms
+        # — but the FIFO arm must never have seen a preemption). The CPU
+        # bench (make hostpath-bench --only-qos) already pins the
+        # contract (victim streams token-exact, interactive admitted past
+        # the batch backlog); this measures interactive p99 TTFT vs solo
+        # and the batch tok/s cost of preemption at 7B, where a parked
+        # row's replay rides the prefix cache instead of re-prefilling.
+        for arm, arm_url in (
+                ("qos_off", B7_URL),
+                ("qos_on", B7_URL + "&qos=1")):
+            b = fits(arm, 1500)
+            if b:
+                bank(run_step(
+                    arm, [sys.executable, "-c", _SERVE_ONE, arm_url, "2",
+                          arm, "600"], budget=b))
     if "qq" not in skip:
         b = fits("qq", 3100, n_children=2)  # two ~1500s precision arms
         if b:

@@ -132,9 +132,10 @@ def _config():
             # the exposition's engine block reports.
             {"name": "LLM1",
              # kv_pages=1 so the paged-pool gauge/counter families
-             # (ISSUE 17) ride the same live exposition.
+             # (ISSUE 17) ride the same live exposition; qos=1 so the
+             # scheduler families (ISSUE 18) do too.
              "url": "tpu://llama-tiny?seed=3&slots=2&prefix_store=host"
-                    "&decode_loop=2&kv_pages=1",
+                    "&decode_loop=2&kv_pages=1&qos=1",
              "model": "t"},
         ],
     }
@@ -248,6 +249,23 @@ async def test_live_metrics_exposition_validates():
                     "quorum_tpu_engine_spec_accepted_total",
                     "quorum_tpu_engine_spec_draft_tokens_total",
                     "quorum_tpu_engine_spec_overlapped_total"):
+        assert f"# TYPE {counter} counter" in text, counter
+
+    # QoS scheduler families (ISSUE 18, docs/scheduling.md): the
+    # preemption counters and the per-class queue-depth gauge expose even
+    # at zero (no preemption happened for this traffic), and the engine
+    # block carries the qos flag plus the per-engine preempt/replay/shed
+    # split — qos is a gauge (a flag), the rest counters
+    for counter in ("quorum_tpu_preemptions_total",
+                    "quorum_tpu_preempted_tokens_total"):
+        assert f"# TYPE {counter} counter" in text, counter
+    assert "# TYPE quorum_tpu_sched_queue_depth gauge" in text
+    assert "# TYPE quorum_tpu_engine_qos gauge" in text
+    assert 'quorum_tpu_engine_qos{backend="LLM1"} 1' in text
+    for counter in ("quorum_tpu_engine_preemptions_total",
+                    "quorum_tpu_engine_preempted_tokens_total",
+                    "quorum_tpu_engine_replayed_tokens_total",
+                    "quorum_tpu_engine_predictive_sheds_total"):
         assert f"# TYPE {counter} counter" in text, counter
 
     # recompile sentinel (ISSUE 9, docs/static_analysis.md): the counter

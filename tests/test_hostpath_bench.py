@@ -23,7 +23,8 @@ into every suite run), and pins the dispatch accounting the bench reports:
     printed number — wall-clock on a shared CI core flakes)
 """
 
-from scripts.hostpath_bench import interference, paged, run, sharded, spec
+from scripts.hostpath_bench import (interference, paged, qos, run, sharded,
+                                    spec)
 
 
 def test_hostpath_bench_counters():
@@ -138,3 +139,27 @@ def test_paged_bench_smoke():
     assert m["paged_paged_peak_rows"] > m["paged_dense_rows"]
     assert m["paged_rows_per_chip_ratio"] >= 2.0
     assert 0.0 < m["paged_peak_page_occupancy"] <= 1.0
+
+
+def test_qos_bench_smoke():
+    """The QoS scheduler A/B legs (ISSUE 18, docs/scheduling.md): both
+    arms complete mixed interactive+batch churn, preemptions fire on the
+    qos arm with every parked token replayed (token-exactness itself is
+    pinned by tests/test_sched.py), and the ratios are finite numbers
+    (the fifo/qos p99 ORDERING is the bench's printed acceptance —
+    wall-clock percentiles on a shared CI core flake)."""
+    m = qos(tokens=24, churn=3, arrivals=4)
+    for tag in ("fifo", "qos"):
+        assert m[f"qos_{tag}_interactive_ttft_p50_ms"] >= 0.0
+        assert m[f"qos_{tag}_interactive_ttft_p99_ms"] >= \
+            m[f"qos_{tag}_interactive_ttft_p50_ms"] - 1e-9
+        assert m[f"qos_{tag}_churn_streams"] > 0
+        assert m[f"qos_{tag}_churn_tok_s"] > 0
+    assert m["qos_solo_ttft_p50_ms"] >= 0.0
+    # The qos arm really scheduled: preemptions fired and every parked
+    # token was regenerated through the replay guard.
+    assert m["qos_preemptions"] >= 1, m
+    assert m["qos_preempted_tokens"] >= 1
+    assert m["qos_replayed_tokens"] == m["qos_preempted_tokens"]
+    assert m["qos_ttft_p99_ratio"] > 0.0
+    assert m["qos_batch_degradation"] > 0.0

@@ -493,3 +493,35 @@ def test_transient_exhaustion_queues_and_drains():
     finally:
         paged.shutdown()
         dense.shutdown()
+
+
+@slow
+def test_self_donor_reclaim_unwedges_own_slot():
+    """A slot group's OWN retained donor chain is a page source for its
+    own next claim: a donor holding most of the pool must not wedge the
+    slot's re-admission (pre-PR-18 this deadlocked — _paged_fits and
+    _paged_claim protected the claiming group's donor from reclaim while
+    its pages were neither free nor reclaimable, so the admission waited
+    forever; surfaced by chaos phase 8). The resubmission still streams
+    token for token what the first run streamed."""
+    eng = InferenceEngine(SPEC, seed=0, n_slots=1, kv_pages=True,
+                          kv_page_size=16, decode_chunk=4)
+    try:
+        prompt = list(range(3, 33))  # 30 tokens
+        # 30 prompt + 48 budget + 1 overshoot = 79 positions -> 5 of the
+        # 8 pool pages; the retained donor after the first run holds all
+        # 5, leaving only 3 free.
+        first = _gen(eng, prompt, 48)
+        assert len(first) == 48
+        done = {}
+
+        def run():
+            done["out"] = _gen(eng, prompt, 48)
+
+        th = threading.Thread(target=run, daemon=True)
+        th.start()
+        th.join(60)
+        assert not th.is_alive(), "re-admission wedged on own donor"
+        assert done["out"] == first
+    finally:
+        eng.shutdown()

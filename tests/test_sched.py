@@ -1,0 +1,539 @@
+"""QoS scheduler (``qos=1``, ISSUE 18, docs/scheduling.md) acceptance:
+
+- **policy core**: explicit ``priority`` wins, headroom derives the rest
+  (``background`` never derived); WFQ gives every backlogged class its
+  w/Σw share; within a class earliest-deadline-headroom first, with
+  resume credit ahead of fresh arrivals; idle classes cannot bank credit.
+- **cost model**: the ONE shed decision point — capacity messages stay
+  byte-identical to the pre-QoS engine, Retry-After turns honest once the
+  EWMAs are warm, and the predictive shed never fires cold or with QoS
+  off.
+- **preemption**: an interactive arrival with no free slot parks a
+  strictly-lower-class resident at a reap boundary, and the parked stream
+  is TOKEN-FOR-TOKEN identical to its unpreempted run — greedy and
+  sampled, dense and paged, colocated and zero-drain (the replay-based
+  resume contract; no new device programs).
+- **cache-key pin**: ``qos`` is not part of the engine cache key — a
+  qos=1 backend shares the qos=0 backend's engine and flips the flag
+  (opt-in wins, the prefix_cache sharing rule).
+- **knob validation**: malformed ``priority``/``tenant`` are one 400 at
+  the HTTP edge and a ValueError at ``engine.submit``.
+
+Pure host policy/cost/controller tests are fast-tier; engine-scale
+preemption drills are slow-tier like every other engine test."""
+
+import dataclasses
+import threading
+import time
+
+import pytest
+
+from quorum_tpu import oai
+from quorum_tpu.engine.engine import (
+    DeadlineExceeded,
+    EngineBreakerOpen,
+    InferenceEngine,
+    QueueFullError,
+    get_engine,
+)
+from quorum_tpu.models.model_config import MODEL_PRESETS
+from quorum_tpu.ops.sampling import SamplerConfig
+from quorum_tpu.sched import (
+    PRIORITY_CLASSES,
+    CostModel,
+    PreemptionController,
+    SchedPolicy,
+    class_rank,
+    to_slo_class,
+)
+from quorum_tpu.sched.cost import MARGIN, MIN_OBS
+from quorum_tpu.sched.policy import _env_weights
+
+slow = pytest.mark.slow
+
+SPEC = dataclasses.replace(MODEL_PRESETS["llama-tiny"], max_seq=128)
+GREEDY = SamplerConfig(temperature=0.0)
+SAMPLED = SamplerConfig(temperature=0.9, top_p=0.9)
+
+
+class FakeReq:
+    """The duck-typed subset of engine._Request the policy layer reads."""
+
+    def __init__(self, cls="batch", deadline=None, t_submit=0.0,
+                 n_preempts=0, tenant=None, cancelled=False, want_lp=-1,
+                 emitted=0, preempt_flag=False, rid="r"):
+        self.sched_class = cls
+        self.deadline = deadline
+        self.t_submit = t_submit
+        self.n_preempts = n_preempts
+        self.tenant = tenant
+        self.cancel = threading.Event()
+        if cancelled:
+            self.cancel.set()
+        self.want_lp = want_lp
+        self.emitted = emitted
+        self.preempt_flag = preempt_flag
+        self.rid = rid
+
+
+# ---- policy core (fast) ----------------------------------------------------
+
+
+def test_classify_explicit_knob_wins_background_never_derived():
+    p = SchedPolicy()
+    now = 100.0
+    assert p.classify("background", now + 1, now) == "background"
+    assert p.classify("interactive", None, now) == "interactive"
+    # derived: tight headroom -> interactive, loose/none -> batch
+    assert p.classify(None, now + 1.0, now) == "interactive"
+    assert p.classify(None, now + 10_000.0, now) == "batch"
+    assert p.classify(None, None, now) == "batch"
+    # background is NEVER derived, only explicit
+    for deadline in (None, now + 0.5, now + 10_000.0):
+        assert p.classify(None, deadline, now) != "background"
+
+
+def test_class_rank_and_slo_mapping():
+    assert class_rank("interactive") < class_rank("batch") \
+        < class_rank("background")
+    assert class_rank("no-such-class") == class_rank("batch")
+    assert to_slo_class("interactive") == "interactive"
+    assert to_slo_class("batch") == "batch"
+    assert to_slo_class("background") == "batch"
+
+
+def test_wfq_backlogged_share_meets_floor():
+    """With every class backlogged, an order() pass interleaves classes
+    by weight: each class receives at least ~w/Σw of any admission
+    window (the starvation bound)."""
+    p = SchedPolicy(weights={"interactive": 4, "batch": 2, "background": 1})
+    pending = ([FakeReq("interactive", t_submit=i) for i in range(14)]
+               + [FakeReq("batch", t_submit=i) for i in range(14)]
+               + [FakeReq("background", t_submit=i) for i in range(14)])
+    ordered = p.order(pending, now=0.0)
+    first14 = [r.sched_class for r in ordered[:14]]
+    # 14 admissions at 4:2:1 -> interactive 8, batch 4, background 2
+    assert first14.count("interactive") == 8, first14
+    assert first14.count("batch") == 4, first14
+    assert first14.count("background") == 2, first14
+    # order() simulated picks must not move the real clocks
+    assert all(v == 0.0 for v in p._vtime.values())
+
+
+def test_within_class_headroom_then_fifo_then_resume_credit():
+    p = SchedPolicy()
+    now = 50.0
+    tight = FakeReq("batch", deadline=now + 1, t_submit=3.0)
+    loose = FakeReq("batch", deadline=now + 100, t_submit=1.0)
+    none_ = FakeReq("batch", deadline=None, t_submit=0.0)
+    pending = [none_, loose, tight]
+    assert pending[p.pick(pending, now)] is tight  # earliest headroom
+    # resume credit beats even tighter headroom: a parked victim goes
+    # first within its class
+    parked = FakeReq("batch", deadline=None, t_submit=9.0, n_preempts=1)
+    pending = [none_, loose, tight, parked]
+    assert pending[p.pick(pending, now)] is parked
+    # FIFO is the final tie-break
+    a = FakeReq("batch", t_submit=1.0)
+    b = FakeReq("batch", t_submit=2.0)
+    assert [b, a][p.pick([b, a], now)] is a
+
+
+def test_idle_class_cannot_bank_credit():
+    """A long-idle class's clock re-syncs to the floor on its next
+    charge: it does not monopolize admissions afterwards."""
+    p = SchedPolicy(weights={"interactive": 1, "batch": 1, "background": 1})
+    for i in range(50):  # batch runs alone for a long while
+        p.charge(FakeReq("batch"))
+    pending = [FakeReq("interactive", t_submit=i) for i in range(4)] \
+        + [FakeReq("batch", t_submit=i) for i in range(4)]
+    ordered = p.order(pending, now=0.0)
+    # equal weights -> the first charge clamps interactive's clock to the
+    # system floor, so it gets at most ~one turn of credit: batch is back
+    # in rotation within three picks instead of after four
+    classes = [r.sched_class for r in ordered]
+    assert "batch" in classes[:3], classes
+    assert classes[:4] != ["interactive"] * 4, classes
+
+
+def test_tenant_weight_scales_within_class():
+    p = SchedPolicy(weights={"interactive": 1, "batch": 1, "background": 1},
+                    tenant_weights={"heavy": 4.0})
+    # identical classes: the heavy tenant's admissions advance the class
+    # clock 4x slower, so its requests cost less virtual time
+    before = dict(p._vtime)
+    p.charge(FakeReq("batch", tenant="heavy"))
+    light_cost = None
+    q = SchedPolicy(weights={"interactive": 1, "batch": 1, "background": 1},
+                    tenant_weights={"heavy": 4.0})
+    q.charge(FakeReq("batch", tenant=None))
+    light_cost = q._vtime["batch"]
+    assert p._vtime["batch"] == pytest.approx(light_cost / 4.0)
+    assert before["batch"] == 0.0
+
+
+def test_env_weights_parse_and_clamp(monkeypatch):
+    monkeypatch.setenv("X_W", "batch=5,junk,=3,background=-1,"
+                              "interactive=not-a-number, spaced = 2 ")
+    w = _env_weights("X_W", {"interactive": 4.0, "batch": 2.0,
+                             "background": 1.0})
+    assert w["batch"] == 5.0          # parsed
+    assert w["background"] == 1.0     # non-positive ignored
+    assert w["interactive"] == 4.0    # malformed ignored
+    assert w["spaced"] == 2.0         # whitespace tolerated
+
+
+def test_queue_depths_counts_by_class():
+    p = SchedPolicy()
+    pending = [FakeReq("interactive"), FakeReq("batch"), FakeReq("batch"),
+               FakeReq("background")]
+    assert p.queue_depths(pending) == {
+        "interactive": 1, "batch": 2, "background": 1}
+    assert p.queue_depths([]) == {c: 0 for c in PRIORITY_CLASSES}
+
+
+# ---- cost model (fast) -----------------------------------------------------
+
+
+class FakeBreaker:
+    def __init__(self, open_=False, ra=5.0):
+        self._open = open_
+        self._ra = ra
+
+    def allow(self, now):
+        return not self._open
+
+    def retry_after(self, now):
+        return self._ra
+
+
+def test_presubmit_deadline_and_breaker():
+    cm = CostModel()
+    assert cm.presubmit(now=10.0, deadline=20.0, breaker=None) is None
+    d = cm.presubmit(now=10.0, deadline=10.0, breaker=None)
+    assert d is not None and d.kind == "deadline"
+    b = cm.presubmit(now=10.0, deadline=None, breaker=FakeBreaker(True, 7.5))
+    assert b is not None and b.kind == "breaker" and b.retry_after == 7.5
+
+
+def test_capacity_messages_byte_identical_to_pre_qos():
+    """The queue-full and pool-span texts are a client-facing contract
+    (the HTTP layer threads them into 503 bodies verbatim)."""
+    cm = CostModel()
+    full = cm.queue_check(now=0.0, deadline=None, n_pending=8,
+                          max_pending=8, qos=False)
+    assert full.kind == "queue_full"
+    assert full.detail == "engine admission queue full (8 waiting)"
+    assert full.retry_after == 1.0  # cold: the historical floor
+    span = cm.queue_check(now=0.0, deadline=None, n_pending=0,
+                          max_pending=8, qos=False, page_need=40,
+                          pool_pages=32)
+    assert span.kind == "pool_span"
+    assert span.detail == ("request span of 40 pages exceeds the kv page "
+                           "pool (32 pages)")
+
+
+def test_predictive_shed_gates_cold_warm_and_off():
+    cm = CostModel()
+    tight = dict(now=0.0, deadline=0.5, n_pending=4, max_pending=64)
+    # cold: no evidence, never sheds (FIFO-era behaviour preserved)
+    assert cm.queue_check(qos=True, **tight) is None
+    for _ in range(MIN_OBS):
+        cm.observe_queue_wait(2.0)
+        cm.observe_service(3.0)
+    # warm + qos: est = 2 + 3*3 = 11s >> MARGIN * 0.5s -> shed
+    d = cm.queue_check(qos=True, **tight)
+    assert d is not None and d.kind == "deadline"
+    assert d.retry_after >= 1.0
+    assert cm.n_predictive_sheds == 1
+    # same evidence, qos off: never predictive-sheds
+    assert cm.queue_check(qos=False, **tight) is None
+    # no deadline: nothing to be infeasible against
+    assert cm.queue_check(qos=True, now=0.0, deadline=None, n_pending=4,
+                          max_pending=64) is None
+    # empty queue: the head admits immediately, no prediction
+    assert cm.queue_check(qos=True, now=0.0, deadline=0.5, n_pending=0,
+                          max_pending=64) is None
+    # generous headroom: est within MARGIN x remaining -> no shed
+    assert cm.queue_check(qos=True, now=0.0, deadline=100.0, n_pending=4,
+                          max_pending=64) is None
+
+
+def test_retry_hint_honest_once_warm():
+    cm = CostModel()
+    assert cm.retry_hint() == 1.0
+    for _ in range(MIN_OBS):
+        cm.observe_queue_wait(4.0)
+    assert cm.retry_hint() == pytest.approx(4.0)
+    # sub-second queues keep the 1s floor the HTTP layer always advertised
+    cm2 = CostModel()
+    for _ in range(MIN_OBS):
+        cm2.observe_queue_wait(0.05)
+    assert cm2.retry_hint() == 1.0
+
+
+def test_estimated_queue_wait_shape():
+    cm = CostModel()
+    assert cm.estimated_queue_wait(3) is None  # cold
+    for _ in range(MIN_OBS):
+        cm.observe_queue_wait(1.0)
+        cm.observe_service(2.0)
+    assert cm.estimated_queue_wait(1) == pytest.approx(1.0)
+    assert cm.estimated_queue_wait(3) == pytest.approx(1.0 + 2 * 2.0)
+
+
+def test_expired_predicate_and_snapshot():
+    cm = CostModel()
+    r = FakeReq(deadline=5.0)
+    assert CostModel.expired(r, 6.0)
+    assert not CostModel.expired(r, 4.0)
+    r.cancel.set()
+    assert not CostModel.expired(r, 6.0)  # already cancelled: not re-shed
+    assert not CostModel.expired(FakeReq(deadline=None), 6.0)
+    snap = cm.snapshot()
+    assert set(snap) == {"queue_wait_ewma_s", "service_ewma_s",
+                         "queue_obs", "service_obs", "predictive_sheds"}
+    # MARGIN is the documented 2x conservatism; a drive-by change to it
+    # should have to touch this pin
+    assert MARGIN == 2.0
+
+
+# ---- preemption controller (fast) ------------------------------------------
+
+
+def test_pick_victim_strictly_lower_class_only():
+    pc = PreemptionController()
+    head = FakeReq("interactive")
+    slots = [FakeReq("interactive"), FakeReq("batch", emitted=5)]
+    row, victim = pc.pick_victim(head, slots, 0, len(slots))
+    assert row == 1 and victim is slots[1]
+    # equal class is never a victim
+    assert pc.pick_victim(FakeReq("batch"), [FakeReq("batch")], 0, 1) is None
+    # and a batch head can still preempt background
+    row, victim = pc.pick_victim(
+        FakeReq("batch"), [FakeReq("background", emitted=1)], 0, 1)
+    assert victim.sched_class == "background"
+
+
+def test_pick_victim_order_lowest_class_fewest_tokens_youngest():
+    pc = PreemptionController()
+    head = FakeReq("interactive")
+    bg_cheap = FakeReq("background", emitted=2, t_submit=5.0)
+    bg_deep = FakeReq("background", emitted=40, t_submit=1.0)
+    batch = FakeReq("batch", emitted=0, t_submit=0.0)
+    slots = [batch, bg_deep, bg_cheap]
+    row, victim = pc.pick_victim(head, slots, 0, len(slots))
+    assert victim is bg_cheap  # lowest class first, then fewest tokens
+
+
+def test_pick_victim_exclusions():
+    pc = PreemptionController(max_preempts=2)
+    head = FakeReq("interactive")
+    for bad in (FakeReq("batch", cancelled=True),
+                FakeReq("batch", preempt_flag=True),
+                FakeReq("batch", want_lp=0),       # logprobs delivered
+                FakeReq("batch", n_preempts=2),    # budget exhausted
+                None):
+        assert pc.pick_victim(head, [bad], 0, 1) is None
+
+
+# ---- knob validation (fast) ------------------------------------------------
+
+
+def test_http_priority_and_tenant_validation():
+    ok = {"messages": [{"role": "user", "content": "hi"}]}
+    assert oai.validate_request_body({**ok, "priority": "interactive"}) \
+        is None
+    assert oai.validate_request_body({**ok, "tenant": "acme"}) is None
+    for bad in ("urgent", 3, True, ""):
+        err = oai.validate_request_body({**ok, "priority": bad})
+        assert err is not None and "priority" in err, bad
+    for bad in ("", "x" * 65, 7, ["t"]):
+        err = oai.validate_request_body({**ok, "tenant": bad})
+        assert err is not None and "tenant" in err, bad
+
+
+# ---- engine integration (slow) ---------------------------------------------
+
+
+def _drain(eng, req, sink):
+    for t in eng.stream_results(req):
+        sink.append(t)
+
+
+def _preempt_drill(eng, sampler, *, seed=5):
+    """Run the canonical park/resume drill on ``eng`` (qos=1, slots=1):
+    a batch stream is mid-decode when an interactive arrival lands; the
+    victim must resume and match its solo run token for token."""
+    victim_ids = [11, 13, 17, 19, 23, 29]
+    solo = list(eng.stream_results(eng.submit(
+        list(victim_ids), max_new_tokens=40, sampler=sampler, seed=seed)))
+    assert len(solo) == 40
+    before = eng.n_preemptions
+    victim = eng.submit(list(victim_ids), max_new_tokens=40,
+                        sampler=sampler, seed=seed, priority="batch")
+    got: list = []
+    th = threading.Thread(target=_drain, args=(eng, victim, got),
+                          daemon=True)
+    th.start()
+    deadline = time.time() + 60
+    while victim.emitted < 8 and time.time() < deadline:
+        time.sleep(0.005)
+    assert victim.emitted >= 8, "victim never reached mid-decode"
+    bene = eng.submit([41, 43, 47], max_new_tokens=6, sampler=sampler,
+                      seed=9, priority="interactive")
+    bene_got = list(eng.stream_results(bene))
+    th.join(120)
+    assert not th.is_alive(), "victim stream never completed"
+    assert len(bene_got) == 6
+    assert eng.n_preemptions == before + 1, \
+        f"preemptions {before}->{eng.n_preemptions}"
+    assert got == solo, (len(got), len(solo))
+
+
+@slow
+@pytest.mark.parametrize("kw", [
+    dict(),                                   # dense colocated
+    dict(kv_pages=True, kv_page_size=16),     # paged colocated
+    dict(zero_drain=True, prefill_chunk=16),  # dense zero-drain
+], ids=["dense", "paged", "zero_drain"])
+@pytest.mark.parametrize("sampler", [GREEDY, SAMPLED],
+                         ids=["greedy", "sampled"])
+def test_preempted_stream_token_exact(kw, sampler):
+    eng = InferenceEngine(SPEC, seed=0, n_slots=1, decode_chunk=4,
+                          qos=True, **kw)
+    try:
+        _preempt_drill(eng, sampler)
+        m = eng.metrics()
+        assert m["qos"] == 1
+        assert m["preemptions_total"] >= 1
+        assert m["preempted_tokens_total"] >= 8
+        assert m["replayed_tokens_total"] == m["preempted_tokens_total"]
+        if eng.kv_pages:
+            # exact page accounting across park/resume: nothing leaked
+            # (allocated = retained prefix donors only, zero live claims)
+            assert m["kv_pages_allocated"] + m["kv_pages_free"] == \
+                eng.kv_pool_pages
+            with eng._cond:
+                assert all(c == 0 for c in eng._page_claims)
+    finally:
+        eng.shutdown()
+
+
+@slow
+def test_qos_not_in_engine_cache_key_and_opt_in_wins():
+    """The cache-key pin: a qos=0 and a qos=1 backend over the same
+    checkpoint share ONE engine (qos is pure host policy — no program or
+    weight difference), and any opt-in flips the shared flag."""
+    spec = dataclasses.replace(SPEC, max_seq=96)  # private cache row
+    e_off = get_engine(spec, seed=7, n_slots=1, qos=False)
+    e_on = get_engine(spec, seed=7, n_slots=1, qos=True)
+    try:
+        assert e_on is e_off
+        assert e_off.qos is True  # the explicit opt-in won
+        # and a later qos=False caller cannot un-opt the shared engine
+        assert get_engine(spec, seed=7, n_slots=1, qos=False).qos is True
+    finally:
+        e_off.shutdown()
+
+
+@slow
+def test_submit_rejects_unknown_priority():
+    eng = InferenceEngine(SPEC, seed=0, n_slots=1, qos=True)
+    try:
+        with pytest.raises(ValueError, match="priority"):
+            eng.submit([3, 4, 5], max_new_tokens=4, sampler=GREEDY,
+                       priority="urgent")
+    finally:
+        eng.shutdown()
+
+
+@slow
+def test_shed_mapping_deadline_breaker_queue_full():
+    """_raise_shed maps the cost model's decisions onto the engine's
+    typed exceptions: expired deadline -> DeadlineExceeded("queue"),
+    open breaker -> EngineBreakerOpen, capacity -> QueueFullError with a
+    dynamic retry_after the HTTP layer forwards as Retry-After."""
+    eng = InferenceEngine(SPEC, seed=0, n_slots=1, max_pending=1, qos=True)
+    try:
+        with pytest.raises(DeadlineExceeded):
+            eng.submit([3, 4, 5], max_new_tokens=4, sampler=GREEDY,
+                       deadline=time.monotonic() - 1.0)
+        # fill the slot and the 1-deep queue, then overflow it (early
+        # submits may admit before later ones arrive; keep pushing and
+        # keep every accepted handle so the drain below is complete)
+        cancel = threading.Event()
+        held = []
+        with pytest.raises(QueueFullError) as exc:
+            while True:
+                held.append(eng.submit([5, 6, 7] * 8, max_new_tokens=64,
+                                       sampler=GREEDY, cancel=cancel))
+        assert exc.value.retry_after >= 1.0
+        assert "admission queue full" in str(exc.value)
+        cancel.set()
+        for r in held:
+            for _ in eng.stream_results(r):
+                pass
+        # breaker: open it (threshold failures in-window) and expect the
+        # typed rejection
+        now = time.monotonic()
+        for _ in range(eng.breaker.threshold):
+            eng.breaker.record_failure(now)
+        with pytest.raises(EngineBreakerOpen):
+            eng.submit([3, 4], max_new_tokens=2, sampler=GREEDY)
+    finally:
+        eng.shutdown()
+
+
+@slow
+def test_predictive_shed_end_to_end():
+    """With warm EWMAs, live queue pressure, and a hopeless deadline, the
+    engine sheds at submit (DeadlineExceeded -> 503 queue stage) instead
+    of letting the request time out in line."""
+    eng = InferenceEngine(SPEC, seed=0, n_slots=1, qos=True)
+    try:
+        for _ in range(MIN_OBS):  # warm the evidence
+            eng.cost_model.observe_queue_wait(2.0)
+            eng.cost_model.observe_service(3.0)
+        cancel = threading.Event()
+        occupant = eng.submit([5, 6, 7] * 6, max_new_tokens=64,
+                              sampler=GREEDY, cancel=cancel)
+        waiter = eng.submit([6, 7, 8] * 6, max_new_tokens=8,
+                            sampler=GREEDY, cancel=cancel)
+        before = eng.cost_model.n_predictive_sheds
+        with pytest.raises(DeadlineExceeded):
+            eng.submit([9, 10, 11], max_new_tokens=4, sampler=GREEDY,
+                       deadline=time.monotonic() + 0.5)
+        assert eng.cost_model.n_predictive_sheds == before + 1
+        cancel.set()
+        for r in (occupant, waiter):
+            for _ in eng.stream_results(r):
+                pass
+    finally:
+        eng.shutdown()
+
+
+@slow
+def test_qos_off_is_fifo_and_inert():
+    """The default path: qos=0 admits in submit order (no policy pick),
+    exports qos=0, and never counts preemptions."""
+    eng = InferenceEngine(SPEC, seed=0, n_slots=2)
+    try:
+        outs = {}
+
+        def run(i):
+            outs[i] = list(eng.generate_stream(
+                [3 + i, 4 + i, 5 + i], max_new_tokens=6, sampler=GREEDY,
+                seed=i))
+        ths = [threading.Thread(target=run, args=(i,)) for i in range(6)]
+        for t in ths:
+            t.start()
+        for t in ths:
+            t.join(120)
+        assert len(outs) == 6
+        m = eng.metrics()
+        assert m["qos"] == 0
+        assert m["preemptions_total"] == 0
+        assert m["predictive_sheds_total"] == 0
+    finally:
+        eng.shutdown()
