@@ -291,6 +291,17 @@ class HttpBackend:
             for event in parser.flush():
                 if isinstance(event, dict):
                     yield event
+            # A compliant stream ALWAYS terminates with [DONE] (we return
+            # above); a clean EOF without it is a truncated stream — the
+            # upstream died after its last flushed frame. Surfacing it as
+            # a mid-stream failure (instead of normal exhaustion) is what
+            # lets the router's resume path catch deaths that land on a
+            # frame boundary.
+            raise BackendError(
+                f"Backend {self.name} stream ended without [DONE]",
+                status_code=500)
+        except BackendError:
+            raise
         except Exception as e:
             logger.warning("Backend %s stream failure: %s", self.name, e)
             raise BackendError(f"Backend {self.name} error: {e}", status_code=500) from e

@@ -840,6 +840,31 @@ class TpuBackend:
         max_new = _request_number(body, key, float(self.default_max_tokens))
         if max_new < 1:
             raise _invalid_request(f"Invalid value for {key!r}: must be >= 1")
+        # Cross-replica stream resume (docs/robustness.md "Zero-loss
+        # streams"): the router re-submits a broken stream with the ids it
+        # already delivered; the engine's replay guard swallows their
+        # regeneration. Shape-validated at the proxy edge
+        # (oai.validate_request_body) — re-checked here because the knob is
+        # vocabulary-dependent and internal callers can bypass the edge.
+        rt = body.get("resume_tokens")
+        if rt is not None:
+            vocab = self.engine.spec.vocab_size
+            if not (isinstance(rt, list) and rt and all(
+                    isinstance(t, int) and not isinstance(t, bool)
+                    and 0 <= t < vocab for t in rt)):
+                raise _invalid_request(
+                    "'resume_tokens' must be a non-empty list of in-vocab "
+                    "token ids")
+            if n != 1:
+                raise _invalid_request("'resume_tokens' requires n=1")
+            if want_lp:
+                raise _invalid_request(
+                    "'resume_tokens' cannot be combined with 'logprobs'")
+            if len(rt) > int(max_new):
+                raise _invalid_request(
+                    f"'resume_tokens' ({len(rt)} ids) exceeds the "
+                    f"completion budget ({int(max_new)})")
+        rc = body.get("resume_chars")
         return {
             "model": effective["model"],
             "prompt_ids": ids,
@@ -858,6 +883,11 @@ class TpuBackend:
             # engine.submit; inert unless the engine runs qos=1.
             "priority": body.get("priority"),
             "tenant": body.get("tenant"),
+            "resume_tokens": list(rt) if rt else None,
+            "resume_chars": int(rc) if rc is not None else None,
+            # Emit per-chunk token ids (``qt_tokens``) so the router can
+            # journal the stream for a possible future resume.
+            "stream_token_ids": bool(body.get("stream_token_ids")),
         }
 
     def _plan_grammar(self, rf: Any):
@@ -964,6 +994,9 @@ class TpuBackend:
             grammar=plan["grammar"],
             priority=plan.get("priority"),
             tenant=plan.get("tenant"),
+            # n == 1 is enforced whenever resume_tokens is set, so only
+            # choice 0 can ever carry a journal.
+            resume_tokens=plan.get("resume_tokens") if idx == 0 else None,
         )
 
     def _lp_entry(self, tid: int, record, top_n: int) -> dict[str, Any]:
@@ -1615,27 +1648,58 @@ class TpuBackend:
         except DeadlineExceeded as e:
             cancel_all()
             raise _deadline_error(self.name, e) from None
+        except ValueError as e:
+            # Engine-side resume validation (journal vs budget) — a bad
+            # journal is the caller's error, not a server fault.
+            cancel_all()
+            raise _invalid_request(str(e)) from None
 
         def produce(idx: int, req):
             """Drain one choice; events are (kind, choice_index, payload)."""
             detok = self.tokenizer.detokenizer()
             matcher = _StopMatcher(plan["stops"])
             pending_lp: list = []
+            # Token ids consumed since the last emitted text — shipped as
+            # ``qt_tokens`` on the chunk that carries their text, so the
+            # router's journal only ever names ids whose text the client
+            # actually received (ids with still-buffered bytes wait).
+            pending_ids: list = []
 
             def emit(text: str):
                 # Same alignment rule as _consume: entries ship only with
                 # the text that contains their token (stop-swallowed or
                 # still-buffered text keeps its entries pending).
                 lp = self._take_aligned(pending_lp, len(text))
+                ids, pending_ids[:] = list(pending_ids), []
                 loop.call_soon_threadsafe(
-                    queue.put_nowait, ("text", idx, (text, lp)))
+                    queue.put_nowait, ("text", idx, (text, lp, ids)))
 
             try:
+                resume = plan["resume_tokens"] if idx == 0 else None
+                if resume:
+                    # Rebuild the delivered prefix through a FRESH
+                    # detokenizer + stop matcher — the continuation then
+                    # renders byte-exactly where the dead replica's stream
+                    # stopped. The engine swallows the regenerated journal
+                    # tokens, so the loop below only ever sees NEW tokens.
+                    prefix = ""
+                    for tok in resume:
+                        prefix += matcher.feed(detok.feed(tok))
+                    want = plan["resume_chars"]
+                    if matcher.hit or (want is not None
+                                       and len(prefix) != want):
+                        why = (", stop string inside the journal"
+                               if matcher.hit else "")
+                        raise RuntimeError(
+                            "resume replay diverged before admission: "
+                            f"journal renders {len(prefix)} chars "
+                            f"(client received {want}{why})")
                 for i, tok in enumerate(self.engine.stream_results(req)):
                     if tok == self.tokenizer.eos_id:
                         finishes[idx] = "stop"
                         break
                     counts[idx] += 1
+                    pending_ids.append(tok)
                     if plan["logprobs"] >= 0 and i < len(req.lp):
                         pending_lp.append(
                             self._lp_entry(tok, req.lp[i], top_n))
@@ -1651,20 +1715,31 @@ class TpuBackend:
                         break
                     if text:
                         emit(text)
-                tail = matcher.feed(detok.flush()) + matcher.flush()
-                if matcher.hit:
-                    # Stop string completed in the flushed tail (see complete()).
-                    finishes[idx] = "stop"
-                if tail:
-                    emit(tail)
-                if pending_lp and not matcher.hit:
-                    # Same stranding fix as _consume: without a stop hit,
-                    # every delivered token's entry ships — in a final
-                    # (possibly empty-content) delta when byte-level decode
-                    # lengths outran the incremental text.
-                    rest, pending_lp = list(pending_lp), []
-                    loop.call_soon_threadsafe(
-                        queue.put_nowait, ("text", idx, ("", rest)))
+                if getattr(req, "parked", False):
+                    # Drain park (docs/robustness.md): the router resumes
+                    # this stream on a sibling from the delivered prefix.
+                    # Flushing the detok tail here would deliver text the
+                    # resumed stream re-renders (duplicate bytes) — hold
+                    # it back; the finish tells the router to resume, the
+                    # client never sees it.
+                    finishes[idx] = "parked"
+                else:
+                    tail = matcher.feed(detok.flush()) + matcher.flush()
+                    if matcher.hit:
+                        # Stop string completed in the flushed tail (see
+                        # complete()).
+                        finishes[idx] = "stop"
+                    if tail:
+                        emit(tail)
+                    if pending_lp and not matcher.hit:
+                        # Same stranding fix as _consume: without a stop
+                        # hit, every delivered token's entry ships — in a
+                        # final (possibly empty-content) delta when
+                        # byte-level decode lengths outran the incremental
+                        # text.
+                        rest, pending_lp = list(pending_lp), []
+                        loop.call_soon_threadsafe(
+                            queue.put_nowait, ("text", idx, ("", rest, [])))
                 loop.call_soon_threadsafe(queue.put_nowait, ("end", idx, None))
             except Exception as e:  # normalized below on the consumer side
                 loop.call_soon_threadsafe(queue.put_nowait, ("err", idx, e))
@@ -1702,12 +1777,17 @@ class TpuBackend:
                 for pos, (kind, idx, val) in enumerate(events):
                     more = pos < len(events) - 1
                     if kind == "text":
-                        text, lp = val
+                        text, lp, ids = val
                         out = oai.chunk(id=chunk_id, model=model,
                                         delta={"content": text}, index=idx)
                         if plan["logprobs"] >= 0:
                             out["choices"][0]["logprobs"] = {
                                 "content": lp, "refusal": None}
+                        if plan["stream_token_ids"] and ids:
+                            # Resume journal metadata: the ids whose text
+                            # this chunk carries (stripped by the router
+                            # unless the client opted in).
+                            out["qt_tokens"] = ids
                         yield oai.more(out) if more else out
                     elif kind == "end":
                         ended += 1

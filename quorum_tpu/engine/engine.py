@@ -254,6 +254,23 @@ class GrammarArenaFull(RuntimeError):
     retryable; resident grammars keep serving)."""
 
 
+class ReplayDivergence(RuntimeError):
+    """A replay guard byte-compare failed: a token regenerated during a
+    preemption resume (or a cross-replica stream resume submitted with
+    ``resume_tokens``) did not equal the token already delivered to the
+    client. The determinism contract (token sequence = f(prompt, seed,
+    sampler)) broke — the stream fails LOUDLY with this distinct error so
+    callers (the router's resume path above all) can tell "this resume
+    must not be retried, degrade to the error-chunk contract" apart from
+    an ordinary transport failure they may fail over."""
+
+    def __init__(self, position: int, regenerated: int, delivered: int):
+        super().__init__(
+            f"replay diverged at position {position}: regenerated token "
+            f"{regenerated} != delivered token {delivered}")
+        self.position = position
+
+
 class EngineBreakerOpen(Exception):
     """The engine's failure breaker is open: repeated device-state rebuilds
     inside the sliding window mean new admissions would likely hit the same
@@ -359,7 +376,7 @@ class _Request:
         "trace", "t_submit", "tspans", "deadline", "expired", "grammar",
         "g_start", "dfa_host", "n_inflight", "spec_state", "rid",
         "priority", "tenant", "sched_class", "n_preempts", "replay",
-        "preempt_flag", "t_admit",
+        "preempt_flag", "t_admit", "parked",
     )
 
     def __init__(self, prompt_ids, budget, sampler: SamplerConfig, seed, eos_id,
@@ -428,6 +445,11 @@ class _Request:
         self.replay: "list[int] | None" = None
         self.preempt_flag = False
         self.t_admit: "float | None" = None
+        # Drain park marker: set (before the end frame) when a draining
+        # engine retired this stream mid-generation so the consumer can
+        # finish it with finish_reason "parked" — the router's cue to
+        # resume the stream on a sibling replica from its journal.
+        self.parked = False
         self.lp: list = []
         # Request-scoped tracing: the server's trace (when this submission
         # happens inside a traced request context) rides along so the
@@ -907,6 +929,13 @@ _GUARDED_BY = {
     "_snap_backlog": {"lock": "_cond", "holders": ["_queue_snapshot"]},
     "_pending_dfa_resets": {"lock": "_cond", "holders": ["_release_slot"]},
     "_stop": {"lock": "_cond"},
+    # drain lifecycle (ISSUE 19): flags flipped by drain()/undrain() on a
+    # server thread, read by _submit's admission gate and the decode
+    # loop's _sweep_drain_parks; the parked counter is bumped under the
+    # same lock by both park sites.
+    "draining": {"lock": "_cond"},
+    "_draining_park": {"lock": "_cond"},
+    "n_drain_parked": {"lock": "_cond"},
     # single-owner: the decode scheduler thread's dispatch ring (drained
     # by _fail_all on that same thread's exception path; speculative
     # dispatches append through _try_spec_dispatch on the same thread)
@@ -1564,6 +1593,17 @@ class InferenceEngine:
         self.n_preemptions = 0
         self.n_preempted_tokens = 0
         self.n_replayed_tokens = 0
+        # Drain lifecycle (docs/robustness.md "Zero-loss streams"): while
+        # ``draining`` the submit gate sheds new admissions (QueueFullError
+        # → 503 → the router fails the request over pre-first-byte) and
+        # /ready reports degraded so the router rotates the replica out;
+        # with ``park=True`` the decode loop's _sweep_drain_parks
+        # additionally retires every resident/pending stream with
+        # finish_reason "parked" — the router resumes each on a sibling
+        # from its journal, so a drain under live traffic loses nothing.
+        self.draining = False
+        self._draining_park = False
+        self.n_drain_parked = 0
         # Monotonic counters for /metrics (written on the scheduler/submit
         # paths; reads are snapshots, exactness across a race is not needed).
         self.n_requests = 0
@@ -3863,6 +3903,7 @@ class InferenceEngine:
         grammar=None,  # CompiledGrammar: constrained decoding (structured output)
         priority: str | None = None,  # dispatch class (sched.PRIORITY_CLASSES)
         tenant: str | None = None,  # tenant id for weighted-fair admission
+        resume_tokens: "list[int] | None" = None,  # already-delivered ids to replay
     ) -> _Request | None:
         """Enqueue a generation and return its handle (``None`` when there is
         nothing to generate). Raises :class:`QueueFullError` *synchronously*
@@ -3881,7 +3922,16 @@ class InferenceEngine:
         ``priority`` pins the QoS dispatch class (one of
         ``sched.PRIORITY_CLASSES``; default: derived from deadline headroom)
         and ``tenant`` names the weighted-fair accounting bucket — both
-        inert unless the engine was built with ``qos=True``."""
+        inert unless the engine was built with ``qos=True``.
+
+        ``resume_tokens`` resumes a stream another engine already served
+        part of (docs/robustness.md "Zero-loss streams"): the ids ride the
+        PR 18 replay guard — the request admits ordinarily (prefix-store /
+        tier-0 reuse makes the replay cheap), regenerates the delivered
+        prefix deterministically from (prompt, seed, sampler), and
+        ``_emit`` byte-compares + swallows each replayed token before any
+        new token reaches the consumer. A mismatch fails the stream with
+        :class:`ReplayDivergence` — never a silent fork."""
         return self._submit(
             prompt_ids,
             max_new_tokens=max_new_tokens,
@@ -3899,6 +3949,7 @@ class InferenceEngine:
             grammar=grammar,
             priority=priority,
             tenant=tenant,
+            resume_tokens=resume_tokens,
         )
 
     def stream_results(self, req: _Request | None) -> Iterator[int]:
@@ -3951,7 +4002,8 @@ class InferenceEngine:
     def _submit(self, prompt_ids, *, max_new_tokens, sampler, seed, eos_id,
                 cancel, decode_chunk, pp=0.0, fp=0.0, bias_row=None,
                 want_lp=-1, member=0, deadline=None,
-                grammar=None, priority=None, tenant=None) -> _Request | None:
+                grammar=None, priority=None, tenant=None,
+                resume_tokens=None) -> _Request | None:
         spec = self.spec
         if not 0 <= member < self.members:
             raise ValueError(
@@ -3961,6 +4013,13 @@ class InferenceEngine:
             raise ValueError(
                 f"priority must be one of {PRIORITY_CLASSES}, "
                 f"got {priority!r}")
+        if self.draining:
+            # The drain gate: a draining engine admits nothing new — the
+            # 503 this raises is exactly the pre-first-byte failure the
+            # router fails over, so traffic moves to siblings on its own.
+            err = QueueFullError("engine draining")
+            err.retry_after = 1.0
+            raise err
         if grammar is not None:
             # Constrained decoding preconditions, checked synchronously so a
             # misconfiguration is a clean rejection, not a wedged stream:
@@ -3990,6 +4049,20 @@ class InferenceEngine:
         budget = min(max_new_tokens, spec.max_seq - len(prompt))
         if budget <= 0 or (cancel is not None and cancel.is_set()):
             return None
+        replay: "list[int] | None" = None
+        if resume_tokens:
+            # Cross-replica resume (docs/robustness.md): the delivered ids
+            # become the replay expectation — same guard, same swallow path
+            # as a preemption resume. Checked synchronously so a bad
+            # journal is a clean rejection, not a wedged stream.
+            replay = [int(t) for t in resume_tokens]
+            if any(not 0 <= t < spec.vocab_size for t in replay):
+                raise ValueError(
+                    "resume_tokens contains out-of-vocabulary ids")
+            if len(replay) > budget:
+                raise ValueError(
+                    f"resume_tokens longer ({len(replay)}) than the "
+                    f"generation budget ({budget})")
         req = _Request(
             prompt, budget, sampler, seed, eos_id,
             cancel if cancel is not None else threading.Event(),
@@ -3998,6 +4071,12 @@ class InferenceEngine:
             deadline=deadline, grammar=grammar, priority=priority,
             tenant=tenant,
         )
+        if replay:
+            # Resume admission: the journal ids are replayed token-for-token
+            # through ordinary decode — _emit's replay guard byte-compares
+            # and swallows each regenerated token (PR 18 machinery), so the
+            # client stream picks up exactly where it died.
+            req.replay = replay
         now = time.monotonic()
         req.sched_class = self._policy.classify(priority, deadline, now)
         # Every shed decision — deadline-expired, breaker, queue capacity,
@@ -4143,6 +4222,12 @@ class InferenceEngine:
                 "preempted_tokens_total": self.n_preempted_tokens,
                 "replayed_tokens_total": self.n_replayed_tokens,
                 "predictive_sheds_total": self.cost_model.n_predictive_sheds,
+                # Drain lifecycle (ISSUE 19 / docs/robustness.md): whether
+                # admissions are gated shut, and how many resident streams
+                # drain-with-park retired with a ``parked`` finish (each
+                # one a router-side proactive resume on a sibling).
+                "draining": 1 if self.draining else 0,
+                "drain_parked_total": self.n_drain_parked,
             }
 
     def health(self) -> dict:
@@ -4168,6 +4253,9 @@ class InferenceEngine:
             "pending": pending,
             "queue_limit": self.max_pending,
             "rebuilds_total": self.n_rebuilds,
+            # A draining engine still answers /health but must shed
+            # /ready: the fleet rotates it out while residents finish.
+            "draining": self.draining,
         }
 
     def shutdown(self, timeout: float = 30.0) -> None:
@@ -4256,6 +4344,7 @@ class InferenceEngine:
             try:
                 self._sweep_deadlines()
                 self._sweep_preemptions()
+                self._sweep_drain_parks()
                 if self.disagg:
                     # The deferred decode-side state work the colocated
                     # loop runs inside _start_admissions.
@@ -4621,6 +4710,12 @@ class InferenceEngine:
                         if row is not None:
                             break
                     if row is None:
+                        # No member head has a usable row: with QoS on,
+                        # each head may flag a lower-class victim within
+                        # its OWN member's row range (member-local parks
+                        # keep stacked weight sets independent).
+                        for h in heads:
+                            self._maybe_flag_preemption_locked(h)
                         return  # no head has a usable row this iteration
                     if self.kv_pages:
                         # One claim per group member: the slot group's chain
@@ -5197,21 +5292,27 @@ class InferenceEngine:
         (:meth:`_sweep_preemptions` — every ``_slots`` mutation that
         touches live device state stays on that thread's turn order).
 
-        Gated to plain engines (members == 1, ensemble == 1): stacked and
-        quorum rows co-batch one logical request across weight sets, and
-        parking a single member's row would desynchronize the set."""
+        Gated to ensemble == 1 engines: quorum rows co-batch one logical
+        request across weight sets, and parking a single member's row
+        would desynchronize the set. Stacked-member engines ARE eligible:
+        each member's requests live in their own row range
+        (``member * n_slots .. +n_slots``), so the victim search is
+        restricted to the head's member — replay bookkeeping is already
+        per-request, so the park/resume cycle is member-local."""
         if not self.qos or head.cancel.is_set() or head.preempt_flag:
             return
-        if self.members != 1 or self.ensemble != 1:
+        if self.ensemble != 1:
             return
         if any(b is head for _, _, b in self._preempt_pending):
             return  # one outstanding park order per beneficiary
-        picked = self._preempt.pick_victim(head, self._slots, 0, self._rows)
+        lo = head.member * self.n_slots
+        picked = self._preempt.pick_victim(head, self._slots, lo,
+                                           lo + self.n_slots)
         if picked is None:
             return
         row, victim = picked
         victim.preempt_flag = True
-        self._preempt_pending.append(  # qlint: allow-unguarded(the _locked suffix is the contract: both callers sit inside _start_admissions' `with self._cond:` scope — the lint's scope walker only sees the enclosing def)
+        self._preempt_pending.append(  # qlint: allow-unguarded(the _locked suffix is the contract: every caller sits inside _start_admissions'/_start_admissions_members' `with self._cond:` scope — the lint's scope walker only sees the enclosing def)
             (row, victim, head))
         self._cond.notify_all()
 
@@ -5277,6 +5378,84 @@ class InferenceEngine:
                 FLIGHT.record("preempt-fault", rid=victim.rid,
                               engine=self._tag, loop="decode", row=row,
                               error=f"{type(e).__name__}: {e}"[:200])
+
+    def _sweep_drain_parks(self) -> None:
+        """Drain with park=1: retire every resident stream at this reap
+        boundary (decode scheduler thread). Parking IS the ordinary
+        release path — the row's prefix lands in the resident map / host
+        prefix store exactly as a finished stream's would, which is what
+        the router-side drain migration then ships to siblings. The
+        consumer sees a ``parked`` finish (never an error): the router
+        proactively resumes the stream on a sibling with the delivered
+        token ids as its replay journal (docs/robustness.md)."""
+        if not self._draining_park:
+            return
+        with self._cond:
+            rows = [(i, r) for i, r in enumerate(self._slots)
+                    if r is not None]
+        for i, req in rows:
+            with self._cond:
+                if self._slots[i] is not req or req.cancel.is_set():
+                    continue  # finished/cancelled since listing
+                self._release_slot(i, req)
+                self.n_drain_parked += 1
+            # `parked` BEFORE the end frame: the consumer reads it the
+            # moment stream_results returns.
+            req.parked = True
+            req.out.put(("end", None))
+            FLIGHT.record("drain-park", rid=req.rid, engine=self._tag,
+                          loop="decode", row=i, emitted=req.emitted)
+
+    def drain(self, park: bool = False) -> dict:
+        """Begin a graceful drain: gate admissions shut (new submits shed
+        with a retryable 503 — the router's pre-first-byte failover moves
+        them to siblings) and either let residents finish (default) or
+        park them (``park=True``): queued requests end ``parked``
+        immediately, active rows at the decode loop's next reap boundary
+        (:meth:`_sweep_drain_parks`). Idempotent; returns
+        :meth:`drain_status`."""
+        parked_pending: "list[_Request]" = []
+        with self._cond:
+            self.draining = True
+            if park:
+                self._draining_park = True
+                # Queued requests never touched device state: retire them
+                # here rather than making them wait for rows that are
+                # themselves being parked.
+                parked_pending = list(self._pending)
+                del self._pending[:]
+                self.n_drain_parked += len(parked_pending)
+            self._cond.notify_all()
+        for r in parked_pending:
+            r.parked = True
+            r.out.put(("end", None))
+            FLIGHT.record("drain-park", rid=r.rid, engine=self._tag,
+                          loop="decode", row=-1, emitted=r.emitted)
+        return self.drain_status()
+
+    def undrain(self) -> dict:
+        """Reopen admissions (clears both drain flags); returns
+        :meth:`drain_status`."""
+        with self._cond:
+            self.draining = False
+            self._draining_park = False
+            self._cond.notify_all()
+        return self.drain_status()
+
+    def drain_status(self) -> dict:
+        """Drain progress for the router's drain orchestration poll:
+        ``resident`` counts every stream still attached (active rows +
+        in-flight admissions + queue) — zero means the replica holds no
+        client state and is safe to take down."""
+        with self._cond:
+            busy = sum(1 for r in self._slots if r is not None)
+            return {
+                "draining": self.draining,
+                "park": self._draining_park,
+                "resident": busy + len(self._admitting)
+                + len(self._pending),
+                "parked_total": self.n_drain_parked,
+            }
 
     def _device_state_ok(self) -> bool:
         """Whether the donated per-slot device state survived the last
@@ -6268,9 +6447,8 @@ class InferenceEngine:
                 req.replay = None
             if tok != expect:
                 req.replay = None
-                req.out.put(("err", RuntimeError(
-                    f"preemption replay diverged at position {req.emitted}: "
-                    f"regenerated token {tok} != delivered token {expect}")))
+                req.out.put(("err", ReplayDivergence(
+                    req.emitted, tok, expect)))
                 req.cancel.set()
                 return True
         req.emitted += 1
@@ -6285,9 +6463,14 @@ class InferenceEngine:
             req.dfa_host = int(req.grammar.trans[req.dfa_host, tok])
         if replaying:
             # Already delivered before the preemption: swallowed, not
-            # re-queued, not re-counted (a replayed token never ends the
-            # stream — a terminal token would have ended it back then).
+            # re-queued, not re-counted (an EOS never appears in a replay
+            # expectation — it would have ended the stream back then). A
+            # cross-replica resume journal CAN cover the whole budget
+            # though (the replica died on the last token): end as length.
             self.n_replayed_tokens += 1
+            if req.emitted >= req.budget:
+                req.out.put(("end", "length"))
+                return True
             return False
         self.n_tokens += 1
         req.out.put(("tok", tok))

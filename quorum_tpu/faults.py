@@ -23,6 +23,7 @@ rejects it so a typo'd chaos case cannot silently test nothing):
   ``engine.preempt``          QoS mid-decode preemption parking turn
   ``http.request``            HTTP backend non-streaming request I/O
   ``http.stream``             HTTP backend streaming request I/O
+  ``router.resume``           router mid-stream resume re-submission
 """
 
 from __future__ import annotations
@@ -40,6 +41,7 @@ SITES = (
     "engine.preempt",
     "http.request",
     "http.stream",
+    "router.resume",
 )
 
 

@@ -282,6 +282,39 @@ def validate_request_body(body: dict[str, Any]) -> str | None:
             not isinstance(tenant, str) or not tenant or len(tenant) > 64):
         return (f"Invalid value for 'tenant': {tenant!r} (a non-empty "
                 "string of at most 64 characters)")
+    # Stream-resume knobs (docs/robustness.md "Zero-loss streams"): the
+    # router re-submits a broken stream with the token ids it already
+    # relayed (``resume_tokens``) plus the delivered content length
+    # (``resume_chars`` — the backend's splice-consistency check), and
+    # asks for per-chunk token-id metadata (``stream_token_ids``) so it
+    # can journal the continuation too. Internal knobs — validated here
+    # so a malformed resume is one 400, never a wedged replay.
+    rt = body.get("resume_tokens")
+    if rt is not None:
+        if not isinstance(rt, list) or not rt or not all(
+                isinstance(t, int) and not isinstance(t, bool) and t >= 0
+                for t in rt):
+            return (f"Invalid value for 'resume_tokens': must be a "
+                    "non-empty array of non-negative token ids")
+        if body.get("logprobs"):
+            return ("'resume_tokens' cannot be combined with 'logprobs' "
+                    "(replayed tokens carry no logprob records)")
+        if body.get("n") not in (None, 1):
+            return "'resume_tokens' requires n=1"
+        if not body.get("stream"):
+            return "'resume_tokens' requires stream=true"
+    rc = body.get("resume_chars")
+    if rc is not None:
+        if isinstance(rc, bool) or not isinstance(rc, int) or rc < 0:
+            return (f"Invalid value for 'resume_chars': {rc!r} (a "
+                    "non-negative integer)")
+        if rt is None:
+            return "'resume_chars' requires 'resume_tokens'"
+    sti = body.get("stream_token_ids")
+    if sti is not None and not isinstance(sti, bool):
+        return f"Invalid value for 'stream_token_ids': {sti!r}"
+    if sti and body.get("n") not in (None, 1):
+        return "'stream_token_ids' requires n=1"
     if "messages" in body and not isinstance(body["messages"], list):
         return "Invalid value for 'messages': must be an array"
     # Cross-tier trace propagation (docs/observability.md "Fleet plane"):

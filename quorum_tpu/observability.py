@@ -361,6 +361,16 @@ ROUTER_MIGRATED_BYTES = METRICS.counter(
 ROUTER_MIGRATED_CHAINS = METRICS.counter(
     "quorum_tpu_router_migrated_chains_total",
     "Prefix chunk chains moved between replicas by rotation migration.")
+ROUTER_STREAM_RESUMES = METRICS.counter(
+    "quorum_tpu_router_stream_resumes_total",
+    "Mid-stream resume outcomes (docs/robustness.md 'Zero-loss streams'): "
+    "resumed = the journaled stream spliced onto a sibling replica "
+    "token-exactly; divergence = the sibling's replay byte-check failed "
+    "and the stream degraded to the error-chunk contract; failed = a "
+    "resume attempt died pre-commit and the next candidate was tried; "
+    "exhausted = no candidate/deadline remained; unresumable = the "
+    "journal could not cover the stream (no token-id metadata, bound "
+    "overflow, or the finish chunk already relayed).")
 
 # Fleet observability plane (ISSUE 16, docs/observability.md "Fleet
 # plane"): cross-tier trace propagation, per-replica telemetry absorption,

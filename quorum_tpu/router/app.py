@@ -12,11 +12,23 @@ polling for ring rotation with prefix migration (``replica.py``).
 Failover contract (the one the HTTP backend's streaming retry boundary
 makes safe): a replica that fails BEFORE its 2xx event stream opens —
 connect error, 5xx, 503 shed — moves the request to the next ring
-candidate; once a stream is open, tokens are on the client's wire and a
-mid-stream failure surfaces as an SSE error chunk, never a re-send
-(double-delivered tokens are a correctness bug, not a retry). Non-streaming
-requests failover on any 5xx outcome. 4xx outcomes relay immediately — a
-client error is the same on every replica.
+candidate; non-streaming requests failover on any 5xx outcome; 4xx
+outcomes relay immediately — a client error is the same on every replica.
+
+Once a stream is open, a mid-stream death is NOT a re-send — it is a
+token-exact RESUME (docs/robustness.md "Zero-loss streams"): the router
+journals each live stream's emitted token ids (the replicas attach them
+as ``qt_tokens`` when the router sets ``stream_token_ids``; stripped
+before the client), and on a broken stream (or a drain-parked one —
+finish ``parked``) re-submits on the next ring candidate with
+``resume_tokens``/``resume_chars``. The replica replays the delivered
+prefix through the engine's byte-comparing replay guard and emits only
+the continuation, which the router splices into the still-open SSE
+stream — no duplicate or dropped frames, original chunk identity, usage
+merged as the union. Divergence, journal overflow, or candidate
+exhaustion degrade to the PR 12 error-chunk contract; every outcome
+lands on ``quorum_tpu_router_stream_resumes_total{outcome=}`` and the
+recorder under the request's trace-id.
 
 SSE pass-through preserves TTFT: upstream events re-encode and flush
 frame-by-frame as they arrive (no buffering, no coalescing beyond the
@@ -35,7 +47,7 @@ from typing import Any, AsyncIterator
 
 import httpx
 
-from quorum_tpu import oai, sse
+from quorum_tpu import faults, oai, sse
 from quorum_tpu.backends.base import BackendError
 from quorum_tpu.observability import (
     METRICS,
@@ -43,6 +55,7 @@ from quorum_tpu.observability import (
     ROUTER_AFFINITY_MISSES,
     ROUTER_FAILOVERS,
     ROUTER_REQUESTS,
+    ROUTER_STREAM_RESUMES,
     TRACE_PROPAGATED,
 )
 from quorum_tpu.router import affinity
@@ -98,6 +111,110 @@ class _StreamGuard:
             await aclose()
 
 
+class _StreamJournal:
+    """One live stream's bounded resume journal: the emitted token ids
+    (from the replica's ``qt_tokens`` chunk metadata) plus the delivered
+    char count — exactly what a sibling needs to regenerate and swallow
+    the delivered prefix (``resume_tokens``/``resume_chars``). Also owns
+    the splice bookkeeping: original chunk identity (resumed chunks are
+    rewritten to it so the client sees ONE stream) and the usage union
+    (``completion_tokens`` = journaled ids, replayed tokens never
+    double-counted)."""
+
+    __slots__ = ("limit", "ids", "chars", "finished", "unresumable",
+                 "cid", "created", "resumed")
+
+    def __init__(self, limit: int):
+        self.limit = int(limit)
+        self.ids: list[int] = []
+        self.chars = 0
+        self.finished = False      # a finish/error chunk reached the client
+        self.unresumable = False   # journal overflow or missing qt_tokens
+        self.cid: str | None = None
+        self.created: Any = None
+        self.resumed = False       # at least one splice committed
+
+    def absorb(self, ev: Any) -> str:
+        """Record ``ev`` — mutating it: ``qt_tokens`` is stripped (router-
+        internal metadata), and after a splice chunk identity/usage are
+        rewritten — and classify it: ``"forward"`` (relay to the client)
+        or ``"parked"`` (a drain-parked finish: swallow and resume)."""
+        if not isinstance(ev, dict):
+            return "forward"
+        qt = ev.pop("qt_tokens", None)
+        if ev.get("id") == "error":
+            # An upstream-relayed error chunk ends the stream for the
+            # client; a later transport death must not trigger a resume.
+            self.finished = True
+            return "forward"
+        choices = ev.get("choices")
+        if choices is None and "usage" not in ev:
+            return "forward"
+        if self.cid is None and ev.get("id"):
+            self.cid = ev.get("id")
+            self.created = ev.get("created")
+        elif self.resumed and ev.get("id") and self.cid is not None:
+            ev["id"] = self.cid
+            if self.created is not None:
+                ev["created"] = self.created
+        usage = ev.get("usage")
+        if isinstance(usage, dict) and self.resumed:
+            usage["completion_tokens"] = len(self.ids)
+            usage["total_tokens"] = (
+                int(usage.get("prompt_tokens") or 0) + len(self.ids))
+        for c in choices or []:
+            if not isinstance(c, dict):
+                continue
+            fin = c.get("finish_reason")
+            if fin == "parked":
+                return "parked"
+            if fin:
+                self.finished = True
+            content = (c.get("delta") or {}).get("content")
+            if content:
+                self.chars += len(content)
+                if qt:
+                    self.ids.extend(qt)
+                    if len(self.ids) > self.limit:
+                        self.unresumable = True
+                else:
+                    # Content the journal can't attribute to token ids:
+                    # a resume would drop or duplicate it — degrade.
+                    self.unresumable = True
+        return "forward"
+
+
+def _is_role_only(ev: Any) -> bool:
+    """A role-announcement chunk with no content/finish — a resumed
+    stream re-emits one, which the splice must swallow (the client
+    already has it)."""
+    if not isinstance(ev, dict) or ev.get("id") == "error":
+        return False
+    if "usage" in ev:
+        return False
+    choices = ev.get("choices") or []
+    if len(choices) != 1 or choices[0].get("finish_reason"):
+        return False
+    delta = choices[0].get("delta") or {}
+    return bool(delta) and set(delta) <= {"role"}
+
+
+def _is_error_chunk(ev: Any) -> bool:
+    if not isinstance(ev, dict):
+        return False
+    if ev.get("id") == "error":
+        return True
+    choices = ev.get("choices") or []
+    return bool(choices) and choices[0].get("finish_reason") == "error"
+
+
+def _error_text(ev: Any) -> str:
+    try:
+        return str(ev["choices"][0]["delta"].get("content") or "")
+    except Exception:
+        return ""
+
+
 @dataclass
 class RouterConfig:
     """Config for one router process (``python -m quorum_tpu.router``)."""
@@ -121,6 +238,13 @@ class RouterConfig:
     burn_threshold: float = 0.5
     burn_class: str = "interactive"
     telemetry_max_age: float = 10.0
+    # Mid-stream resume (module docstring): journal live streams and
+    # re-place broken ones token-exactly. Off → the plain PR 12 contract
+    # (mid-stream death = error chunk). resume_max_tokens bounds the
+    # per-stream journal; a stream that outgrows it degrades to the
+    # error-chunk contract instead of growing without bound.
+    stream_resume: bool = True
+    resume_max_tokens: int = 4096
 
     def __post_init__(self) -> None:
         if self.policy not in ("affinity", "random"):
@@ -147,7 +271,8 @@ class RouterConfig:
             "ready_interval", "migrate_on_rotation", "vnodes",
             "load_factor", "breaker_threshold", "breaker_window",
             "breaker_cooldown", "burn_threshold", "burn_class",
-            "telemetry_max_age") if k in raw}
+            "telemetry_max_age", "stream_resume",
+            "resume_max_tokens") if k in raw}
         return cls(replicas=replicas, **kwargs)
 
 
@@ -287,6 +412,21 @@ def create_router_app(cfg: RouterConfig,
             timeout = cfg.timeout
         deadline = time.monotonic() + timeout
 
+        # A stream is resumable when the router may journal it: resume
+        # enabled, single choice, no logprobs (replayed tokens carry no
+        # records), and the client did not claim the token-id channel for
+        # itself (an explicit stream_token_ids passes qt_tokens through
+        # untouched — the router must not strip what the client asked
+        # for) or supply its own journal.
+        resumable = (is_streaming and cfg.stream_resume
+                     and not body.get("stream_token_ids")
+                     and not body.get("logprobs")
+                     and body.get("n") in (None, 1)
+                     and body.get("resume_tokens") is None)
+        if resumable:
+            body = dict(body)
+            body["stream_token_ids"] = True
+
         primary, candidates = _pick(body)
         if not candidates:
             return _shed_response()
@@ -310,13 +450,18 @@ def create_router_app(cfg: RouterConfig,
             headers["traceparent"] = traceparent
             r.inflight += 1
             r.requests += 1
+            # A resumed stream migrates mid-flight: the holder names the
+            # replica currently carrying it, so the guard's single
+            # decrement always lands on the right one (the splice itself
+            # moves the count: old -1, new +1, holder re-pointed).
+            holder = {"replica": r}
             decremented = [False]
             guard_owns = False  # True once a _StreamGuard took ownership
 
-            def dec(r=r, flag=decremented):
+            def dec(holder=holder, flag=decremented):
                 if not flag[0]:
                     flag[0] = True
-                    r.inflight -= 1
+                    holder["replica"].inflight -= 1
 
             try:
                 if is_streaming:
@@ -335,8 +480,13 @@ def create_router_app(cfg: RouterConfig,
                                     span=span_id,
                                     **({"failover": 1} if attempt > 1
                                        else {}))
+                    journal = (_StreamJournal(cfg.resume_max_tokens)
+                               if resumable else None)
                     resp = StreamingResponse(_StreamGuard(
-                        _passthrough(r, rid, first, stream), dec))
+                        _passthrough(holder, rid, first, stream,
+                                     body=body, headers=dict(headers),
+                                     deadline=deadline, journal=journal),
+                        dec))
                     guard_owns = True
                     resp.headers["X-Routed-To"] = name
                     resp.headers["X-Request-Id"] = rid
@@ -415,30 +565,181 @@ def create_router_app(cfg: RouterConfig,
                                 headers=resp_headers)
         return _shed_response()
 
+    async def _resume_stream(holder: dict, rid: str, body: dict,
+                             headers: dict, deadline: float,
+                             journal: _StreamJournal):
+        """Re-place a broken/parked stream on the next ring candidate
+        within the remaining deadline. Commit point is the first NON-role
+        event of the replacement stream: a normal chunk splices (returns
+        ``("ok", (event, stream))``), a divergence error chunk degrades
+        (``("diverged", message)`` — retrying siblings cannot help when
+        the replay guard itself refused), any other failure moves to the
+        next candidate; ``("exhausted", None)`` when none commit. Every
+        outcome lands on the resume counter + recorder."""
+        dead = holder["replica"].name
+        base = dict(body)
+        base["stream"] = True
+        base["stream_token_ids"] = True
+        base.pop("resume_tokens", None)
+        base.pop("resume_chars", None)
+        if journal.ids:
+            base["resume_tokens"] = list(journal.ids)
+            base["resume_chars"] = journal.chars
+        _, candidates = _pick(body)
+        for name in candidates:
+            if name == dead:
+                continue
+            r2 = mgr.replicas[name]
+            if not r2.breaker.allow():
+                continue
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                break
+            span_id, traceparent = tracecontext.child_traceparent(rid)
+            h2 = dict(headers)
+            h2["traceparent"] = traceparent
+            probe = None
+            try:
+                faults.fire("router.resume")
+                stream2 = r2.backend.stream(base, h2, remaining)
+                probe = await stream2.__anext__()
+                while _is_role_only(probe):
+                    # The replacement re-announces the role; the client
+                    # already has that chunk — swallow, probe deeper.
+                    probe = await stream2.__anext__()
+            except StopAsyncIteration:
+                probe = None
+            except Exception as e:
+                r2.breaker.record_failure()
+                ROUTER_STREAM_RESUMES.inc(outcome="failed")
+                RECORDER.record("router-resume-failed", rid=rid,
+                                loop="router", replica=name,
+                                error=str(e)[:200], span=span_id)
+                continue
+            if probe is None or _is_error_chunk(probe):
+                text = _error_text(probe) if probe is not None else ""
+                if "diverged" in text:
+                    ROUTER_STREAM_RESUMES.inc(outcome="divergence")
+                    RECORDER.record("router-resume-diverged", rid=rid,
+                                    loop="router", replica=name,
+                                    span=span_id)
+                    return "diverged", text
+                r2.breaker.record_failure()
+                ROUTER_STREAM_RESUMES.inc(outcome="failed")
+                RECORDER.record("router-resume-failed", rid=rid,
+                                loop="router", replica=name,
+                                error=text[:200] or "empty stream",
+                                span=span_id)
+                continue
+            # Committed: move the in-flight count with the stream. The
+            # guard's single decrement follows the holder, so the old
+            # replica is released here and the new one at stream end.
+            r_old = holder["replica"]
+            r2.inflight += 1
+            r2.requests += 1
+            holder["replica"] = r2
+            r_old.inflight -= 1
+            r2.breaker.record_success()
+            journal.resumed = True
+            ROUTER_STREAM_RESUMES.inc(outcome="resumed")
+            ROUTER_REQUESTS.inc(replica=name, outcome="resume")
+            RECORDER.record("router-stream-resume", rid=rid,
+                            loop="router", replica=name,
+                            from_replica=dead,
+                            replayed=len(journal.ids), span=span_id)
+            return "ok", (probe, stream2)
+        ROUTER_STREAM_RESUMES.inc(outcome="exhausted")
+        RECORDER.record("router-resume-exhausted", rid=rid, loop="router",
+                        from_replica=dead)
+        return "exhausted", None
+
     async def _passthrough(
-        r: Replica, rid: str,
+        holder: dict, rid: str,
         first: dict[str, Any] | None,
         rest: AsyncIterator[dict[str, Any]],
+        *, body: dict | None = None,
+        headers: dict | None = None,
+        deadline: float = 0.0,
+        journal: _StreamJournal | None = None,
     ) -> AsyncIterator[bytes]:
         """SSE pass-through: re-encode upstream events frame-by-frame (the
         h11 server flushes each yield — TTFT rides the first upstream
-        event untouched). Mid-stream failure → error chunk + [DONE],
-        NEVER a failover (tokens are already on the wire). The in-flight
-        decrement belongs to the wrapping :class:`_StreamGuard`, which
-        fires even when this body never runs."""
+        event untouched). The in-flight decrement belongs to the wrapping
+        :class:`_StreamGuard`, which fires even when this body never runs.
+
+        With a ``journal``, a mid-stream failure (or a drain-parked
+        finish) is a token-exact RESUME on a sibling (module docstring) —
+        the continuation splices into this same generator and relaying
+        continues (repeat deaths resume again). Without one — resume off,
+        or the request isn't journalable — failure degrades to the PR 12
+        contract: error chunk + [DONE], never a re-send."""
         model = "unknown"
-        try:
-            if first is not None:
-                model = first.get("model") or model
-                yield sse.encode_event(first)
-            async for event in rest:
-                yield sse.encode_event(event)
-        except BackendError as e:
-            r.breaker.record_failure()
-            RECORDER.record("router-stream-broken", rid=rid, loop="router",
-                            replica=r.name, error=str(e)[:200])
-            yield sse.encode_event(
-                oai.error_chunk(f"Backend failed: {e}", model=model))
+        current = rest
+        pending = first
+        while True:
+            broke: BackendError | None = None
+            parked = False
+            try:
+                while True:
+                    if pending is not None:
+                        event, pending = pending, None
+                    else:
+                        event = await current.__anext__()
+                    if isinstance(event, dict):
+                        model = event.get("model") or model
+                    if journal is not None \
+                            and journal.absorb(event) == "parked":
+                        parked = True
+                        break
+                    yield sse.encode_event(event)
+            except StopAsyncIteration:
+                break
+            except BackendError as e:
+                broke = e
+            r_old = holder["replica"]
+            if parked:
+                # The replica is draining: the park finish is the resume
+                # signal, not a failure — the breaker stays clean.
+                RECORDER.record("router-stream-parked", rid=rid,
+                                loop="router", replica=r_old.name)
+                aclose = getattr(current, "aclose", None)
+                if aclose is not None:
+                    try:
+                        await aclose()
+                    except Exception:
+                        pass
+            else:
+                r_old.breaker.record_failure()
+                RECORDER.record("router-stream-broken", rid=rid,
+                                loop="router", replica=r_old.name,
+                                error=str(broke)[:200])
+            if journal is not None and journal.finished:
+                # Death after the finish chunk: the client already has
+                # the whole completion — just close cleanly.
+                break
+            if journal is None or journal.unresumable:
+                if journal is not None:
+                    ROUTER_STREAM_RESUMES.inc(outcome="unresumable")
+                yield sse.encode_event(oai.error_chunk(
+                    f"Backend failed: {broke or 'stream parked'}",
+                    model=model))
+                break
+            status, payload = await _resume_stream(
+                holder, rid, body or {}, headers or {}, deadline, journal)
+            if status == "ok":
+                pending, current = payload
+                continue
+            if status == "diverged":
+                # The upstream error chunk already carries the full
+                # "Backend failed: ... diverged ..." message — forward it.
+                yield sse.encode_event(oai.error_chunk(
+                    payload or "Backend failed: resume replay diverged",
+                    model=model))
+            else:
+                yield sse.encode_event(oai.error_chunk(
+                    f"Backend failed: {broke or 'stream parked'} "
+                    "(resume exhausted)", model=model))
+            break
         yield sse.encode_done()
 
     @app.route("GET", "/health", "/v1/health")
@@ -607,6 +908,29 @@ def create_router_app(cfg: RouterConfig,
         except Exception as e:
             return JSONResponse(
                 {"error": {"message": f"migration failed: {e}",
+                           "type": "proxy_error"}},
+                status_code=502)
+        return JSONResponse(out)
+
+    @app.route("POST", "/router/drain", "/v1/router/drain")
+    async def drain(request: Request) -> Response:
+        """Operator-triggered graceful drain of ``?replica=NAME``: rotate
+        it out of the ring, park its live streams (which the data plane
+        proactively resumes on siblings — zero failed requests), wait for
+        residency to hit zero, and migrate its prefix chains to the
+        survivors."""
+        name = request.query_params.get("replica", "")
+        if name not in mgr.replicas:
+            return JSONResponse(
+                {"error": {"message": f"unknown replica {name!r}; "
+                           f"configured: {sorted(mgr.replicas)}",
+                           "type": "invalid_request_error"}},
+                status_code=404)
+        try:
+            out = await mgr.drain(name)
+        except Exception as e:
+            return JSONResponse(
+                {"error": {"message": f"drain failed: {e}",
                            "type": "proxy_error"}},
                 status_code=502)
         return JSONResponse(out)

@@ -17,6 +17,21 @@ replica-kill drill (a killable process with slow streams), and
 
   POST /admin/shed      /ready answers 503 from now on (rotation trigger)
   POST /admin/recover   /ready answers 200 again
+  POST /admin/abort?after=N   next stream dies (raises) after N content
+                              chunks — an in-process mid-stream death
+  POST /admin/diverge   resume submissions answer a divergence error chunk
+                        (the replay-guard-mismatch drill; ?off clears)
+  POST /admin/drain?park=0|1  gate admissions (503) + shed /ready; park=1
+                              parks live streams (finish "parked") at the
+                              next word boundary — GET polls progress,
+                              POST /admin/undrain reopens
+
+Resume semantics mirror the real backend (docs/robustness.md "Zero-loss
+streams"): ``stream_token_ids`` attaches each chunk's token ids as
+``qt_tokens`` (ByteTokenizer: one id per byte), and a ``resume_tokens``
+journal is byte-compared against the scripted completion — a mismatch
+(or the diverge knob) degrades to an error chunk containing "resume
+replay diverged", exactly the real replay guard's failure shape.
 
 Fleet-plane surfaces (docs/observability.md) are scripted too: each state
 owns a PRIVATE :class:`~quorum_tpu.telemetry.recorder.FlightRecorder`
@@ -98,6 +113,13 @@ class FakeReplicaState:
         self.requests = 0
         self.prefix_hits = 0
         self.tokens_restored = 0
+        # Drill knobs + drain lifecycle (module docstring).
+        self.abort_after: int | None = None  # one-shot mid-stream death
+        self.diverge_resume = False
+        self.draining = False
+        self.park_streams = False
+        self.active_streams = 0
+        self.n_parked = 0
 
     def clock(self) -> float:
         """This replica's (possibly skewed) monotonic clock."""
@@ -140,9 +162,10 @@ def create_fake_replica_app(state: FakeReplicaState) -> App:
                 {"error": {"message": f"Invalid JSON body: {e}",
                            "type": "invalid_request_error"}},
                 status_code=400)
-        if state.shedding:
+        if state.shedding or state.draining:
             return JSONResponse(
-                {"error": {"message": "shedding (admin)",
+                {"error": {"message": ("engine draining" if state.draining
+                                       else "shedding (admin)"),
                            "type": "overloaded_error"}},
                 status_code=503, headers={"Retry-After": "1"})
         # Cross-tier trace identity, scripted like the real server:
@@ -165,13 +188,34 @@ def create_fake_replica_app(state: FakeReplicaState) -> App:
         completion = "".join(words)
         matched = state.observe(prompt, completion)
         model = body.get("model") or "fake"
+        # Cross-replica resume, scripted like the real replay guard: the
+        # journal must be a byte-exact prefix of THIS replica's scripted
+        # completion (ByteTokenizer: one id per char), and the delivered
+        # char count must land inside it — anything else (or the admin
+        # diverge knob) is the distinct divergence failure.
+        rt = body.get("resume_tokens")
+        skip_chars = 0
+        diverged = False
+        if rt:
+            rc = body.get("resume_chars")
+            skip_chars = int(rc) if rc is not None else len(rt)
+            full_ids = state.tokenizer.encode(completion)
+            if (state.diverge_resume or list(rt) != full_ids[:len(rt)]
+                    or skip_chars > len(completion)):
+                diverged = True
+        want_ids = bool(body.get("stream_token_ids"))
+        want_usage = bool(
+            (body.get("stream_options") or {}).get("include_usage"))
         t_issue = state.clock()
         state.recorder.record("dispatch", rid=trace_id, engine=state.name,
                               loop="decode", t=t_issue, family="fake",
                               span=span_id)
         if body.get("stream"):
             resp = StreamingResponse(
-                _stream(model, words, matched, trace_id, t_issue))
+                _stream(model, words, matched, trace_id, t_issue,
+                        skip_chars=skip_chars, want_ids=want_ids,
+                        diverged=diverged,
+                        prompt_tokens=len(prompt) if want_usage else None))
             resp.headers["X-Fake-Replica"] = state.name
             resp.headers["traceparent"] = traceparent
             return resp
@@ -192,24 +236,82 @@ def create_fake_replica_app(state: FakeReplicaState) -> App:
             "traceparent": traceparent})
 
     async def _stream(model: str, words: list[str], matched: int,
-                      trace_id: str, t_issue: float,
+                      trace_id: str, t_issue: float, *,
+                      skip_chars: int = 0, want_ids: bool = False,
+                      diverged: bool = False,
+                      prompt_tokens: int | None = None,
                       ) -> AsyncIterator[bytes]:
         cid = f"chatcmpl-{state.name}"
         yield sse.encode_event(
             oai.chunk(id=cid, model=model, delta={"role": "assistant"}))
+        if diverged:
+            # The real replay guard's failure shape: the server wraps the
+            # engine's ReplayDivergence in an error chunk whose message
+            # contains "diverged" — the router keys its degrade on that.
+            yield sse.encode_event(oai.error_chunk(
+                "Backend failed: resume replay diverged: journal is not "
+                "a prefix of this replica's stream", model=model))
+            yield sse.encode_done()
+            t_ready = state.clock()
+            state.recorder.record(
+                "reap", rid=trace_id, engine=state.name, loop="decode",
+                t=t_ready, t_issue=t_issue, t_ready=t_ready,
+                family="fake", depth=0, tokens=0)
+            return
         sent = 0
+        new_chars = 0
+        parked = False
+        state.active_streams += 1
         try:
+            rem = skip_chars  # delivered prefix: resumed streams skip it
             for w in words:
+                if rem >= len(w):
+                    rem -= len(w)
+                    continue
+                piece, rem = w[rem:], 0
+                if state.park_streams:
+                    # Drain park at a word boundary — the finish tells
+                    # the router to resume elsewhere; no error, no tail.
+                    parked = True
+                    break
                 if state.chunk_delay:
                     await asyncio.sleep(state.chunk_delay)
-                yield sse.encode_event(
-                    oai.chunk(id=cid, model=model, delta={"content": w}))
+                if state.abort_after is not None \
+                        and sent >= state.abort_after:
+                    # One-shot scripted mid-stream death (in-process
+                    # equivalent of the SIGKILL drill).
+                    state.abort_after = None
+                    raise RuntimeError("aborted mid-stream (admin)")
+                out = oai.chunk(id=cid, model=model,
+                                delta={"content": piece})
+                if want_ids:
+                    out["qt_tokens"] = state.tokenizer.encode(piece)
+                yield sse.encode_event(out)
                 sent += 1
-            yield sse.encode_event(
-                oai.chunk(id=cid, model=model, delta={},
-                          finish_reason="stop"))
+                new_chars += len(piece)
+            if parked:
+                state.n_parked += 1
+                yield sse.encode_event(
+                    oai.chunk(id=cid, model=model, delta={},
+                              finish_reason="parked"))
+            else:
+                yield sse.encode_event(
+                    oai.chunk(id=cid, model=model, delta={},
+                              finish_reason="stop"))
+                if prompt_tokens is not None:
+                    # stream_options.include_usage, real-backend shaped:
+                    # completion counts NEW tokens only — the router owns
+                    # the union across a resume splice.
+                    uc = oai.chunk(id=cid, model=model, delta={})
+                    uc["choices"] = []
+                    uc["usage"] = {
+                        "prompt_tokens": prompt_tokens,
+                        "completion_tokens": new_chars,
+                        "total_tokens": prompt_tokens + new_chars}
+                    yield sse.encode_event(uc)
             yield sse.encode_done()
         finally:
+            state.active_streams -= 1
             # Reap lands however the stream ends — a killed/broken
             # stream still leaves its span in the ring (the chaos drill
             # asserts the failed-over trace-id appears on the survivor).
@@ -225,9 +327,10 @@ def create_fake_replica_app(state: FakeReplicaState) -> App:
 
     @app.route("GET", "/ready", "/v1/ready")
     async def ready(request: Request) -> Response:
-        if state.shedding:
+        if state.shedding or state.draining:
             return JSONResponse(
-                {"status": "unready", "reason": "shedding"},
+                {"status": "unready",
+                 "reason": "draining" if state.draining else "shedding"},
                 status_code=503, headers={"Retry-After": "1"})
         return JSONResponse({"status": "ready"})
 
@@ -240,6 +343,51 @@ def create_fake_replica_app(state: FakeReplicaState) -> App:
     async def recover(request: Request) -> Response:
         state.shedding = False
         return JSONResponse({"shedding": False})
+
+    @app.route("POST", "/admin/abort", "/v1/admin/abort")
+    async def admin_abort(request: Request) -> Response:
+        """One-shot scripted mid-stream death: the next stream raises
+        after ``?after=N`` content chunks (default 1) — the in-process
+        stand-in for the SIGKILL drill."""
+        raw = request.query_params.get("after", "1")
+        try:
+            state.abort_after = max(0, int(raw))
+        except ValueError:
+            return JSONResponse(
+                {"error": {"message": f"'after' must be an integer, got "
+                           f"{raw!r}", "type": "invalid_request_error"}},
+                status_code=400)
+        return JSONResponse({"abort_after": state.abort_after})
+
+    @app.route("POST", "/admin/diverge", "/v1/admin/diverge")
+    async def admin_diverge(request: Request) -> Response:
+        """Make resume submissions fail the scripted replay guard
+        (``?off=1`` clears) — the divergence-degrade drill's lever."""
+        state.diverge_resume = request.query_params.get("off") is None
+        return JSONResponse({"diverge_resume": state.diverge_resume})
+
+    @app.route("POST", "/admin/drain", "/v1/admin/drain")
+    async def admin_drain(request: Request) -> Response:
+        state.draining = True
+        if request.query_params.get("park", "0") not in ("0", "", None):
+            state.park_streams = True
+        return JSONResponse({"draining": True,
+                             "park": state.park_streams,
+                             "resident": state.active_streams,
+                             "parked_total": state.n_parked})
+
+    @app.route("GET", "/admin/drain", "/v1/admin/drain")
+    async def admin_drain_status(request: Request) -> Response:
+        return JSONResponse({"draining": state.draining,
+                             "park": state.park_streams,
+                             "resident": state.active_streams,
+                             "parked_total": state.n_parked})
+
+    @app.route("POST", "/admin/undrain", "/v1/admin/undrain")
+    async def admin_undrain(request: Request) -> Response:
+        state.draining = False
+        state.park_streams = False
+        return JSONResponse({"draining": False})
 
     @app.route("POST", "/admin/burn", "/v1/admin/burn")
     async def admin_burn(request: Request) -> Response:

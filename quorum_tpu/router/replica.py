@@ -347,6 +347,65 @@ class ReplicaSet:
                 "migrated_bytes": moved_bytes,
                 "targets": sorted(groups)}
 
+    # ---- graceful drain ----------------------------------------------------
+
+    DRAIN_POLL_S = 0.1
+    DRAIN_TIMEOUT_S = 30.0
+
+    async def drain(self, name: str) -> dict:
+        """Gracefully drain one replica with zero failed requests
+        (docs/robustness.md "Zero-loss streams"): (1) remove it from the
+        ring FIRST — pre-removal, under the transition lock, so the
+        /ready poller's was_in→unready transition never fires and
+        double-migrates; (2) ``POST /admin/drain?park=1`` — the replica
+        sheds admissions and parks its live streams, each of which the
+        data plane proactively resumes on a sibling (the ``parked``
+        finish is the signal); (3) poll ``GET /admin/drain`` until no
+        stream is resident (bounded); (4) migrate its prefix chains to
+        the ring survivors (the PR 12 path). The replica stays configured
+        (undrain + /ready recovery bring it back)."""
+        r = self.replicas[name]
+        async with self._transition_lock:
+            if name in self.ring:
+                self.ring.remove(name)
+                RECORDER.record("router-replica-out", loop="router",
+                                replica=name, reachable=r.reachable,
+                                drain=True)
+        resp = await self._control.post(
+            f"{r.url}/admin/drain", params={"park": "1"},
+            timeout=READY_TIMEOUT_S)
+        if resp.status_code != 200:
+            return {"replica": name, "drained": False,
+                    "error": f"drain request HTTP {resp.status_code}"}
+        r.ready = False
+        deadline = time.perf_counter() + self.DRAIN_TIMEOUT_S
+        resident = None
+        while time.perf_counter() < deadline:
+            try:
+                status = await self._control.get(
+                    f"{r.url}/admin/drain", timeout=READY_TIMEOUT_S)
+                resident = (status.json() or {}).get("resident")
+            except Exception:
+                resident = None
+            if resident == 0:
+                break
+            await asyncio.sleep(self.DRAIN_POLL_S)
+        migrated: dict = {}
+        if len(self.ring):
+            try:
+                migrated = await self.migrate_from(name)
+            except Exception:
+                logger.exception(
+                    "prefix migration from draining %s failed (best "
+                    "effort)", name)
+        out = {"replica": name, "drained": resident == 0,
+               "resident": resident, **migrated}
+        RECORDER.record("router-drain", loop="router", replica=name,
+                        drained=resident == 0, resident=resident,
+                        chains=migrated.get("migrated_chains", 0))
+        logger.info("drained replica %s: %s", name, out)
+        return out
+
     # ---- teardown ----------------------------------------------------------
 
     async def aclose(self) -> None:

@@ -244,7 +244,11 @@ def create_app(
                     or not row["snapshot_worker_alive"]):
                 return "unhealthy", checks
             if (row["breaker"] != "closed"
-                    or row["pending"] >= row["queue_limit"]):
+                    or row["pending"] >= row["queue_limit"]
+                    or row.get("draining")):
+                # Draining: admissions are gated shut (POST /admin/drain)
+                # but residents still finish — degraded sheds /ready so
+                # the fleet rotates the replica out while they do.
                 status = "degraded"
         # SLO burn-rate degradation (telemetry/slo.py): opt-in via
         # QUORUM_TPU_SLO_READY_BURN — while a class burns objectives past
@@ -334,7 +338,7 @@ def create_app(
                   "zero_drain", "breaker_state",
                   "kv_pages", "kv_page_size",
                   "kv_pages_allocated", "kv_pages_free",
-                  "qos")
+                  "qos", "draining")
         # One snapshot per distinct engine (_distinct_engines). Each
         # family's TYPE line appears exactly once, with all its samples
         # grouped — the Prometheus text format rejects repeated TYPE lines.
@@ -583,6 +587,59 @@ def create_app(
                 status_code=400)
         stats["backend"] = name
         return JSONResponse(stats)
+
+    @app.route("POST", "/admin/drain", "/v1/admin/drain")
+    async def admin_drain(request: Request) -> Response:
+        """Begin a graceful drain of every engine-backed backend
+        (docs/robustness.md "Zero-loss streams"): admissions shed with a
+        retryable 503 (the router fails the shed requests over
+        pre-first-byte) and /ready goes unready so the fleet rotates the
+        replica out. Default lets residents finish; ``?park=1``
+        additionally parks them — each active stream ends with a
+        ``parked`` finish the router proactively resumes on a sibling.
+        Idempotent; returns per-engine drain status."""
+        _, reg = await current()
+        park = request.query_params.get("park", "0") not in ("0", "", None)
+        rows = []
+        for name, engine in _distinct_engines(reg, "drain"):
+            row = await asyncio.to_thread(engine.drain, park)
+            row["backend"] = name
+            rows.append(row)
+        if not rows:
+            return JSONResponse(
+                {"error": {"message": "no engine-backed backend to drain",
+                           "type": "invalid_request_error"}},
+                status_code=404)
+        return JSONResponse({"draining": True, "engines": rows})
+
+    @app.route("GET", "/admin/drain", "/v1/admin/drain")
+    async def admin_drain_status(request: Request) -> Response:
+        """Drain progress: ``resident`` per engine counts every stream
+        still attached (active + admitting + queued) — all zeros means
+        the process holds no client state and is safe to take down."""
+        _, reg = await current()
+        rows = []
+        for name, engine in _distinct_engines(reg, "drain_status"):
+            row = engine.drain_status()
+            row["backend"] = name
+            rows.append(row)
+        return JSONResponse({
+            "draining": any(r["draining"] for r in rows),
+            "resident": sum(r["resident"] for r in rows),
+            "engines": rows,
+        })
+
+    @app.route("POST", "/admin/undrain", "/v1/admin/undrain")
+    async def admin_undrain(request: Request) -> Response:
+        """Reopen admissions after a drain (the rollback knob for an
+        aborted rotation); idempotent."""
+        _, reg = await current()
+        rows = []
+        for name, engine in _distinct_engines(reg, "undrain"):
+            row = engine.undrain()
+            row["backend"] = name
+            rows.append(row)
+        return JSONResponse({"draining": False, "engines": rows})
 
     @app.route("POST", "/chat/completions", "/v1/chat/completions")
     async def chat_completions(request: Request) -> Response:

@@ -139,7 +139,11 @@ async def _router_kill_drill(check) -> None:
             replicas=[("kill-a", url_a), ("kill-b", url_b)],
             ready_interval=0.25, retries=1, timeout=20.0,
             breaker_threshold=2, breaker_cooldown=0.5,
-            migrate_on_rotation=False)
+            migrate_on_rotation=False,
+            # This phase pins the RESUME-OFF degrade contract (exactly one
+            # error chunk on the killed stream, never a re-send); phase 9
+            # runs the same kill with resume ON and asserts zero loss.
+            stream_resume=False)
         router_app = create_router_app(rcfg)
         mgr = router_app.state["replica_set"]
 
@@ -539,6 +543,245 @@ async def _qos_preemption_drill(check) -> None:
     check("qos: preemption metrics exported",
           m.get("qos") == 1 and m.get("preemptions_total", 0) >= 1
           and m.get("preempted_tokens_total", 0) >= 1)
+
+
+async def _stream_resume_drill(check) -> None:
+    """Phase 9 body (ISSUE 19, docs/robustness.md "Zero-loss streams"):
+    with resume ON, a SIGKILLed replica's live stream continues on the
+    survivor with the client-visible token sequence IDENTICAL to an
+    uninterrupted run; a survivor whose replay guard refuses the journal
+    degrades to the PR 12 error-chunk contract with no duplicate frames
+    (likewise a fault injected at ``router.resume``); and a scripted
+    drain of 1-of-2 replicas under live traffic finishes every request —
+    zero failures — with the parked stream proactively resumed."""
+    import httpx
+
+    from quorum_tpu import faults
+    from quorum_tpu.observability import ROUTER_STREAM_RESUMES
+    from quorum_tpu.router import affinity as aff
+    from quorum_tpu.router.app import RouterConfig, create_router_app
+    from quorum_tpu.telemetry.recorder import RECORDER
+
+    async def consume(rc, body: dict) -> dict:
+        out = {"text": "", "frames": [], "done": False, "error_chunks": 0,
+               "error_text": "", "roles": 0, "routed": None, "ids": set()}
+        async with rc.stream("POST", "/chat/completions",
+                             json=body) as resp:
+            out["status"] = resp.status_code
+            out["routed"] = resp.headers.get("x-routed-to")
+            async for line in resp.aiter_lines():
+                if not line.startswith("data: "):
+                    continue
+                data = line[len("data: "):]
+                if data.strip() == "[DONE]":
+                    out["done"] = True
+                    continue
+                ev = json.loads(data)
+                if ev.get("id"):
+                    out["ids"].add(ev["id"])
+                choice = (ev.get("choices") or [{}])[0]
+                delta = choice.get("delta") or {}
+                if choice.get("finish_reason") == "error":
+                    out["error_chunks"] += 1
+                    out["error_text"] += delta.get("content") or ""
+                elif delta.get("role"):
+                    out["roles"] += 1
+                elif delta.get("content"):
+                    out["text"] += delta["content"]
+                    out["frames"].append(delta["content"])
+        return out
+
+    async def cluster(tag: str):
+        """Spawn a killable pair + a resume-ON router over them."""
+        proc_a, url_a = _spawn_fake_replica(f"{tag}-a", chunk_delay=0.05,
+                                            tokens=60)
+        proc_b, url_b = _spawn_fake_replica(f"{tag}-b", chunk_delay=0.05,
+                                            tokens=60)
+        rcfg = RouterConfig(
+            replicas=[(f"{tag}-a", url_a), (f"{tag}-b", url_b)],
+            ready_interval=0.25, retries=1, timeout=20.0,
+            breaker_threshold=3, breaker_cooldown=0.5,
+            migrate_on_rotation=False)
+        router_app = create_router_app(rcfg)
+        return (proc_a, url_a), (proc_b, url_b), rcfg, router_app
+
+    def keyed_to(target: str, mgr, rcfg, *, salt: str = "") -> dict:
+        for i in range(200):
+            msgs = [{"role": "user",
+                     "content": f"resume{salt} conversation {i}: "
+                                "please answer at length"}]
+            key = aff.conversation_key({"messages": msgs},
+                                       rcfg.affinity_chunk)
+            if mgr.ring.primary(key) == target:
+                return {"model": "m", "messages": msgs,
+                        "stream": True, "max_tokens": 60}
+        raise RuntimeError(f"no key found for {target}")
+
+    # ---- arm 1: SIGKILL mid-stream -> token-exact resume on survivor ----
+    procs = []
+    try:
+        (proc_a, _), (proc_b, _), rcfg, router_app = await cluster("res")
+        procs += [proc_a, proc_b]
+        mgr = router_app.state["replica_set"]
+        transport = httpx.ASGITransport(app=router_app)
+        async with httpx.AsyncClient(transport=transport,
+                                     base_url="http://router",
+                                     timeout=60.0) as rc:
+            body = keyed_to("res-a", mgr, rcfg)
+            base = await asyncio.wait_for(consume(rc, body), timeout=30.0)
+            check("resume: uninterrupted baseline streams clean",
+                  base["done"] and base["error_chunks"] == 0
+                  and len(base["text"]) > 0, f"{base['status']}")
+            resumed_before = ROUTER_STREAM_RESUMES.value_of(
+                outcome="resumed")
+            task = asyncio.create_task(consume(rc, body))
+            await asyncio.sleep(0.6)  # well mid-stream (60 x 50ms)
+            proc_a.kill()
+            proc_a.wait()
+            got = await asyncio.wait_for(task, timeout=30.0)
+            check("resume: killed stream finishes token-exact on survivor",
+                  got["text"] == base["text"] and got["done"]
+                  and got["error_chunks"] == 0,
+                  f"len={len(got['text'])}/{len(base['text'])} "
+                  f"errors={got['error_chunks']}")
+            check("resume: one role chunk, one chunk identity, no "
+                  "duplicate frames",
+                  got["roles"] == 1 and len(got["ids"]) == 1
+                  and "".join(got["frames"]) == got["text"])
+            check("resume: outcome counted and recorder-evented",
+                  ROUTER_STREAM_RESUMES.value_of(outcome="resumed")
+                  == resumed_before + 1
+                  and "router-stream-resume"
+                  in json.dumps(RECORDER.snapshot()))
+            await mgr.aclose()
+    finally:
+        for proc in procs:
+            if proc.poll() is None:
+                proc.kill()
+                proc.wait()
+
+    # ---- arm 2: divergence + router.resume fault -> error-chunk degrade -
+    procs = []
+    try:
+        (proc_a, url_a), (proc_b, url_b), rcfg, router_app = \
+            await cluster("div")
+        procs += [proc_a, proc_b]
+        mgr = router_app.state["replica_set"]
+        transport = httpx.ASGITransport(app=router_app)
+        async with httpx.AsyncClient(transport=transport,
+                                     base_url="http://router",
+                                     timeout=60.0) as rc, \
+                httpx.AsyncClient(timeout=10.0) as direct:
+            body = keyed_to("div-a", mgr, rcfg)
+            base = await asyncio.wait_for(consume(rc, body), timeout=30.0)
+            # every replica's replay guard refuses the journal
+            for url in (url_a, url_b):
+                await direct.post(f"{url}/admin/diverge")
+            await direct.post(f"{url_a}/admin/abort?after=2")
+            divergence_before = ROUTER_STREAM_RESUMES.value_of(
+                outcome="divergence")
+            got = await asyncio.wait_for(consume(rc, body), timeout=30.0)
+            check("resume divergence: degrades to the error-chunk "
+                  "contract, no duplicate frames",
+                  got["error_chunks"] == 1 and got["done"]
+                  and "diverged" in got["error_text"]
+                  and base["text"].startswith(got["text"])
+                  and got["text"] != base["text"],
+                  f"errors={got['error_chunks']} "
+                  f"text={got['text'][:40]!r}")
+            check("resume divergence: outcome counted",
+                  ROUTER_STREAM_RESUMES.value_of(outcome="divergence")
+                  == divergence_before + 1)
+            # fault injection AT the resume site: the single sibling's
+            # attempt burns, candidates exhaust, same degrade contract
+            await direct.post(f"{url_b}/admin/diverge?off=1")
+            await direct.post(f"{url_a}/admin/diverge?off=1")
+            await direct.post(f"{url_a}/admin/abort?after=2")
+            fired_before = faults.fired("router.resume")
+            faults.arm("router.resume", times=1)
+            try:
+                got = await asyncio.wait_for(consume(rc, body),
+                                             timeout=30.0)
+            finally:
+                faults.disarm()
+            check("resume fault site: router.resume fired and degraded "
+                  "cleanly",
+                  faults.fired("router.resume") == fired_before + 1
+                  and got["error_chunks"] == 1 and got["done"]
+                  and base["text"].startswith(got["text"]),
+                  f"fired={faults.fired('router.resume')} "
+                  f"errors={got['error_chunks']}")
+            await mgr.aclose()
+    finally:
+        for proc in procs:
+            if proc.poll() is None:
+                proc.kill()
+                proc.wait()
+
+    # ---- arm 3: graceful drain of 1-of-2 under live traffic ------------
+    procs = []
+    try:
+        (proc_a, url_a), (proc_b, url_b), rcfg, router_app = \
+            await cluster("drn")
+        procs += [proc_a, proc_b]
+        mgr = router_app.state["replica_set"]
+        transport = httpx.ASGITransport(app=router_app)
+        async with httpx.AsyncClient(transport=transport,
+                                     base_url="http://router",
+                                     timeout=60.0) as rc, \
+                httpx.AsyncClient(timeout=10.0) as direct:
+            body_a = keyed_to("drn-a", mgr, rcfg)
+            body_b = keyed_to("drn-b", mgr, rcfg, salt="x")
+            base_a = await asyncio.wait_for(consume(rc, body_a),
+                                            timeout=30.0)
+            base_b = await asyncio.wait_for(consume(rc, body_b),
+                                            timeout=30.0)
+            stream_a = asyncio.create_task(consume(rc, body_a))
+            stream_b = asyncio.create_task(consume(rc, body_b))
+            await asyncio.sleep(0.6)  # both streams live
+            r = await rc.post("/router/drain?replica=drn-a")
+            report = r.json()
+            # live traffic THROUGH the drain window: all must complete
+            extra = await asyncio.wait_for(asyncio.gather(
+                *(rc.post("/chat/completions",
+                          json={"model": "m", "max_tokens": 4,
+                                "messages": [{"role": "user",
+                                              "content": f"drain load "
+                                                         f"{i}"}]})
+                  for i in range(4))), timeout=20.0)
+            got_a = await asyncio.wait_for(stream_a, timeout=30.0)
+            got_b = await asyncio.wait_for(stream_b, timeout=30.0)
+            check("drain: reported drained with zero residents",
+                  r.status_code == 200 and report.get("drained") is True
+                  and report.get("resident") == 0, f"{report}")
+            check("drain: parked stream resumed token-exact — zero loss",
+                  got_a["text"] == base_a["text"] and got_a["done"]
+                  and got_a["error_chunks"] == 0,
+                  f"len={len(got_a['text'])}/{len(base_a['text'])}")
+            check("drain: survivor stream untouched",
+                  got_b["text"] == base_b["text"] and got_b["done"]
+                  and got_b["error_chunks"] == 0)
+            check("drain: zero failed requests under live traffic",
+                  all(x.status_code == 200 for x in extra)
+                  and all(x.headers.get("x-routed-to") == "drn-b"
+                          for x in extra),
+                  f"statuses={[x.status_code for x in extra]}")
+            check("drain: replica out of the ring, drain on the recorder",
+                  "drn-a" not in mgr.ring
+                  and "router-drain" in json.dumps(RECORDER.snapshot()))
+            # undrain + recovery: the replica rejoins on a /ready tick
+            await direct.post(f"{url_a}/admin/undrain")
+            deadline = time.time() + 5.0
+            while time.time() < deadline and "drn-a" not in mgr.ring:
+                await asyncio.sleep(0.1)
+            check("drain: undrained replica rejoins the ring",
+                  "drn-a" in mgr.ring, f"ring={sorted(mgr.ring.members)}")
+            await mgr.aclose()
+    finally:
+        for proc in procs:
+            if proc.poll() is None:
+                proc.kill()
+                proc.wait()
 
 
 def _config() -> dict:
@@ -1031,6 +1274,17 @@ async def _run(quick: bool) -> None:
         if not quick:
             print("phase 8: qos preemption", flush=True)
             await _qos_preemption_drill(check)
+
+        # ---- phase 9: zero-loss streams (resume + drain) -----------------
+        # ISSUE 19's acceptance drill: SIGKILL mid-stream with resume ON
+        # -> the client-visible sequence is identical to an uninterrupted
+        # run; a refusing replay guard (and a fault at router.resume)
+        # degrades to the phase-6 error-chunk contract with no duplicate
+        # frames; draining 1-of-2 replicas under live traffic fails zero
+        # requests and proactively resumes the parked stream.
+        if not quick:
+            print("phase 9: zero-loss stream resume + drain", flush=True)
+            await _stream_resume_drill(check)
 
     from quorum_tpu.engine.engine import shutdown_all_engines
 

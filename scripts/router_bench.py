@@ -240,6 +240,166 @@ def run_fake(n_replicas: int = 2, *, n_conversations: int = 8,
         max_tokens=max_tokens))
 
 
+# ---- zero-loss stream resume legs (ISSUE 19) -------------------------------
+
+
+async def _stream_and_maybe_break(client: httpx.AsyncClient, base: str,
+                                  body: dict, *, break_after: int = 0,
+                                  on_break=None) -> dict:
+    """Stream ``body`` through ``base``; after ``break_after`` content
+    chunks call ``on_break(routed_to)`` once (SIGKILL / scripted abort).
+    Returns the delivered text plus the timing the resume leg reports."""
+    out = {"text": "", "done": False, "error_chunks": 0, "routed": None,
+           "chunks": 0, "broke_at": None, "first_after_break": None}
+    async with client.stream(
+            "POST", f"{base}/chat/completions", json=body,
+            headers={"Authorization": "Bearer bench"},
+            timeout=120.0) as resp:
+        if resp.status_code != 200:
+            raise RuntimeError(f"stream HTTP {resp.status_code}")
+        out["routed"] = resp.headers.get("x-routed-to")
+        async for line in resp.aiter_lines():
+            if not line.startswith("data: "):
+                continue
+            data = line[len("data: "):]
+            if data.strip() == "[DONE]":
+                out["done"] = True
+                continue
+            ev = json.loads(data)
+            choice = (ev.get("choices") or [{}])[0]
+            delta = choice.get("delta") or {}
+            if choice.get("finish_reason") == "error":
+                out["error_chunks"] += 1
+            elif delta.get("content"):
+                out["text"] += delta["content"]
+                out["chunks"] += 1
+                if (out["broke_at"] is not None
+                        and out["first_after_break"] is None):
+                    out["first_after_break"] = time.perf_counter()
+                if (on_break is not None and out["broke_at"] is None
+                        and out["chunks"] >= break_after):
+                    on_break(out["routed"])
+                    out["broke_at"] = time.perf_counter()
+    return out
+
+
+def _resume_report(base: dict, got: dict, resumed: int) -> dict:
+    """The shared resume-leg report: token-for-token vs the uninterrupted
+    run, the client-visible resume gap, and the replayed-journal size from
+    the router's recorder event."""
+    from quorum_tpu.telemetry.recorder import RECORDER
+
+    events = [e for e in RECORDER.snapshot()
+              if e.get("kind") == "router-stream-resume"]
+    gap = None
+    if got["broke_at"] is not None and got["first_after_break"] is not None:
+        gap = got["first_after_break"] - got["broke_at"]
+    return {
+        "token_exact": (got["text"] == base["text"] and got["done"]
+                        and got["error_chunks"] == 0),
+        "resumed": resumed,
+        "replayed_tokens": events[-1].get("replayed") if events else None,
+        "resume_latency_s": round(gap, 4) if gap is not None else None,
+        "delivered_tokens": got["chunks"],
+    }
+
+
+async def _run_resume_fake_async(*, max_tokens: int = 40) -> dict:
+    """Fake resume leg: two scripted replicas behind the resume-ON
+    router; the serving replica dies (scripted abort) mid-stream and the
+    client-visible sequence must equal the uninterrupted run."""
+    from quorum_tpu.observability import ROUTER_STREAM_RESUMES
+    from quorum_tpu.router.app import RouterConfig, create_router_app
+    from quorum_tpu.router.fake_replica import (
+        FakeReplicaState,
+        create_fake_replica_app,
+    )
+    from quorum_tpu.server.serve import start_server
+
+    states, servers, urls = [], [], []
+    for i in range(2):
+        st = FakeReplicaState(f"fake-{i}", max_tokens=max_tokens,
+                              chunk_delay=0.01)
+        srv = await start_server(create_fake_replica_app(st),
+                                 "127.0.0.1", 0)
+        states.append(st)
+        servers.append(srv)
+        urls.append(f"http://127.0.0.1:{srv.sockets[0].getsockname()[1]}")
+    cfg = RouterConfig(
+        replicas=[(f"fake-{i}", u) for i, u in enumerate(urls)],
+        policy="affinity", ready_interval=0.0)
+    router_app = create_router_app(cfg)
+    router_srv = await start_server(router_app, "127.0.0.1", 0)
+    router_url = (
+        f"http://127.0.0.1:{router_srv.sockets[0].getsockname()[1]}")
+    try:
+        async with httpx.AsyncClient() as client:
+            body = {"model": "fake", "stream": True,
+                    "max_tokens": max_tokens,
+                    "messages": [{"role": "user", "content":
+                                  conversation_opening("R", 0)}]}
+            base = await _stream_and_maybe_break(client, router_url, body)
+            before = ROUTER_STREAM_RESUMES.value_of(outcome="resumed")
+
+            def scripted_abort(name: str) -> None:
+                states[int(name.rsplit("-", 1)[1])].abort_after = 0
+
+            got = await _stream_and_maybe_break(
+                client, router_url, body, break_after=4,
+                on_break=scripted_abort)
+            resumed = int(ROUTER_STREAM_RESUMES.value_of(outcome="resumed")
+                          - before)
+    finally:
+        await app_close(router_app)
+        for srv in servers + [router_srv]:
+            srv.close()
+    return _resume_report(base, got, resumed)
+
+
+def run_resume_fake(*, max_tokens: int = 40) -> dict:
+    """Entry point shared with tests/test_router_bench.py."""
+    return asyncio.run(_run_resume_fake_async(max_tokens=max_tokens))
+
+
+async def _resume_leg(client: httpx.AsyncClient,
+                      replicas: list[tuple[str, str]], base_url: str,
+                      procs_by_name: dict, *, model: str,
+                      max_tokens: int = 24) -> dict:
+    """Real resume leg (N=2): SIGKILL the serving replica mid-stream;
+    the resumed stream must be token-for-token identical to the
+    single-replica baseline. Runs LAST — it leaves a corpse."""
+    from quorum_tpu.observability import ROUTER_STREAM_RESUMES
+    from quorum_tpu.router.app import RouterConfig, create_router_app
+    from quorum_tpu.server.serve import start_server
+
+    cfg = RouterConfig(replicas=replicas, policy="affinity",
+                       ready_interval=0.25, timeout=120.0)
+    router_app = create_router_app(cfg)
+    router_srv = await start_server(router_app, "127.0.0.1", 0)
+    router_url = (
+        f"http://127.0.0.1:{router_srv.sockets[0].getsockname()[1]}")
+    try:
+        body = {"model": model, "stream": True, "temperature": 0.0,
+                "max_tokens": max_tokens,
+                "messages": [{"role": "user", "content":
+                              conversation_opening("Z", 0)}]}
+        # the single-replica truth for this conversation
+        base = await _stream_and_maybe_break(client, base_url, body)
+
+        def sigkill(name: str) -> None:
+            procs_by_name[name].kill()
+
+        before = ROUTER_STREAM_RESUMES.value_of(outcome="resumed")
+        got = await _stream_and_maybe_break(
+            client, router_url, body, break_after=4, on_break=sigkill)
+        resumed = int(ROUTER_STREAM_RESUMES.value_of(outcome="resumed")
+                      - before)
+    finally:
+        await app_close(router_app)
+        router_srv.close()
+    return _resume_report(base, got, resumed)
+
+
 # ---- real mode (subprocess tpu:// engine replicas) -------------------------
 
 
@@ -380,6 +540,15 @@ async def _run_real_async(n_replicas: int, *, n_conversations: int,
                 max_tokens=max_tokens)
             print(f"[router-bench] real N={n_replicas} fleet: "
                   f"{json.dumps(out['fleet'])}", flush=True)
+
+        # ---- zero-loss resume leg (ISSUE 19) — LAST: it kills a replica
+        procs_by_name = {name: proc
+                         for (name, _), proc in zip(replicas, procs)}
+        async with httpx.AsyncClient() as client:
+            out["resume"] = await _resume_leg(
+                client, replicas, base_url, procs_by_name, model=model)
+            print(f"[router-bench] real N={n_replicas} resume: "
+                  f"{json.dumps(out['resume'])}", flush=True)
     finally:
         for proc in procs:
             proc.kill()
@@ -550,6 +719,11 @@ def main() -> int:
         if not fleet.get("outputs_pinned_vs_single"):
             failures.append("real n2 fleet: outputs diverged under burn "
                             "demotion")
+        resume = leg.get("resume", {})
+        if not (resume.get("token_exact") and resume.get("resumed")):
+            failures.append("real n2 resume: mid-stream kill did not "
+                            "resume token-for-token vs single-replica "
+                            f"({json.dumps(resume)})")
     out["failures"] = failures
     print(json.dumps(out), flush=True)
     return 1 if failures else 0

@@ -268,6 +268,14 @@ async def test_live_metrics_exposition_validates():
                     "quorum_tpu_engine_predictive_sheds_total"):
         assert f"# TYPE {counter} counter" in text, counter
 
+    # drain lifecycle (ISSUE 19, docs/robustness.md "Zero-loss streams"):
+    # the draining flag is a gauge (0 on a serving engine), the parked-
+    # stream tally a counter — both expose even when no drain ever ran
+    assert "# TYPE quorum_tpu_engine_draining gauge" in text
+    assert 'quorum_tpu_engine_draining{backend="LLM1"} 0' in text
+    assert ("# TYPE quorum_tpu_engine_drain_parked_total counter"
+            in text)
+
     # recompile sentinel (ISSUE 9, docs/static_analysis.md): the counter
     # fed by the analysis/compile_watch.py log-compiles hook exposes a
     # sample even at zero — post-warmup compiles are a serving bug an
@@ -386,6 +394,7 @@ async def test_live_metrics_exposition_validates():
                     "quorum_tpu_router_migrated_bytes_total",
                     "quorum_tpu_router_migrated_chains_total",
                     "quorum_tpu_router_burn_demotions_total",
+                    "quorum_tpu_router_stream_resumes_total",
                     "quorum_tpu_trace_propagated_total"):
         assert f"# TYPE {counter} counter" in text, counter
 

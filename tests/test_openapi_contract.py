@@ -65,7 +65,8 @@ def test_document_paths_match_served_routes():
         "/chat/completions", "/completions", "/embeddings", "/health",
         "/ready", "/models", "/metrics", "/debug/traces",
         "/debug/traces/{request_id}", "/debug/engine/timeline",
-        "/debug/prefix/chunks", "/debug/profile", "/debug/telemetry"}
+        "/debug/prefix/chunks", "/debug/profile", "/debug/telemetry",
+        "/admin/drain", "/admin/undrain"}
     assert [s["url"] for s in DOC["servers"]] == ["/", "/v1"]
     post = DOC["paths"]["/chat/completions"]["post"]
     assert set(post["responses"]) == {

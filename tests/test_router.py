@@ -570,6 +570,193 @@ async def test_router_admin_migrate_endpoint():
         assert out["targets"] == [dst]
 
 
+# ---- mid-stream resume + graceful drain (zero-loss streams) -----------------
+
+
+async def _collect(c: _Cluster, body: dict):
+    """Stream ``body`` through the router; return (events, headers)."""
+    import json as _json
+
+    async with c.client.stream(
+            "POST", "/chat/completions", json=body) as resp:
+        assert resp.status_code == 200, await resp.aread()
+        headers = dict(resp.headers)
+        raw = (await resp.aread()).decode()
+    frames = [ln[6:] for ln in raw.splitlines() if ln.startswith("data: ")]
+    assert frames and frames[-1] == "[DONE]"
+    return [_json.loads(f) for f in frames[:-1]], headers
+
+
+def _content(events: list[dict]) -> str:
+    return "".join((c.get("delta") or {}).get("content") or ""
+                   for e in events for c in e.get("choices") or [])
+
+
+async def test_router_stream_resume_token_exact():
+    """A mid-stream death resumes on the sibling with the client-visible
+    sequence identical to an uninterrupted run: one role chunk, one chunk
+    identity, no error chunks, no duplicate or dropped content — and the
+    resume is counted."""
+    from quorum_tpu.observability import ROUTER_STREAM_RESUMES
+
+    async with _Cluster(2) as c:
+        body = {"model": "m", "stream": True, "messages": _conv(0)}
+        base_events, base_h = await _collect(c, body)
+        base_text = _content(base_events)
+        assert base_text
+        # arm a one-shot mid-stream death on the serving replica
+        home = int(base_h["x-routed-to"][1:])
+        c.states[home].abort_after = 2
+        before = ROUTER_STREAM_RESUMES.value_of(outcome="resumed")
+        events, _ = await _collect(c, body)
+        assert _content(events) == base_text
+        assert not any(e.get("id") == "error" for e in events)
+        roles = [e for e in events if e.get("choices")
+                 and (e["choices"][0].get("delta") or {}).get("role")]
+        assert len(roles) == 1  # the replacement's role chunk is swallowed
+        assert len({e["id"] for e in events if e.get("id")}) == 1
+        assert events[-1]["choices"][0]["finish_reason"] == "stop"
+        # qt_tokens is router-internal metadata — never reaches the client
+        assert not any("qt_tokens" in e for e in events)
+        assert ROUTER_STREAM_RESUMES.value_of(outcome="resumed") \
+            == before + 1
+
+
+async def test_router_stream_resume_usage_union():
+    """Usage across a resume splice is the union: ``completion_tokens``
+    counts each generated token ONCE (journal size), never journal +
+    replayed continuation."""
+    async with _Cluster(2) as c:
+        body = {"model": "m", "stream": True, "messages": _conv(3),
+                "stream_options": {"include_usage": True}}
+        base_events, base_h = await _collect(c, body)
+        base_usage = [e["usage"] for e in base_events if e.get("usage")]
+        assert len(base_usage) == 1
+        c.states[int(base_h["x-routed-to"][1:])].abort_after = 2
+        events, _ = await _collect(c, body)
+        usage = [e["usage"] for e in events if e.get("usage")]
+        assert len(usage) == 1
+        assert usage[0] == base_usage[0]  # identical to the unbroken run
+
+
+async def test_router_stream_resume_divergence_degrades():
+    """When the survivor's replay guard refuses the journal, the stream
+    degrades to the error-chunk contract: delivered content stays a clean
+    prefix (no duplicate frames), exactly one error chunk, then [DONE]."""
+    from quorum_tpu.observability import ROUTER_STREAM_RESUMES
+
+    async with _Cluster(2) as c:
+        body = {"model": "m", "stream": True, "messages": _conv(5)}
+        base_events, base_h = await _collect(c, body)
+        base_text = _content(base_events)
+        for st in c.states:
+            st.diverge_resume = True
+        c.states[int(base_h["x-routed-to"][1:])].abort_after = 2
+        before = ROUTER_STREAM_RESUMES.value_of(outcome="divergence")
+        events, _ = await _collect(c, body)
+        errors = [e for e in events if e.get("id") == "error"]
+        assert len(errors) == 1
+        assert "diverged" in errors[0]["choices"][0]["delta"]["content"]
+        assert errors[0]["choices"][0]["finish_reason"] == "error"
+        text = _content(events[:-1])
+        assert base_text.startswith(text) and text != base_text
+        assert ROUTER_STREAM_RESUMES.value_of(outcome="divergence") \
+            == before + 1
+
+
+async def test_router_client_token_ids_passthrough_disables_resume():
+    """A client that itself asks for ``stream_token_ids`` gets the ids
+    untouched — and the router cannot journal that stream (the knob is
+    the client's), so a death degrades to the error-chunk contract."""
+    async with _Cluster(2) as c:
+        body = {"model": "m", "stream": True, "messages": _conv(7),
+                "stream_token_ids": True}
+        events, h = await _collect(c, body)
+        content = [e for e in events
+                   if _content([e])]
+        assert content and all(e.get("qt_tokens") for e in content)
+        c.states[int(h["x-routed-to"][1:])].abort_after = 1
+        events2, _ = await _collect(c, body)
+        errors = [e for e in events2 if e.get("id") == "error"]
+        assert len(errors) == 1
+
+
+async def test_router_stream_resume_disabled_keeps_error_contract():
+    """``stream_resume: false`` restores the PR 12 behavior byte-for-byte:
+    one error chunk, [DONE], no second submission."""
+    async with _Cluster(2, stream_resume=False) as c:
+        body = {"model": "m", "stream": True, "messages": _conv(9)}
+        _, h = await _collect(c, body)
+        home = int(h["x-routed-to"][1:])
+        requests_before = [st.requests for st in c.states]
+        c.states[home].abort_after = 1
+        events, _ = await _collect(c, body)
+        errors = [e for e in events if e.get("id") == "error"]
+        assert len(errors) == 1
+        after = [st.requests for st in c.states]
+        assert sum(after) == sum(requests_before) + 1  # no re-placement
+
+
+async def test_router_drain_zero_loss():
+    """POST /router/drain gracefully empties one replica under live
+    traffic: the in-flight stream parks, resumes on the sibling, and the
+    client sees the identical uninterrupted token sequence — zero failed
+    requests; the drained replica leaves the ring and new turns route to
+    the survivor with migrated-prefix warmth."""
+    async with _Cluster(2) as c:
+        body = {"model": "m", "stream": True, "messages": _conv(2)}
+        base_events, base_h = await _collect(c, body)
+        base_text = _content(base_events)
+        home = base_h["x-routed-to"]
+        # slow the scripted decode so the drain lands mid-stream
+        for st in c.states:
+            st.chunk_delay = 0.02
+        task = asyncio.ensure_future(_collect(c, body))
+        await asyncio.sleep(0.05)  # a few chunks in
+        r = await c.client.post(f"/router/drain?replica={home}")
+        assert r.status_code == 200, r.text
+        report = r.json()
+        assert report["drained"] is True and report["resident"] == 0
+        events, _ = await task
+        assert _content(events) == base_text
+        assert not any(e.get("id") == "error" for e in events)
+        assert c.states[int(home[1:])].n_parked == 1
+        # membership: out of the ring, new turns go to the survivor
+        assert home not in c.mgr.ring
+        r2 = await c.chat(_conv(2))
+        assert r2.status_code == 200
+        assert r2.headers["x-routed-to"] != home
+        # unknown replica → 404
+        assert (await c.client.post(
+            "/router/drain?replica=nope")).status_code == 404
+
+
+async def test_router_resume_fault_site_falls_to_next_candidate():
+    """An injected failure at ``router.resume`` burns the first candidate
+    and the resume commits on the next one (N=3 so a sibling remains)."""
+    from quorum_tpu import faults
+    from quorum_tpu.observability import ROUTER_STREAM_RESUMES
+
+    async with _Cluster(3) as c:
+        body = {"model": "m", "stream": True, "messages": _conv(11)}
+        base_events, base_h = await _collect(c, body)
+        base_text = _content(base_events)
+        c.states[int(base_h["x-routed-to"][1:])].abort_after = 1
+        failed = ROUTER_STREAM_RESUMES.value_of(outcome="failed")
+        resumed = ROUTER_STREAM_RESUMES.value_of(outcome="resumed")
+        faults.arm("router.resume", times=1)
+        try:
+            events, _ = await _collect(c, body)
+        finally:
+            faults.disarm()
+        assert _content(events) == base_text
+        assert not any(e.get("id") == "error" for e in events)
+        assert ROUTER_STREAM_RESUMES.value_of(outcome="failed") \
+            == failed + 1
+        assert ROUTER_STREAM_RESUMES.value_of(outcome="resumed") \
+            == resumed + 1
+
+
 # ---- real-engine migration round trip (slow tier) ---------------------------
 
 

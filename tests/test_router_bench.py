@@ -28,3 +28,17 @@ def test_router_bench_fake_smoke():
     assert out["random"]["outputs_pinned_vs_single"]
     assert out["affinity"]["completion_tokens"] > 0
     assert sum(out["affinity"]["requests_per_replica"]) == 6 * 3
+
+
+def test_router_bench_resume_fake_smoke():
+    """The zero-loss resume leg at toy scale (ISSUE 19): a scripted
+    mid-stream death resumes on the sibling with the client-visible
+    sequence identical to the uninterrupted run, and the leg reports the
+    resume gap + replayed-journal size."""
+    rb = _load_bench()
+    out = rb.run_resume_fake(max_tokens=24)
+    assert out["token_exact"], out
+    assert out["resumed"] == 1, out
+    assert out["replayed_tokens"] and out["replayed_tokens"] > 0, out
+    assert out["resume_latency_s"] is not None \
+        and out["resume_latency_s"] >= 0, out

@@ -421,6 +421,61 @@ def test_preempted_stream_token_exact(kw, sampler):
 
 
 @slow
+@pytest.mark.parametrize("sampler", [GREEDY, SAMPLED],
+                         ids=["greedy", "sampled"])
+def test_members_preemption_token_exact_and_member_local(sampler):
+    """ISSUE 19 lifts the members==1 preemption gate: the victim range is
+    member-LOCAL (flat row m·n_slots+s), so an interactive arrival on
+    member 0 parks only member 0's batch resident — the bystander stream
+    on member 1 is never preempted — and both streams stay token-for-token
+    identical to their solo runs (per-member replay bookkeeping)."""
+    eng = InferenceEngine(SPEC, seed=0, n_slots=1, decode_chunk=4,
+                          qos=True, members=2)
+    try:
+        victim_ids = [11, 13, 17, 19, 23, 29]
+        by_ids = [31, 37, 41, 43]
+        solo_v = list(eng.stream_results(eng.submit(
+            list(victim_ids), max_new_tokens=40, sampler=sampler,
+            seed=5, member=0)))
+        solo_b = list(eng.stream_results(eng.submit(
+            list(by_ids), max_new_tokens=30, sampler=sampler,
+            seed=3, member=1)))
+        before = eng.n_preemptions
+        victim = eng.submit(list(victim_ids), max_new_tokens=40,
+                            sampler=sampler, seed=5, priority="batch",
+                            member=0)
+        bystander = eng.submit(list(by_ids), max_new_tokens=30,
+                               sampler=sampler, seed=3, priority="batch",
+                               member=1)
+        got_v: list = []
+        got_b: list = []
+        th_v = threading.Thread(target=_drain, args=(eng, victim, got_v),
+                                daemon=True)
+        th_b = threading.Thread(target=_drain, args=(eng, bystander, got_b),
+                                daemon=True)
+        th_v.start()
+        th_b.start()
+        deadline = time.time() + 60
+        while victim.emitted < 8 and time.time() < deadline:
+            time.sleep(0.005)
+        assert victim.emitted >= 8, "victim never reached mid-decode"
+        bene = eng.submit([41, 43, 47], max_new_tokens=6, sampler=sampler,
+                          seed=9, priority="interactive", member=0)
+        bene_got = list(eng.stream_results(bene))
+        th_v.join(120)
+        th_b.join(120)
+        assert not th_v.is_alive() and not th_b.is_alive()
+        assert len(bene_got) == 6
+        # exactly ONE preemption, and it hit member 0's resident
+        assert eng.n_preemptions == before + 1
+        assert victim.n_preempts == 1 and bystander.n_preempts == 0
+        assert got_v == solo_v, (len(got_v), len(solo_v))
+        assert got_b == solo_b, (len(got_b), len(solo_b))
+    finally:
+        eng.shutdown()
+
+
+@slow
 def test_qos_not_in_engine_cache_key_and_opt_in_wins():
     """The cache-key pin: a qos=0 and a qos=1 backend over the same
     checkpoint share ONE engine (qos is pure host policy — no program or
