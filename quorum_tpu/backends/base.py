@@ -21,15 +21,22 @@ from quorum_tpu import oai
 class BackendError(Exception):
     """A backend call failed. Carries the normalized OpenAI-style error body
     plus any response headers the relay must preserve (``Retry-After`` on
-    503 overload/breaker-open and 504 deadline responses)."""
+    503 overload/breaker-open and 504 deadline responses).
+
+    ``code`` is an optional machine-readable failure class
+    (``"resume_diverged"`` for a replay-guard byte-compare failure) that
+    rides the SSE error chunk as ``qt_error`` — callers that branch on the
+    failure kind key on it, never on message text."""
 
     def __init__(self, message: str, *, status_code: int = 500,
                  body: dict | None = None,
-                 headers: dict[str, str] | None = None):
+                 headers: dict[str, str] | None = None,
+                 code: str | None = None):
         super().__init__(message)
         self.status_code = status_code
         self.body = body or oai.error_body(message, code=status_code)
         self.headers = dict(headers or {})
+        self.code = code
 
 
 @dataclass

@@ -248,6 +248,7 @@ from quorum_tpu.engine.engine import (
     GrammarArenaFull,
     InferenceEngine,
     QueueFullError,
+    ReplayDivergence,
     get_engine,
     get_engine_from_ckpt,
 )
@@ -341,6 +342,15 @@ def _top_dict(pairs) -> dict[str, float]:
         if text not in out:
             out[text] = float(lp)
     return out
+
+
+class _DrainParked(RuntimeError):
+    """The engine parked this request mid-generation (drain with park=1).
+    A streaming consumer surfaces it as finish_reason ``"parked"`` — the
+    router's cue to resume on a sibling — but a NON-streaming consumer
+    has no resume journal, so the partial text must become a retryable
+    503 (the router re-places the whole request), never a truncated
+    200."""
 
 
 def _invalid_request(message: str) -> BackendError:
@@ -1072,6 +1082,12 @@ class TpuBackend:
                 # stop string matched: abort decoding now, not at budget
                 result.finish_reason = "stop"
                 break
+        if getattr(req, "parked", False):
+            # Drain park (docs/robustness.md): the engine only parks
+            # unfinished requests, so whatever decoded so far is a
+            # truncated prefix — it must not ship as a 200.
+            raise _DrainParked(
+                "request parked by a draining engine before completion")
         tail = matcher.feed(detok.flush()) + matcher.flush()
         pieces.append(tail)
         if lp_content is not None:
@@ -1157,6 +1173,13 @@ class TpuBackend:
             # server fault (docs/structured_output.md).
             cancel_all()
             raise _overloaded(self.name, str(e)) from None
+        except _DrainParked:
+            # Drain park (park=1): no resume path without a stream — shed
+            # as a retryable 503 so the router re-places the request on a
+            # sibling instead of relaying truncated text as a 200.
+            cancel_all()
+            raise _overloaded(
+                self.name, "replica is draining (request parked)") from None
         except BackendError:
             raise
         except Exception as e:
@@ -1518,6 +1541,13 @@ class TpuBackend:
             except GrammarArenaFull as e:
                 cancel_all()
                 raise _overloaded(self.name, str(e)) from None
+            except _DrainParked:
+                # See complete(): a drain-parked non-streaming request
+                # sheds retryably rather than returning truncated text.
+                cancel_all()
+                raise _overloaded(
+                    self.name,
+                    "replica is draining (request parked)") from None
             except BackendError:
                 raise
             except Exception as e:
@@ -1690,10 +1720,11 @@ class TpuBackend:
                                        and len(prefix) != want):
                         why = (", stop string inside the journal"
                                if matcher.hit else "")
-                        raise RuntimeError(
-                            "resume replay diverged before admission: "
-                            f"journal renders {len(prefix)} chars "
-                            f"(client received {want}{why})")
+                        raise ReplayDivergence(
+                            len(resume), message=(
+                                "resume replay diverged before admission: "
+                                f"journal renders {len(prefix)} chars "
+                                f"(client received {want}{why})"))
                 for i, tok in enumerate(self.engine.stream_results(req)):
                     if tok == self.tokenizer.eos_id:
                         finishes[idx] = "stop"
@@ -1799,6 +1830,13 @@ class TpuBackend:
                             raise _deadline_error(self.name, val) from val
                         if isinstance(val, GrammarArenaFull):
                             raise _overloaded(self.name, str(val)) from val
+                        if isinstance(val, ReplayDivergence):
+                            # Structured failure class: the router's
+                            # resume path keys its degrade-don't-retry
+                            # decision on ``code``, not message text.
+                            raise BackendError(
+                                f"Backend {self.name} failed: {val}",
+                                code="resume_diverged") from val
                         raise BackendError(
                             f"Backend {self.name} failed: {val}") from val
         except asyncio.TimeoutError:

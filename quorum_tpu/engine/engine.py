@@ -264,8 +264,11 @@ class ReplayDivergence(RuntimeError):
     must not be retried, degrade to the error-chunk contract" apart from
     an ordinary transport failure they may fail over."""
 
-    def __init__(self, position: int, regenerated: int, delivered: int):
+    def __init__(self, position: int, regenerated: int | None = None,
+                 delivered: int | None = None, *,
+                 message: str | None = None):
         super().__init__(
+            message if message is not None else
             f"replay diverged at position {position}: regenerated token "
             f"{regenerated} != delivered token {delivered}")
         self.position = position

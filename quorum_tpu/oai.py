@@ -100,15 +100,23 @@ def final_chunk(content: str, *, model: str) -> dict[str, Any]:
     )
 
 
-def error_chunk(message: str, *, model: str) -> dict[str, Any]:
+def error_chunk(
+    message: str, *, model: str, code: str | None = None
+) -> dict[str, Any]:
     # The all-backends-failed / mid-stream-failure SSE chunk: id "error",
     # finish_reason "error" (contract asserted by the streaming tests).
-    return chunk(
+    # ``code`` rides as ``qt_error``: a machine-readable failure class
+    # ("resume_diverged") the router classifies on instead of message
+    # text; it is router-internal and stripped before reaching clients.
+    out = chunk(
         id="error",
         model=model,
         delta={"content": message},
         finish_reason="error",
     )
+    if code:
+        out["qt_error"] = code
+    return out
 
 
 def empty_usage() -> dict[str, int]:

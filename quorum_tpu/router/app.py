@@ -142,6 +142,7 @@ class _StreamJournal:
         if not isinstance(ev, dict):
             return "forward"
         qt = ev.pop("qt_tokens", None)
+        ev.pop("qt_error", None)  # router-internal failure class
         if ev.get("id") == "error":
             # An upstream-relayed error chunk ends the stream for the
             # client; a later transport death must not trigger a resume.
@@ -206,6 +207,37 @@ def _is_error_chunk(ev: Any) -> bool:
         return True
     choices = ev.get("choices") or []
     return bool(choices) and choices[0].get("finish_reason") == "error"
+
+
+def _is_divergence_chunk(ev: Any) -> bool:
+    """A replay-guard refusal: the upstream error chunk carries the
+    structured ``qt_error: "resume_diverged"`` marker (set by the real
+    server and the fake replica alike) — classification never keys on
+    message text, which rewording would silently break."""
+    return isinstance(ev, dict) and ev.get("qt_error") == "resume_diverged"
+
+
+def _is_parked_finish(ev: Any) -> bool:
+    """A drain-park finish chunk: internal ``finish_reason: "parked"``
+    that must never reach a client — journalled streams resume on it,
+    journal-less ones degrade to the error-chunk contract."""
+    if not isinstance(ev, dict) or ev.get("id") == "error":
+        return False
+    return any(isinstance(c, dict) and c.get("finish_reason") == "parked"
+               for c in ev.get("choices") or [])
+
+
+async def _aclose_quiet(stream: Any) -> None:
+    """Close an upstream stream generator without letting cleanup errors
+    mask the real outcome (an abandoned generator would hold its HTTP
+    response open until GC)."""
+    aclose = getattr(stream, "aclose", None)
+    if aclose is None:
+        return
+    try:
+        await aclose()
+    except Exception:
+        pass
 
 
 def _error_text(ev: Any) -> str:
@@ -599,6 +631,7 @@ def create_router_app(cfg: RouterConfig,
             h2 = dict(headers)
             h2["traceparent"] = traceparent
             probe = None
+            stream2 = None
             try:
                 faults.fire("router.resume")
                 stream2 = r2.backend.stream(base, h2, remaining)
@@ -610,6 +643,7 @@ def create_router_app(cfg: RouterConfig,
             except StopAsyncIteration:
                 probe = None
             except Exception as e:
+                await _aclose_quiet(stream2)
                 r2.breaker.record_failure()
                 ROUTER_STREAM_RESUMES.inc(outcome="failed")
                 RECORDER.record("router-resume-failed", rid=rid,
@@ -617,8 +651,11 @@ def create_router_app(cfg: RouterConfig,
                                 error=str(e)[:200], span=span_id)
                 continue
             if probe is None or _is_error_chunk(probe):
+                # Every non-commit path releases the replacement stream —
+                # an abandoned generator would pin the upstream response.
+                await _aclose_quiet(stream2)
                 text = _error_text(probe) if probe is not None else ""
-                if "diverged" in text:
+                if _is_divergence_chunk(probe):
                     ROUTER_STREAM_RESUMES.inc(outcome="divergence")
                     RECORDER.record("router-resume-diverged", rid=rid,
                                     loop="router", replica=name,
@@ -687,10 +724,19 @@ def create_router_app(cfg: RouterConfig,
                         event = await current.__anext__()
                     if isinstance(event, dict):
                         model = event.get("model") or model
-                    if journal is not None \
-                            and journal.absorb(event) == "parked":
-                        parked = True
-                        break
+                    if journal is not None:
+                        if journal.absorb(event) == "parked":
+                            parked = True
+                            break
+                    else:
+                        # No journal (resume off / not journalable): the
+                        # internal park finish still must not reach the
+                        # client — swallow it and degrade below.
+                        if isinstance(event, dict):
+                            event.pop("qt_error", None)
+                        if _is_parked_finish(event):
+                            parked = True
+                            break
                     yield sse.encode_event(event)
             except StopAsyncIteration:
                 break
@@ -702,12 +748,7 @@ def create_router_app(cfg: RouterConfig,
                 # signal, not a failure — the breaker stays clean.
                 RECORDER.record("router-stream-parked", rid=rid,
                                 loop="router", replica=r_old.name)
-                aclose = getattr(current, "aclose", None)
-                if aclose is not None:
-                    try:
-                        await aclose()
-                    except Exception:
-                        pass
+                await _aclose_quiet(current)
             else:
                 r_old.breaker.record_failure()
                 RECORDER.record("router-stream-broken", rid=rid,

@@ -30,8 +30,8 @@ Resume semantics mirror the real backend (docs/robustness.md "Zero-loss
 streams"): ``stream_token_ids`` attaches each chunk's token ids as
 ``qt_tokens`` (ByteTokenizer: one id per byte), and a ``resume_tokens``
 journal is byte-compared against the scripted completion — a mismatch
-(or the diverge knob) degrades to an error chunk containing "resume
-replay diverged", exactly the real replay guard's failure shape.
+(or the diverge knob) degrades to an error chunk tagged ``qt_error:
+"resume_diverged"``, exactly the real replay guard's failure shape.
 
 Fleet-plane surfaces (docs/observability.md) are scripted too: each state
 owns a PRIVATE :class:`~quorum_tpu.telemetry.recorder.FlightRecorder`
@@ -246,11 +246,13 @@ def create_fake_replica_app(state: FakeReplicaState) -> App:
             oai.chunk(id=cid, model=model, delta={"role": "assistant"}))
         if diverged:
             # The real replay guard's failure shape: the server wraps the
-            # engine's ReplayDivergence in an error chunk whose message
-            # contains "diverged" — the router keys its degrade on that.
+            # engine's ReplayDivergence in an error chunk carrying the
+            # structured ``qt_error: "resume_diverged"`` marker — the
+            # router keys its degrade on that, never on message text.
             yield sse.encode_event(oai.error_chunk(
                 "Backend failed: resume replay diverged: journal is not "
-                "a prefix of this replica's stream", model=model))
+                "a prefix of this replica's stream", model=model,
+                code="resume_diverged"))
             yield sse.encode_done()
             t_ready = state.clock()
             state.recorder.record(

@@ -157,7 +157,9 @@ async def _stream_with_role(
         # SSE error chunk and terminate.
         if out := co.drain():
             yield out
-        yield sse.encode_event(oai.error_chunk(f"Backend failed: {e}", model=model))
+        yield sse.encode_event(oai.error_chunk(
+            f"Backend failed: {e}", model=model,
+            code=getattr(e, "code", None)))
     if out := co.drain():
         yield out
     yield sse.encode_done()
@@ -596,7 +598,9 @@ def create_app(
         pre-first-byte) and /ready goes unready so the fleet rotates the
         replica out. Default lets residents finish; ``?park=1``
         additionally parks them — each active stream ends with a
-        ``parked`` finish the router proactively resumes on a sibling.
+        ``parked`` finish the router proactively resumes on a sibling,
+        and a parked NON-streaming request sheds as a retryable 503
+        (no resume journal — truncated text must never ship as a 200).
         Idempotent; returns per-engine drain status."""
         _, reg = await current()
         park = request.query_params.get("park", "0") not in ("0", "", None)

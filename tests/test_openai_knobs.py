@@ -299,6 +299,45 @@ def test_one_choice_finishing_does_not_truncate_siblings():
     assert choices[1]["message"]["content"] == b.tokenizer.decode([11] * 8)
 
 
+# ---- drain park: non-streaming must shed, never return truncated text ------
+
+class _ParkingEngine:
+    """Stub engine honoring the drain-park contract: a few tokens, then
+    ``req.parked = True`` set BEFORE the stream ends (engine
+    _sweep_drain_parks semantics)."""
+
+    def __init__(self, tokens):
+        from quorum_tpu.models.model_config import MODEL_PRESETS
+
+        self.spec = MODEL_PRESETS["llama-tiny"]
+        self.tokens = list(tokens)
+
+    def submit(self, prompt_ids, *, cancel=None, **kw):
+        import types
+
+        return types.SimpleNamespace(parked=False, lp=[], cancel=cancel)
+
+    def stream_results(self, req):
+        yield from self.tokens
+        req.parked = True
+
+
+def test_drain_park_non_streaming_is_retryable_503():
+    """A drain-parked request on the NON-streaming path has no resume
+    journal: the partial text must become a retryable 503 overload (the
+    router re-places the whole request on a sibling), never a truncated
+    200 with finish_reason "length"."""
+    b = TpuBackend.from_spec(BackendSpec(
+        name="park", url="tpu://llama-tiny?seed=5", model="m"))
+    b.engine = _ParkingEngine([7, 8, 9])
+    with pytest.raises(BackendError) as ei:
+        run(b.complete({**BASE, "max_tokens": 8}, {}, 60))
+    assert ei.value.status_code == 503
+    assert ei.value.body["error"]["type"] == "overloaded_error"
+    assert "draining" in str(ei.value)
+    assert "Retry-After" in ei.value.headers
+
+
 # ---- proxy-level validation & status relay (app layer) ---------------------
 
 async def _app_post(config, body, **fakes):

@@ -642,7 +642,9 @@ async def test_router_stream_resume_usage_union():
 async def test_router_stream_resume_divergence_degrades():
     """When the survivor's replay guard refuses the journal, the stream
     degrades to the error-chunk contract: delivered content stays a clean
-    prefix (no duplicate frames), exactly one error chunk, then [DONE]."""
+    prefix (no duplicate frames), exactly one error chunk, then [DONE].
+    Classification rides the structured ``qt_error`` marker, which —
+    like ``qt_tokens`` — never reaches the client."""
     from quorum_tpu.observability import ROUTER_STREAM_RESUMES
 
     async with _Cluster(2) as c:
@@ -658,6 +660,7 @@ async def test_router_stream_resume_divergence_degrades():
         assert len(errors) == 1
         assert "diverged" in errors[0]["choices"][0]["delta"]["content"]
         assert errors[0]["choices"][0]["finish_reason"] == "error"
+        assert not any("qt_error" in e for e in events)
         text = _content(events[:-1])
         assert base_text.startswith(text) and text != base_text
         assert ROUTER_STREAM_RESUMES.value_of(outcome="divergence") \
@@ -695,6 +698,24 @@ async def test_router_stream_resume_disabled_keeps_error_contract():
         assert len(errors) == 1
         after = [st.requests for st in c.states]
         assert sum(after) == sum(requests_before) + 1  # no re-placement
+
+
+async def test_router_park_without_journal_degrades_to_error_chunk():
+    """A drain park on a stream the router cannot resume (``stream_resume``
+    off → no journal) must not relay the internal ``parked`` finish to
+    the client: it degrades to the error-chunk contract — one error
+    chunk, then [DONE]."""
+    async with _Cluster(2, stream_resume=False) as c:
+        for st in c.states:
+            st.park_streams = True
+        body = {"model": "m", "stream": True, "messages": _conv(11)}
+        events, _ = await _collect(c, body)
+        finishes = [ch.get("finish_reason")
+                    for e in events for ch in e.get("choices") or []]
+        assert "parked" not in finishes
+        errors = [e for e in events if e.get("id") == "error"]
+        assert len(errors) == 1
+        assert "parked" in errors[0]["choices"][0]["delta"]["content"]
 
 
 async def test_router_drain_zero_loss():
