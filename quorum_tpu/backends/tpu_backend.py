@@ -184,6 +184,18 @@ URL grammar:  ``tpu://<model-id>?<spec overrides>&<engine options>``
                    and coalesced same-bucket admissions — advance ALL
                    members in one dispatch: an N-model quorum pays N× the
                    compute, not N× the per-chunk host turnaround
+  member_seeds=    ``distinct`` (default) seeds member i with seed+i;
+                   ``shared`` stacks M copies of the SAME weights (all
+                   members seed identically) — the diversity then comes
+                   from per-member sampling streams, and the shared
+                   weights are what make ``quorum_dedup=1`` sound
+  quorum_dedup=1   shared-prefix member dedup (docs/quorum.md): when a
+                   full member group admits the same prompt, prefill it
+                   ONCE on member 0's weights and broadcast the KV into
+                   all M cache rows — prefill FLOPs drop ~M×. Requires
+                   ``member_seeds=shared`` (distinct weights produce
+                   distinct KV) and is structural (engine-construction
+                   time); counted by quorum_tpu_quorum_dedup_tokens_total
   prefix_cache=0   disable automatic prefix caching (default on): a request
                    whose prompt prefix is already resident in a free slot's
                    KV cache admits into that slot and prefills only the
@@ -608,6 +620,14 @@ class TpuBackend:
             # (pre-QoS keys stay byte-identical; qos=0 and qos=1 URLs
             # share one engine, opt-in winning).
             qos=_parse_bool_opt("qos", opts.get("qos", "0")),
+            # Quorum serving (docs/quorum.md): member_seeds=shared stacks
+            # M copies of ONE weight set (a quorum of sampling streams);
+            # quorum_dedup=1 prefills a full group's shared prompt once
+            # and broadcasts the K/V. Both structural (engine cache key);
+            # value/compose errors live in the engine.
+            member_seeds=opts.get("member_seeds", "distinct"),
+            quorum_dedup=_parse_bool_opt(
+                "quorum_dedup", opts.get("quorum_dedup", "0")),
         )
         store = str(opts.get("prefix_store", "")).strip().lower()
         if store in ("", "0", "none", "off"):
@@ -666,6 +686,16 @@ class TpuBackend:
                 f"members=N does not apply to ckpt= backends "
                 f"({_CKPT_MEMBERS_ERROR}; use seed= for sampling diversity)")
         if ckpt:
+            # The quorum knobs configure the stacked members=N random init,
+            # which ckpt= rejects above — strip the defaults (ckpt engines
+            # are keyed/built without them) and fail a non-default loudly.
+            if (eng_kw.pop("member_seeds") != "distinct"
+                    or eng_kw.pop("quorum_dedup")):
+                raise ValueError(
+                    "member_seeds=/quorum_dedup= do not apply to ckpt= "
+                    "backends: they configure the stacked members=N init, "
+                    "and members=N does not apply to ckpt= (one loaded "
+                    "weight set; use seed= for sampling diversity)")
             # seed= still differentiates ensemble members: it offsets the
             # sampling RNG (weights are shared — one checkpoint on device).
             rng_offset = int(opts.get("seed", 0))

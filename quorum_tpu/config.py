@@ -168,6 +168,18 @@ class AggregateParams:
     include_original_query: bool = True
     query_format: str = "Original query: {query}\n\n"
     suppress_individual_responses: bool = False
+    # In-engine aggregation hop (docs/quorum.md): the synthesis request is
+    # a first-class engine request — aggregator_priority pins its QoS
+    # dispatch class on qos=1 engines (interactive/batch/background; ""
+    # sends no knob), stream_aggregate relays the aggregator's tokens to
+    # the client AS THEY DECODE on the streaming path (instead of one
+    # buffered final chunk), and speculative_aggregation asserts at boot
+    # that the aggregator's engine runs prompt-lookup speculation
+    # (spec_decode > 0) — the aggregation prompt quotes the members' tails,
+    # which is exactly what prompt-lookup drafts the aggregate from.
+    aggregator_priority: str = "interactive"
+    stream_aggregate: bool = False
+    speculative_aggregation: bool = False
 
     @classmethod
     def from_dict(cls, d: dict[str, Any]) -> "AggregateParams":
@@ -190,6 +202,15 @@ class AggregateParams:
         p.suppress_individual_responses = bool(
             d.get("suppress_individual_responses", p.suppress_individual_responses)
         )
+        prio = d.get("aggregator_priority", p.aggregator_priority)
+        if prio not in ("", "interactive", "batch", "background"):
+            raise ValueError(
+                f"invalid aggregator_priority {prio!r} (interactive, "
+                "batch, background, or \"\" to send no priority knob)")
+        p.aggregator_priority = prio
+        p.stream_aggregate = bool(d.get("stream_aggregate", p.stream_aggregate))
+        p.speculative_aggregation = bool(
+            d.get("speculative_aggregation", p.speculative_aggregation))
         return p
 
 

@@ -1022,6 +1022,8 @@ class InferenceEngine:
         kv_page_size: int = 0,
         kv_pool_pages: int = 0,
         qos: bool = False,
+        member_seeds: str = "distinct",
+        quorum_dedup: bool = False,
     ):
         self.spec = spec.validate()
         self.mesh = mesh or single_device_mesh()
@@ -1327,6 +1329,53 @@ class InferenceEngine:
                     "(ring attention inside the member vmap)")
             if params is not None:
                 raise ValueError(_CKPT_MEMBERS_ERROR)
+        # Quorum knobs (docs/quorum.md). member_seeds picks the stacked
+        # weight init: "distinct" (default) gives member i seed+i — M
+        # different models; "shared" gives every member the SAME weights
+        # (seed for all), so the stack is one model fanned into M sampling
+        # streams — the quorum-of-samples topology, and the precondition
+        # for shared-prefix dedup (identical weights ⇒ identical K/V).
+        if member_seeds not in ("distinct", "shared"):
+            raise ValueError(
+                f"unknown member_seeds {member_seeds!r} (distinct or shared)")
+        self.member_seeds = member_seeds
+        if member_seeds == "shared" and self.ensemble > 1:
+            raise ValueError(
+                "member_seeds=shared does not compose with ensemble>1: all "
+                f"{self.ensemble} consensus members would init identical "
+                "weights, so the averaged logits ARE member 0's logits — "
+                "consensus over M copies of one model is just the model")
+        self.quorum_dedup = bool(quorum_dedup)
+        if self.quorum_dedup:
+            if self.members <= 1:
+                raise ValueError(
+                    "quorum_dedup=1 requires members>1: there is no second "
+                    "member to share the prefill with")
+            if self.member_seeds != "shared":
+                raise ValueError(
+                    "quorum_dedup=1 requires member_seeds=shared: with "
+                    "distinct seeds member m's cache row must hold "
+                    "K_m = f_{W_m}(prompt) — M different projections of one "
+                    "prompt, which broadcasting member 0's K_0 cannot "
+                    "produce; add member_seeds=shared (one weight set, M "
+                    "sampling streams) or drop quorum_dedup")
+            if self.staged:
+                raise ValueError(
+                    "quorum_dedup=1 does not compose with disagg/zero_drain: "
+                    "staged engines admit every prompt through the chunked "
+                    "segment path, and the dedup broadcast rides the "
+                    "member-coalesced single-shot program — drop one knob")
+            if self.kv_quant:
+                raise ValueError(
+                    "quorum_dedup=1 does not compose with kv_quant=int8: "
+                    "the broadcast scatters raw K/V; the quantized cache's "
+                    "(values, scales) pair would need a second quantizing "
+                    "scatter the program does not carry — drop one knob")
+        # Prefill tokens NOT recomputed by shared-prefix dedup, and the
+        # dedup admissions that saved them (docs/quorum.md gate: tokens
+        # per request down ~M× on shared prompts).
+        self.quorum_dedup_tokens = 0
+        self.quorum_dedup_prefills = 0
         # Paged KV slot memory (tpu://…&kv_pages=1, docs/tpu_backends.md):
         # the dense [L, n_slots, K, max_seq, hd] rectangle becomes a page
         # pool [L, P, K, page_size, hd] plus a per-row on-device page table
@@ -1349,10 +1398,17 @@ class InferenceEngine:
                     "per-stage page table — drop one knob")
             if self.ensemble > 1:
                 raise ValueError(
-                    "kv_pages=1 does not compose with ensemble>1: the "
-                    "consensus decode averages logits inside a program "
-                    "that assumes one shared history window per row — "
-                    "stacked members=M compose; ensemble does not (yet)")
+                    "kv_pages=1 does not compose with ensemble>1: member m "
+                    "reads its history through its OWN pool copy — "
+                    "pool[m, table[m, slot]] — but the host allocator keeps "
+                    f"one page chain per slot group ({self.n_slots} "
+                    f"chains), not one per member row ({self.ensemble}x"
+                    f"{self.n_slots}), so per-member tables can never "
+                    "diverge. Stacked members=M share each slot group's "
+                    "history by construction (one prompt per group, one "
+                    "chain) and compose; consensus rows would need "
+                    "per-member chains — run ensemble cells dense or drop "
+                    "one knob")
             if draft_spec is not None:
                 raise ValueError(
                     "kv_pages=1 does not compose with a draft model "
@@ -1734,10 +1790,14 @@ class InferenceEngine:
             # Same stacked-init program for members and ensembles ([M, …]
             # leaves, one seed per member, quant applied per member inside
             # the init); only the *decode semantics* differ.
+            # member_seeds=shared repeats ONE seed: every member holds
+            # identical weights (one model, M sampling streams) — the
+            # quorum_dedup precondition (docs/quorum.md).
             stacked = max(self.members, self.ensemble)
+            seeds = ([seed] * stacked if self.member_seeds == "shared"
+                     else [seed + i for i in range(stacked)])
             return init_params_ensemble_sharded(
-                spec, mesh, [seed + i for i in range(stacked)],
-                quant=self.quant)
+                spec, mesh, seeds, quant=self.quant)
         if params is not None:
             out = shard_pytree(mesh, params, n_kv_heads=spec.n_kv_heads)
             if self.quant == "int8":
@@ -2308,6 +2368,125 @@ class InferenceEngine:
             ),
         )
         self._admit_cache[("members", bucket)] = fn
+        return fn
+
+    def _dedup_admit_fn(self, bucket: int):
+        """Jitted shared-prefix dedup admission (``quorum_dedup=1``,
+        docs/quorum.md): a full quorum group carries the SAME prompt and
+        (``member_seeds=shared``) the same weights, so member 0's K/V IS
+        every member's K/V. The prompt prefills ONCE — unvmapped, into a
+        ``[L, 1, K, bucket, hd]`` scratch mini-cache; prefill's attention
+        runs on the in-flight q/k/v and only *writes* the cache, so the
+        scratch costs one bucket of HBM, not a slot copy — and the result
+        broadcasts into all M stacked rows of the shared slot: one
+        dynamic_update_slice over the member axis (dense), or one scatter
+        through the slot group's shared page chain (``kv_pages=1``: the M
+        pool copies share ONE chain, so a single id vector addresses every
+        member — the aliasing form of the broadcast). Sampling is
+        per-member and bit-identical to ``_admit_fn_members``, so each
+        member's stream stays token-for-token the stream the M-prefill
+        path produces."""
+        fn = self._admit_cache.get(("dedup", bucket))
+        if fn is not None:
+            return fn
+        spec = self.spec
+        n_top = min(TOP_LOGPROBS, spec.vocab_size)
+        n_s = self.n_slots
+        mem = self.members
+        ps = self.kv_page_size
+        paged = self.kv_pages
+        ell, kv, hd = spec.n_layers, spec.n_kv_heads, spec.head_dim
+        dt = jnp.dtype(spec.dtype)
+
+        def admit(params, tokens, lengths, slot, enables, seeds,
+                  temps, topps, topks, pps, fps, bias_rows, budgets, eoss,
+                  ck, cv, token_s, lengths_s, keys_s, temp_s, topp_s, topk_s,
+                  pp_s, fp_s, counts_s, bias_s, live_s, budget_s, eos_s):
+            # Same signature as _admit_fn_members so the dispatch site is
+            # one fn swap. ``enables`` is all-True by construction (the
+            # dedup route only fires on full live groups) — unused.
+            del enables
+            p0 = jax.tree.map(lambda x: x[0], params)
+            mini = jnp.zeros((ell, 1, kv, bucket, hd), dt)
+            logits, mini_k, mini_v = prefill(
+                p0, spec, tokens[0], lengths[0], mini, mini)
+
+            if paged:
+                hp = -(-bucket // ps)
+                pad = hp * ps - bucket
+
+                def bcast(pkv, mini_c):
+                    r = mini_c[:, 0]                   # [L, K, bucket, hd]
+                    if pad:
+                        r = jnp.pad(r, ((0, 0), (0, 0), (0, pad), (0, 0)))
+                    r = r.reshape(ell, kv, hp, ps, hd).transpose(
+                        0, 2, 1, 3, 4)                 # [L, hp, K, ps, hd]
+                    # Chain ids live in every (member, layer) table copy
+                    # identically; entries past the claimed chain are the
+                    # zero sink, which collects the bucket's padded tail
+                    # exactly as page_write_prefill's writes do (masked by
+                    # every attention length mask).
+                    mp = pkv.table.shape[-1]
+                    ids = lax.dynamic_slice(
+                        pkv.table[0, 0], (slot, 0), (1, mp))[0][:hp]
+                    pool = pkv.pool.at[:, :, ids].set(
+                        r.astype(pkv.pool.dtype)[None])
+                    return PagedKV(pool, pkv.table)
+            else:
+                def bcast(cache, mini_c):
+                    upd = jnp.broadcast_to(
+                        mini_c[None].astype(cache.dtype),
+                        (mem, ell, 1, kv, bucket, hd))
+                    return lax.dynamic_update_slice(
+                        cache, upd, (0, 0, slot, 0, 0, 0))
+
+            ck = bcast(ck, mini_k)
+            cv = bcast(cv, mini_v)
+
+            adj = logits[0].astype(jnp.float32)[None, :] + bias_rows  # [M, V]
+            # PRNG identical to _admit_fn_members: per-member seed, split
+            # row 1 samples the first token, row 0 carries.
+            keys = jax.vmap(jax.random.PRNGKey)(seeds)
+            split = jax.vmap(jax.random.split)(keys)
+            firsts = sample_token_rows(adj, split[:, 1], temps, topps, topks)
+            lp_all = jax.nn.log_softmax(adj)
+            top_lp, top_ix = lax.top_k(lp_all, n_top)
+            s_lp = jnp.take_along_axis(lp_all, firsts[:, None], 1)[:, 0]
+            rows = slot + n_s * jnp.arange(mem)
+
+            def upd(arr, vals):
+                return arr.at[rows].set(vals)
+
+            counts_rows = jnp.zeros(
+                (mem, spec.vocab_size), jnp.int32
+            ).at[jnp.arange(mem), firsts].set(1)
+            return (
+                firsts, s_lp, top_ix, top_lp, ck, cv,
+                upd(token_s, firsts),
+                upd(lengths_s, lengths[:, 0]),
+                upd(keys_s, split[:, 0]),
+                upd(temp_s, temps),
+                upd(topp_s, topps),
+                upd(topk_s, topks),
+                upd(pp_s, pps),
+                upd(fp_s, fps),
+                upd(counts_s, counts_rows),
+                upd(bias_s, bias_rows),
+                upd(live_s, (budgets > 1) & (firsts != eoss)),
+                upd(budget_s, budgets - 1),
+                upd(eos_s, eoss),
+            )
+
+        fn = jax.jit(
+            admit,
+            donate_argnames=(
+                "ck", "cv", "token_s", "lengths_s", "keys_s",
+                "temp_s", "topp_s", "topk_s",
+                "pp_s", "fp_s", "counts_s", "bias_s",
+                "live_s", "budget_s", "eos_s",
+            ),
+        )
+        self._admit_cache[("dedup", bucket)] = fn
         return fn
 
     def _seg_fn(self, bucket: int, history: int):
@@ -4829,6 +5008,16 @@ class InferenceEngine:
             live[m] = req
         if not live:
             return
+        # Shared-prefix dedup (docs/quorum.md): when the group is a FULL
+        # quorum (every member live) carrying one identical prompt on a
+        # shared-weights stack, prefill once and broadcast — the prompt's
+        # K/V is member-invariant, so (M-1)·n prefill tokens never run.
+        # Partial groups, cancels, and per-member prompt edits fall back
+        # to the M-prefill program; outputs are token-for-token identical
+        # either way (the pin tests assert it).
+        use_dedup = (self.quorum_dedup and len(live) == mem
+                     and len({tuple(r.prompt_ids)
+                              for r in live.values()}) == 1)
         faults.fire("engine.admit")
         t0 = time.perf_counter()
         (firsts, s_lp, top_ix, top_lp,
@@ -4836,7 +5025,8 @@ class InferenceEngine:
          self._temp, self._topp, self._topk,
          self._pp, self._fp, self._counts, self._bias,
          self._live, self._budget, self._eos,
-         ) = self._admit_fn_members(bucket)(
+         ) = (self._dedup_admit_fn(bucket) if use_dedup
+              else self._admit_fn_members(bucket))(
             self.params, tokens, lengths, np.int32(row), enables, seeds,
             temps, topps, topks, pps, fps, bias_rows, budgets, eoss,
             self._ck, self._cv, self._token, self._lengths, self._keys,
@@ -4848,7 +5038,13 @@ class InferenceEngine:
             firsts, s_lp, top_ix, top_lp)
         t1 = time.perf_counter()
         obs.PREFILL.observe(t1 - t0)
-        self._observe_device_time("single_shot", t1 - t0)
+        self._observe_device_time("dedup" if use_dedup else "single_shot",
+                                  t1 - t0)
+        if use_dedup:
+            saved = (mem - 1) * len(next(iter(live.values())).prompt_ids)
+            self.quorum_dedup_tokens += saved
+            self.quorum_dedup_prefills += 1
+            obs.QUORUM_DEDUP_TOKENS.inc(saved)
         self.breaker.record_success()
         for m, req in live.items():
             if req.trace is not None:
@@ -4859,7 +5055,7 @@ class InferenceEngine:
                 req.trace.add_span_abs(
                     "prefill", t0, t1, tokens=len(req.prompt_ids),
                     bucket=bucket, slot=row, coalesced=len(live),
-                    reused=0, restored=0)
+                    reused=0, restored=0, dedup=int(use_dedup))
         for m, req in live.items():
             flat = m * n_s + row
             self._resident[flat] = list(req.prompt_ids)
@@ -6644,6 +6840,8 @@ def get_engine(
     kv_page_size: int = 0,
     kv_pool_pages: int = 0,
     qos: bool = False,
+    member_seeds: str = "distinct",
+    quorum_dedup: bool = False,
 ) -> InferenceEngine:
     """Engines are keyed by weight identity (spec, seed, mesh, quant,
     ensemble, members, draft model) plus the cache representation (kv_quant)
@@ -6699,7 +6897,14 @@ def get_engine(
            # part of the identity for the same reason n_slots would be if
            # it reshaped the cache.
            (bool(kv_pages), int(kv_page_size), int(kv_pool_pages))
-           if kv_pages else None)
+           if kv_pages else None,
+           # member_seeds is WEIGHT identity (shared vs distinct init
+           # seeds change every stacked leaf), and quorum_dedup is
+           # structural (the dedup admit program + counters exist at
+           # construction) — a dedup URL must never share a non-dedup
+           # engine or vice versa (docs/quorum.md).
+           member_seeds if max(1, int(members)) > 1 else None,
+           bool(quorum_dedup))
     with _ENGINES_LOCK:
         eng = _ENGINES.get(key)
         if eng is None:
@@ -6723,6 +6928,7 @@ def get_engine(
                 prefill_mesh=prefill_mesh, zero_drain=zero_drain,
                 kv_pages=kv_pages, kv_page_size=kv_page_size,
                 kv_pool_pages=kv_pool_pages, qos=qos,
+                member_seeds=member_seeds, quorum_dedup=quorum_dedup,
             )
             _ENGINES[key] = eng
         else:

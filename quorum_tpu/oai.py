@@ -323,6 +323,16 @@ def validate_request_body(body: dict[str, Any]) -> str | None:
         return f"Invalid value for 'stream_token_ids': {sti!r}"
     if sti and body.get("n") not in (None, 1):
         return "'stream_token_ids' requires n=1"
+    # Cross-cell quorum knob (docs/quorum.md): the router fans the request
+    # to M ring replicas and combines. Consumed by the router (stripped
+    # before any replica sees it); a replica receiving it directly rejects
+    # with its own 400 — fanning out is the router's job.
+    if body.get("quorum") is not None:
+        from quorum_tpu.quorum.fanout import validate_quorum
+
+        msg = validate_quorum(body)
+        if msg is not None:
+            return msg
     if "messages" in body and not isinstance(body["messages"], list):
         return "Invalid value for 'messages': must be an array"
     # Cross-tier trace propagation (docs/observability.md "Fleet plane"):

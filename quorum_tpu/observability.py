@@ -372,6 +372,39 @@ ROUTER_STREAM_RESUMES = METRICS.counter(
     "journal could not cover the stream (no token-id metadata, bound "
     "overflow, or the finish chunk already relayed).")
 
+# Native quorum serving (quorum_tpu/quorum/, docs/quorum.md — ISSUE 20):
+# shared-prefix member dedup on stacked engines, the in-engine aggregation
+# hop, and the router's cross-cell quorum fan-out with member-kill
+# degradation.
+QUORUM_DEDUP_TOKENS = METRICS.counter(
+    "quorum_tpu_quorum_dedup_tokens_total",
+    "Prefill tokens NOT recomputed by shared-prefix member dedup "
+    "(quorum_dedup=1 on a members=M engine): a member-complete admission "
+    "group with identical prompts prefills ONCE and broadcasts into the "
+    "[M, ...] stacked cache, saving (M-1) x n_prompt tokens per group.")
+QUORUM_DEGRADED = METRICS.counter(
+    "quorum_tpu_quorum_degraded_total",
+    "Quorum members dropped mid-request while the quorum was SERVED from "
+    "the survivors (never failed), by reason: member_failed = a member "
+    "leg died pre-first-byte on every candidate; stream_broken = a "
+    "member's live stream died and token-exact resume was exhausted; "
+    "resume_diverged = the replay guard refused the member's resume; "
+    "no_content = a member completed empty.")
+QUORUM_REQUESTS = METRICS.counter(
+    "quorum_tpu_quorum_requests_total",
+    "Router-tier quorum fan-outs (the quorum= body knob), by outcome: "
+    "full = every member contributed, degraded = served from a strict "
+    "subset of members, failed = no member produced content.")
+AGGREGATE_DEGRADED = METRICS.counter(
+    "quorum_tpu_aggregate_degraded_total",
+    "Aggregate-strategy combines that fell back to the separator-join of "
+    "the member outputs instead of a real LLM aggregation, by reason: "
+    "no_aggregator = none configured, no_credentials = the aggregator "
+    "required auth no header provided, error = the aggregator call "
+    "failed or returned non-2xx, empty = it returned no content. The "
+    "first underlying error rides the X-Quorum-Aggregate-Error response "
+    "header (docs/quorum.md).")
+
 # Fleet observability plane (ISSUE 16, docs/observability.md "Fleet
 # plane"): cross-tier trace propagation, per-replica telemetry absorption,
 # and burn-aware placement. Registered process-wide like the other router

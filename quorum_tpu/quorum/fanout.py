@@ -1,0 +1,537 @@
+"""Cross-cell quorum fan-out: one request → M ring replicas → one combine.
+
+The router-tier leg of native quorum serving (docs/quorum.md): a request
+carrying ``quorum: M`` fans out to M DISTINCT replicas in ring candidate
+order (heterogeneous members — each leg is an independent cell), and the
+member answers combine at the router, the tier that already owns failover.
+
+Degradation contract (the whole point): a member leg that fails never
+fails the REQUEST —
+
+  - pre-first-byte failure retries the leg on a spare candidate (a ring
+    member not already serving another leg), then drops the member
+    (``member_failed``);
+  - a mid-stream death is first retried TOKEN-EXACT on a spare via the
+    zero-loss resume wire contract (``resume_tokens``/``resume_chars``/
+    ``qt_tokens`` — docs/robustness.md), so a killed member usually
+    finishes its answer on a sibling cell with no duplicate or dropped
+    tokens; only when no spare commits is the member dropped
+    (``stream_broken``, or ``resume_diverged`` when the replay guard
+    itself refused);
+  - a member that completes empty is dropped (``no_content``).
+
+Members resume only onto SPARE candidates, never onto a replica already
+serving another leg: two legs on one cell would silently halve the
+quorum's fault independence, which is worse than an honestly-degraded
+quorum. Every dropped member lands on
+``quorum_tpu_quorum_degraded_total{reason=}`` and the flight recorder;
+the request outcome (``full`` / ``degraded`` / ``failed``) lands on
+``quorum_tpu_quorum_requests_total``. The request fails ONLY when no
+member produced any content at all.
+
+SSE surface reuses the parallel-proxy contract (oai.py): role chunk id
+``chatcmpl-parallel``, member deltas ``chatcmpl-parallel-{i}``, final
+combined chunk ``chatcmpl-parallel-final`` (finish_reason "stop"),
+all-failed error chunk id ``error``, terminating ``[DONE]``.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from dataclasses import dataclass, field
+from typing import Any, AsyncIterator
+
+from quorum_tpu import faults, oai, sse
+from quorum_tpu.backends.base import BackendError
+from quorum_tpu.observability import QUORUM_DEGRADED, QUORUM_REQUESTS
+from quorum_tpu.telemetry.recorder import RECORDER
+
+logger = logging.getLogger(__name__)
+
+# Hard ceiling on the quorum= knob (oai.validate_request_body enforces it
+# request-side): past ~8 members the combine is paying fan-out latency for
+# answers nobody reads, and a typo like quorum=300 must not fan out.
+MAX_QUORUM = 8
+
+QUORUM_MODEL_NAME = "quorum-proxy"
+
+_DONE = object()
+
+
+def validate_quorum(body: dict[str, Any]) -> str | None:
+    """Shape-validate the ``quorum`` body knob (docs/quorum.md). Returns
+    an error message for a 400, or None. Mirrors the other knob checks in
+    :func:`quorum_tpu.oai.validate_request_body` (which calls this)."""
+    q = body.get("quorum")
+    if q is None:
+        return None
+    if isinstance(q, bool) or not isinstance(q, int) \
+            or not 1 <= q <= MAX_QUORUM:
+        return (f"Invalid value for 'quorum': {q!r} (an integer in "
+                f"[1, {MAX_QUORUM}])")
+    if q > 1:
+        if body.get("n") not in (None, 1):
+            return "'quorum' requires n=1"
+        if body.get("logprobs"):
+            return ("'quorum' cannot be combined with 'logprobs' (the "
+                    "combined answer has no single token record stream)")
+        if body.get("resume_tokens") is not None:
+            return ("'quorum' cannot be combined with 'resume_tokens' "
+                    "(member resume is router-internal)")
+        if body.get("stream_token_ids"):
+            return ("'quorum' cannot be combined with 'stream_token_ids' "
+                    "(the quorum combine re-chunks member deltas, so "
+                    "per-chunk token ids would be meaningless)")
+    return None
+
+
+def pop_quorum(body: dict[str, Any]) -> int:
+    """Strip the ``quorum`` knob (it must never reach a replica — a
+    forwarded knob would recurse the fan-out) and return the member count
+    (1 = off). Call after :func:`validate_quorum`."""
+    q = body.pop("quorum", None)
+    return int(q) if isinstance(q, int) and not isinstance(q, bool) else 1
+
+
+def choose_members(candidates: list[str], m: int) -> tuple[list[str], list[str]]:
+    """Split the ring's candidate order into (assigned members, spares).
+
+    The first M candidates ARE the quorum — ring order already encodes
+    affinity-then-load placement, so member 0 is the replica a plain
+    request would have landed on. The rest are the spare pool legs retry
+    and resume onto."""
+    return candidates[:m], candidates[m:]
+
+
+@dataclass
+class QuorumLeg:
+    """One member's outcome: content + usage when served, the degrade
+    reason when dropped. ``replica`` is the cell that finished the leg
+    (after any retry/resume it may differ from the assignment)."""
+
+    index: int
+    replica: str = ""
+    content: str = ""
+    usage: dict[str, Any] | None = None
+    body: dict[str, Any] | None = None   # full completion (non-streaming)
+    ok: bool = False
+    resumed: bool = False
+    degraded_reason: str | None = None
+    error: str = ""
+    status_code: int = 0                 # last upstream status (diagnostics)
+    tried: list[str] = field(default_factory=list)
+
+
+def _drop(leg: QuorumLeg, reason: str, rid: str, error: str = "") -> None:
+    """Drop one member from the quorum: the leg's loss is counted and
+    recorded, the request lives on with the survivors."""
+    leg.degraded_reason = reason
+    if error:
+        leg.error = error[:200]
+    QUORUM_DEGRADED.inc(reason=reason)
+    RECORDER.record("quorum-member-degraded", rid=rid, loop="router",
+                    member=leg.index, replica=leg.replica or "none",
+                    reason=reason, **({"error": leg.error}
+                                      if leg.error else {}))
+
+
+def _next_candidate(leg: QuorumLeg, assigned: str,
+                    spares: list[str], replicas: dict[str, Any]) -> Any:
+    """The leg's next untried cell: its ring assignment first, then the
+    shared spare pool (popped — a spare serves at most one leg). Spares
+    whose breaker is open are skipped, not burned."""
+    if assigned not in leg.tried:
+        leg.tried.append(assigned)
+        r = replicas[assigned]
+        if r.breaker.allow():
+            return r
+    while spares:
+        name = spares.pop(0)
+        if name in leg.tried:
+            continue
+        leg.tried.append(name)
+        r = replicas[name]
+        if r.breaker.allow():
+            return r
+    return None
+
+
+def summarize(m: int, legs: list[QuorumLeg]) -> tuple[str, list[QuorumLeg]]:
+    """(outcome, served legs) for the request-level counter/headers:
+    ``full`` when every member contributed, ``degraded`` for a strict
+    non-empty subset, ``failed`` when nothing came back."""
+    served = [leg for leg in legs if leg.ok and leg.content]
+    if len(served) == m:
+        return "full", served
+    if served:
+        return "degraded", served
+    return "failed", served
+
+
+def quorum_headers(m: int, legs: list[QuorumLeg],
+                   outcome: str) -> dict[str, str]:
+    """Response headers carrying the quorum's shape (openapi.yaml): how
+    many members were asked, how many answered, which cells served, and
+    the first degrade reason when any member was dropped."""
+    served = [leg for leg in legs if leg.ok and leg.content]
+    out = {
+        "X-Quorum-Members": str(m),
+        "X-Quorum-Served": str(len(served)),
+        "X-Quorum-Replicas": ",".join(leg.replica for leg in served),
+    }
+    reasons = [leg.degraded_reason for leg in legs if leg.degraded_reason]
+    if outcome != "full" and reasons:
+        out["X-Quorum-Degraded"] = reasons[0]
+    return out
+
+
+async def _leg_complete(leg: QuorumLeg, assigned: str, spares: list[str],
+                        replicas: dict[str, Any], body: dict[str, Any],
+                        headers: dict[str, str], deadline: float,
+                        rid: str) -> None:
+    """Run one non-streaming member leg to completion or drop. Failure
+    policy mirrors the router's single-request path: 5xx/transport moves
+    to the next spare (the replica already burned its own retry budget),
+    4xx is replica-independent and ends the leg immediately."""
+    while True:
+        r = _next_candidate(leg, assigned, spares, replicas)
+        if r is None:
+            _drop(leg, "member_failed", rid,
+                  leg.error or "no spare candidate")
+            return
+        remaining = deadline - time.monotonic()
+        if remaining <= 0:
+            _drop(leg, "member_failed", rid, "deadline exhausted")
+            return
+        leg.replica = r.name
+        r.inflight += 1
+        r.requests += 1
+        try:
+            faults.fire("quorum.leg")
+            result = await r.backend.complete(body, headers, remaining)
+        except BackendError as e:
+            leg.status_code = e.status_code
+            leg.error = str(e)[:200]
+            if e.status_code < 500:
+                # Client errors are replica-independent: retrying spares
+                # cannot help. Keep the body so an all-4xx quorum relays
+                # the real error, not a 502 wrapper.
+                leg.body = e.body
+                _drop(leg, "member_failed", rid, str(e))
+                return
+            r.breaker.record_failure()
+            continue
+        except Exception as e:  # fault-injection / transport surprises
+            leg.error = str(e)[:200]
+            r.breaker.record_failure()
+            continue
+        finally:
+            r.inflight -= 1
+        leg.status_code = result.status_code
+        if result.status_code >= 500:
+            leg.error = str(result.body)[:200]
+            r.breaker.record_failure()
+            continue
+        r.breaker.record_success()
+        if result.status_code >= 400:
+            leg.body = result.body
+            _drop(leg, "member_failed", rid, str(result.body))
+            return
+        content = oai.extract_content(result.body)
+        if not content:
+            _drop(leg, "no_content", rid)
+            return
+        leg.ok = True
+        leg.content = content
+        leg.usage = result.usage if isinstance(result.usage, dict) else None
+        leg.body = result.body
+        return
+
+
+async def quorum_complete(
+    replicas: dict[str, Any],
+    candidates: list[str],
+    m: int,
+    body: dict[str, Any],
+    headers: dict[str, str],
+    deadline: float,
+    rid: str,
+    separator: str,
+) -> tuple[dict[str, Any], int, dict[str, str]]:
+    """Non-streaming quorum: fan the request to M member legs, combine
+    the survivors' answers into ONE chat.completion. Returns
+    ``(response body, status, extra headers)``."""
+    assigned, spare_list = choose_members(candidates, m)
+    spares = list(spare_list)
+    legs = [QuorumLeg(index=i) for i in range(m)]
+    RECORDER.record("quorum-fanout", rid=rid, loop="router", members=m,
+                    replicas=",".join(assigned), stream=False)
+    coros = []
+    for i, leg in enumerate(legs):
+        if i < len(assigned):
+            coros.append(_leg_complete(leg, assigned[i], spares, replicas,
+                                       body, headers, deadline, rid))
+        else:
+            _drop(leg, "member_failed", rid, "no replica for member")
+    await asyncio.gather(*coros)
+    outcome, served = summarize(m, legs)
+    QUORUM_REQUESTS.inc(outcome=outcome)
+    RECORDER.record("quorum-served", rid=rid, loop="router",
+                    outcome=outcome, served=len(served), members=m)
+    hdrs = quorum_headers(m, legs, outcome)
+    if outcome == "failed":
+        # Relay a replica-independent client error as itself (one 4xx,
+        # not a 502 hiding it); otherwise the PR 12 proxy_error contract.
+        client_err = next((leg for leg in legs
+                           if 400 <= leg.status_code < 500), None)
+        if client_err is not None and client_err.body is not None:
+            return client_err.body, client_err.status_code, hdrs
+        return (oai.error_body(
+            "quorum failed: no member produced content "
+            f"(members={m}, last error: {legs[-1].error or 'none'})"),
+            502, hdrs)
+    first = served[0].body or {}
+    combined = separator.join(leg.content for leg in served)
+    return ({
+        "id": first.get("id", oai.new_request_id()),
+        "object": "chat.completion",
+        "created": first.get("created", oai.now()),
+        "model": first.get("model", QUORUM_MODEL_NAME),
+        "choices": [{
+            "index": 0,
+            "message": {"role": "assistant", "content": combined},
+            "finish_reason": "stop",
+        }],
+        "usage": oai.sum_usage([leg.usage for leg in served]),
+        "quorum": {
+            "members": m,
+            "served": len(served),
+            "replicas": [leg.replica for leg in served],
+            "degraded": [
+                {"member": leg.index, "reason": leg.degraded_reason}
+                for leg in legs if leg.degraded_reason
+            ],
+        },
+    }, 200, hdrs)
+
+
+def _is_role_only(ev: Any) -> bool:
+    if not isinstance(ev, dict) or ev.get("id") == "error":
+        return False
+    if "usage" in ev:
+        return False
+    choices = ev.get("choices") or []
+    if len(choices) != 1 or choices[0].get("finish_reason"):
+        return False
+    delta = choices[0].get("delta") or {}
+    return bool(delta) and set(delta) <= {"role"}
+
+
+def _is_error_chunk(ev: Any) -> bool:
+    if not isinstance(ev, dict):
+        return False
+    if ev.get("id") == "error":
+        return True
+    choices = ev.get("choices") or []
+    return bool(choices) and choices[0].get("finish_reason") == "error"
+
+
+async def _aclose_quiet(stream: Any) -> None:
+    aclose = getattr(stream, "aclose", None)
+    if aclose is None:
+        return
+    try:
+        await aclose()
+    except Exception:
+        pass
+
+
+async def _pump_leg(leg: QuorumLeg, assigned: str, spares: list[str],
+                    replicas: dict[str, Any], base_body: dict[str, Any],
+                    headers: dict[str, str], deadline: float, rid: str,
+                    queue: asyncio.Queue, journal_limit: int) -> None:
+    """Drive one streaming member leg, pushing ``(index, text)`` deltas
+    into the merge queue and ``(index, _DONE)`` at the end (served or
+    dropped — the merger reads the leg's fields).
+
+    The leg journals its delivered token ids (``qt_tokens``, requested
+    via ``stream_token_ids``) so a mid-stream death re-submits on a spare
+    with ``resume_tokens``/``resume_chars`` — the PR 19 token-exact
+    resume, scoped to one member. A replay-guard refusal (the structured
+    ``qt_error: "resume_diverged"`` marker) drops the member immediately:
+    retrying spares cannot help when the guard itself refused."""
+    ids: list[int] = []
+    unresumable = False
+    started = False
+    try:
+        while True:
+            r = _next_candidate(leg, assigned, spares, replicas)
+            if r is None:
+                _drop(leg, "stream_broken" if started else "member_failed",
+                      rid, leg.error or "no spare candidate")
+                return
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                _drop(leg, "stream_broken" if started else "member_failed",
+                      rid, "deadline exhausted")
+                return
+            b = dict(base_body)
+            b["stream"] = True
+            b["stream_token_ids"] = True
+            b.pop("resume_tokens", None)
+            b.pop("resume_chars", None)
+            if started:
+                if unresumable or not ids:
+                    # Delivered content the journal cannot cover: a resume
+                    # would drop or duplicate member deltas the client
+                    # already has — drop the member instead.
+                    _drop(leg, "stream_broken", rid,
+                          leg.error or "journal cannot cover the stream")
+                    return
+                b["resume_tokens"] = list(ids)
+                b["resume_chars"] = len(leg.content)
+            leg.replica = r.name
+            r.inflight += 1
+            r.requests += 1
+            stream = None
+            broke: str = ""
+            finished = False
+            try:
+                faults.fire("quorum.leg")
+                stream = r.backend.stream(b, headers, remaining)
+                async for ev in stream:
+                    if not isinstance(ev, dict):
+                        continue
+                    qt = ev.pop("qt_tokens", None)
+                    if ev.get("qt_error") == "resume_diverged":
+                        r.breaker.record_success()
+                        _drop(leg, "resume_diverged", rid,
+                              oai.extract_delta_content(ev))
+                        return
+                    if _is_error_chunk(ev):
+                        # The replica converted its own failure into the
+                        # error-chunk contract — for the quorum that is a
+                        # leg death, resumable like a transport one.
+                        broke = oai.extract_delta_content(ev) or "error chunk"
+                        break
+                    if _is_role_only(ev):
+                        continue
+                    usage = ev.get("usage")
+                    if isinstance(usage, dict):
+                        leg.usage = usage
+                    text = oai.extract_delta_content(ev)
+                    if text:
+                        started = True
+                        leg.content += text
+                        if qt:
+                            ids.extend(qt)
+                            if len(ids) > journal_limit:
+                                unresumable = True
+                        else:
+                            unresumable = True
+                        await queue.put((leg.index, text))
+                    fin = next((c.get("finish_reason")
+                                for c in ev.get("choices") or []
+                                if isinstance(c, dict)
+                                and c.get("finish_reason")), None)
+                    if fin == "parked":
+                        # Drain-park: the cell is shedding, not failing —
+                        # resume on a spare without burning the breaker.
+                        broke = "stream parked"
+                        break
+                    if fin:
+                        finished = True
+            except Exception as e:
+                broke = str(e)[:200] or type(e).__name__
+            finally:
+                r.inflight -= 1
+                if stream is not None:
+                    await _aclose_quiet(stream)
+            if finished:
+                r.breaker.record_success()
+                if not leg.content:
+                    _drop(leg, "no_content", rid)
+                    return
+                leg.ok = True
+                leg.resumed = len(leg.tried) > 1
+                return
+            leg.error = (broke or "stream ended without finish")[:200]
+            if broke != "stream parked":
+                r.breaker.record_failure()
+            RECORDER.record("quorum-leg-broken", rid=rid, loop="router",
+                            member=leg.index, replica=r.name,
+                            error=leg.error, resumable=bool(
+                                not started or (ids and not unresumable)))
+            # Loop: next candidate, token-exact resume when started.
+    finally:
+        await queue.put((leg.index, _DONE))
+
+
+async def quorum_stream(
+    replicas: dict[str, Any],
+    candidates: list[str],
+    m: int,
+    body: dict[str, Any],
+    headers: dict[str, str],
+    deadline: float,
+    rid: str,
+    separator: str,
+    journal_limit: int = 4096,
+    suppress_individual: bool = False,
+) -> AsyncIterator[bytes]:
+    """Streaming quorum: M member legs merge live into one SSE stream
+    under the parallel-proxy chunk contract, then the final combined
+    chunk joins the survivors. Member deaths degrade mid-flight (the
+    dropped member's delivered deltas stay — they cannot be unsent — and
+    its partial answer joins the combine)."""
+    assigned, spare_list = choose_members(candidates, m)
+    spares = list(spare_list)
+    legs = [QuorumLeg(index=i) for i in range(m)]
+    RECORDER.record("quorum-fanout", rid=rid, loop="router", members=m,
+                    replicas=",".join(assigned), stream=True)
+    yield sse.encode_event(oai.role_chunk(QUORUM_MODEL_NAME))
+
+    queue: asyncio.Queue = asyncio.Queue()
+    tasks = []
+    for i, leg in enumerate(legs):
+        if i < len(assigned):
+            tasks.append(asyncio.create_task(_pump_leg(
+                leg, assigned[i], spares, replicas, body, headers,
+                deadline, rid, queue, journal_limit)))
+        else:
+            _drop(leg, "member_failed", rid, "no replica for member")
+    try:
+        finished = 0
+        while finished < len(tasks):
+            index, item = await queue.get()
+            if item is _DONE:
+                finished += 1
+                continue
+            if not suppress_individual:
+                yield sse.encode_event(oai.content_chunk(
+                    item, model=QUORUM_MODEL_NAME, backend_index=index))
+    finally:
+        for t in tasks:
+            t.cancel()
+
+    outcome, served = summarize(m, legs)
+    QUORUM_REQUESTS.inc(outcome=outcome)
+    RECORDER.record("quorum-served", rid=rid, loop="router",
+                    outcome=outcome, served=len(served), members=m)
+    # Dropped members with partial content still join the combine: their
+    # deltas already reached the client, and a half answer from a killed
+    # cell beats pretending it said nothing.
+    partial = [leg for leg in legs
+               if leg.content and not leg.ok]
+    contributions = sorted(served + partial, key=lambda leg: leg.index)
+    if contributions:
+        combined = separator.join(leg.content for leg in contributions)
+        yield sse.encode_event(oai.final_chunk(combined,
+                                               model=QUORUM_MODEL_NAME))
+    else:
+        yield sse.encode_event(oai.error_chunk(
+            "Error: quorum failed: no member produced content",
+            model=QUORUM_MODEL_NAME))
+    yield sse.encode_done()

@@ -58,6 +58,7 @@ from quorum_tpu.observability import (
     ROUTER_STREAM_RESUMES,
     TRACE_PROPAGATED,
 )
+from quorum_tpu.quorum import fanout as quorum_fanout
 from quorum_tpu.router import affinity
 from quorum_tpu.router.replica import Replica, ReplicaSet
 from quorum_tpu.server.asgi import (
@@ -277,6 +278,9 @@ class RouterConfig:
     # error-chunk contract instead of growing without bound.
     stream_resume: bool = True
     resume_max_tokens: int = 4096
+    # Cross-cell quorum (docs/quorum.md): the separator joining member
+    # answers in the router-tier combine of a ``quorum=M`` request.
+    quorum_separator: str = "\n\n---\n\n"
 
     def __post_init__(self) -> None:
         if self.policy not in ("affinity", "random"):
@@ -304,7 +308,7 @@ class RouterConfig:
             "load_factor", "breaker_threshold", "breaker_window",
             "breaker_cooldown", "burn_threshold", "burn_class",
             "telemetry_max_age", "stream_resume",
-            "resume_max_tokens") if k in raw}
+            "resume_max_tokens", "quorum_separator") if k in raw}
         return cls(replicas=replicas, **kwargs)
 
 
@@ -443,6 +447,51 @@ def create_router_app(cfg: RouterConfig,
         except (TypeError, ValueError):
             timeout = cfg.timeout
         deadline = time.monotonic() + timeout
+
+        # Cross-cell quorum (docs/quorum.md): ``quorum: M`` fans this
+        # request out to M distinct ring candidates and combines at THIS
+        # tier. The knob is validated and STRIPPED here — a forwarded
+        # knob would recurse the fan-out at the replicas. Member deaths
+        # degrade the quorum (token-exact resume on a spare first, then
+        # served from the survivors), never fail the request while any
+        # member holds content.
+        q_msg = quorum_fanout.validate_quorum(body)
+        if q_msg is not None:
+            return JSONResponse(
+                {"error": {"message": q_msg,
+                           "type": "invalid_request_error"}},
+                status_code=400)
+        quorum_m = quorum_fanout.pop_quorum(body)
+        if quorum_m > 1:
+            _, candidates = _pick(body)
+            if not candidates:
+                return _shed_response()
+            span_id, traceparent = tracecontext.child_traceparent(trace_id)
+            headers["traceparent"] = traceparent
+            assigned, _spares = quorum_fanout.choose_members(
+                candidates, quorum_m)
+            if is_streaming:
+                resp = StreamingResponse(quorum_fanout.quorum_stream(
+                    mgr.replicas, candidates, quorum_m, body, headers,
+                    deadline, rid, cfg.quorum_separator,
+                    journal_limit=cfg.resume_max_tokens,
+                    suppress_individual=bool(
+                        body.get("suppress_individual_responses", False))))
+                # Streamed degradation is visible on the counters/recorder
+                # and in the combine, not headers — the member outcomes
+                # are unknown when these go out.
+                resp.headers["X-Quorum-Members"] = str(quorum_m)
+                resp.headers["X-Quorum-Replicas"] = ",".join(assigned)
+                resp.headers["X-Request-Id"] = rid
+                resp.headers["traceparent"] = traceparent
+                return resp
+            q_body, q_status, q_headers = await quorum_fanout.quorum_complete(
+                mgr.replicas, candidates, quorum_m, body, headers,
+                deadline, rid, cfg.quorum_separator)
+            q_headers["X-Request-Id"] = rid
+            q_headers["traceparent"] = traceparent
+            return JSONResponse(q_body, status_code=q_status,
+                                headers=q_headers)
 
         # A stream is resumable when the router may journal it: resume
         # enabled, single choice, no logprobs (replayed tokens carry no

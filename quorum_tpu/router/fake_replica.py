@@ -111,6 +111,10 @@ class FakeReplicaState:
         # /debug/telemetry exports, what burn-aware routing drills on.
         self.burn: dict[str, float] = {}
         self.requests = 0
+        # Raw request bodies, in arrival order — tests assert on what the
+        # router actually forwarded (e.g. that the quorum= knob never
+        # reaches a replica).
+        self.seen_bodies: list[dict] = []
         self.prefix_hits = 0
         self.tokens_restored = 0
         # Drill knobs + drain lifecycle (module docstring).
@@ -162,6 +166,7 @@ def create_fake_replica_app(state: FakeReplicaState) -> App:
                 {"error": {"message": f"Invalid JSON body: {e}",
                            "type": "invalid_request_error"}},
                 status_code=400)
+        state.seen_bodies.append(dict(body))
         if state.shedding or state.draining:
             return JSONResponse(
                 {"error": {"message": ("engine draining" if state.draining

@@ -60,7 +60,7 @@ from quorum_tpu.server.asgi import (
     Response,
     StreamingResponse,
 )
-from quorum_tpu.strategies.combine import combine_outcomes
+from quorum_tpu.strategies.combine import combine_outcomes, degraded_headers
 from quorum_tpu.strategies.fanout import fanout_complete
 from quorum_tpu.strategies.streaming import StreamPlan, parallel_stream
 
@@ -165,6 +165,41 @@ async def _stream_with_role(
     yield sse.encode_done()
 
 
+def _validate_speculative_aggregation(cfg: Config, reg) -> None:
+    """Boot-time check for ``speculative_aggregation: true`` (docs/quorum.md).
+
+    There is no per-request speculation lever — spec_decode is an engine
+    boot knob — so the opt-in is an assertion: the aggregator must be a
+    local ``tpu://`` backend whose engine runs prompt-lookup speculation
+    (the aggregation prompt quotes the members' tails verbatim, which is
+    exactly what prompt lookup drafts the aggregate from). Failing at boot
+    beats silently aggregating unaccelerated."""
+    try:
+        if cfg.strategy_name != "aggregate" or not cfg.aggregate.speculative_aggregation:
+            return
+    except ValueError:
+        raise  # invalid aggregate block: let from_dict's error surface
+    p = cfg.aggregate
+    agg = reg.get(p.aggregator_backend) if p.aggregator_backend else None
+    if agg is None:
+        raise ValueError(
+            "speculative_aggregation: true requires an aggregator_backend "
+            f"(got {p.aggregator_backend!r})")
+    engine = getattr(agg, "engine", None)
+    if engine is None:
+        raise ValueError(
+            f"speculative_aggregation: true requires a tpu:// aggregator "
+            f"(backend {agg.name!r} is {type(agg).__name__}; an HTTP "
+            "upstream's speculation cannot be asserted from here)")
+    if int(getattr(engine, "spec_decode", 0) or 0) <= 0:
+        raise ValueError(
+            f"speculative_aggregation: true but aggregator {agg.name!r} "
+            "runs no speculation (spec_decode=0). Add spec_decode=G "
+            "(e.g. spec_decode=4) to its tpu:// URL — the aggregation "
+            "prompt quotes the members' outputs, which is what "
+            "prompt-lookup speculation drafts from.")
+
+
 def create_app(
     config: Config | None = None,
     registry: BackendRegistry | None = None,
@@ -184,6 +219,7 @@ def create_app(
     """
     cfg = config if config is not None else load_config()
     reg = registry if registry is not None else build_registry(cfg, **backend_overrides)
+    _validate_speculative_aggregation(cfg, reg)
 
     from quorum_tpu.server.reload import ConfigWatcher, Runtime
 
@@ -768,6 +804,19 @@ def create_app(
         # forwarded (upstreams would reject an unknown field).
         body.pop("traceparent", None)
 
+        # Cross-cell quorum is the ROUTER's job (docs/quorum.md): this
+        # server is one cell. quorum=1 is a no-op (stripped); quorum>1
+        # reaching a cell directly is a topology error, not something to
+        # silently serve at 1/M strength.
+        if (body.pop("quorum", None) or 1) > 1:
+            return JSONResponse(
+                {"error": {"message": "'quorum' requires the router tier "
+                           "(python -m quorum_tpu.router): this server is "
+                           "a single cell and cannot fan out across "
+                           "replicas", "type": "invalid_request_error"}},
+                status_code=400,
+            )
+
         is_streaming = bool(body.get("stream", False))
         is_parallel = cfg.parallel_enabled(len(reg))
         # Per-request deadline override (validated above): a client that
@@ -864,7 +913,7 @@ def create_app(
 
         if is_parallel:
             with trace.span("aggregate", strategy=cfg.strategy_name):
-                combined = await combine_outcomes(
+                combined, agg_outcome = await combine_outcomes(
                     cfg, reg, outcomes, body, headers,
                     # The aggregator hop runs AFTER the fan-out: it gets the
                     # remaining budget, not a second full one, so the
@@ -872,7 +921,12 @@ def create_app(
                     aggregator_timeout=max(
                         0.001, deadline - time.monotonic()),
                 )
-            return JSONResponse(combined)
+            # A degraded aggregate (separator-join fallback) is marked in
+            # response headers so clients can tell it from a real synthesis
+            # (docs/quorum.md). Streaming can't do this — headers are gone
+            # by the time the final hop runs — so it relies on the counter
+            # + recorder event instead.
+            return JSONResponse(combined, headers=degraded_headers(agg_outcome))
 
         # Non-parallel: first successful response verbatim (oai_proxy.py:1356-1380).
         first = successes[0]

@@ -8,16 +8,25 @@ protocol — works identically over HTTP upstreams and in-process TPU models.
   streaming.py  the SSE parallel streaming aggregator (live interleaving)
 """
 
-from quorum_tpu.strategies.aggregate import aggregate_responses
-from quorum_tpu.strategies.combine import combine_outcomes
+from quorum_tpu.strategies.aggregate import (
+    AggregateOutcome,
+    aggregate_responses,
+    aggregate_with_status,
+    stream_aggregate_deltas,
+)
+from quorum_tpu.strategies.combine import combine_outcomes, degraded_headers
 from quorum_tpu.strategies.fanout import BackendOutcome, fanout_complete
 from quorum_tpu.strategies.streaming import StreamPlan, parallel_stream
 
 __all__ = [
+    "AggregateOutcome",
     "BackendOutcome",
     "StreamPlan",
     "aggregate_responses",
+    "aggregate_with_status",
     "combine_outcomes",
+    "degraded_headers",
     "fanout_complete",
     "parallel_stream",
+    "stream_aggregate_deltas",
 ]

@@ -22,14 +22,37 @@ from __future__ import annotations
 
 import logging
 import os
-from typing import Any, Sequence
+from dataclasses import dataclass
+from typing import Any, AsyncIterator, Sequence
 
+from quorum_tpu import oai
 from quorum_tpu.backends.base import Backend
 from quorum_tpu.config import AggregateParams
-from quorum_tpu.observability import current_trace, trace_span
+from quorum_tpu.observability import AGGREGATE_DEGRADED, current_trace, trace_span
+from quorum_tpu.telemetry.recorder import RECORDER
 
 logger = logging.getLogger(__name__)
 aggregation_logger = logging.getLogger("aggregation")
+
+
+@dataclass
+class AggregateOutcome:
+    """One combine's result + how it was produced.
+
+    ``degraded_reason`` is None for a real LLM aggregation; otherwise one
+    of no_aggregator / no_credentials / error / empty — the separator-join
+    fallback the reference produced SILENTLY. ``error`` carries the first
+    underlying failure message so the serving layer can surface it
+    (X-Quorum-Aggregate-Error, docs/quorum.md) and a client can tell a
+    degraded combine from a real aggregate."""
+
+    content: str
+    degraded_reason: str | None = None
+    error: str | None = None
+
+    @property
+    def degraded(self) -> bool:
+        return self.degraded_reason is not None
 
 _PLACEHOLDERS = ("{{intermediate_results}}", "{intermediate_results}", "{responses}")
 
@@ -87,23 +110,52 @@ def clean_aggregator_headers(headers: dict[str, str] | None) -> dict[str, str] |
     return clean
 
 
-async def aggregate_responses(
+def aggregation_body(prompt: str, aggregator: Backend,
+                     params: AggregateParams) -> dict[str, Any]:
+    """The aggregation hop's request body. The hop is a first-class engine
+    request (docs/quorum.md): ``aggregator_priority`` pins its QoS dispatch
+    class on qos=1 engines (the aggregate IS the client's response — it
+    defaults to interactive, never queued behind batch prefills) and is
+    harmless on HTTP aggregators, which drop unknown knobs upstream."""
+    body: dict[str, Any] = {
+        "model": aggregator.model or "",
+        "messages": [{"role": "user", "content": prompt}],
+        "stream": False,
+    }
+    if params.aggregator_priority:
+        body["priority"] = params.aggregator_priority
+    return body
+
+
+def _degrade(reason: str, fallback: str,
+             error: str | None = None) -> AggregateOutcome:
+    """Count + record the fallback the reference produced silently."""
+    AGGREGATE_DEGRADED.inc(reason=reason)
+    RECORDER.record("aggregate-degraded", reason=reason,
+                    **({"error": error[:200]} if error else {}))
+    return AggregateOutcome(fallback, degraded_reason=reason, error=error)
+
+
+async def aggregate_with_status(
     labeled_sources: Sequence[tuple[str, str]],
     aggregator: Backend | None,
     params: AggregateParams,
     user_query: str,
     headers: dict[str, str] | None,
     timeout: float = 60.0,
-) -> str:
+) -> AggregateOutcome:
     """Synthesize N source responses via the aggregator backend.
 
-    Any failure (no aggregator, no credentials, HTTP error, exception) degrades
-    to ``intermediate_separator.join(raw sources)`` (oai_proxy.py:479-486).
+    Any failure (no aggregator, no credentials, HTTP error, exception)
+    degrades to ``intermediate_separator.join(raw sources)``
+    (oai_proxy.py:479-486) — but VISIBLY: every fallback ticks
+    ``quorum_tpu_aggregate_degraded_total{reason=}``, lands a recorder
+    event, and carries the first underlying error in the outcome.
     """
     fallback = params.intermediate_separator.join(t for _, t in labeled_sources)
     if aggregator is None:
         aggregation_logger.error("Aggregator backend not configured/found")
-        return fallback
+        return _degrade("no_aggregator", fallback)
 
     prompt = build_aggregation_prompt(labeled_sources, params, user_query)
     aggregation_logger.info("Prompt for aggregator: %s", prompt)
@@ -114,14 +166,10 @@ async def aggregate_responses(
         # keep the reference's skip-on-missing-auth behavior.
         if getattr(aggregator, "requires_auth", True):
             aggregation_logger.error("No authorization header or OPENAI_API_KEY found")
-            return fallback
+            return _degrade("no_credentials", fallback)
         clean_headers = {"Content-Type": "application/json"}
 
-    body: dict[str, Any] = {
-        "model": aggregator.model or "",
-        "messages": [{"role": "user", "content": prompt}],
-        "stream": False,
-    }
+    body = aggregation_body(prompt, aggregator, params)
     try:
         # The synthesis hop is usually the tail-latency dominator of an
         # aggregate-strategy request — span it with the aggregator's name so
@@ -132,9 +180,90 @@ async def aggregate_responses(
         if result.ok:
             content = result.content
             aggregation_logger.info("Aggregator response: %s", content)
-            return content
+            if not content:
+                return _degrade("empty", fallback)
+            return AggregateOutcome(content)
         aggregation_logger.error("Aggregator backend failed: %s", result.body)
-        return fallback
+        err = result.body.get("error") if isinstance(result.body, dict) else None
+        msg = (err or {}).get("message") if isinstance(err, dict) else None
+        return _degrade("error", fallback,
+                        error=str(msg or result.body)[:500])
     except Exception as e:
         aggregation_logger.error("Error calling aggregator backend: %s", e)
-        return fallback
+        return _degrade("error", fallback, error=str(e)[:500])
+
+
+async def stream_aggregate_deltas(
+    labeled_sources: Sequence[tuple[str, str]],
+    aggregator: Backend | None,
+    params: AggregateParams,
+    user_query: str,
+    headers: dict[str, str] | None,
+    timeout: float = 60.0,
+) -> AsyncIterator[str | AggregateOutcome]:
+    """The live aggregation hop (``stream_aggregate: true``, docs/quorum.md):
+    yields the aggregator's text deltas AS THEY DECODE, then exactly one
+    terminal :class:`AggregateOutcome` whose content is the joined stream.
+
+    Degrade contract: a failure *before* the first delta yields the
+    separator-join fallback as one delta (the client still gets content,
+    same as the buffered path); a failure *after* deltas already streamed
+    cannot be unsent, so the stream just ends and the outcome carries the
+    degrade reason — the counter + recorder event fire either way.
+    """
+    fallback = params.intermediate_separator.join(t for _, t in labeled_sources)
+    if aggregator is None:
+        aggregation_logger.error("Aggregator backend not configured/found")
+        yield fallback
+        yield _degrade("no_aggregator", fallback)
+        return
+
+    prompt = build_aggregation_prompt(labeled_sources, params, user_query)
+    aggregation_logger.info("Prompt for aggregator: %s", prompt)
+
+    clean_headers = clean_aggregator_headers(headers)
+    if clean_headers is None:
+        if getattr(aggregator, "requires_auth", True):
+            aggregation_logger.error("No authorization header or OPENAI_API_KEY found")
+            yield fallback
+            yield _degrade("no_credentials", fallback)
+            return
+        clean_headers = {"Content-Type": "application/json"}
+
+    body = aggregation_body(prompt, aggregator, params)
+    body["stream"] = True
+    sent: list[str] = []
+    try:
+        with trace_span(current_trace(), "aggregator-call",
+                        backend=aggregator.name, streamed=1):
+            async for chunk in aggregator.stream(body, clean_headers, timeout):
+                text = oai.extract_delta_content(chunk)
+                if text:
+                    sent.append(text)
+                    yield text
+    except Exception as e:
+        aggregation_logger.error("Error streaming aggregator backend: %s", e)
+        if not sent:
+            yield fallback
+        yield _degrade("error", "".join(sent) or fallback, error=str(e)[:500])
+        return
+    if not sent:
+        yield fallback
+        yield _degrade("empty", fallback)
+        return
+    aggregation_logger.info("Aggregator response: %s", "".join(sent))
+    yield AggregateOutcome("".join(sent))
+
+
+async def aggregate_responses(
+    labeled_sources: Sequence[tuple[str, str]],
+    aggregator: Backend | None,
+    params: AggregateParams,
+    user_query: str,
+    headers: dict[str, str] | None,
+    timeout: float = 60.0,
+) -> str:
+    """Back-compat text-only wrapper around :func:`aggregate_with_status`."""
+    out = await aggregate_with_status(
+        labeled_sources, aggregator, params, user_query, headers, timeout)
+    return out.content

@@ -20,11 +20,27 @@ from quorum_tpu import oai
 from quorum_tpu.backends.registry import BackendRegistry
 from quorum_tpu.config import Config
 from quorum_tpu.filtering import strip_thinking_tags
-from quorum_tpu.strategies.aggregate import aggregate_responses
+from quorum_tpu.strategies.aggregate import AggregateOutcome, aggregate_with_status
 from quorum_tpu.strategies.fanout import BackendOutcome
 
 logger = logging.getLogger(__name__)
 aggregation_logger = logging.getLogger("aggregation")
+
+
+def degraded_headers(outcome: AggregateOutcome | None) -> dict[str, str]:
+    """Response headers marking a degraded combine (docs/quorum.md): the
+    reason plus the first underlying error, so a client can tell the
+    separator-join fallback from a real aggregate without diffing text.
+    Header values must be latin-1-encodable single lines (h11 enforces
+    both); error text is sanitized, not trusted."""
+    if outcome is None or not outcome.degraded:
+        return {}
+    out = {"X-Quorum-Aggregate-Degraded": outcome.degraded_reason or "error"}
+    if outcome.error:
+        clean = " ".join(str(outcome.error).split())
+        out["X-Quorum-Aggregate-Error"] = clean.encode(
+            "latin-1", "replace").decode("latin-1")[:200]
+    return out
 
 
 async def combine_outcomes(
@@ -34,10 +50,15 @@ async def combine_outcomes(
     body: dict[str, Any],
     headers: dict[str, str],
     aggregator_timeout: float,
-) -> dict[str, Any]:
-    """Combine successful outcomes into one chat.completion dict."""
+) -> tuple[dict[str, Any], AggregateOutcome | None]:
+    """Combine successful outcomes into one chat.completion dict.
+
+    Returns ``(completion, aggregate_outcome)`` — the outcome is None for
+    the concatenate strategy and carries the degrade reason/error for the
+    aggregate strategy (the server surfaces it as response headers)."""
     successes = [o for o in outcomes if o.ok]
     strategy = cfg.strategy_name
+    agg_outcome: AggregateOutcome | None = None
 
     if strategy == "aggregate":
         p = cfg.aggregate
@@ -51,7 +72,7 @@ async def combine_outcomes(
         for name, text in labeled:
             aggregation_logger.info("%s response: %s", name, text)
         aggregator = registry.get(p.aggregator_backend) if p.aggregator_backend else None
-        combined = await aggregate_responses(
+        agg_outcome = await aggregate_with_status(
             labeled,
             aggregator,
             p,
@@ -59,6 +80,7 @@ async def combine_outcomes(
             headers,
             aggregator_timeout,
         )
+        combined = agg_outcome.content
         if p.hide_aggregator_thinking:
             combined = strip_thinking_tags(combined, thinking_tags, hide=True)
     else:
@@ -86,4 +108,4 @@ async def combine_outcomes(
             }
         ],
         "usage": usage,
-    }
+    }, agg_outcome

@@ -44,7 +44,11 @@ from quorum_tpu.config import AggregateParams, Config
 from quorum_tpu.filtering import strip_thinking_tags
 from quorum_tpu.native import make_thinking_filter
 from quorum_tpu.observability import trace_span, use_trace
-from quorum_tpu.strategies.aggregate import aggregate_responses
+from quorum_tpu.strategies.aggregate import (
+    AggregateOutcome,
+    aggregate_with_status,
+    stream_aggregate_deltas,
+)
 
 logger = logging.getLogger(__name__)
 aggregation_logger = logging.getLogger("aggregation")
@@ -212,6 +216,41 @@ async def parallel_stream(
             ]
         if labeled:
             if plan.strategy_name == "aggregate" and plan.aggregator is not None and plan.aggregate_params:
+                if plan.aggregate_params.stream_aggregate:
+                    # In-engine aggregation hop, live (docs/quorum.md): the
+                    # aggregator's tokens ARE the client response — each
+                    # delta rides out under the final-chunk id as it
+                    # decodes, so aggregate TTFT is the aggregator's real
+                    # TTFT instead of its full generation time. A closing
+                    # zero-delta chunk carries finish_reason "stop" (the
+                    # buffered path folds both into one chunk).
+                    final_filter = make_thinking_filter(plan.thinking_tags)
+                    with use_trace(trace), trace_span(
+                            trace, "aggregate", strategy=plan.strategy_name,
+                            aggregator=plan.aggregator.name, streamed=1):
+                        agen = stream_aggregate_deltas(
+                            labeled, plan.aggregator, plan.aggregate_params,
+                            plan.user_query, headers,
+                            aggregator_timeout or timeout)
+                        async for item in agen:
+                            if isinstance(item, AggregateOutcome):
+                                break
+                            text = (final_filter.feed(item)
+                                    if plan.hide_final else item)
+                            if text:
+                                yield sse.encode_event(oai.content_chunk(
+                                    text, model=PROXY_MODEL_NAME,
+                                    id=oai.PARALLEL_FINAL_ID))
+                        tail = final_filter.flush() if plan.hide_final else ""
+                    if tail:
+                        yield sse.encode_event(oai.content_chunk(
+                            tail, model=PROXY_MODEL_NAME,
+                            id=oai.PARALLEL_FINAL_ID))
+                    yield sse.encode_event(oai.chunk(
+                        id=oai.PARALLEL_FINAL_ID, model=PROXY_MODEL_NAME,
+                        delta={}, finish_reason="stop"))
+                    yield sse.encode_done()
+                    return
                 # use_trace: this generator body runs under the ASGI server
                 # (the handler's context binding is gone), so the trace must
                 # be re-bound for the aggregator hop's nested spans
@@ -220,7 +259,7 @@ async def parallel_stream(
                 with use_trace(trace), trace_span(
                         trace, "aggregate", strategy=plan.strategy_name,
                         aggregator=plan.aggregator.name):
-                    combined = await aggregate_responses(
+                    outcome = await aggregate_with_status(
                         labeled,
                         plan.aggregator,
                         plan.aggregate_params,
@@ -228,6 +267,7 @@ async def parallel_stream(
                         headers,
                         aggregator_timeout or timeout,
                     )
+                combined = outcome.content
                 if plan.hide_final:
                     combined = strip_thinking_tags(combined, plan.thinking_tags, hide=True)
             else:

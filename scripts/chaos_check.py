@@ -22,7 +22,12 @@ asserts the containment contract of docs/robustness.md:
     untouched, the dead replica's requests fail over and complete
     elsewhere within their deadlines, the /ready poller rotates the
     corpse out of the ring, and with every replica dead the router sheds
-    503 + Retry-After instead of hanging.
+    503 + Retry-After instead of hanging;
+  - the quorum member-kill drill (phase 10, docs/quorum.md): SIGKILL one
+    member of a quorum=3 fan-out mid-generation — with a spare cell the
+    member finishes token-exact elsewhere and the quorum stays full, with
+    no spare the request is served degraded from the survivors, never
+    failed.
 
 Exit codes: 0 = all checks passed, 1 = at least one failed, 2 = the harness
 itself hung (watchdog). ``tests/test_robustness.py`` runs the quick subset
@@ -784,6 +789,139 @@ async def _stream_resume_drill(check) -> None:
                 proc.wait()
 
 
+async def _quorum_member_kill_drill(check) -> None:
+    """Phase 10 body (docs/quorum.md): a ``quorum=3`` fan-out loses one
+    member to SIGKILL mid-generation. With a spare cell in the ring the
+    member finishes token-exact elsewhere and the quorum stays FULL;
+    with no spare the member is dropped and the request is SERVED from
+    the survivors plus the dead member's partial answer — degraded,
+    never failed, no error chunk."""
+    import httpx
+
+    from quorum_tpu.observability import QUORUM_DEGRADED, QUORUM_REQUESTS
+    from quorum_tpu.router.app import RouterConfig, create_router_app
+
+    sep = "\n\n---\n\n"  # RouterConfig.quorum_separator default
+    body = {"model": "m", "stream": True, "quorum": 3, "max_tokens": 60,
+            "messages": [{"role": "user", "content":
+                          "quorum chaos drill: answer at length"}]}
+
+    async def consume(rc) -> dict:
+        out = {"streams": {}, "final": None, "errors": 0, "done": False,
+               "assigned": [], "status": 0}
+        async with rc.stream("POST", "/chat/completions",
+                             json=body) as resp:
+            out["status"] = resp.status_code
+            out["assigned"] = (resp.headers.get("x-quorum-replicas")
+                               or "").split(",")
+            async for line in resp.aiter_lines():
+                if not line.startswith("data: "):
+                    continue
+                data = line[len("data: "):]
+                if data.strip() == "[DONE]":
+                    out["done"] = True
+                    continue
+                ev = json.loads(data)
+                choice = (ev.get("choices") or [{}])[0]
+                delta = choice.get("delta") or {}
+                if (ev.get("id") == "error"
+                        or choice.get("finish_reason") == "error"):
+                    out["errors"] += 1
+                elif ev.get("id") == "chatcmpl-parallel-final":
+                    out["final"] = delta.get("content") or ""
+                elif delta.get("content"):
+                    out["streams"].setdefault(ev.get("id"), "")
+                    out["streams"][ev.get("id")] += delta["content"]
+        return out
+
+    async def cluster(tag: str, n: int):
+        pairs = [_spawn_fake_replica(f"{tag}{i}", chunk_delay=0.05,
+                                     tokens=60) for i in range(n)]
+        rcfg = RouterConfig(
+            replicas=[(f"{tag}{i}", url)
+                      for i, (_, url) in enumerate(pairs)],
+            ready_interval=0.25, retries=1, timeout=30.0,
+            breaker_threshold=3, breaker_cooldown=0.5,
+            migrate_on_rotation=False)
+        return [p for p, _ in pairs], create_router_app(rcfg)
+
+    async def arm(tag: str, n: int, drill) -> None:
+        procs, router_app = await cluster(tag, n)
+        mgr = router_app.state["replica_set"]
+        try:
+            transport = httpx.ASGITransport(app=router_app)
+            async with httpx.AsyncClient(transport=transport,
+                                         base_url="http://router",
+                                         timeout=60.0) as rc:
+                await drill(rc, procs)
+            await mgr.aclose()
+        finally:
+            for proc in procs:
+                if proc.poll() is None:
+                    proc.kill()
+                    proc.wait()
+
+    # ---- arm 1: kill with a spare -> token-exact resume, quorum FULL ----
+    async def with_spare(rc, procs):
+        base = await asyncio.wait_for(consume(rc), timeout=30.0)
+        texts = set(base["streams"].values())
+        check("quorum: uninterrupted 3-member fan-out combines clean",
+              base["done"] and base["errors"] == 0
+              and len(base["streams"]) == 3 and len(texts) == 1
+              and base["final"] == sep.join([texts.pop()] * 3),
+              f"status={base['status']} members={len(base['streams'])}")
+        degraded_before = QUORUM_DEGRADED.value
+        full_before = QUORUM_REQUESTS.value_of(outcome="full")
+        task = asyncio.create_task(consume(rc))
+        await asyncio.sleep(0.6)  # well mid-stream (60 x 50ms chunks)
+        victim = procs[int(base["assigned"][0].removeprefix("qs"))]
+        victim.kill()
+        victim.wait()
+        got = await asyncio.wait_for(task, timeout=30.0)
+        check("quorum: killed member finishes token-exact on the spare "
+              "(quorum stays full)",
+              got["done"] and got["errors"] == 0
+              and got["final"] == base["final"],
+              f"errors={got['errors']} "
+              f"len={len(got['final'] or '')}/{len(base['final'] or '')}")
+        check("quorum: spare-covered kill counts full, not degraded",
+              QUORUM_REQUESTS.value_of(outcome="full") == full_before + 1
+              and QUORUM_DEGRADED.value == degraded_before)
+
+    await arm("qs", 4, with_spare)
+
+    # ---- arm 2: kill with NO spare -> served degraded, never failed -----
+    async def no_spare(rc, procs):
+        base = await asyncio.wait_for(consume(rc), timeout=30.0)
+        t = next(iter(base["streams"].values()))
+        broken_before = QUORUM_DEGRADED.value_of(reason="stream_broken")
+        degr_before = QUORUM_REQUESTS.value_of(outcome="degraded")
+        failed_before = QUORUM_REQUESTS.value_of(outcome="failed")
+        task = asyncio.create_task(consume(rc))
+        await asyncio.sleep(0.6)
+        victim = procs[int(base["assigned"][0].removeprefix("qn"))]
+        victim.kill()
+        victim.wait()
+        got = await asyncio.wait_for(task, timeout=30.0)
+        pieces = (got["final"] or "").split(sep)
+        partials = [p for p in pieces if p != t]
+        check("quorum: member death with no spare serves the survivors "
+              "(no error chunk, partial answer joins the combine)",
+              got["done"] and got["errors"] == 0 and len(pieces) == 3
+              and pieces.count(t) == 2 and len(partials) == 1
+              and partials[0] and t.startswith(partials[0]),
+              f"errors={got['errors']} pieces={len(pieces)}")
+        check("quorum: the loss is counted degraded, never failed",
+              QUORUM_DEGRADED.value_of(reason="stream_broken")
+              == broken_before + 1
+              and QUORUM_REQUESTS.value_of(outcome="degraded")
+              == degr_before + 1
+              and QUORUM_REQUESTS.value_of(outcome="failed")
+              == failed_before)
+
+    await arm("qn", 3, no_spare)
+
+
 def _config() -> dict:
     return {
         "settings": {"timeout": 30},
@@ -1285,6 +1423,17 @@ async def _run(quick: bool) -> None:
         if not quick:
             print("phase 9: zero-loss stream resume + drain", flush=True)
             await _stream_resume_drill(check)
+
+        # ---- phase 10: quorum member-kill degradation --------------------
+        # Native quorum serving's containment contract (docs/quorum.md):
+        # SIGKILL one member of a quorum=3 fan-out mid-generation. With a
+        # spare cell the member resumes token-exact and the quorum stays
+        # full; with no spare the request is served from the survivors
+        # (plus the dead member's partial answer) — degraded on the
+        # counters, never failed, never an error chunk.
+        if not quick:
+            print("phase 10: quorum member-kill", flush=True)
+            await _quorum_member_kill_drill(check)
 
     from quorum_tpu.engine.engine import shutdown_all_engines
 

@@ -532,6 +532,67 @@ def paged(tokens: int = 8, streams: int = 24, page_size: int = 16,
     return out
 
 
+def dedup(prompt_len: int = 48, tokens: int = 8, members: int = 3,
+          rounds: int = 8) -> dict:
+    """Shared-prefix member dedup (docs/quorum.md): a ``members=M``
+    shared-weights engine fans one prompt into M sampling streams; with
+    ``quorum_dedup=1`` a coalesced member-complete admission prefills the
+    prompt ONCE and broadcasts the K/V into all M cache rows. A
+    prefill-heavy fan-out mix (long prompt, short decode) measures the
+    headline: prefill tokens computed per fan-out down ~M×, outputs
+    token-for-token identical to the M-prefill baseline. A round only
+    dedups when all M submits coalesce into one admission group, so the
+    reported ratio is the honest mixed-traffic number; ``dedup_rounds``
+    says how many of ``rounds`` took the fast path."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+
+    from quorum_tpu.engine.engine import InferenceEngine
+    from quorum_tpu.models.model_config import MODEL_PRESETS
+    from quorum_tpu.ops.sampling import SamplerConfig
+
+    spec = MODEL_PRESETS["llama-tiny"]
+    greedy = SamplerConfig(temperature=0.0)
+    prompt = [(5 + 11 * j) % (spec.vocab_size - 1) + 1
+              for j in range(prompt_len)]
+    kw = dict(seed=0, members=members, decode_chunk=4, n_slots=2,
+              member_seeds="shared", prefix_cache=False)
+
+    def fan(eng) -> list[list[int]]:
+        reqs = [eng.submit(list(prompt), max_new_tokens=tokens,
+                           sampler=greedy, seed=7 + m, member=m)
+                for m in range(members)]
+        return [list(eng.stream_results(r)) for r in reqs]
+
+    out: dict = {"dedup_members": members, "dedup_prompt_len": prompt_len,
+                 "dedup_rounds_driven": rounds}
+    results: dict[str, list] = {}
+    nominal = rounds * members * prompt_len
+    for tag, extra in (("off", {}), ("on", {"quorum_dedup": True})):
+        eng = InferenceEngine(spec, **kw, **extra)
+        try:
+            fan(eng)  # warm-up (compiles both prefill variants)
+            tokens_before = eng.quorum_dedup_tokens
+            prefills_before = eng.quorum_dedup_prefills
+            t0 = time.perf_counter()
+            results[tag] = [fan(eng) for _ in range(rounds)]
+            wall = time.perf_counter() - t0
+            # savings over the measured rounds only (warm-up excluded)
+            saved = eng.quorum_dedup_tokens - tokens_before
+            out[f"dedup_{tag}_wall_s"] = round(wall, 3)
+            out[f"dedup_{tag}_prefill_tokens"] = nominal - saved
+            if tag == "on":
+                out["dedup_rounds"] = (eng.quorum_dedup_prefills
+                                       - prefills_before)
+        finally:
+            eng.shutdown()
+    out["dedup_prefill_token_ratio"] = round(
+        out["dedup_off_prefill_tokens"]
+        / max(1, out["dedup_on_prefill_tokens"]), 2)
+    out["dedup_tokens_match"] = results["off"] == results["on"]
+    return out
+
+
 def qos(tokens: int = 24, churn: int = 3, arrivals: int = 8) -> dict:
     """QoS scheduler A/B (ISSUE 18, docs/scheduling.md): interactive TTFT
     under a batch backlog, FIFO vs ``qos=1``, on one llama-tiny engine.
@@ -682,7 +743,17 @@ def main() -> int:
     ap.add_argument("--only-qos", action="store_true",
                     help="run ONLY the QoS scheduler A/B legs (bench.py's "
                          "subprocess phase)")
+    ap.add_argument("--skip-dedup", action="store_true",
+                    help="skip the shared-prefix member-dedup legs")
+    ap.add_argument("--only-dedup", action="store_true",
+                    help="run ONLY the shared-prefix member-dedup legs "
+                         "(bench.py's subprocess phase)")
     args = ap.parse_args()
+    if args.only_dedup:
+        md = dedup()
+        _print_dedup(md)
+        print(json.dumps(md), flush=True)
+        return 0
     if args.only_qos:
         mq = qos()
         _print_qos(mq)
@@ -823,8 +894,25 @@ def main() -> int:
         mq = qos()
         _print_qos(mq)
         m.update(mq)
+    if not args.skip_dedup:
+        md = dedup()
+        _print_dedup(md)
+        m.update(md)
     print(json.dumps(m), flush=True)
     return 0
+
+
+def _print_dedup(md: dict) -> None:
+    print(f"shared-prefix member dedup (members={md['dedup_members']}, "
+          f"{md['dedup_prompt_len']}-token prompt, "
+          f"{md['dedup_rounds_driven']} fan-outs):")
+    print(f"  prefill tokens computed: {md['dedup_off_prefill_tokens']} -> "
+          f"{md['dedup_on_prefill_tokens']} "
+          f"({md['dedup_prefill_token_ratio']:.2f}x fewer; "
+          f"{md['dedup_rounds']}/{md['dedup_rounds_driven']} fan-outs "
+          "coalesced)")
+    print(f"  wall: {md['dedup_off_wall_s']}s -> {md['dedup_on_wall_s']}s, "
+          f"token-for-token identical: {md['dedup_tokens_match']}")
 
 
 def _print_paged(mp: dict) -> None:

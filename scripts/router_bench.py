@@ -400,6 +400,212 @@ async def _resume_leg(client: httpx.AsyncClient,
     return _resume_report(base, got, resumed)
 
 
+# ---- cross-cell quorum legs (docs/quorum.md) -------------------------------
+
+
+async def _first_byte_latency(client: httpx.AsyncClient, base: str,
+                              body: dict) -> float:
+    """Seconds from POST to the first streamed content delta — the TTFT a
+    quorum client actually experiences (role chunks don't count)."""
+    t0 = time.perf_counter()
+    async with client.stream(
+            "POST", f"{base}/chat/completions", json={**body, "stream": True},
+            headers={"Authorization": "Bearer bench"},
+            timeout=120.0) as resp:
+        if resp.status_code != 200:
+            raise RuntimeError(f"stream HTTP {resp.status_code}")
+        async for line in resp.aiter_lines():
+            if not line.startswith("data: "):
+                continue
+            data = line[len("data: "):]
+            if data.strip() == "[DONE]":
+                break
+            ev = json.loads(data)
+            delta = (ev.get("choices") or [{}])[0].get("delta") or {}
+            if delta.get("content"):
+                return time.perf_counter() - t0
+    raise RuntimeError("stream produced no content delta")
+
+
+async def _quorum_measurements(client: httpx.AsyncClient, base: str, *,
+                               model: str, max_tokens: int, iters: int,
+                               quorum: int, family: str) -> dict:
+    """The fan-out latency A/B shared by the fake and real quorum legs:
+    p50 first-content-byte latency of plain requests vs ``quorum: M``
+    through the same router, plus one non-streaming combine's shape."""
+    def body(i: int, **kw) -> dict:
+        return {"model": model, "temperature": 0.0,
+                "max_tokens": max_tokens, **kw,
+                "messages": [{"role": "user", "content":
+                              conversation_opening(family, i)}]}
+
+    single = [await _first_byte_latency(client, base, body(i))
+              for i in range(iters)]
+    fanned = [await _first_byte_latency(client, base,
+                                        body(i, quorum=quorum))
+              for i in range(iters)]
+    single_p50 = sorted(single)[len(single) // 2]
+    quorum_p50 = sorted(fanned)[len(fanned) // 2]
+
+    r = await client.post(f"{base}/chat/completions",
+                          json=body(0, quorum=quorum),
+                          headers={"Authorization": "Bearer bench"},
+                          timeout=120.0)
+    combined = r.json()
+    q = combined.get("quorum") or {}
+    return {
+        "single_ttft_p50_s": round(single_p50, 4),
+        "quorum_ttft_p50_s": round(quorum_p50, 4),
+        "ttft_ratio": round(quorum_p50 / single_p50, 3)
+        if single_p50 > 0 else None,
+        "ttft_delta_s": round(quorum_p50 - single_p50, 4),
+        "combine_status": r.status_code,
+        "combine_outcome": ("full" if q.get("served") == quorum
+                            else "degraded" if q.get("served")
+                            else "failed"),
+        "combine_served": q.get("served"),
+        "combined_content": combined.get("choices", [{}])[0]
+        .get("message", {}).get("content", ""),
+    }
+
+
+def _ttft_within_gate(leg: dict, *, ratio: float = 1.5,
+                      slack_s: float = 0.05) -> bool:
+    """The fan-out latency gate: quorum p50 TTFT within ``ratio``× the
+    single-member p50 — with a small absolute floor so sub-millisecond
+    fake TTFTs don't fail on scheduling jitter alone."""
+    return (leg["ttft_ratio"] is not None
+            and (leg["ttft_ratio"] <= ratio
+                 or leg["ttft_delta_s"] <= slack_s))
+
+
+async def _run_quorum_fake_async(*, iters: int = 10,
+                                 max_tokens: int = 12) -> dict:
+    """Fake quorum leg: 4 scripted replicas (20 ms first-byte floor so the
+    TTFT ratio measures fan-out overhead, not socket jitter) behind the
+    real router. Measures the latency A/B, pins the combine against the
+    replicas' deterministic completion, then degrades: shedding one
+    assigned member must stay full (spare covers), shedding the spare too
+    must serve degraded — never fail."""
+    from quorum_tpu.observability import QUORUM_DEGRADED
+    from quorum_tpu.router.app import RouterConfig, create_router_app
+    from quorum_tpu.router.fake_replica import (
+        FakeReplicaState,
+        create_fake_replica_app,
+        deterministic_completion,
+    )
+    from quorum_tpu.server.serve import start_server
+
+    states, servers, urls = [], [], []
+    for i in range(4):
+        st = FakeReplicaState(f"fake-{i}", max_tokens=max_tokens,
+                              chunk_delay=0.02)
+        srv = await start_server(create_fake_replica_app(st),
+                                 "127.0.0.1", 0)
+        states.append(st)
+        servers.append(srv)
+        urls.append(f"http://127.0.0.1:{srv.sockets[0].getsockname()[1]}")
+    cfg = RouterConfig(
+        replicas=[(f"fake-{i}", u) for i, u in enumerate(urls)],
+        policy="affinity", ready_interval=0.0)
+    router_app = create_router_app(cfg)
+    router_srv = await start_server(router_app, "127.0.0.1", 0)
+    router_url = (
+        f"http://127.0.0.1:{router_srv.sockets[0].getsockname()[1]}")
+    try:
+        async with httpx.AsyncClient() as client:
+            out = await _quorum_measurements(
+                client, router_url, model="fake", max_tokens=max_tokens,
+                iters=iters, quorum=3, family="Q")
+            prompt = conversation_opening("Q", 0)
+            rendered = states[0].tokenizer.render_chat(
+                [{"role": "user", "content": prompt}])
+            want = "".join(deterministic_completion(rendered, max_tokens))
+            out["combined_pinned"] = (
+                out.pop("combined_content")
+                == cfg.quorum_separator.join([want] * 3))
+
+            # member-kill: shed one serving member → the spare covers
+            body = {"model": "fake", "temperature": 0.0,
+                    "max_tokens": max_tokens, "quorum": 3,
+                    "messages": [{"role": "user", "content": prompt}]}
+            r0 = await client.post(f"{router_url}/chat/completions",
+                                   json=body, timeout=120.0)
+            assigned = r0.headers["x-quorum-replicas"].split(",")
+            spare = [f"fake-{i}" for i in range(4)
+                     if f"fake-{i}" not in assigned][0]
+            by_name = {st.name: st for st in states}
+            by_name[assigned[0]].shedding = True
+            t0 = time.perf_counter()
+            r1 = await client.post(f"{router_url}/chat/completions",
+                                   json=body, timeout=120.0)
+            out["kill_with_spare_latency_s"] = round(
+                time.perf_counter() - t0, 4)
+            out["kill_with_spare_outcome"] = (
+                "full" if r1.json().get("quorum", {}).get("served") == 3
+                else "degraded")
+
+            # ...and with the spare gone too: served degraded, never failed
+            by_name[spare].shedding = True
+            before = QUORUM_DEGRADED.value
+            t0 = time.perf_counter()
+            r2 = await client.post(f"{router_url}/chat/completions",
+                                   json=body, timeout=120.0)
+            out["degraded_latency_s"] = round(time.perf_counter() - t0, 4)
+            out["degraded_status"] = r2.status_code
+            out["degraded_served"] = r2.json().get(
+                "quorum", {}).get("served")
+            out["degraded_reason"] = r2.headers.get("x-quorum-degraded")
+            out["degraded_counted"] = QUORUM_DEGRADED.value > before
+    finally:
+        await app_close(router_app)
+        for srv in servers + [router_srv]:
+            srv.close()
+    return out
+
+
+def run_quorum_fake(*, iters: int = 10, max_tokens: int = 12) -> dict:
+    """Entry point shared with tests/test_router_bench.py."""
+    return asyncio.run(_run_quorum_fake_async(
+        iters=iters, max_tokens=max_tokens))
+
+
+async def _quorum_leg(client: httpx.AsyncClient,
+                      replicas: list[tuple[str, str]], *, model: str,
+                      max_tokens: int, iters: int = 5) -> dict:
+    """Real quorum leg: quorum=3 over three live engine cells (the two
+    bench replicas + the baseline, enrolled as a third ring member —
+    identical engines, so the combine pins against 3× one member's
+    greedy output). Runs before the resume leg, which leaves a corpse."""
+    from quorum_tpu.router.app import RouterConfig, create_router_app
+    from quorum_tpu.server.serve import start_server
+
+    cfg = RouterConfig(replicas=replicas, policy="affinity",
+                       ready_interval=0.0, timeout=120.0)
+    router_app = create_router_app(cfg)
+    router_srv = await start_server(router_app, "127.0.0.1", 0)
+    router_url = (
+        f"http://127.0.0.1:{router_srv.sockets[0].getsockname()[1]}")
+    try:
+        out = await _quorum_measurements(
+            client, router_url, model=model, max_tokens=max_tokens,
+            iters=iters, quorum=3, family="QR")
+        # identical engines + temperature 0 → every member emits the same
+        # answer; the combine must be exactly three copies of it
+        single = await _chat(client, replicas[0][1], {
+            "model": model, "temperature": 0.0, "max_tokens": max_tokens,
+            "messages": [{"role": "user", "content":
+                          conversation_opening("QR", 0)}]})
+        want = single["choices"][0]["message"]["content"]
+        out["combined_pinned"] = (
+            out.pop("combined_content")
+            == cfg.quorum_separator.join([want] * 3))
+    finally:
+        await app_close(router_app)
+        router_srv.close()
+    return out
+
+
 # ---- real mode (subprocess tpu:// engine replicas) -------------------------
 
 
@@ -540,6 +746,15 @@ async def _run_real_async(n_replicas: int, *, n_conversations: int,
                 max_tokens=max_tokens)
             print(f"[router-bench] real N={n_replicas} fleet: "
                   f"{json.dumps(out['fleet'])}", flush=True)
+
+        # ---- cross-cell quorum leg (docs/quorum.md): the baseline
+        # enrolls as a third ring member for a real 3-cell fan-out
+        async with httpx.AsyncClient() as client:
+            out["quorum"] = await _quorum_leg(
+                client, replicas + [("real-single", base_url)],
+                model=model, max_tokens=max_tokens)
+            print(f"[router-bench] real N=3 quorum: "
+                  f"{json.dumps(out['quorum'])}", flush=True)
 
         # ---- zero-loss resume leg (ISSUE 19) — LAST: it kills a replica
         procs_by_name = {name: proc
@@ -699,6 +914,24 @@ def main() -> int:
             if not leg["affinity"]["outputs_pinned_vs_single"]:
                 failures.append(f"fake n{n}: outputs diverged from "
                                 "single-replica serving")
+        q = run_quorum_fake()
+        out["fake"]["quorum"] = q
+        print(f"[router-bench] fake quorum: ttft {q['single_ttft_p50_s']}s "
+              f"-> {q['quorum_ttft_p50_s']}s ({q['ttft_ratio']}x), "
+              f"combine={q['combine_outcome']} "
+              f"degraded_served={q['degraded_served']}", flush=True)
+        if not _ttft_within_gate(q):
+            failures.append("fake quorum: quorum=3 p50 TTFT not within "
+                            f"1.5x single-member ({json.dumps(q)})")
+        if not (q["combine_outcome"] == "full" and q["combined_pinned"]):
+            failures.append("fake quorum: healthy combine not full/pinned")
+        if q["kill_with_spare_outcome"] != "full":
+            failures.append("fake quorum: spare did not cover a killed "
+                            "member")
+        if not (q["degraded_status"] == 200 and q["degraded_served"] == 2
+                and q["degraded_counted"]):
+            failures.append("fake quorum: member kill without spare did "
+                            "not serve degraded")
     if mode in ("real", "all"):
         leg = run_real(2, n_conversations=args.conversations,
                        turns=args.turns, max_tokens=args.tokens)
@@ -719,6 +952,16 @@ def main() -> int:
         if not fleet.get("outputs_pinned_vs_single"):
             failures.append("real n2 fleet: outputs diverged under burn "
                             "demotion")
+        quorum = leg.get("quorum", {})
+        # wider absolute slack than the fake leg: real CPU-engine TTFTs
+        # wobble by tens of ms run to run
+        if not _ttft_within_gate(quorum, slack_s=0.25):
+            failures.append("real quorum: quorum=3 p50 TTFT not within "
+                            f"1.5x single-member ({json.dumps(quorum)})")
+        if not (quorum.get("combine_outcome") == "full"
+                and quorum.get("combined_pinned")):
+            failures.append("real quorum: combine not full/pinned "
+                            f"({json.dumps(quorum)})")
         resume = leg.get("resume", {})
         if not (resume.get("token_exact") and resume.get("resumed")):
             failures.append("real n2 resume: mid-stream kill did not "

@@ -7,7 +7,13 @@ import pytest
 from quorum_tpu import sse
 from quorum_tpu.backends import BackendError, FakeBackend
 from quorum_tpu.config import AggregateParams
-from quorum_tpu.strategies.aggregate import build_aggregation_prompt
+from quorum_tpu.observability import AGGREGATE_DEGRADED
+from quorum_tpu.strategies.aggregate import (
+    aggregate_with_status,
+    build_aggregation_prompt,
+)
+from quorum_tpu.strategies.combine import degraded_headers
+from quorum_tpu.telemetry.recorder import RECORDER
 from tests.conftest import make_client, two_backend_parallel_config
 
 AUTH = {"Authorization": "Bearer sk-test"}
@@ -177,6 +183,128 @@ def test_prompt_builder_placeholder_variants():
     params.prompt_template = "no placeholder at all"
     out = build_aggregation_prompt([("A", "body")], params, "")
     assert "body" in out
+
+
+# ---- degrade visibility (docs/quorum.md) -----------------------------------
+# The reference fell back to a separator-join SILENTLY; here every fallback
+# is visible three ways: response headers (X-Quorum-Aggregate-Degraded +
+# -Error), the quorum_tpu_aggregate_degraded_total{reason=} counter, and a
+# flight-recorder event.
+
+
+async def _degraded_request(cfg, *, headers=AUTH, **fakes):
+    """POST one chat completion and return the response with the recorder on."""
+    old = RECORDER.enabled
+    RECORDER.enabled = True
+    try:
+        async with make_client(cfg, **fakes) as client:
+            return await client.post(
+                "/chat/completions",
+                json={"model": "m",
+                      "messages": [{"role": "user", "content": "q"}]},
+                headers=headers,
+            )
+    finally:
+        RECORDER.enabled = old
+
+
+async def test_degrade_error_visible_in_headers_counter_and_recorder():
+    f1 = FakeBackend("LLM1", text="alpha")
+    f2 = FakeBackend("LLM2", text="beta")
+    agg = FakeBackend("AGG", fail_with=BackendError("agg down", status_code=500))
+    before = AGGREGATE_DEGRADED.value_of(reason="error")
+    r = await _degraded_request(agg_cfg(), LLM1=f1, LLM2=f2, AGG=agg)
+    assert r.status_code == 200  # degraded, never failed
+    assert r.json()["choices"][0]["message"]["content"] == "alpha\n---\nbeta"
+    assert r.headers["x-quorum-aggregate-degraded"] == "error"
+    assert "agg down" in r.headers["x-quorum-aggregate-error"]
+    assert AGGREGATE_DEGRADED.value_of(reason="error") == before + 1
+    evs = [e for e in RECORDER.snapshot() if e["kind"] == "aggregate-degraded"]
+    assert evs and evs[-1]["reason"] == "error"
+    assert "agg down" in evs[-1]["error"]
+
+
+async def test_degrade_no_aggregator_reason():
+    f1 = FakeBackend("LLM1", text="a")
+    f2 = FakeBackend("LLM2", text="b")
+    before = AGGREGATE_DEGRADED.value_of(reason="no_aggregator")
+    r = await _degraded_request(agg_cfg(aggregator_backend="GHOST"),
+                                LLM1=f1, LLM2=f2)
+    assert r.status_code == 200
+    assert r.headers["x-quorum-aggregate-degraded"] == "no_aggregator"
+    # no underlying error for a config-shaped degrade
+    assert "x-quorum-aggregate-error" not in r.headers
+    assert AGGREGATE_DEGRADED.value_of(reason="no_aggregator") == before + 1
+
+
+async def test_degrade_no_credentials_reason(monkeypatch):
+    """The server 401s credential-less requests at the door, so this reason
+    only fires for embedded callers — pin it at the library layer, plus the
+    header mapping degraded_headers() would produce for it."""
+    monkeypatch.delenv("OPENAI_API_KEY", raising=False)
+    agg = FakeBackend("AGG", text="never")  # requires_auth=True by default
+    before = AGGREGATE_DEGRADED.value_of(reason="no_credentials")
+    out = await aggregate_with_status(
+        [("LLM1", "a"), ("LLM2", "b")], agg, AggregateParams(
+            intermediate_separator="\n---\n"), "q", headers=None)
+    assert out.degraded and out.degraded_reason == "no_credentials"
+    assert out.content == "a\n---\nb"
+    assert AGGREGATE_DEGRADED.value_of(reason="no_credentials") == before + 1
+    assert agg.calls == []  # the hop was skipped, not attempted
+    assert degraded_headers(out) == {
+        "X-Quorum-Aggregate-Degraded": "no_credentials"}
+
+
+async def test_degrade_empty_reason():
+    f1 = FakeBackend("LLM1", text="a")
+    f2 = FakeBackend("LLM2", text="b")
+    agg = FakeBackend("AGG", text="")  # 200 with no content
+    before = AGGREGATE_DEGRADED.value_of(reason="empty")
+    r = await _degraded_request(agg_cfg(), LLM1=f1, LLM2=f2, AGG=agg)
+    assert r.status_code == 200
+    assert r.json()["choices"][0]["message"]["content"] == "a\n---\nb"
+    assert r.headers["x-quorum-aggregate-degraded"] == "empty"
+    assert AGGREGATE_DEGRADED.value_of(reason="empty") == before + 1
+
+
+async def test_real_aggregation_carries_no_degrade_header():
+    f1 = FakeBackend("LLM1", text="a")
+    f2 = FakeBackend("LLM2", text="b")
+    agg = FakeBackend("AGG", text="synth")
+    before = AGGREGATE_DEGRADED.value
+    r = await _degraded_request(agg_cfg(), LLM1=f1, LLM2=f2, AGG=agg)
+    assert r.status_code == 200
+    assert "x-quorum-aggregate-degraded" not in r.headers
+    assert "x-quorum-aggregate-error" not in r.headers
+    assert AGGREGATE_DEGRADED.value == before
+
+
+async def test_stream_aggregate_degrade_ticks_counter_and_serves_fallback():
+    """Streaming already sent its headers when the hop fails, so the ONLY
+    degrade signals are the counter + recorder event — and the client still
+    gets the separator-join fallback under the final-chunk id, never an
+    error chunk."""
+    f1 = FakeBackend("LLM1", chunks=["al", "pha"])
+    f2 = FakeBackend("LLM2", chunks=["beta"])
+    agg = FakeBackend("AGG", fail_with=BackendError("agg down", status_code=500))
+    before = AGGREGATE_DEGRADED.value_of(reason="error")
+    async with make_client(agg_cfg(stream_aggregate=True),
+                           LLM1=f1, LLM2=f2, AGG=agg) as client:
+        r = await client.post(
+            "/chat/completions",
+            json={"model": "m", "stream": True,
+                  "messages": [{"role": "user", "content": "q"}]},
+            headers=AUTH,
+        )
+        events = list(sse.iter_data_events(r.content))
+    assert r.status_code == 200
+    finals = [e for e in events[:-1]
+              if isinstance(e, dict) and e["id"] == "chatcmpl-parallel-final"]
+    joined = "".join(e["choices"][0]["delta"].get("content", "") for e in finals)
+    assert joined == "alpha\n---\nbeta"
+    assert not any(isinstance(e, dict) and e.get("id") == "error"
+                   for e in events[:-1])
+    assert AGGREGATE_DEGRADED.value_of(reason="error") == before + 1
 
 
 async def test_fully_local_two_hop_aggregation():

@@ -398,6 +398,16 @@ async def test_live_metrics_exposition_validates():
                     "quorum_tpu_trace_propagated_total"):
         assert f"# TYPE {counter} counter" in text, counter
 
+    # native quorum serving families (docs/quorum.md): shared-prefix
+    # dedup savings, member-kill degradation + request outcomes, and the
+    # aggregation hop's fallback visibility — process-wide counters, so
+    # they expose (at zero here) on every tier
+    for counter in ("quorum_tpu_quorum_dedup_tokens_total",
+                    "quorum_tpu_quorum_degraded_total",
+                    "quorum_tpu_quorum_requests_total",
+                    "quorum_tpu_aggregate_degraded_total"):
+        assert f"# TYPE {counter} counter" in text, counter
+
     # fleet-plane families (ISSUE 16): burn gauge absorbed from replica
     # telemetry and the telemetry-poll latency histogram
     assert "# TYPE quorum_tpu_router_replica_burn gauge" in text

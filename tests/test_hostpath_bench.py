@@ -23,8 +23,8 @@ into every suite run), and pins the dispatch accounting the bench reports:
     printed number — wall-clock on a shared CI core flakes)
 """
 
-from scripts.hostpath_bench import (interference, paged, qos, run, sharded,
-                                    spec)
+from scripts.hostpath_bench import (dedup, interference, paged, qos, run,
+                                    sharded, spec)
 
 
 def test_hostpath_bench_counters():
@@ -163,3 +163,20 @@ def test_qos_bench_smoke():
     assert m["qos_replayed_tokens"] == m["qos_preempted_tokens"]
     assert m["qos_ttft_p99_ratio"] > 0.0
     assert m["qos_batch_degradation"] > 0.0
+
+
+def test_dedup_bench_smoke():
+    """The shared-prefix member dedup A/B leg (docs/quorum.md): dedup-on
+    output stays token-for-token identical to dedup-off, every coalesced
+    fan-out saves exactly (members-1)*prompt_len prefill tokens, and the
+    reported ratio reflects a real reduction (the WALL ordering is the
+    bench's printed acceptance — wall-clock on a shared CI core flakes)."""
+    m = dedup(prompt_len=24, tokens=4, members=3, rounds=4)
+    assert m["dedup_tokens_match"] is True
+    assert 1 <= m["dedup_rounds"] <= m["dedup_rounds_driven"]
+    # Exact per-admission savings arithmetic: each coalesced fan-out
+    # prefills the prompt once instead of `members` times.
+    assert (m["dedup_off_prefill_tokens"] - m["dedup_on_prefill_tokens"]
+            == m["dedup_rounds"] * (3 - 1) * 24)
+    assert m["dedup_prefill_token_ratio"] > 1.0
+    assert m["dedup_off_wall_s"] >= 0.0 and m["dedup_on_wall_s"] >= 0.0

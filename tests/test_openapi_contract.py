@@ -308,6 +308,81 @@ async def test_live_constrained_response_and_dead_end_conform():
         assert body["error"]["type"] == "grammar_error"
 
 
+# ---- quorum fan-out (docs/quorum.md) ---------------------------------------
+
+QUORUM_REASONS = {"member_failed", "stream_broken", "resume_diverged",
+                  "no_content"}
+
+
+def test_quorum_knob_and_headers_documented():
+    """The quorum request knob, the X-Quorum-* response headers, and the
+    body summary object are all in the document, with reason enums
+    matching the fan-out code's degrade vocabulary."""
+    req = DOC["components"]["schemas"]["CreateChatCompletionRequest"]
+    q = req["properties"]["quorum"]
+    assert (q["type"], q["minimum"], q["maximum"]) == ("integer", 1, 8)
+    from quorum_tpu.quorum.fanout import MAX_QUORUM
+    assert q["maximum"] == MAX_QUORUM
+
+    hdrs = DOC["components"]["headers"]
+    for name in ("XQuorumMembers", "XQuorumServed", "XQuorumReplicas",
+                 "XQuorumDegraded", "XQuorumAggregateDegraded",
+                 "XQuorumAggregateError"):
+        assert name in hdrs, name
+    assert set(hdrs["XQuorumDegraded"]["schema"]["enum"]) == QUORUM_REASONS
+    assert set(hdrs["XQuorumAggregateDegraded"]["schema"]["enum"]) == {
+        "no_aggregator", "no_credentials", "error", "empty"}
+
+    ok_headers = DOC["paths"]["/chat/completions"]["post"][
+        "responses"]["200"]["headers"]
+    for wire in ("X-Quorum-Members", "X-Quorum-Served", "X-Quorum-Replicas",
+                 "X-Quorum-Degraded", "X-Quorum-Aggregate-Degraded",
+                 "X-Quorum-Aggregate-Error"):
+        assert wire in ok_headers, wire
+
+    summary = DOC["components"]["schemas"]["QuorumSummary"]
+    reason = summary["properties"]["degraded"]["items"][
+        "properties"]["reason"]
+    assert set(reason["enum"]) == QUORUM_REASONS
+
+
+def test_quorum_request_and_summary_schemas_validate():
+    import jsonschema as _js
+    base = {"messages": [{"role": "user", "content": "x"}]}
+    check("CreateChatCompletionRequest", {**base, "quorum": 3})
+    check("CreateChatCompletionRequest", {**base, "quorum": 1})
+    for bad in (0, 9, "3", 2.5):
+        with pytest.raises(_js.ValidationError):
+            check("CreateChatCompletionRequest", {**base, "quorum": bad})
+    check("QuorumSummary", {"members": 3, "served": 2,
+                            "replicas": ["r0", "r2"],
+                            "degraded": [{"member": 1,
+                                          "reason": "member_failed"}]})
+    with pytest.raises(_js.ValidationError):
+        check("QuorumSummary", {"members": 3, "served": 2,
+                                "replicas": ["r0"],
+                                "degraded": [{"member": 1,
+                                              "reason": "gremlins"}]})
+
+
+async def test_live_quorum_response_conforms():
+    """A real quorum=3 combine from the router tier validates against the
+    response schema — including the quorum summary object — and carries
+    the documented headers."""
+    from tests.test_router import _Cluster
+    async with _Cluster(3) as c:
+        resp = await c.chat([{"role": "user", "content": "conformance"}],
+                            quorum=3, max_tokens=8)
+    assert resp.status_code == 200, resp.text
+    body = resp.json()
+    check("CreateChatCompletionResponse", body)
+    check("QuorumSummary", body["quorum"])
+    assert resp.headers["x-quorum-members"] == "3"
+    assert resp.headers["x-quorum-served"] == "3"
+    assert len(resp.headers["x-quorum-replicas"].split(",")) == 3
+    assert "x-quorum-degraded" not in resp.headers
+
+
 async def test_live_model_not_found_conforms():
     async with make_client(single_backend_config()) as client:
         resp = await client.post(

@@ -42,3 +42,25 @@ def test_router_bench_resume_fake_smoke():
     assert out["replayed_tokens"] and out["replayed_tokens"] > 0, out
     assert out["resume_latency_s"] is not None \
         and out["resume_latency_s"] >= 0, out
+
+
+def test_router_bench_quorum_fake_smoke():
+    """The cross-cell quorum leg at toy scale (docs/quorum.md): quorum=3
+    combine is full with the combined body pinned to 3x the deterministic
+    single-member answer, a member kill with a spare in the ring finishes
+    full (token-exact resume elsewhere), and killing the spare too serves
+    the request degraded from the survivors — 200, 2/3 members, counter
+    ticked (the 1.5x TTFT ratio is the bench's printed acceptance gate;
+    wall-clock on a shared CI core flakes)."""
+    rb = _load_bench()
+    out = rb.run_quorum_fake(iters=4, max_tokens=8)
+    assert out["combine_status"] == 200, out
+    assert out["combine_outcome"] == "full", out
+    assert out["combine_served"] == 3, out
+    assert out["combined_pinned"], out
+    assert out["single_ttft_p50_s"] > 0.0 and out["quorum_ttft_p50_s"] > 0.0
+    assert out["kill_with_spare_outcome"] == "full", out
+    assert out["degraded_status"] == 200, out
+    assert out["degraded_served"] == 2, out
+    assert out["degraded_reason"] == "member_failed", out
+    assert out["degraded_counted"], out
